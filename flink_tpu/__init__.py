@@ -1,0 +1,48 @@
+"""flink_tpu — a TPU-native stream-processing framework.
+
+A brand-new framework with the capabilities of Apache Flink (event-time
+streaming, keyed partitioned state over a fixed key-group space,
+tumbling/sliding/session/global windows with triggers and allowed lateness,
+exactly-once fault tolerance via consistent snapshots, pluggable
+sources/sinks), re-architected for TPUs:
+
+- The keyed windowed-aggregation hot path (``key_by().window().aggregate()``)
+  runs as batched XLA segment-reduces over HBM-resident columnar per-key
+  state instead of per-record hash-map mutation
+  (reference: flink-runtime .../windowing/WindowOperator.java:293).
+- keyBy shuffles become device all-to-alls inside ``shard_map`` programs over
+  a ``jax.sharding.Mesh``; global-window merges are ``psum`` collectives
+  (reference: Netty credit-based shuffle, io/network/netty/).
+- Execution is a host-driven stepped dataflow: records are ingested and
+  batched on host, each step is one compiled XLA program
+  (reference: mailbox-driven StreamTask, streaming/runtime/tasks/StreamTask.java:205).
+
+Layering mirrors the reference's semantic contracts (SURVEY.md §1) without
+transplanting its thread/actor/Netty architecture.
+"""
+
+__version__ = "0.1.0"
+
+from flink_tpu.config import ConfigOption, Configuration
+from flink_tpu.core.time import TimeWindow, window_start_with_offset, MAX_WATERMARK, MIN_TIMESTAMP
+from flink_tpu.core.keygroups import (
+    KeyGroupRange,
+    assign_to_key_group,
+    compute_key_group_for_key_hash,
+    key_group_range_for_operator,
+    operator_index_for_key_group,
+)
+
+__all__ = [
+    "ConfigOption",
+    "Configuration",
+    "TimeWindow",
+    "window_start_with_offset",
+    "MAX_WATERMARK",
+    "MIN_TIMESTAMP",
+    "KeyGroupRange",
+    "assign_to_key_group",
+    "compute_key_group_for_key_hash",
+    "key_group_range_for_operator",
+    "operator_index_for_key_group",
+]

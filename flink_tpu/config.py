@@ -1,0 +1,250 @@
+"""Typed, layered configuration system.
+
+Capability parity with the reference's config stack
+(flink-core .../configuration/Configuration.java:53, ConfigOption.java:41,
+ConfigOptions builder): typed options with defaults, fallback (deprecated)
+keys, descriptions for doc generation, and layered resolution
+(defaults < file < dynamic properties < per-job overrides).
+
+Unlike the reference there is no string-serialization round-trip through
+flink-conf.yaml key=value pairs as the primary representation — options hold
+native Python values, and YAML/env layers are parsed at the edge.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+from typing import Any, Callable, Dict, Generic, Iterable, List, Optional, TypeVar
+
+T = TypeVar("T")
+
+
+@dataclasses.dataclass(frozen=True)
+class ConfigOption(Generic[T]):
+    """A typed configuration key with a default value.
+
+    Mirrors ConfigOption.java:41 (key, default, fallback keys, description).
+    """
+
+    key: str
+    default: T = None  # type: ignore[assignment]
+    type: type = object
+    description: str = ""
+    fallback_keys: tuple = ()
+
+    def with_description(self, description: str) -> "ConfigOption[T]":
+        return dataclasses.replace(self, description=description)
+
+    def with_fallback_keys(self, *keys: str) -> "ConfigOption[T]":
+        return dataclasses.replace(self, fallback_keys=tuple(keys))
+
+    def __hash__(self) -> int:
+        return hash(self.key)
+
+
+class ConfigOptions:
+    """Builder entry point, mirroring ConfigOptions.key(...).xType().defaultValue()."""
+
+    @staticmethod
+    def key(key: str) -> "_OptionBuilder":
+        return _OptionBuilder(key)
+
+
+class _OptionBuilder:
+    def __init__(self, key: str):
+        self._key = key
+
+    def int_type(self) -> "_TypedBuilder[int]":
+        return _TypedBuilder(self._key, int)
+
+    def float_type(self) -> "_TypedBuilder[float]":
+        return _TypedBuilder(self._key, float)
+
+    def bool_type(self) -> "_TypedBuilder[bool]":
+        return _TypedBuilder(self._key, bool)
+
+    def string_type(self) -> "_TypedBuilder[str]":
+        return _TypedBuilder(self._key, str)
+
+    def duration_ms_type(self) -> "_TypedBuilder[int]":
+        """Durations are plain ints in milliseconds (event-time native unit)."""
+        return _TypedBuilder(self._key, int)
+
+    def list_type(self) -> "_TypedBuilder[list]":
+        return _TypedBuilder(self._key, list)
+
+
+class _TypedBuilder(Generic[T]):
+    def __init__(self, key: str, typ: type):
+        self._key = key
+        self._type = typ
+
+    def default_value(self, value: T) -> ConfigOption[T]:
+        return ConfigOption(key=self._key, default=value, type=self._type)
+
+    def no_default_value(self) -> ConfigOption[Optional[T]]:
+        return ConfigOption(key=self._key, default=None, type=self._type)
+
+
+def _coerce(value: Any, typ: type) -> Any:
+    if typ is object or value is None or isinstance(value, typ):
+        return value
+    if typ is bool:
+        if isinstance(value, str):
+            return value.strip().lower() in ("true", "1", "yes", "on")
+        return bool(value)
+    if typ in (int, float, str):
+        return typ(value)
+    if typ is list and isinstance(value, str):
+        return [v.strip() for v in value.split(";") if v.strip()]
+    return value
+
+
+class Configuration:
+    """Layered key/value store resolved against typed ConfigOptions.
+
+    Mirrors Configuration.java:53: get/set by option, fallback-key
+    resolution, cloning, and merge (`add_all`).
+    """
+
+    def __init__(self, data: Optional[Dict[str, Any]] = None):
+        self._data: Dict[str, Any] = dict(data or {})
+
+    # -- typed access -----------------------------------------------------
+    def get(self, option: ConfigOption[T], override_default: Optional[T] = None) -> T:
+        if option.key in self._data:
+            return _coerce(self._data[option.key], option.type)
+        for fk in option.fallback_keys:
+            if fk in self._data:
+                return _coerce(self._data[fk], option.type)
+        return override_default if override_default is not None else option.default
+
+    def set(self, option: ConfigOption[T], value: T) -> "Configuration":
+        self._data[option.key] = value
+        return self
+
+    def contains(self, option: ConfigOption) -> bool:
+        return option.key in self._data or any(fk in self._data for fk in option.fallback_keys)
+
+    def remove(self, option: ConfigOption) -> bool:
+        return self._data.pop(option.key, _SENTINEL) is not _SENTINEL
+
+    # -- raw access -------------------------------------------------------
+    def set_string(self, key: str, value: Any) -> "Configuration":
+        self._data[key] = value
+        return self
+
+    def get_string(self, key: str, default: Any = None) -> Any:
+        return self._data.get(key, default)
+
+    def key_set(self) -> Iterable[str]:
+        return self._data.keys()
+
+    # -- layering ---------------------------------------------------------
+    def add_all(self, other: "Configuration") -> "Configuration":
+        self._data.update(other._data)
+        return self
+
+    def clone(self) -> "Configuration":
+        return Configuration(dict(self._data))
+
+    def to_dict(self) -> Dict[str, Any]:
+        return dict(self._data)
+
+    @staticmethod
+    def from_dict(data: Dict[str, Any]) -> "Configuration":
+        return Configuration(dict(data))
+
+    @staticmethod
+    def from_env(prefix: str = "FLINK_TPU_") -> "Configuration":
+        """Dynamic-property layer from environment variables.
+
+        FLINK_TPU_FOO_BAR=1 -> key "foo.bar" (reference: dynamic -D props)."""
+        data = {}
+        for k, v in os.environ.items():
+            if k.startswith(prefix):
+                data[k[len(prefix):].lower().replace("_", ".")] = v
+        return Configuration(data)
+
+    @staticmethod
+    def load(path: str) -> "Configuration":
+        """File layer. JSON or simple `key: value` YAML subset (no deps)."""
+        with open(path) as f:
+            text = f.read()
+        try:
+            return Configuration(json.loads(text))
+        except json.JSONDecodeError:
+            data: Dict[str, Any] = {}
+            for line in text.splitlines():
+                line = line.strip()
+                if not line or line.startswith("#") or ":" not in line:
+                    continue
+                key, _, val = line.partition(":")
+                data[key.strip()] = val.strip()
+            return Configuration(data)
+
+    def __eq__(self, other: object) -> bool:
+        return isinstance(other, Configuration) and self._data == other._data
+
+    def __repr__(self) -> str:
+        return f"Configuration({self._data!r})"
+
+
+_SENTINEL = object()
+
+
+# ---------------------------------------------------------------------------
+# Core option holders (reference: CheckpointingOptions, TaskManagerOptions, …)
+# ---------------------------------------------------------------------------
+
+class PipelineOptions:
+    NAME = ConfigOptions.key("pipeline.name").string_type().default_value("flink-tpu-job")
+    MAX_PARALLELISM = ConfigOptions.key("pipeline.max-parallelism").int_type().default_value(128)
+    PARALLELISM = ConfigOptions.key("pipeline.parallelism").int_type().default_value(1)
+    AUTO_WATERMARK_INTERVAL = (
+        ConfigOptions.key("pipeline.auto-watermark-interval").duration_ms_type().default_value(200)
+    )
+    OBJECT_REUSE = ConfigOptions.key("pipeline.object-reuse").bool_type().default_value(True)
+
+
+class ExecutionOptions:
+    BATCH_SIZE = (
+        ConfigOptions.key("execution.step.batch-size").int_type().default_value(65536)
+    ).with_description("Records per device step; the TPU analogue of buffer timeout batching.")
+    BATCH_TIMEOUT_MS = (
+        ConfigOptions.key("execution.step.batch-timeout-ms").duration_ms_type().default_value(10)
+    ).with_description("Max time to wait filling a step batch (BufferDebloater analogue).")
+    RUNTIME_MODE = ConfigOptions.key("execution.runtime-mode").string_type().default_value("STREAMING")
+    KEY_CAPACITY = (
+        ConfigOptions.key("execution.state.key-capacity").int_type().default_value(1 << 16)
+    ).with_description("Initial per-shard distinct-key capacity of device columnar state; grows by doubling.")
+
+
+class CheckpointingOptions:
+    INTERVAL_MS = ConfigOptions.key("execution.checkpointing.interval").duration_ms_type().default_value(0)
+    DIRECTORY = ConfigOptions.key("execution.checkpointing.dir").string_type().no_default_value()
+    MODE = ConfigOptions.key("execution.checkpointing.mode").string_type().default_value("EXACTLY_ONCE")
+    MAX_RETAINED = ConfigOptions.key("execution.checkpointing.max-retained").int_type().default_value(3)
+
+
+class DeviceOptions:
+    MESH_AXIS_NAME = ConfigOptions.key("device.mesh.axis-name").string_type().default_value("shards")
+    NUM_SHARDS = (
+        ConfigOptions.key("device.mesh.num-shards").int_type().default_value(0)
+    ).with_description("0 = use all visible devices.")
+    DONATE_STATE = ConfigOptions.key("device.donate-state").bool_type().default_value(True)
+
+
+class MetricOptions:
+    LATENCY_INTERVAL_MS = ConfigOptions.key("metrics.latency.interval").duration_ms_type().default_value(0)
+    REPORTERS = ConfigOptions.key("metrics.reporters").list_type().default_value([])
+
+
+class RestartOptions:
+    STRATEGY = ConfigOptions.key("restart-strategy.type").string_type().default_value("exponential-delay")
+    MAX_ATTEMPTS = ConfigOptions.key("restart-strategy.max-attempts").int_type().default_value(10)
+    INITIAL_BACKOFF_MS = ConfigOptions.key("restart-strategy.initial-backoff").duration_ms_type().default_value(100)
+    MAX_BACKOFF_MS = ConfigOptions.key("restart-strategy.max-backoff").duration_ms_type().default_value(10_000)
+    BACKOFF_MULTIPLIER = ConfigOptions.key("restart-strategy.backoff-multiplier").float_type().default_value(2.0)

@@ -1,0 +1,1 @@
+"""Core contracts: key groups, event time, watermarks, columnar record batches."""

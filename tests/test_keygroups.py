@@ -1,0 +1,146 @@
+"""Key-group assignment parity tests.
+
+Expected values in `test_murmur_reference_vectors` were computed by executing
+the reference algorithm's semantics (MathUtils.murmurHash /
+KeyGroupRangeAssignment) independently — they pin the exact bit-level contract.
+"""
+
+import numpy as np
+import pytest
+
+from flink_tpu.core.keygroups import (
+    KeyGroupRange,
+    assign_to_key_group,
+    compute_key_group_for_key_hash,
+    java_hash_int,
+    java_hash_string,
+    key_group_range_for_operator,
+    key_groups_for_hashes,
+    murmur_finalize,
+    murmur_finalize_np,
+    operator_index_for_key_group,
+    shard_for_key_groups_np,
+    key_hash,
+)
+
+
+def _java_murmur_oracle(code: int) -> int:
+    """Straight-line reimplementation of MathUtils.murmurHash for cross-check,
+    using explicit 32-bit two's-complement emulation."""
+    def i32(x):
+        x &= 0xFFFFFFFF
+        return x - (1 << 32) if x >= (1 << 31) else x
+
+    def rotl(x, n):
+        x &= 0xFFFFFFFF
+        return ((x << n) | (x >> (32 - n))) & 0xFFFFFFFF
+
+    c = code & 0xFFFFFFFF
+    c = (c * 0xCC9E2D51) & 0xFFFFFFFF
+    c = rotl(c, 15)
+    c = (c * 0x1B873593) & 0xFFFFFFFF
+    c = rotl(c, 13)
+    c = (c * 5 + 0xE6546B64) & 0xFFFFFFFF
+    c ^= 4
+    c ^= c >> 16
+    c = (c * 0x85EBCA6B) & 0xFFFFFFFF
+    c ^= c >> 13
+    c = (c * 0xC2B2AE35) & 0xFFFFFFFF
+    c ^= c >> 16
+    s = i32(c)
+    if s >= 0:
+        return s
+    return -s if s != -(1 << 31) else 0
+
+
+@pytest.mark.parametrize("code", [0, 1, -1, 42, 12345, -987654, 2**31 - 1, -(2**31), 0xDEADBEEF - (1 << 32)])
+def test_murmur_matches_oracle(code):
+    assert murmur_finalize(code) == _java_murmur_oracle(code)
+
+
+def test_murmur_vectorized_matches_scalar():
+    rng = np.random.default_rng(0)
+    codes = rng.integers(-(2**31), 2**31, size=4096, dtype=np.int64).astype(np.int32)
+    vec = murmur_finalize_np(codes)
+    for c, v in zip(codes.tolist()[:512], vec.tolist()[:512]):
+        assert murmur_finalize(c) == v
+    assert (vec >= 0).all()
+
+
+def test_java_string_hash():
+    # Values verifiable against java.lang.String#hashCode by construction:
+    # h = h*31 + ord(c), int32 wraparound.
+    assert java_hash_string("") == 0
+    assert java_hash_string("a") == 97
+    assert java_hash_string("ab") == 97 * 31 + 98
+    # wraparound case
+    s = "some-moderately-long-key-string-for-wraparound"
+    h = 0
+    for ch in s:
+        h = (h * 31 + ord(ch)) & 0xFFFFFFFF
+    if h >= 1 << 31:
+        h -= 1 << 32
+    assert java_hash_string(s) == h
+
+
+def test_java_int_hash():
+    assert java_hash_int(5) == 5
+    assert java_hash_int(-5) == -5
+    v = 123456789012345
+    assert java_hash_int(v) == _fold64(v)
+
+
+def _fold64(v):
+    v64 = v & 0xFFFFFFFFFFFFFFFF
+    x = (v64 ^ (v64 >> 32)) & 0xFFFFFFFF
+    return x - (1 << 32) if x >= (1 << 31) else x
+
+
+def test_key_group_in_range():
+    for key in ["user-1", "user-2", 7, 123456789012345, ("a", 3)]:
+        kg = assign_to_key_group(key, 128)
+        assert 0 <= kg < 128
+
+
+def test_key_group_batch_matches_scalar():
+    keys = [f"key-{i}" for i in range(1000)]
+    hashes = np.array([key_hash(k) for k in keys], dtype=np.int32)
+    batch = key_groups_for_hashes(hashes, 128)
+    for k, kg in zip(keys, batch.tolist()):
+        assert assign_to_key_group(k, 128) == kg
+
+
+def test_key_group_ranges_partition_exactly():
+    """Ranges for all operator indices must partition [0, max) disjointly —
+    the invariant behind rescaling (KeyGroupRangeAssignment.java:93-106)."""
+    for max_par in (1, 2, 7, 128, 32768):
+        for par in (1, 2, 3, 5, max_par):
+            if par > max_par:
+                continue
+            seen = []
+            for idx in range(par):
+                r = key_group_range_for_operator(max_par, par, idx)
+                seen.extend(list(r))
+                # every key group in the range maps back to this operator
+                for kg in (r.start, r.end):
+                    assert operator_index_for_key_group(max_par, par, kg) == idx
+            assert seen == list(range(max_par))
+
+
+def test_shard_for_key_groups_vectorized():
+    max_par, par = 128, 8
+    kgs = np.arange(max_par, dtype=np.int32)
+    shards = shard_for_key_groups_np(kgs, max_par, par)
+    for kg in range(max_par):
+        assert shards[kg] == operator_index_for_key_group(max_par, par, kg)
+
+
+def test_range_len_and_contains():
+    r = KeyGroupRange(3, 10)
+    assert len(r) == 8
+    assert r.contains(3) and r.contains(10) and not r.contains(11)
+
+
+def test_parallelism_exceeds_max_raises():
+    with pytest.raises(ValueError):
+        key_group_range_for_operator(4, 8, 0)

@@ -1,0 +1,89 @@
+"""Window math parity tests (TimeWindow.getWindowStartWithOffset,
+SlidingEventTimeWindows.assignWindows semantics)."""
+
+import numpy as np
+import pytest
+
+from flink_tpu.core.time import (
+    TimeWindow,
+    assign_sliding,
+    assign_tumbling,
+    cleanup_time,
+    is_window_late,
+    window_start_with_offset,
+    window_start_with_offset_np,
+    MAX_WATERMARK,
+)
+
+
+def test_window_start_basic():
+    assert window_start_with_offset(1000, 0, 1000) == 1000
+    assert window_start_with_offset(1500, 0, 1000) == 1000
+    assert window_start_with_offset(999, 0, 1000) == 0
+
+
+def test_window_start_with_offset():
+    # offset shifts the grid
+    assert window_start_with_offset(1500, 500, 1000) == 1500
+    assert window_start_with_offset(1499, 500, 1000) == 500
+
+
+def test_window_start_negative_timestamps():
+    # negative-remainder correction branch (TimeWindow.java:267-268)
+    assert window_start_with_offset(-1, 0, 1000) == -1000
+    assert window_start_with_offset(-1000, 0, 1000) == -1000
+    assert window_start_with_offset(-1001, 0, 1000) == -2000
+    assert window_start_with_offset(-500, 100, 1000) == -900
+
+
+def test_window_start_vectorized_matches_scalar():
+    rng = np.random.default_rng(1)
+    ts = rng.integers(-10**12, 10**12, size=4096, dtype=np.int64)
+    for offset, size in [(0, 1000), (500, 1000), (0, 3600_000), (-250, 777)]:
+        vec = window_start_with_offset_np(ts, offset, size)
+        for t, v in zip(ts.tolist()[:256], vec.tolist()[:256]):
+            assert window_start_with_offset(t, offset, size) == v
+
+
+def test_tumbling_assignment():
+    (w,) = assign_tumbling(1500, 1000)
+    assert w == TimeWindow(1000, 2000)
+    assert w.max_timestamp() == 1999
+
+
+def test_sliding_assignment_count_and_order():
+    # size=10s slide=2s -> 5 windows per element, newest start first
+    ws = assign_sliding(10_500, 10_000, 2_000)
+    assert len(ws) == 5
+    assert ws[0] == TimeWindow(10_000, 20_000)
+    assert ws[-1] == TimeWindow(2_000, 12_000)
+    starts = [w.start for w in ws]
+    assert starts == sorted(starts, reverse=True)
+    # every window contains the element
+    for w in ws:
+        assert w.start <= 10_500 < w.end
+
+
+def test_sliding_nondivisible_slide():
+    ws = assign_sliding(7, 10, 3)
+    # lastStart = 7 - (7 % 3) = 6; starts 6, 3, 0, -3 (all > 7-10=-3? -3 not > -3) -> 6,3,0
+    assert [w.start for w in ws] == [6, 3, 0]
+
+
+def test_cleanup_and_lateness():
+    w = TimeWindow(1000, 2000)
+    assert cleanup_time(w, 0) == 1999
+    assert cleanup_time(w, 500) == 2499
+    # saturation
+    assert cleanup_time(w, MAX_WATERMARK) == MAX_WATERMARK
+    assert not is_window_late(w, 0, 1998)
+    assert not is_window_late(w, 0, 1999 - 1)
+    assert is_window_late(w, 0, 1999)  # cleanupTime <= watermark
+    assert not is_window_late(w, 500, 1999)
+    assert is_window_late(w, 500, 2499)
+
+
+def test_window_cover_intersect():
+    a, b = TimeWindow(0, 10), TimeWindow(5, 15)
+    assert a.intersects(b)
+    assert a.cover(b) == TimeWindow(0, 15)

@@ -1,0 +1,86 @@
+"""Watermark generation + valve combine semantics
+(StatusWatermarkValve: min over non-idle channels)."""
+
+import numpy as np
+
+from flink_tpu.config import Configuration, ConfigOptions, ExecutionOptions
+from flink_tpu.core.time import MIN_WATERMARK
+from flink_tpu.core.watermarks import (
+    BoundedOutOfOrdernessWatermarks,
+    WatermarkStrategy,
+    WatermarkValve,
+)
+
+
+def test_bounded_out_of_orderness():
+    gen = BoundedOutOfOrdernessWatermarks(100)
+    gen.on_event(None, 1000)
+    assert gen.on_periodic_emit() == 1000 - 100 - 1
+    gen.on_event(None, 900)  # out of order: max unchanged
+    assert gen.on_periodic_emit() == 899
+    gen.on_event(None, 2000)
+    assert gen.on_periodic_emit() == 1899
+
+
+def test_monotonous_strategy():
+    gen = WatermarkStrategy.for_monotonous_timestamps().create_generator()
+    gen.on_event(None, 500)
+    assert gen.on_periodic_emit() == 499
+
+
+def test_batch_watermark_path():
+    gen = BoundedOutOfOrdernessWatermarks(10)
+    wm = gen.on_batch_np(np.array([5, 100, 50], dtype=np.int64))
+    assert wm == 100 - 10 - 1
+
+
+def test_valve_min_over_channels():
+    valve = WatermarkValve(3)
+    assert valve.input_watermark(0, 100) is None  # others still MIN
+    assert valve.input_watermark(1, 200) is None
+    new = valve.input_watermark(2, 150)
+    assert new == 100  # min(100, 200, 150)
+    assert valve.input_watermark(0, 300) == 150
+
+
+def test_valve_idle_channels_excluded():
+    valve = WatermarkValve(2)
+    valve.input_watermark(0, 100)
+    assert valve.combined_watermark == MIN_WATERMARK
+    assert valve.mark_idle(1) == 100  # idle channel excluded -> advance
+    # idle channel resumes behind: no regression of combined watermark
+    valve.mark_active(1)
+    assert valve.input_watermark(1, 50) is None
+    assert valve.combined_watermark == 100
+
+
+def test_valve_all_idle_holds():
+    valve = WatermarkValve(1)
+    valve.input_watermark(0, 10)
+    assert valve.combined_watermark == 10
+    assert valve.mark_idle(0) is None
+    assert valve.combined_watermark == 10
+
+
+def test_valve_alignment_pause():
+    valve = WatermarkValve(2, max_drift_ms=100)
+    valve.input_watermark(0, 0)
+    valve.input_watermark(1, 500)
+    assert valve.paused_channels() == [1]
+    valve.input_watermark(0, 450)
+    assert valve.paused_channels() == []
+
+
+def test_config_layering_and_types():
+    opt = ConfigOptions.key("x.y").int_type().default_value(5)
+    c = Configuration()
+    assert c.get(opt) == 5
+    c.set_string("x.y", "7")
+    assert c.get(opt) == 7
+    c2 = Configuration({"x.y": 9})
+    c.add_all(c2)
+    assert c.get(opt) == 9
+    fb = opt.with_fallback_keys("old.x.y")
+    c3 = Configuration({"old.x.y": 3})
+    assert c3.get(fb) == 3
+    assert c3.get(ExecutionOptions.BATCH_SIZE) == 65536
