@@ -1,0 +1,1 @@
+"""User-facing DataStream-style API."""

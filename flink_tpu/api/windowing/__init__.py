@@ -1,0 +1,21 @@
+"""Windowing API: assigners, triggers, evictors (reference:
+flink-runtime .../streaming/api/windowing/, flink-streaming-java session
+assigners)."""
+
+from flink_tpu.api.windowing.assigners import (
+    WindowAssigner,
+    TumblingEventTimeWindows,
+    SlidingEventTimeWindows,
+    EventTimeSessionWindows,
+    GlobalWindows,
+    GlobalWindow,
+)
+from flink_tpu.api.windowing.triggers import (
+    Trigger,
+    TriggerResult,
+    EventTimeTrigger,
+    CountTrigger,
+    PurgingTrigger,
+    NeverTrigger,
+)
+from flink_tpu.api.windowing.evictors import Evictor, CountEvictor, TimeEvictor

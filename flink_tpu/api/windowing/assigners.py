@@ -1,0 +1,253 @@
+"""Window assigners.
+
+Reference semantics:
+- TumblingEventTimeWindows / SlidingEventTimeWindows
+  (flink-runtime .../api/windowing/assigners/): grid windows via
+  TimeWindow.getWindowStartWithOffset.
+- EventTimeSessionWindows (flink-streaming-java .../assigners/
+  EventTimeSessionWindows.java): per-element window [ts, ts+gap), merged by
+  the operator's MergingWindowSet.
+- GlobalWindows (.../assigners/GlobalWindows.java): single window, default
+  NeverTrigger.
+
+TPU note: grid assigners also expose the *slice decomposition* used by the
+device operator (slice = gcd-granule of (size, slide, offset); a window is a
+contiguous run of slices) — the same pane/slice trick as the reference SQL
+runtime's tvf/slicing/ assigners, which is what makes sliding windows a
+segment-reduce instead of size/slide redundant state copies.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import List, Optional
+
+import numpy as np
+
+from flink_tpu.api.windowing.triggers import (
+    EventTimeTrigger,
+    NeverTrigger,
+    ProcessingTimeTrigger,
+    Trigger,
+)
+from flink_tpu.core.time import (
+    MIN_TIMESTAMP,
+    TimeWindow,
+    assign_sliding,
+    assign_tumbling,
+    window_start_with_offset,
+)
+
+
+@dataclasses.dataclass(frozen=True)
+class GlobalWindow:
+    """The singleton namespace of GlobalWindows (GlobalWindow.java)."""
+
+    def max_timestamp(self) -> int:
+        from flink_tpu.core.time import MAX_WATERMARK
+        return MAX_WATERMARK
+
+    def __repr__(self) -> str:
+        return "GlobalWindow"
+
+
+class WindowAssigner:
+    """Base contract (WindowAssigner.java): assign windows per element,
+    provide the default trigger, and declare event-time-ness."""
+
+    is_event_time: bool = True
+    is_merging: bool = False
+
+    def assign_windows(self, element, timestamp: int) -> List:
+        raise NotImplementedError
+
+    def get_default_trigger(self) -> Trigger:
+        raise NotImplementedError
+
+    # -- slice decomposition (device path; None = not sliceable) ----------
+    @property
+    def slice_ms(self) -> Optional[int]:
+        return None
+
+    @property
+    def slices_per_window(self) -> Optional[int]:
+        return None
+
+    @property
+    def slide_slices(self) -> Optional[int]:
+        """Slices between consecutive window starts."""
+        return None
+
+    @property
+    def offset_ms(self) -> int:
+        return 0
+
+
+class TumblingEventTimeWindows(WindowAssigner):
+    def __init__(self, size_ms: int, offset_ms: int = 0):
+        if abs(offset_ms) >= size_ms or size_ms <= 0:
+            raise ValueError(
+                f"TumblingEventTimeWindows requires size > 0 and |offset| < size, got size={size_ms} offset={offset_ms}"
+            )
+        self.size = size_ms
+        self.offset = offset_ms
+
+    @staticmethod
+    def of(size_ms: int, offset_ms: int = 0) -> "TumblingEventTimeWindows":
+        return TumblingEventTimeWindows(size_ms, offset_ms)
+
+    def assign_windows(self, element, timestamp: int) -> List[TimeWindow]:
+        return assign_tumbling(timestamp, self.size, self.offset)
+
+    def get_default_trigger(self) -> Trigger:
+        return EventTimeTrigger()
+
+    @property
+    def slice_ms(self) -> int:
+        return self.size
+
+    @property
+    def slices_per_window(self) -> int:
+        return 1
+
+    @property
+    def slide_slices(self) -> int:
+        return 1
+
+    @property
+    def offset_ms(self) -> int:
+        return self.offset
+
+    def __repr__(self) -> str:
+        return f"TumblingEventTimeWindows(size={self.size}, offset={self.offset})"
+
+
+class SlidingEventTimeWindows(WindowAssigner):
+    def __init__(self, size_ms: int, slide_ms: int, offset_ms: int = 0):
+        if abs(offset_ms) >= slide_ms or size_ms <= 0:
+            raise ValueError(
+                f"SlidingEventTimeWindows requires size > 0 and |offset| < slide, got size={size_ms} slide={slide_ms} offset={offset_ms}"
+            )
+        self.size = size_ms
+        self.slide = slide_ms
+        self.offset = offset_ms
+
+    @staticmethod
+    def of(size_ms: int, slide_ms: int, offset_ms: int = 0) -> "SlidingEventTimeWindows":
+        return SlidingEventTimeWindows(size_ms, slide_ms, offset_ms)
+
+    def assign_windows(self, element, timestamp: int) -> List[TimeWindow]:
+        return assign_sliding(timestamp, self.size, self.slide, self.offset)
+
+    def get_default_trigger(self) -> Trigger:
+        return EventTimeTrigger()
+
+    # Slice decomposition: slice granule = gcd(size, slide). A window of
+    # `size` covers size/g consecutive slices; windows start every slide/g
+    # slices. When slide divides size this is exactly the reference's
+    # tvf/slicing SliceAssigners.sliding decomposition.
+    @property
+    def slice_ms(self) -> int:
+        return math.gcd(self.size, self.slide)
+
+    @property
+    def slices_per_window(self) -> int:
+        return self.size // self.slice_ms
+
+    @property
+    def slide_slices(self) -> int:
+        return self.slide // self.slice_ms
+
+    @property
+    def offset_ms(self) -> int:
+        return self.offset
+
+    def __repr__(self) -> str:
+        return f"SlidingEventTimeWindows(size={self.size}, slide={self.slide}, offset={self.offset})"
+
+
+class EventTimeSessionWindows(WindowAssigner):
+    """Each element gets [ts, ts + gap); overlapping windows merge
+    (EventTimeSessionWindows.java + MergingWindowSet)."""
+
+    is_merging = True
+
+    def __init__(self, gap_ms: int):
+        if gap_ms <= 0:
+            raise ValueError("Session gap must be positive")
+        self.gap = gap_ms
+
+    @staticmethod
+    def with_gap(gap_ms: int) -> "EventTimeSessionWindows":
+        return EventTimeSessionWindows(gap_ms)
+
+    def assign_windows(self, element, timestamp: int) -> List[TimeWindow]:
+        return [TimeWindow(timestamp, timestamp + self.gap)]
+
+    def get_default_trigger(self) -> Trigger:
+        return EventTimeTrigger()
+
+    def merge_windows(self, windows: List[TimeWindow]):
+        """Returns list of (merged_window, [source_windows]) for overlapping
+        runs (MergingWindowAssigner.mergeWindows semantics)."""
+        if not windows:
+            return []
+        sorted_ws = sorted(windows, key=lambda w: (w.start, w.end))
+        merged = []
+        cur_cover = sorted_ws[0]
+        cur_members = [sorted_ws[0]]
+        for w in sorted_ws[1:]:
+            # session merge: touching windows ([a,b) and [b,c)) DO merge
+            if w.start <= cur_cover.end:
+                cur_cover = cur_cover.cover(w)
+                cur_members.append(w)
+            else:
+                merged.append((cur_cover, cur_members))
+                cur_cover, cur_members = w, [w]
+        merged.append((cur_cover, cur_members))
+        return merged
+
+    def __repr__(self) -> str:
+        return f"EventTimeSessionWindows(gap={self.gap})"
+
+
+class ProcessingTimeSessionWindows(EventTimeSessionWindows):
+    is_event_time = False
+
+    def get_default_trigger(self) -> Trigger:
+        return ProcessingTimeTrigger()
+
+
+class GlobalWindows(WindowAssigner):
+    """All elements into one global window; never fires unless a custom
+    trigger (e.g. CountTrigger) is set (GlobalWindows.java:95 NeverTrigger)."""
+
+    _WINDOW = GlobalWindow()
+
+    def assign_windows(self, element, timestamp: int) -> List[GlobalWindow]:
+        return [self._WINDOW]
+
+    @staticmethod
+    def create() -> "GlobalWindows":
+        return GlobalWindows()
+
+    def get_default_trigger(self) -> Trigger:
+        return NeverTrigger()
+
+    def __repr__(self) -> str:
+        return "GlobalWindows()"
+
+
+class TumblingProcessingTimeWindows(TumblingEventTimeWindows):
+    is_event_time = False
+
+    def get_default_trigger(self) -> Trigger:
+        return ProcessingTimeTrigger()
+
+
+class SlidingProcessingTimeWindows(SlidingEventTimeWindows):
+    is_event_time = False
+
+    def get_default_trigger(self) -> Trigger:
+        return ProcessingTimeTrigger()

@@ -1,0 +1,222 @@
+"""Triggers: decide when a window's contents are emitted.
+
+Reference semantics (flink-runtime .../api/windowing/triggers/):
+- TriggerResult ∈ {CONTINUE, FIRE, PURGE, FIRE_AND_PURGE}
+- EventTimeTrigger.onElement: FIRE immediately if window.maxTimestamp() <=
+  currentWatermark (late-but-allowed element), else register an event-time
+  timer at maxTimestamp() and CONTINUE; onEventTime: FIRE iff time ==
+  window.maxTimestamp().
+- CountTrigger: per-(key, window) ReducingState count; FIRE_AND... no — FIRE
+  when count >= maxCount, resetting the count (CountTrigger.java clears via
+  state.clear() only in clear(); onElement adds 1 and fires + clears count).
+- PurgingTrigger wraps any trigger, turning FIRE into FIRE_AND_PURGE.
+
+The TriggerContext gives triggers per-(key, window) partitioned state and
+timer registration — same contract as Trigger.TriggerContext.
+"""
+
+from __future__ import annotations
+
+import enum
+from typing import Any, Optional
+
+
+class TriggerResult(enum.Flag):
+    CONTINUE = 0
+    FIRE = enum.auto()
+    PURGE = enum.auto()
+    FIRE_AND_PURGE = FIRE | PURGE
+
+    @property
+    def is_fire(self) -> bool:
+        return bool(self & TriggerResult.FIRE)
+
+    @property
+    def is_purge(self) -> bool:
+        return bool(self & TriggerResult.PURGE)
+
+
+class TriggerContext:
+    """Per-invocation context: current key/window fixed by the operator."""
+
+    def get_current_watermark(self) -> int:
+        raise NotImplementedError
+
+    def register_event_time_timer(self, time: int) -> None:
+        raise NotImplementedError
+
+    def delete_event_time_timer(self, time: int) -> None:
+        raise NotImplementedError
+
+    def register_processing_time_timer(self, time: int) -> None:
+        raise NotImplementedError
+
+    def delete_processing_time_timer(self, time: int) -> None:
+        raise NotImplementedError
+
+    def get_trigger_state(self, name: str, default=None) -> Any:
+        """Partitioned per-(key, window) trigger state (ValueState analogue)."""
+        raise NotImplementedError
+
+    def set_trigger_state(self, name: str, value) -> None:
+        raise NotImplementedError
+
+    def clear_trigger_state(self, name: str) -> None:
+        raise NotImplementedError
+
+
+class Trigger:
+    def on_element(self, element, timestamp: int, window, ctx: TriggerContext) -> TriggerResult:
+        raise NotImplementedError
+
+    def on_event_time(self, time: int, window, ctx: TriggerContext) -> TriggerResult:
+        raise NotImplementedError
+
+    def on_processing_time(self, time: int, window, ctx: TriggerContext) -> TriggerResult:
+        raise NotImplementedError
+
+    def can_merge(self) -> bool:
+        return False
+
+    def on_merge(self, window, ctx: TriggerContext) -> None:
+        raise NotImplementedError(f"{type(self).__name__} cannot merge")
+
+    def clear(self, window, ctx: TriggerContext) -> None:
+        pass
+
+
+class EventTimeTrigger(Trigger):
+    """EventTimeTrigger.java exact semantics."""
+
+    def on_element(self, element, timestamp, window, ctx) -> TriggerResult:
+        if window.max_timestamp() <= ctx.get_current_watermark():
+            return TriggerResult.FIRE
+        ctx.register_event_time_timer(window.max_timestamp())
+        return TriggerResult.CONTINUE
+
+    def on_event_time(self, time, window, ctx) -> TriggerResult:
+        return TriggerResult.FIRE if time == window.max_timestamp() else TriggerResult.CONTINUE
+
+    def on_processing_time(self, time, window, ctx) -> TriggerResult:
+        return TriggerResult.CONTINUE
+
+    def can_merge(self) -> bool:
+        return True
+
+    def on_merge(self, window, ctx) -> None:
+        # only re-register if the merged window's timer is still in the future
+        if window.max_timestamp() > ctx.get_current_watermark():
+            ctx.register_event_time_timer(window.max_timestamp())
+
+    def clear(self, window, ctx) -> None:
+        ctx.delete_event_time_timer(window.max_timestamp())
+
+    def __repr__(self):
+        return "EventTimeTrigger()"
+
+
+class ProcessingTimeTrigger(Trigger):
+    def on_element(self, element, timestamp, window, ctx) -> TriggerResult:
+        ctx.register_processing_time_timer(window.max_timestamp())
+        return TriggerResult.CONTINUE
+
+    def on_event_time(self, time, window, ctx) -> TriggerResult:
+        return TriggerResult.CONTINUE
+
+    def on_processing_time(self, time, window, ctx) -> TriggerResult:
+        return TriggerResult.FIRE
+
+    def can_merge(self) -> bool:
+        return True
+
+    def on_merge(self, window, ctx) -> None:
+        ctx.register_processing_time_timer(window.max_timestamp())
+
+    def clear(self, window, ctx) -> None:
+        ctx.delete_processing_time_timer(window.max_timestamp())
+
+
+class CountTrigger(Trigger):
+    """Fires once the per-(key, window) element count reaches max_count
+    (CountTrigger.java: ReducingState sum; fire clears the count)."""
+
+    def __init__(self, max_count: int):
+        self.max_count = max_count
+
+    @staticmethod
+    def of(max_count: int) -> "CountTrigger":
+        return CountTrigger(max_count)
+
+    def on_element(self, element, timestamp, window, ctx) -> TriggerResult:
+        count = (ctx.get_trigger_state("count") or 0) + 1
+        if count >= self.max_count:
+            ctx.clear_trigger_state("count")
+            return TriggerResult.FIRE
+        ctx.set_trigger_state("count", count)
+        return TriggerResult.CONTINUE
+
+    def on_event_time(self, time, window, ctx) -> TriggerResult:
+        return TriggerResult.CONTINUE
+
+    def on_processing_time(self, time, window, ctx) -> TriggerResult:
+        return TriggerResult.CONTINUE
+
+    def can_merge(self) -> bool:
+        return True
+
+    def on_merge(self, window, ctx) -> None:
+        pass  # counts were merged by state merge
+
+    def clear(self, window, ctx) -> None:
+        ctx.clear_trigger_state("count")
+
+
+class PurgingTrigger(Trigger):
+    """Wraps a trigger, upgrading FIRE to FIRE_AND_PURGE (PurgingTrigger.java)."""
+
+    def __init__(self, inner: Trigger):
+        self.inner = inner
+
+    @staticmethod
+    def of(inner: Trigger) -> "PurgingTrigger":
+        return PurgingTrigger(inner)
+
+    def _wrap(self, result: TriggerResult) -> TriggerResult:
+        return TriggerResult.FIRE_AND_PURGE if result.is_fire else result
+
+    def on_element(self, element, timestamp, window, ctx) -> TriggerResult:
+        return self._wrap(self.inner.on_element(element, timestamp, window, ctx))
+
+    def on_event_time(self, time, window, ctx) -> TriggerResult:
+        return self._wrap(self.inner.on_event_time(time, window, ctx))
+
+    def on_processing_time(self, time, window, ctx) -> TriggerResult:
+        return self._wrap(self.inner.on_processing_time(time, window, ctx))
+
+    def can_merge(self) -> bool:
+        return self.inner.can_merge()
+
+    def on_merge(self, window, ctx) -> None:
+        self.inner.on_merge(window, ctx)
+
+    def clear(self, window, ctx) -> None:
+        self.inner.clear(window, ctx)
+
+
+class NeverTrigger(Trigger):
+    """GlobalWindows' default: never fires (GlobalWindows.java NeverTrigger)."""
+
+    def on_element(self, element, timestamp, window, ctx) -> TriggerResult:
+        return TriggerResult.CONTINUE
+
+    def on_event_time(self, time, window, ctx) -> TriggerResult:
+        return TriggerResult.CONTINUE
+
+    def on_processing_time(self, time, window, ctx) -> TriggerResult:
+        return TriggerResult.CONTINUE
+
+    def can_merge(self) -> bool:
+        return True
+
+    def on_merge(self, window, ctx) -> None:
+        pass

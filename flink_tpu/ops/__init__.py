@@ -1,0 +1,1 @@
+"""Device compute kernels: segment reduction, window firing, top-k."""

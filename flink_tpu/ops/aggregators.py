@@ -1,0 +1,189 @@
+"""Aggregator specs usable on both the device path and the Python oracle.
+
+The reference folds window contents into a single accumulator per
+(key, window) via ReducingState/AggregatingState
+(HeapAggregatingState.add:94) — state per key×window is one ACC. The device
+path makes the ACC *columnar*: each accumulator field is one [keys, slices]
+array in HBM, updated by scatter-combine and merged across slices by a
+segment reduce at fire time.
+
+A `DeviceAggregator` therefore restricts accumulators to a flat dict of
+numeric fields, each with a scatter combiner in {add, min, max} — enough for
+sum/count/min/max/mean/sum-of-squares-style analytics (the YSB/Nexmark
+baseline set). Arbitrary Python `AggregateFunction`s run on the oracle
+operator instead (same split as the reference, where only
+Reducing/AggregatingState windows pre-aggregate).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Any, Callable, Dict, Optional, Tuple
+
+import numpy as np
+
+from flink_tpu.api.functions import AggregateFunction
+
+# scatter sources
+VALUE = "value"   # scatter the record's value column
+ONE = "one"       # scatter constant 1 (count)
+
+
+@dataclasses.dataclass(frozen=True)
+class AccField:
+    """One columnar accumulator field: a [keys, slices] device array."""
+
+    name: str
+    dtype: Any            # numpy dtype of the field
+    identity: float       # padding / empty-slice value
+    scatter: str          # 'add' | 'min' | 'max'
+    source: str = VALUE   # which input column feeds the scatter
+
+
+@dataclasses.dataclass(frozen=True)
+class DeviceAggregator:
+    """Columnar aggregator: fields + an extract over the combined fields.
+
+    `extract` maps {field_name: array} -> result array (any backend: works
+    with both numpy and jnp inputs since it must use only ufunc-style ops).
+    """
+
+    name: str
+    fields: Tuple[AccField, ...]
+    extract: Callable[[Dict[str, Any]], Any]
+    result_dtype: Any = np.float32
+
+    def field(self, name: str) -> AccField:
+        for f in self.fields:
+            if f.name == name:
+                return f
+        raise KeyError(name)
+
+    def python_equivalent(self) -> AggregateFunction:
+        """Scalar AggregateFunction with identical math, for the oracle."""
+        return _ColumnarAsPython(self)
+
+
+_SCATTER_NP = {
+    "add": lambda a, b: a + b,
+    "min": np.minimum,
+    "max": np.maximum,
+}
+
+
+class _ColumnarAsPython(AggregateFunction):
+    """Scalar-dict interpretation of a DeviceAggregator (oracle parity)."""
+
+    def __init__(self, spec: DeviceAggregator):
+        self.spec = spec
+
+    def create_accumulator(self):
+        return {f.name: f.identity for f in self.spec.fields}
+
+    def add(self, value, acc):
+        out = dict(acc)
+        for f in self.spec.fields:
+            v = 1 if f.source == ONE else value
+            out[f.name] = _SCATTER_NP[f.scatter](acc[f.name], v)
+        return out
+
+    def get_result(self, acc):
+        res = self.spec.extract({k: np.asarray(v) for k, v in acc.items()})
+        arr = np.asarray(res)
+        return arr.item() if arr.ndim == 0 else arr
+
+    def merge(self, a, b):
+        return {
+            f.name: _SCATTER_NP[f.scatter](a[f.name], b[f.name])
+            for f in self.spec.fields
+        }
+
+
+# ---------------------------------------------------------------------------
+# Built-ins
+# ---------------------------------------------------------------------------
+
+def sum_agg(dtype=np.float32) -> DeviceAggregator:
+    return DeviceAggregator(
+        "sum",
+        (AccField("sum", dtype, 0, "add"),),
+        lambda f: f["sum"],
+        result_dtype=dtype,
+    )
+
+
+def count_agg() -> DeviceAggregator:
+    return DeviceAggregator(
+        "count",
+        (AccField("count", np.int32, 0, "add", source=ONE),),
+        lambda f: f["count"],
+        result_dtype=np.int32,
+    )
+
+
+def min_agg(dtype=np.float32) -> DeviceAggregator:
+    ident = _max_of(dtype)
+    return DeviceAggregator(
+        "min", (AccField("min", dtype, ident, "min"),), lambda f: f["min"], result_dtype=dtype
+    )
+
+
+def max_agg(dtype=np.float32) -> DeviceAggregator:
+    ident = _min_of(dtype)
+    return DeviceAggregator(
+        "max", (AccField("max", dtype, ident, "max"),), lambda f: f["max"], result_dtype=dtype
+    )
+
+
+def mean_agg(dtype=np.float32) -> DeviceAggregator:
+    return DeviceAggregator(
+        "mean",
+        (
+            AccField("sum", dtype, 0, "add"),
+            AccField("count", np.int32, 0, "add", source=ONE),
+        ),
+        lambda f: f["sum"] / _maximum(f["count"], 1),
+        result_dtype=dtype,
+    )
+
+
+def _maximum(a, b):
+    # dispatches correctly for both numpy and jax array inputs
+    if isinstance(a, np.ndarray) or np.isscalar(a):
+        return np.maximum(a, b)
+    import jax.numpy as jnp
+    return jnp.maximum(a, b)
+
+
+def _max_of(dtype) -> float:
+    dt = np.dtype(dtype)
+    if dt.kind == "f":
+        return float(np.finfo(dt).max)
+    return int(np.iinfo(dt).max)
+
+
+def _min_of(dtype) -> float:
+    dt = np.dtype(dtype)
+    if dt.kind == "f":
+        return float(np.finfo(dt).min)
+    return int(np.iinfo(dt).min)
+
+
+BUILTINS = {
+    "sum": sum_agg,
+    "count": count_agg,
+    "min": min_agg,
+    "max": max_agg,
+    "mean": mean_agg,
+}
+
+
+def resolve(agg) -> Optional[DeviceAggregator]:
+    """Resolve a user-provided aggregate spec to a DeviceAggregator if it can
+    run on the device path; None means fall back to the oracle operator."""
+    if isinstance(agg, DeviceAggregator):
+        return agg
+    if isinstance(agg, str) and agg in BUILTINS:
+        return BUILTINS[agg]()
+    return None

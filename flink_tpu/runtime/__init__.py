@@ -1,0 +1,1 @@
+"""Runtime: operators, timers, tasks, executors."""

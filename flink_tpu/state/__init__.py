@@ -1,0 +1,1 @@
+"""Keyed state: descriptors, heap backend (oracle/CPU), columnar device backend."""

@@ -1,0 +1,184 @@
+"""Heap keyed-state backend: the CPU/oracle state store.
+
+Capability parity with HeapKeyedStateBackend
+(flink-runtime .../state/heap/HeapKeyedStateBackend.java:85): named states of
+kind Value/List/Map/Reducing/Aggregating, scoped by (current key, current
+namespace), organized per key group for snapshot/rescale. Namespaces are the
+window objects, exactly as in the reference (state per key×window).
+
+Snapshots are deep-ish copies of the per-key-group tables (the reference uses
+copy-on-write state maps for async snapshots, CopyOnWriteStateMap.java:108;
+here snapshot cost is dominated by the device state anyway, and the heap
+backend is the small/oracle path, so a plain copy keeps it simple and
+correct).
+"""
+
+from __future__ import annotations
+
+import copy
+import dataclasses
+from typing import Any, Callable, Dict, Iterable, List, Optional, Tuple
+
+from flink_tpu.api.functions import AggregateFunction, ReduceFunction, as_reduce_function
+from flink_tpu.core.keygroups import KeyGroupRange, assign_to_key_group
+
+
+@dataclasses.dataclass(frozen=True)
+class StateDescriptor:
+    name: str
+    kind: str  # 'value' | 'list' | 'map' | 'reducing' | 'aggregating'
+    default: Any = None
+    reduce_fn: Optional[ReduceFunction] = None
+    agg_fn: Optional[AggregateFunction] = None
+
+
+def value_state(name: str, default=None) -> StateDescriptor:
+    return StateDescriptor(name, "value", default)
+
+
+def list_state(name: str) -> StateDescriptor:
+    return StateDescriptor(name, "list")
+
+
+def map_state(name: str) -> StateDescriptor:
+    return StateDescriptor(name, "map")
+
+
+def reducing_state(name: str, reduce_fn) -> StateDescriptor:
+    return StateDescriptor(name, "reducing", reduce_fn=as_reduce_function(reduce_fn))
+
+
+def aggregating_state(name: str, agg_fn: AggregateFunction) -> StateDescriptor:
+    return StateDescriptor(name, "aggregating", agg_fn=agg_fn)
+
+
+class HeapKeyedStateBackend:
+    """State tables: {state_name: {key_group: {(key, namespace): value}}}.
+
+    The (current_key, current_namespace) context is set by the operator
+    before state access, mirroring AbstractKeyedStateBackend.setCurrentKey /
+    InternalKvState.setCurrentNamespace.
+    """
+
+    def __init__(self, key_group_range: KeyGroupRange, max_parallelism: int):
+        self.key_group_range = key_group_range
+        self.max_parallelism = max_parallelism
+        self._tables: Dict[str, Dict[int, Dict[Tuple, Any]]] = {}
+        self._descriptors: Dict[str, StateDescriptor] = {}
+        self._current_key: Any = None
+        self._current_key_group: int = -1
+
+    # -- context ----------------------------------------------------------
+    def set_current_key(self, key) -> None:
+        self._current_key = key
+        self._current_key_group = assign_to_key_group(key, self.max_parallelism)
+
+    @property
+    def current_key(self):
+        return self._current_key
+
+    def register(self, descriptor: StateDescriptor) -> None:
+        self._descriptors.setdefault(descriptor.name, descriptor)
+        self._tables.setdefault(descriptor.name, {})
+
+    # -- access (key from context, namespace explicit) --------------------
+    def _slot(self, name: str) -> Dict[Tuple, Any]:
+        table = self._tables[name]
+        return table.setdefault(self._current_key_group, {})
+
+    def get(self, name: str, namespace=None):
+        desc = self._descriptors[name]
+        val = self._slot(name).get((self._current_key, namespace), _MISSING)
+        if val is _MISSING:
+            return copy.copy(desc.default) if desc.kind == "value" else None
+        return val
+
+    def put(self, name: str, value, namespace=None) -> None:
+        self._slot(name)[(self._current_key, namespace)] = value
+
+    def add(self, name: str, value, namespace=None) -> None:
+        """Reducing/Aggregating/List add (HeapAggregatingState.add:94)."""
+        desc = self._descriptors[name]
+        slot = self._slot(name)
+        k = (self._current_key, namespace)
+        cur = slot.get(k, _MISSING)
+        if desc.kind == "list":
+            if cur is _MISSING:
+                slot[k] = [value]
+            else:
+                cur.append(value)
+        elif desc.kind == "reducing":
+            slot[k] = value if cur is _MISSING else desc.reduce_fn.reduce(cur, value)
+        elif desc.kind == "aggregating":
+            acc = desc.agg_fn.create_accumulator() if cur is _MISSING else cur
+            slot[k] = desc.agg_fn.add(value, acc)
+        else:
+            raise TypeError(f"add() not supported for state kind {desc.kind}")
+
+    def clear(self, name: str, namespace=None) -> None:
+        self._slot(name).pop((self._current_key, namespace), None)
+
+    def merge_namespaces(self, name: str, target, sources: Iterable) -> None:
+        """Merge state of `sources` namespaces into `target` for the current
+        key (used by session-window merge; InternalMergingState)."""
+        desc = self._descriptors[name]
+        slot = self._slot(name)
+        merged = slot.pop((self._current_key, target), _MISSING)
+        for ns in sources:
+            v = slot.pop((self._current_key, ns), _MISSING)
+            if v is _MISSING:
+                continue
+            if merged is _MISSING:
+                merged = v
+            elif desc.kind == "list":
+                merged = merged + v
+            elif desc.kind == "reducing":
+                merged = desc.reduce_fn.reduce(merged, v)
+            elif desc.kind == "aggregating":
+                merged = desc.agg_fn.merge(merged, v)
+            else:
+                raise TypeError(f"merge not supported for kind {desc.kind}")
+        if merged is not _MISSING:
+            slot[(self._current_key, target)] = merged
+
+    # -- introspection / snapshot ----------------------------------------
+    def namespaces_for_key(self, name: str, key) -> List:
+        kg = assign_to_key_group(key, self.max_parallelism)
+        table = self._tables.get(name, {}).get(kg, {})
+        return [ns for (k, ns) in table.keys() if k == key]
+
+    def keys(self, name: str) -> List:
+        out = set()
+        for kg_table in self._tables.get(name, {}).values():
+            out.update(k for (k, _ns) in kg_table.keys())
+        return list(out)
+
+    def is_empty(self) -> bool:
+        return all(not kg for t in self._tables.values() for kg in t.values())
+
+    def snapshot(self) -> Dict:
+        """Per-key-group snapshot: {state_name: {kg: {(key, ns): value}}}."""
+        return copy.deepcopy(self._tables)
+
+    def restore(self, snap: Dict, descriptors: Optional[Dict[str, StateDescriptor]] = None) -> None:
+        if descriptors:
+            for d in descriptors.values():
+                self.register(d)
+        # keep only key groups in our range (rescale-aware restore, S1)
+        self._tables = {
+            name: {
+                kg: dict(entries)
+                for kg, entries in table.items()
+                if self.key_group_range.contains(kg)
+            }
+            for name, table in copy.deepcopy(snap).items()
+        }
+        for name in self._descriptors:
+            self._tables.setdefault(name, {})
+
+    @property
+    def descriptors(self) -> Dict[str, StateDescriptor]:
+        return dict(self._descriptors)
+
+
+_MISSING = object()

@@ -1,0 +1,4 @@
+"""Test utilities: operator harnesses (reference:
+flink-runtime test util KeyedOneInputStreamOperatorTestHarness.java)."""
+
+from flink_tpu.testing.harness import KeyedWindowOperatorHarness

@@ -1,0 +1,52 @@
+"""Operator test harness: drive an operator without any cluster.
+
+Mirrors the reference's workhorse testing pattern
+(AbstractStreamOperatorTestHarness.java /
+KeyedOneInputStreamOperatorTestHarness.java): push records and watermarks,
+inspect emitted output and snapshots. Works for both the oracle operator and
+the device-backed operator (duck-typed: process_record / process_watermark /
+drain_output / snapshot / restore)."""
+
+from __future__ import annotations
+
+from typing import Any, Callable, List, Optional, Tuple
+
+
+class KeyedWindowOperatorHarness:
+    def __init__(self, operator, key_selector: Callable[[Any], Any] = None,
+                 value_selector: Callable[[Any], Any] = None):
+        self.op = operator
+        self.key_selector = key_selector or (lambda v: v[0])
+        self.value_selector = value_selector or (lambda v: v[1])
+        self.watermark = None
+
+    def process_element(self, value, timestamp: int) -> None:
+        self.op.process_record(self.key_selector(value), self.value_selector(value), timestamp)
+
+    def process_elements(self, *records: Tuple[Any, int]) -> None:
+        for value, ts in records:
+            self.process_element(value, ts)
+
+    def process_watermark(self, watermark: int) -> None:
+        self.watermark = watermark
+        self.op.process_watermark(watermark)
+
+    def set_processing_time(self, time: int) -> None:
+        self.op.advance_processing_time(time)
+
+    def extract_output(self) -> List[Tuple[Any, Any, Any, int]]:
+        """Returns (key, window, result, timestamp) tuples emitted so far."""
+        return self.op.drain_output()
+
+    def extract_results(self) -> List[Tuple[Any, Any]]:
+        """(key, result) pairs, window/ts dropped."""
+        return [(k, r) for k, _w, r, _t in self.extract_output()]
+
+    def side_output(self, tag_id: str) -> List:
+        return list(self.op.side_output.get(tag_id, []))
+
+    def snapshot(self) -> dict:
+        return self.op.snapshot()
+
+    def restore(self, snap: dict) -> None:
+        self.op.restore(snap)

@@ -1,0 +1,232 @@
+"""Oracle WindowOperator semantics tests — these encode the reference's
+documented behaviors (WindowOperator.java) and are the contract the device
+operator is later property-tested against."""
+
+import pytest
+
+from flink_tpu.api.functions import ProcessWindowFunction, ReduceAggregate
+from flink_tpu.api.windowing.assigners import (
+    EventTimeSessionWindows,
+    GlobalWindows,
+    SlidingEventTimeWindows,
+    TumblingEventTimeWindows,
+)
+from flink_tpu.api.windowing.evictors import CountEvictor
+from flink_tpu.api.windowing.triggers import CountTrigger, PurgingTrigger
+from flink_tpu.core.time import TimeWindow
+from flink_tpu.ops.aggregators import count_agg, max_agg, sum_agg
+from flink_tpu.runtime.oracle_window_operator import OracleWindowOperator
+from flink_tpu.testing.harness import KeyedWindowOperatorHarness
+
+
+def make_op(assigner, agg="sum", **kw):
+    from flink_tpu.ops.aggregators import BUILTINS
+    agg_fn = BUILTINS[agg]().python_equivalent() if isinstance(agg, str) else agg
+    return OracleWindowOperator(assigner, agg_fn, **kw)
+
+
+def h(assigner, agg="sum", **kw):
+    return KeyedWindowOperatorHarness(make_op(assigner, agg, **kw))
+
+
+def test_tumbling_sum_basic():
+    t = h(TumblingEventTimeWindows.of(1000))
+    t.process_elements((("a", 1.0), 100), (("a", 2.0), 900), (("b", 5.0), 500))
+    assert t.extract_output() == []  # nothing fires before watermark
+    t.process_watermark(999)
+    out = sorted(t.extract_output())
+    assert out == [
+        ("a", TimeWindow(0, 1000), 3.0, 999),
+        ("b", TimeWindow(0, 1000), 5.0, 999),
+    ]
+
+
+def test_tumbling_multiple_windows_fire_in_order():
+    t = h(TumblingEventTimeWindows.of(1000))
+    t.process_elements((("a", 1.0), 100), (("a", 2.0), 1100), (("a", 4.0), 2100))
+    t.process_watermark(5000)  # watermark jump fires all three in time order
+    out = t.extract_output()
+    assert [r for (_, _, r, _) in out] == [1.0, 2.0, 4.0]
+    assert [ts for (_, _, _, ts) in out] == [999, 1999, 2999]
+
+
+def test_sliding_count_overlap():
+    # size 10s slide 2s: element at t=10500 lands in 5 windows
+    t = h(SlidingEventTimeWindows.of(10_000, 2_000), agg="count")
+    t.process_element(("k", 1.0), 10_500)
+    t.process_watermark(30_000)
+    out = t.extract_output()
+    assert len(out) == 5
+    assert all(r == 1 for (_, _, r, _) in out)
+    ends = sorted(w.end for (_, w, _, _) in out)
+    assert ends == [12_000, 14_000, 16_000, 18_000, 20_000]
+
+
+def test_late_element_within_allowed_lateness_refires():
+    t = h(TumblingEventTimeWindows.of(1000), allowed_lateness=500)
+    t.process_element(("a", 1.0), 100)
+    t.process_watermark(999)
+    assert t.extract_results() == [("a", 1.0)]
+    # late but within lateness: immediate per-record re-fire with updated acc
+    t.process_element(("a", 2.0), 200)
+    assert t.extract_results() == [("a", 3.0)]
+    # beyond cleanup time (999+500): dropped
+    t.process_watermark(1499)
+    t.process_element(("a", 7.0), 300)
+    assert t.extract_results() == []
+    assert t.op.num_late_records_dropped == 1
+
+
+def test_late_element_side_output():
+    t = KeyedWindowOperatorHarness(
+        make_op(TumblingEventTimeWindows.of(1000), emit_late_to_side_output=True)
+    )
+    t.process_element(("a", 1.0), 100)
+    t.process_watermark(999)
+    t.process_element(("a", 2.0), 150)  # window already cleaned (lateness 0)
+    assert t.side_output("late-data") == [("a", 2.0, 150)]
+
+
+def test_cleanup_frees_state():
+    op = make_op(TumblingEventTimeWindows.of(1000))
+    t = KeyedWindowOperatorHarness(op)
+    t.process_element(("a", 1.0), 100)
+    t.process_watermark(999)
+    assert op.state.is_empty()  # cleanup timer == maxTimestamp when lateness=0
+
+
+def test_count_trigger_on_global_window():
+    t = h(GlobalWindows.create(), agg="sum", trigger=PurgingTrigger.of(CountTrigger.of(3)))
+    for i in range(7):
+        t.process_element(("k", 1.0), i)
+    # fires at counts 3 and 6, purging each time
+    assert t.extract_results() == [("k", 3.0), ("k", 3.0)]
+
+
+def test_global_window_never_fires_by_default():
+    t = h(GlobalWindows.create())
+    for i in range(100):
+        t.process_element(("k", 1.0), i)
+    t.process_watermark(10**9)
+    assert t.extract_output() == []
+
+
+def test_session_merge_basic():
+    t = h(EventTimeSessionWindows.with_gap(1000))
+    t.process_elements((("u", 1.0), 0), (("u", 2.0), 500), (("u", 4.0), 900))
+    t.process_watermark(10_000)
+    out = t.extract_output()
+    assert len(out) == 1
+    key, window, result, ts = out[0]
+    assert (key, result) == ("u", 7.0)
+    assert window == TimeWindow(0, 1900)  # [0, 900+1000)
+    assert ts == 1899
+
+
+def test_session_two_sessions_per_key():
+    t = h(EventTimeSessionWindows.with_gap(100))
+    t.process_elements((("u", 1.0), 0), (("u", 2.0), 50), (("u", 10.0), 500))
+    t.process_watermark(10_000)
+    out = sorted(t.extract_output(), key=lambda o: o[1].start)
+    assert [(o[0], o[2]) for o in out] == [("u", 3.0), ("u", 10.0)]
+    assert out[0][1] == TimeWindow(0, 150)
+    assert out[1][1] == TimeWindow(500, 600)
+
+
+def test_session_bridging_element_merges_sessions():
+    t = h(EventTimeSessionWindows.with_gap(100))
+    t.process_elements((("u", 1.0), 0), (("u", 2.0), 300))
+    # bridge arrives before watermark: [0,100) and [300,400) merge via [80,180)+[150,250)? no:
+    t.process_element(("u", 4.0), 90)   # extends first session to [0,190)
+    t.process_element(("u", 8.0), 180)  # [180,280) overlaps [0,190) and... not [300,400)
+    t.process_element(("u", 16.0), 250) # [250,350) bridges to [300,400)
+    t.process_watermark(10_000)
+    out = t.extract_output()
+    assert len(out) == 1
+    assert out[0][2] == 31.0
+    assert out[0][1] == TimeWindow(0, 400)
+
+
+def test_session_out_of_order_no_double_fire():
+    t = h(EventTimeSessionWindows.with_gap(100))
+    t.process_element(("u", 1.0), 200)
+    t.process_element(("u", 2.0), 100)  # merges to [100, 300)
+    t.process_watermark(298)
+    assert t.extract_output() == []
+    t.process_watermark(299)
+    out = t.extract_output()
+    assert len(out) == 1
+    assert out[0][1] == TimeWindow(100, 300)
+    assert out[0][2] == 3.0
+
+
+def test_reduce_function_path():
+    t = KeyedWindowOperatorHarness(
+        make_op(TumblingEventTimeWindows.of(1000), agg=ReduceAggregate(lambda a, b: max(a, b)))
+    )
+    t.process_elements((("a", 3.0), 0), (("a", 9.0), 10), (("a", 5.0), 20))
+    t.process_watermark(999)
+    assert t.extract_results() == [("a", 9.0)]
+
+
+def test_builtin_aggregator_python_equivalents():
+    for name, expected in [("sum", 6.0), ("count", 3), ("max", 3.0), ("min", 1.0), ("mean", 2.0)]:
+        t = h(TumblingEventTimeWindows.of(1000), agg=name)
+        t.process_elements((("a", 1.0), 0), (("a", 2.0), 1), (("a", 3.0), 2))
+        t.process_watermark(999)
+        assert t.extract_results() == [("a", expected)], name
+
+
+def test_process_window_function():
+    class CountingPWF(ProcessWindowFunction):
+        def process(self, key, context, elements):
+            for e in elements:
+                yield (key, context.window.start, e)
+
+    t = KeyedWindowOperatorHarness(
+        make_op(TumblingEventTimeWindows.of(1000), agg="sum", window_function=CountingPWF())
+    )
+    t.process_element(("a", 5.0), 100)
+    t.process_watermark(1000)
+    (out,) = t.extract_output()
+    assert out[2] == ("a", 0, 5.0)
+
+
+def test_evictor_buffered_path():
+    t = KeyedWindowOperatorHarness(
+        OracleWindowOperator(
+            TumblingEventTimeWindows.of(1000),
+            None,  # buffering (no pre-aggregation), like EvictingWindowOperator
+            evictor=CountEvictor.of(2),
+        )
+    )
+    t.process_elements((("a", 1.0), 0), (("a", 2.0), 1), (("a", 3.0), 2))
+    t.process_watermark(999)
+    # only last 2 elements survive eviction
+    assert [r for (_, _, r, _) in t.extract_output()] == [2.0, 3.0]
+
+
+def test_snapshot_restore_roundtrip():
+    op = make_op(TumblingEventTimeWindows.of(1000))
+    t = KeyedWindowOperatorHarness(op)
+    t.process_element(("a", 1.0), 100)
+    t.process_element(("b", 2.0), 200)
+    snap = t.snapshot()
+
+    op2 = make_op(TumblingEventTimeWindows.of(1000))
+    t2 = KeyedWindowOperatorHarness(op2)
+    t2.restore(snap)
+    t2.process_element(("a", 10.0), 300)
+    t2.process_watermark(999)
+    assert sorted(t2.extract_results()) == [("a", 11.0), ("b", 2.0)]
+    # original continues independently
+    t.process_watermark(999)
+    assert sorted(t.extract_results()) == [("a", 1.0), ("b", 2.0)]
+
+
+def test_watermark_does_not_regress_fire():
+    t = h(TumblingEventTimeWindows.of(1000))
+    t.process_element(("a", 1.0), 100)
+    t.process_watermark(999)
+    t.process_watermark(500)  # regressing watermark must not re-fire
+    assert len(t.extract_output()) == 1
