@@ -18,6 +18,7 @@ Reducing/AggregatingState windows pre-aggregate).
 from __future__ import annotations
 
 import dataclasses
+import functools
 import math
 from typing import Any, Callable, Dict, Optional, Tuple
 
@@ -41,12 +42,16 @@ class AccField:
     source: str = VALUE   # which input column feeds the scatter
 
 
-@dataclasses.dataclass(frozen=True)
+@dataclasses.dataclass(frozen=True, eq=False)
 class DeviceAggregator:
     """Columnar aggregator: fields + an extract over the combined fields.
 
     `extract` maps {field_name: array} -> result array (any backend: works
     with both numpy and jnp inputs since it must use only ufunc-style ops).
+
+    eq=False ⇒ identity hashing: instances are cache keys for compiled
+    kernels (segment_ops builders are lru_cached on them), so builtin
+    factories below memoize and return singletons per dtype.
     """
 
     name: str
@@ -104,6 +109,7 @@ class _ColumnarAsPython(AggregateFunction):
 # Built-ins
 # ---------------------------------------------------------------------------
 
+@functools.lru_cache(maxsize=None)
 def sum_agg(dtype=np.float32) -> DeviceAggregator:
     return DeviceAggregator(
         "sum",
@@ -113,6 +119,7 @@ def sum_agg(dtype=np.float32) -> DeviceAggregator:
     )
 
 
+@functools.lru_cache(maxsize=None)
 def count_agg() -> DeviceAggregator:
     return DeviceAggregator(
         "count",
@@ -122,6 +129,7 @@ def count_agg() -> DeviceAggregator:
     )
 
 
+@functools.lru_cache(maxsize=None)
 def min_agg(dtype=np.float32) -> DeviceAggregator:
     ident = _max_of(dtype)
     return DeviceAggregator(
@@ -129,6 +137,7 @@ def min_agg(dtype=np.float32) -> DeviceAggregator:
     )
 
 
+@functools.lru_cache(maxsize=None)
 def max_agg(dtype=np.float32) -> DeviceAggregator:
     ident = _min_of(dtype)
     return DeviceAggregator(
@@ -136,6 +145,7 @@ def max_agg(dtype=np.float32) -> DeviceAggregator:
     )
 
 
+@functools.lru_cache(maxsize=None)
 def mean_agg(dtype=np.float32) -> DeviceAggregator:
     return DeviceAggregator(
         "mean",

@@ -1,0 +1,188 @@
+"""Columnar device state: HBM-resident per-(key, slice) accumulator columns.
+
+The `JaxColumnarStateBackend` sibling of the reference's HeapKeyedStateBackend
+(HeapKeyedStateBackend.java:85): instead of a hash map of (key, window) →
+accumulator objects mutated per record (CopyOnWriteStateMap.java:108), state
+is a dict of dense [K, S] device arrays — K = distinct-key capacity, S =
+slice-ring capacity — plus a host-side key dictionary mapping raw keys to
+dense row ids and a slice ring that reuses columns as windows expire.
+
+Snapshots pull the arrays to host (device→host is the step-aligned barrier,
+SURVEY.md §7 stage 5) together with the key dictionary and ring frontiers.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, List, Optional, Tuple
+
+import jax
+import numpy as np
+
+from flink_tpu.ops.aggregators import DeviceAggregator
+from flink_tpu.ops import segment_ops
+
+
+class KeyDictionary:
+    """Raw key -> dense row id. `dense_int` mode skips the dict entirely for
+    pre-densified integer keys (sources that emit key ids, e.g. benchmark
+    generators and the keyBy shuffle's re-densified output)."""
+
+    def __init__(self, dense_int: bool = False):
+        self.dense_int = dense_int
+        self._map: Dict[Any, int] = {}
+        self._keys: List[Any] = []
+
+    def __len__(self) -> int:
+        return len(self._keys)
+
+    @property
+    def num_ids(self) -> int:
+        return len(self._keys)
+
+    def lookup_or_insert(self, keys: np.ndarray) -> Tuple[np.ndarray, int]:
+        """Map a batch of raw keys to dense ids, inserting unseen keys.
+        Returns (ids int32[B], required_capacity)."""
+        if self.dense_int:
+            ids = keys.astype(np.int32)
+            hi = int(ids.max()) + 1 if ids.size else 0
+            if hi > len(self._keys):
+                self._keys.extend(range(len(self._keys), hi))
+            return ids, len(self._keys)
+        m = self._map
+        out = np.empty(len(keys), dtype=np.int32)
+        for i, k in enumerate(keys):
+            kid = m.get(k)
+            if kid is None:
+                kid = len(self._keys)
+                m[k] = kid
+                self._keys.append(k)
+            out[i] = kid
+        return out, len(self._keys)
+
+    def key_at(self, kid: int):
+        return self._keys[kid]
+
+    def keys_for(self, kids: np.ndarray) -> List:
+        ks = self._keys
+        return [ks[int(i)] for i in kids]
+
+    def snapshot(self) -> dict:
+        return {"dense_int": self.dense_int, "keys": list(self._keys)}
+
+    @staticmethod
+    def restore(snap: dict) -> "KeyDictionary":
+        d = KeyDictionary(snap["dense_int"])
+        d._keys = list(snap["keys"])
+        d._map = {} if d.dense_int else {k: i for i, k in enumerate(d._keys)}
+        return d
+
+
+@dataclasses.dataclass
+class RingFrontiers:
+    """Host-tracked slice-ring accounting (all in absolute slice indices)."""
+
+    purged_to: int = None      # slices < purged_to are recycled  # type: ignore[assignment]
+    min_used: int = None       # smallest slice ever written       # type: ignore[assignment]
+    max_used: int = None       # largest slice ever written        # type: ignore[assignment]
+
+
+class ColumnarWindowState:
+    """Device arrays + key dictionary + ring accounting for one shard."""
+
+    PURGE_CHUNK = 8
+
+    def __init__(
+        self,
+        agg: DeviceAggregator,
+        *,
+        key_capacity: int = 1 << 12,
+        num_slices: int = 64,
+        dense_int_keys: bool = False,
+        device=None,
+    ):
+        self.agg = agg
+        self.K = key_capacity
+        self.S = num_slices
+        self.device = device
+        self.keydict = KeyDictionary(dense_int_keys)
+        self.frontiers = RingFrontiers()
+        self.acc, self.count = segment_ops.init_state_arrays(agg, self.K, self.S)
+        self._ingest = segment_ops.make_ingest_fn(agg, track_touch=True)
+        self._fire = segment_ops.make_fire_fn(agg, masked=False)
+        self._fire_masked = segment_ops.make_fire_fn(agg, masked=True)
+        self._purge = segment_ops.make_purge_fn(agg, self.PURGE_CHUNK)
+        self.last_touch = None  # bool[K,S] from the most recent ingest
+
+    # ------------------------------------------------------------------
+    def ensure_key_capacity(self, required: int) -> None:
+        if required <= self.K:
+            return
+        new_k = self.K
+        while new_k < required:
+            new_k *= 2
+        self.acc, self.count = segment_ops.grow_keys(self.acc, self.count, self.agg, new_k)
+        if self.last_touch is not None:
+            import jax.numpy as jnp
+            pad = jnp.zeros((new_k - self.K, self.S), dtype=self.last_touch.dtype)
+            self.last_touch = jnp.concatenate([self.last_touch, pad], axis=0)
+        self.K = new_k
+
+    def ring_pos(self, slices: np.ndarray) -> np.ndarray:
+        return (slices % self.S).astype(np.int32)
+
+    def ingest(self, kid: np.ndarray, slices_abs: np.ndarray, vals: np.ndarray) -> None:
+        """Scatter a prepared batch into the columns.
+        kid == INVALID_INDEX lanes are dropped."""
+        f = self.frontiers
+        valid = kid != segment_ops.INVALID_INDEX
+        live = slices_abs[valid]
+        if live.size:
+            lo, hi = int(live.min()), int(live.max())
+            f.min_used = lo if f.min_used is None else min(f.min_used, lo)
+            f.max_used = hi if f.max_used is None else max(f.max_used, hi)
+        spos = np.where(valid, slices_abs % self.S, segment_ops.INVALID_INDEX).astype(np.int32)
+        self.acc, self.count, self.last_touch = self._ingest(
+            self.acc, self.count, kid.astype(np.int32), spos, vals
+        )
+
+    def fire(self, slice_range: range, *, touch_mask: bool = False):
+        """Combine the window's slices; returns (result, counts, mask) device arrays."""
+        positions = np.asarray([s % self.S for s in slice_range], dtype=np.int32)
+        if touch_mask:
+            return self._fire_masked(self.acc, self.count, positions, self.last_touch)
+        return self._fire(self.acc, self.count, positions)
+
+    def purge_slices(self, slices_abs: List[int]) -> None:
+        """Reset columns of expired absolute slices (chunked)."""
+        for i in range(0, len(slices_abs), self.PURGE_CHUNK):
+            chunk = slices_abs[i : i + self.PURGE_CHUNK]
+            positions = np.full(self.PURGE_CHUNK, segment_ops.INVALID_INDEX, dtype=np.int32)
+            positions[: len(chunk)] = [s % self.S for s in chunk]
+            self.acc, self.count = self._purge(self.acc, self.count, positions)
+
+    def reset_all(self) -> None:
+        self.acc, self.count = segment_ops.init_state_arrays(self.agg, self.K, self.S)
+        self.last_touch = None
+
+    # ------------------------------------------------------------------
+    def snapshot(self) -> dict:
+        return {
+            "acc": {k: np.asarray(v) for k, v in self.acc.items()},
+            "count": np.asarray(self.count),
+            "keydict": self.keydict.snapshot(),
+            "frontiers": dataclasses.asdict(self.frontiers),
+            "K": self.K,
+            "S": self.S,
+        }
+
+    def restore(self, snap: dict) -> None:
+        import jax.numpy as jnp
+
+        self.K = snap["K"]
+        self.S = snap["S"]
+        self.acc = {k: jnp.asarray(v) for k, v in snap["acc"].items()}
+        self.count = jnp.asarray(snap["count"])
+        self.keydict = KeyDictionary.restore(snap["keydict"])
+        self.frontiers = RingFrontiers(**snap["frontiers"])
+        self.last_touch = None

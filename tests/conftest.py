@@ -9,6 +9,19 @@ Must run before any `import jax` in test modules.
 
 import os
 
-os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=8")
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Force-override: the environment presets JAX_PLATFORMS=axon (real TPU via a
+# single-client relay); tests must not claim the chip.
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=8"
+os.environ["JAX_PLATFORMS"] = "cpu"
 os.environ.setdefault("JAX_ENABLE_COMPILATION_CACHE", "false")
+
+# The axon PJRT plugin is registered by a sitecustomize hook that imports jax
+# before pytest starts, so the env vars above are too late for jax's config:
+# update the live config and drop the axon factory before any backend init
+# (its single-client relay hangs when probed from a second process).
+import jax  # noqa: E402
+from jax._src import xla_bridge as _xb  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+_xb._backend_factories.pop("axon", None)
+_xb._topology_factories.pop("axon", None)

@@ -1,0 +1,248 @@
+"""Device (columnar) window operator tests: semantics + parity vs the oracle.
+
+Parity criterion (BASELINE.json "result parity"): for any interleaving of
+records and watermarks, the set of emitted (key, window) pairs and the LAST
+emitted value per (key, window) must equal the oracle's. When records are
+flushed one per batch, emissions match one-for-one; intra-batch late updates
+to the same (key, window) coalesce by design (documented batching semantics).
+"""
+
+import numpy as np
+import pytest
+
+from flink_tpu.api.windowing.assigners import SlidingEventTimeWindows, TumblingEventTimeWindows
+from flink_tpu.core.time import TimeWindow
+from flink_tpu.ops.aggregators import BUILTINS
+from flink_tpu.runtime.oracle_window_operator import OracleWindowOperator
+from flink_tpu.runtime.tpu_window_operator import TpuWindowOperator
+from flink_tpu.testing.harness import KeyedWindowOperatorHarness
+
+
+def tpu_h(assigner, agg="sum", **kw):
+    return KeyedWindowOperatorHarness(TpuWindowOperator(assigner, agg, **kw))
+
+
+def oracle_h(assigner, agg="sum", **kw):
+    agg_fn = BUILTINS[agg]().python_equivalent()
+    return KeyedWindowOperatorHarness(OracleWindowOperator(assigner, agg_fn, **kw))
+
+
+def test_tumbling_sum_basic():
+    t = tpu_h(TumblingEventTimeWindows.of(1000))
+    t.process_elements((("a", 1.0), 100), (("a", 2.0), 900), (("b", 5.0), 500))
+    t.process_watermark(999)
+    out = sorted(t.extract_output())
+    assert out == [
+        ("a", TimeWindow(0, 1000), 3.0, 999),
+        ("b", TimeWindow(0, 1000), 5.0, 999),
+    ]
+
+
+def test_tumbling_fire_order_and_timestamps():
+    t = tpu_h(TumblingEventTimeWindows.of(1000))
+    t.process_elements((("a", 1.0), 100), (("a", 2.0), 1100), (("a", 4.0), 2100))
+    t.process_watermark(5000)
+    out = t.extract_output()
+    assert [r for (_, _, r, _) in out] == [1.0, 2.0, 4.0]
+    assert [ts for (_, _, _, ts) in out] == [999, 1999, 2999]
+
+
+def test_sliding_count_five_windows():
+    t = tpu_h(SlidingEventTimeWindows.of(10_000, 2_000), agg="count")
+    t.process_element(("k", 1.0), 10_500)
+    t.process_watermark(30_000)
+    out = t.extract_output()
+    assert len(out) == 5
+    assert sorted(w.end for (_, w, _, _) in out) == [12_000, 14_000, 16_000, 18_000, 20_000]
+    assert all(r == 1 for (_, _, r, _) in out)
+
+
+def test_no_fire_before_watermark():
+    t = tpu_h(TumblingEventTimeWindows.of(1000))
+    t.process_element(("a", 1.0), 100)
+    t.process_watermark(998)
+    assert t.extract_output() == []
+    t.process_watermark(999)
+    assert len(t.extract_output()) == 1
+
+
+def test_late_refire_within_lateness():
+    t = tpu_h(TumblingEventTimeWindows.of(1000), allowed_lateness=500)
+    t.process_element(("a", 1.0), 100)
+    t.process_watermark(999)
+    assert t.extract_results() == [("a", 1.0)]
+    t.process_element(("a", 2.0), 200)
+    t.process_watermark(999)  # no-op advance; flush happens on watermark
+    assert t.extract_results() == [("a", 3.0)]
+    t.process_watermark(1499)  # cleanup passes
+    t.process_element(("a", 7.0), 300)
+    t.process_watermark(1499)
+    assert t.extract_results() == []
+    assert t.op.num_late_records_dropped == 1
+
+
+def test_refire_only_touched_keys():
+    t = tpu_h(TumblingEventTimeWindows.of(1000), allowed_lateness=1000)
+    t.process_elements((("a", 1.0), 100), (("b", 2.0), 200))
+    t.process_watermark(999)
+    assert sorted(t.extract_results()) == [("a", 1.0), ("b", 2.0)]
+    t.process_element(("a", 10.0), 300)  # only "a" re-fires
+    t.process_watermark(1000)
+    assert t.extract_results() == [("a", 11.0)]
+
+
+def test_late_side_output():
+    t = tpu_h(TumblingEventTimeWindows.of(1000), emit_late_to_side_output=True)
+    t.process_element(("a", 1.0), 100)
+    t.process_watermark(999)
+    t.extract_output()
+    t.process_element(("a", 2.0), 150)
+    t.process_watermark(1000)
+    assert t.side_output("late-data") == [("a", 2.0, 150)]
+
+
+def test_sliding_late_refires_all_live_windows():
+    # size 2000 slide 1000, lateness 5000: late element re-fires both its windows
+    t = tpu_h(SlidingEventTimeWindows.of(2000, 1000), allowed_lateness=5000)
+    t.process_element(("k", 1.0), 1500)  # windows [0,2000) and [1000,3000)
+    t.process_watermark(2999)  # both fire
+    assert len(t.extract_output()) == 2
+    t.process_element(("k", 2.0), 1600)  # late, both windows still live
+    t.process_watermark(3000)
+    out = sorted(t.extract_output(), key=lambda o: o[1].start)
+    assert [(o[1], o[2]) for o in out] == [
+        (TimeWindow(0, 2000), 3.0),
+        (TimeWindow(1000, 3000), 3.0),
+    ]
+
+
+def test_key_capacity_growth():
+    t = tpu_h(TumblingEventTimeWindows.of(1000), key_capacity=4)
+    for i in range(37):
+        t.process_element((f"key-{i}", 1.0), 100)
+    t.process_watermark(999)
+    out = t.extract_output()
+    assert len(out) == 37
+    assert t.op.state.K >= 37
+
+
+def test_ring_overflow_future_records_buffered():
+    # S=8 slices of 1000ms; record 100 slices ahead must wait on host
+    t = tpu_h(TumblingEventTimeWindows.of(1000), num_slices=8)
+    t.process_element(("a", 1.0), 500)
+    t.process_element(("b", 2.0), 100_500)  # far future
+    t.process_watermark(999)
+    assert t.extract_results() == [("a", 1.0)]
+    assert len(t.op._future) == 1
+    t.process_watermark(100_999)  # purge advances; future record ingested+fired
+    assert t.extract_results() == [("b", 2.0)]
+    assert not t.op._future
+
+
+def test_aggregators_on_device():
+    for name, expected in [("sum", 6.0), ("count", 3), ("max", 3.0), ("min", 1.0), ("mean", 2.0)]:
+        t = tpu_h(TumblingEventTimeWindows.of(1000), agg=name)
+        t.process_elements((("a", 1.0), 0), (("a", 2.0), 1), (("a", 3.0), 2))
+        t.process_watermark(999)
+        assert t.extract_results() == [("a", pytest.approx(expected))], name
+
+
+def test_columnar_batch_ingest_path():
+    op = TpuWindowOperator(TumblingEventTimeWindows.of(1000), "sum", dense_int_keys=True)
+    keys = np.array([0, 1, 0, 2, 1], dtype=np.int64)
+    vals = np.array([1, 2, 3, 4, 5], dtype=np.float32)
+    ts = np.array([100, 200, 300, 1500, 1600], dtype=np.int64)
+    op.process_batch(keys, vals, ts)
+    op.process_watermark(1999)
+    out = sorted(op.drain_output())
+    assert out == [
+        (0, TimeWindow(0, 1000), 4.0, 999),
+        (1, TimeWindow(0, 1000), 2.0, 999),
+        (1, TimeWindow(1000, 2000), 5.0, 1999),
+        (2, TimeWindow(1000, 2000), 4.0, 1999),
+    ]
+
+
+def test_snapshot_restore_roundtrip():
+    t = tpu_h(TumblingEventTimeWindows.of(1000))
+    t.process_elements((("a", 1.0), 100), (("b", 2.0), 200))
+    snap = t.snapshot()
+
+    op2 = TpuWindowOperator(TumblingEventTimeWindows.of(1000), "sum")
+    op2.restore(snap)
+    t2 = KeyedWindowOperatorHarness(op2)
+    t2.process_element(("a", 10.0), 300)
+    t2.process_watermark(999)
+    assert sorted(t2.extract_results()) == [("a", 11.0), ("b", 2.0)]
+
+
+def _run_parity(assigner_fn, agg, records, wm_stride, lateness=0, seed=0):
+    """Feed identical record/watermark interleavings to both operators,
+    one record per batch (exact per-record emission parity)."""
+    tpu = tpu_h(assigner_fn(), agg=agg, allowed_lateness=lateness, num_slices=256)
+    orc = oracle_h(assigner_fn(), agg=agg, allowed_lateness=lateness)
+    max_ts = 0
+    for i, (key, val, ts) in enumerate(records):
+        for h in (tpu, orc):
+            h.process_element((key, val), ts)
+        tpu.op.flush()  # per-record ingest => per-record late-refire parity
+        max_ts = max(max_ts, ts)
+        if (i + 1) % wm_stride == 0:
+            wm = max_ts - 700  # bounded out-of-orderness style watermark
+            for h in (tpu, orc):
+                h.process_watermark(wm)
+    for h in (tpu, orc):
+        h.process_watermark(max_ts + 10**6)
+
+    def norm(out):
+        d = {}
+        for k, w, r, ts in out:
+            d[(k, w)] = (round(float(r), 3), ts)
+        return d
+
+    t_out, o_out = tpu.extract_output(), orc.extract_output()
+    assert norm(t_out) == norm(o_out)
+    assert len(t_out) == len(o_out)  # per-record batches -> emission-count parity
+    assert tpu.op.num_late_records_dropped == orc.op.num_late_records_dropped
+
+
+@pytest.mark.parametrize("agg", ["sum", "count", "max", "mean"])
+def test_parity_random_tumbling(agg):
+    rng = np.random.default_rng(42)
+    records = [
+        (f"k{rng.integers(0, 7)}", float(rng.integers(1, 10)), int(rng.integers(0, 20_000)))
+        for _ in range(400)
+    ]
+    _run_parity(lambda: TumblingEventTimeWindows.of(1000), agg, records, wm_stride=25)
+
+
+def test_parity_random_sliding_with_lateness():
+    rng = np.random.default_rng(7)
+    records = [
+        (f"k{rng.integers(0, 5)}", float(rng.integers(1, 10)), int(rng.integers(0, 15_000)))
+        for _ in range(300)
+    ]
+    _run_parity(
+        lambda: SlidingEventTimeWindows.of(3000, 1000), "sum", records, wm_stride=20, lateness=500
+    )
+
+
+def test_parity_sliding_nondivisible():
+    rng = np.random.default_rng(3)
+    records = [
+        (f"k{rng.integers(0, 4)}", float(rng.integers(1, 5)), int(rng.integers(0, 10_000)))
+        for _ in range(200)
+    ]
+    _run_parity(
+        lambda: SlidingEventTimeWindows.of(2100, 900), "sum", records, wm_stride=15
+    )
+
+
+def test_parity_with_offset():
+    rng = np.random.default_rng(11)
+    records = [
+        (f"k{rng.integers(0, 3)}", 1.0, int(rng.integers(0, 8_000))) for _ in range(150)
+    ]
+    _run_parity(
+        lambda: TumblingEventTimeWindows.of(1000, offset_ms=250), "count", records, wm_stride=10
+    )
