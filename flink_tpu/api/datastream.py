@@ -1,0 +1,266 @@
+"""DataStream-style fluent API.
+
+Capability parity with the reference's DataStream V1 surface
+(flink-runtime .../streaming/api/datastream/DataStream.java:111,
+KeyedStream.java:94 window() :705, WindowedStream.java reduce :181 /
+aggregate :310, StreamExecutionEnvironment.java:1823 execute()): fluent
+map/flatMap/filter/keyBy/window/aggregate/sink chains recording a
+Transformation DAG, executed by the stepped local executor (and, sharded,
+by the parallel executor).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, List, Optional, Sequence, Union
+
+from flink_tpu.api.functions import (
+    AggregateFunction,
+    as_key_selector,
+)
+from flink_tpu.api.windowing.assigners import WindowAssigner
+from flink_tpu.api.windowing.triggers import Trigger
+from flink_tpu.api.windowing.evictors import Evictor
+from flink_tpu.config import Configuration, PipelineOptions
+from flink_tpu.core.watermarks import WatermarkStrategy
+from flink_tpu.graph.transformation import Transformation, plan
+from flink_tpu.connectors.source import CollectionSource, Source
+from flink_tpu.connectors.sink import CollectSink, Sink
+
+
+class StreamExecutionEnvironment:
+    """Entry point (StreamExecutionEnvironment.java). Holds config and the
+    set of sink transformations; execute() plans and runs."""
+
+    def __init__(self, config: Optional[Configuration] = None):
+        self.config = config or Configuration()
+        self._sinks: List[Transformation] = []
+
+    @staticmethod
+    def get_execution_environment(config: Optional[Configuration] = None) -> "StreamExecutionEnvironment":
+        return StreamExecutionEnvironment(config)
+
+    # -- config -----------------------------------------------------------
+    def set_parallelism(self, parallelism: int) -> "StreamExecutionEnvironment":
+        self.config.set(PipelineOptions.PARALLELISM, parallelism)
+        return self
+
+    def set_max_parallelism(self, max_parallelism: int) -> "StreamExecutionEnvironment":
+        self.config.set(PipelineOptions.MAX_PARALLELISM, max_parallelism)
+        return self
+
+    @property
+    def parallelism(self) -> int:
+        return self.config.get(PipelineOptions.PARALLELISM)
+
+    @property
+    def max_parallelism(self) -> int:
+        return self.config.get(PipelineOptions.MAX_PARALLELISM)
+
+    # -- sources ----------------------------------------------------------
+    def from_source(
+        self,
+        source: Source,
+        watermark_strategy: Optional[WatermarkStrategy] = None,
+        name: str = "source",
+    ) -> "DataStream":
+        t = Transformation(
+            "source", name, [], {"source": source, "watermark_strategy": watermark_strategy}
+        )
+        return DataStream(self, t)
+
+    def from_collection(
+        self,
+        items: Sequence,
+        timestamp_fn: Optional[Callable] = None,
+        watermark_strategy: Optional[WatermarkStrategy] = None,
+    ) -> "DataStream":
+        return self.from_source(
+            CollectionSource(items, timestamp_fn), watermark_strategy, name="collection"
+        )
+
+    # -- execution --------------------------------------------------------
+    def execute(self, job_name: Optional[str] = None):
+        from flink_tpu.runtime.executor import LocalPipelineExecutor
+
+        if not self._sinks:
+            raise RuntimeError("No sinks defined; nothing to execute")
+        if len(self._sinks) > 1:
+            raise NotImplementedError("multiple sinks per job arrive with multi-topology support")
+        graph = plan(self._sinks[0])
+        executor = LocalPipelineExecutor(self.config)
+        return executor.execute(graph, job_name or self.config.get(PipelineOptions.NAME))
+
+    def execute_async(self, job_name: Optional[str] = None):
+        """Submit to the in-process mini-cluster (Dispatcher analogue)."""
+        from flink_tpu.runtime.minicluster import MiniCluster
+
+        if len(self._sinks) != 1:
+            raise RuntimeError("exactly one sink required")
+        graph = plan(self._sinks[0])
+        return MiniCluster.get_shared().submit(graph, self.config, job_name)
+
+
+class DataStream:
+    def __init__(self, env: StreamExecutionEnvironment, transform: Transformation):
+        self.env = env
+        self.transform = transform
+
+    def _derive(self, kind: str, name: str, config: dict) -> "DataStream":
+        return DataStream(self.env, Transformation(kind, name, [self.transform], config))
+
+    # -- record-local ops --------------------------------------------------
+    def map(self, fn: Callable, name: str = "map") -> "DataStream":
+        fn = fn.map if hasattr(fn, "map") else fn
+        return self._derive("map", name, {"fn": fn})
+
+    def flat_map(self, fn: Callable, name: str = "flat_map") -> "DataStream":
+        fn = fn.flat_map if hasattr(fn, "flat_map") else fn
+        return self._derive("flat_map", name, {"fn": fn})
+
+    def filter(self, fn: Callable, name: str = "filter") -> "DataStream":
+        fn = fn.filter if hasattr(fn, "filter") else fn
+        return self._derive("filter", name, {"fn": fn})
+
+    # -- partitioning ------------------------------------------------------
+    def key_by(self, key_selector: Callable, name: str = "key_by") -> "KeyedStream":
+        sel = as_key_selector(key_selector)
+        t = Transformation("key_by", name, [self.transform], {"key_selector": sel})
+        return KeyedStream(self.env, t)
+
+    # -- sinks -------------------------------------------------------------
+    def sink_to(self, sink: Sink, name: str = "sink") -> "DataStreamSink":
+        t = Transformation("sink", name, [self.transform], {"sink": sink})
+        self.env._sinks.append(t)
+        return DataStreamSink(self.env, t)
+
+    def print(self) -> "DataStreamSink":
+        from flink_tpu.connectors.sink import PrintSink
+
+        return self.sink_to(PrintSink(), name="print")
+
+    def collect(self) -> CollectSink:
+        """Convenience: attach a CollectSink and return it (results after
+        env.execute())."""
+        sink = CollectSink()
+        self.sink_to(sink, name="collect")
+        return sink
+
+
+class DataStreamSink:
+    def __init__(self, env, transform):
+        self.env = env
+        self.transform = transform
+
+    def uid(self, uid: str) -> "DataStreamSink":
+        self.transform.uid = uid
+        return self
+
+
+class KeyedStream(DataStream):
+    """Keyed partitioned stream (KeyedStream.java:94)."""
+
+    @property
+    def key_selector(self) -> Callable:
+        return self.transform.config["key_selector"]
+
+    def window(self, assigner: WindowAssigner) -> "WindowedStream":
+        return WindowedStream(self, assigner)
+
+    # rolling (non-windowed) keyed reduce — oracle/CPU path
+    def reduce(self, fn: Callable, name: str = "keyed_reduce") -> "DataStream":
+        t = Transformation(
+            "reduce", name, [self.transform], {"reduce_fn": fn, "key_selector": self.key_selector}
+        )
+        return DataStream(self.env, t)
+
+    def process(self, process_fn, name: str = "keyed_process") -> "DataStream":
+        """Low-level keyed ProcessFunction with timers (oracle/CPU path)."""
+        t = Transformation(
+            "process_keyed",
+            name,
+            [self.transform],
+            {"process_fn": process_fn, "key_selector": self.key_selector},
+        )
+        return DataStream(self.env, t)
+
+
+class WindowedStream:
+    """Builder for windowed aggregations (WindowedStream.java;
+    the builder decides oracle vs device operator the same way
+    WindowOperatorBuilder.java:79 selects sync vs async operators)."""
+
+    def __init__(self, keyed: KeyedStream, assigner: WindowAssigner):
+        self._keyed = keyed
+        self._assigner = assigner
+        self._trigger: Optional[Trigger] = None
+        self._evictor: Optional[Evictor] = None
+        self._allowed_lateness = 0
+        self._side_output_late = False
+
+    def trigger(self, trigger: Trigger) -> "WindowedStream":
+        self._trigger = trigger
+        return self
+
+    def evictor(self, evictor: Evictor) -> "WindowedStream":
+        self._evictor = evictor
+        return self
+
+    def allowed_lateness(self, lateness_ms: int) -> "WindowedStream":
+        self._allowed_lateness = lateness_ms
+        return self
+
+    def side_output_late_data(self) -> "WindowedStream":
+        self._side_output_late = True
+        return self
+
+    def _agg_transform(self, aggregate, value_fn, window_fn, name) -> DataStream:
+        t = Transformation(
+            "window_aggregate",
+            name,
+            [self._keyed.transform],
+            {
+                "assigner": self._assigner,
+                "aggregate": aggregate,
+                "value_fn": value_fn,
+                "window_fn": window_fn,
+                "trigger": self._trigger,
+                "evictor": self._evictor,
+                "allowed_lateness": self._allowed_lateness,
+                "side_output_late": self._side_output_late,
+                "key_selector": self._keyed.key_selector,
+            },
+        )
+        return DataStream(self._keyed.env, t)
+
+    def aggregate(
+        self,
+        aggregate: Union[str, AggregateFunction, Any],
+        value_fn: Optional[Callable] = None,
+        window_fn=None,
+        name: str = "window_aggregate",
+    ) -> DataStream:
+        """`aggregate` is a builtin name ('sum'/'count'/'min'/'max'/'mean'),
+        a DeviceAggregator (device path), or an AggregateFunction (oracle).
+        `value_fn` extracts the numeric column for device aggregation."""
+        return self._agg_transform(aggregate, value_fn, window_fn, name)
+
+    def reduce(self, fn: Callable, name: str = "window_reduce") -> DataStream:
+        from flink_tpu.api.functions import ReduceAggregate
+
+        return self._agg_transform(ReduceAggregate(fn), None, None, name)
+
+    def sum(self, value_fn: Optional[Callable] = None) -> DataStream:
+        return self.aggregate("sum", value_fn, name="window_sum")
+
+    def count(self) -> DataStream:
+        return self.aggregate("count", name="window_count")
+
+    def max(self, value_fn: Optional[Callable] = None) -> DataStream:
+        return self.aggregate("max", value_fn, name="window_max")
+
+    def min(self, value_fn: Optional[Callable] = None) -> DataStream:
+        return self.aggregate("min", value_fn, name="window_min")
+
+    def process(self, window_fn, name: str = "window_process") -> DataStream:
+        """Buffered window with ProcessWindowFunction (no pre-aggregation)."""
+        return self._agg_transform(None, None, window_fn, name)

@@ -1,0 +1,14 @@
+"""Sources and sinks (reference: FLIP-27 Source SPI flink-core
+.../api/connector/source/Source.java:37, Sink V2 .../sink2/Sink.java:38,
+built-ins under flink-connectors/)."""
+
+from flink_tpu.connectors.source import (
+    Source,
+    SourceReader,
+    SourceSplit,
+    SplitEnumerator,
+    CollectionSource,
+    DataGeneratorSource,
+    FileSource,
+)
+from flink_tpu.connectors.sink import Sink, SinkWriter, Committer, CollectSink, PrintSink, FileSink

@@ -1,0 +1,142 @@
+"""Sinks (Sink V2 contract: Sink → SinkWriter (+ Committer for 2PC),
+flink-core .../api/connector/sink2/Sink.java:38, SinkWriter.java:32,
+Committer.java:39).
+
+Exactly-once sinks stage output per checkpoint epoch and commit on
+notify_checkpoint_complete — barrier-aligned two-phase commit, where our
+"barrier" is a step boundary (SURVEY.md §7 stage 5)."""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import tempfile
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+
+class SinkWriter:
+    def write(self, value, timestamp: Optional[int] = None) -> None:
+        raise NotImplementedError
+
+    def write_batch(self, values: Sequence, timestamps=None) -> None:
+        for i, v in enumerate(values):
+            self.write(v, None if timestamps is None else int(timestamps[i]))
+
+    def prepare_commit(self) -> List[Any]:
+        """Returns committables for the current epoch (2PC phase 1)."""
+        return []
+
+    def flush(self) -> None:
+        pass
+
+    def close(self) -> None:
+        pass
+
+
+class Committer:
+    def commit(self, committables: List[Any]) -> None:
+        pass
+
+
+class Sink:
+    def create_writer(self) -> SinkWriter:
+        raise NotImplementedError
+
+    def create_committer(self) -> Optional[Committer]:
+        return None
+
+
+# ---------------------------------------------------------------------------
+
+class _CollectWriter(SinkWriter):
+    def __init__(self, store: List):
+        self.store = store
+
+    def write(self, value, timestamp=None) -> None:
+        self.store.append(value)
+
+    def write_batch(self, values, timestamps=None) -> None:
+        self.store.extend(values)
+
+
+class CollectSink(Sink):
+    """Test/dev sink collecting into a Python list."""
+
+    def __init__(self):
+        self.results: List = []
+
+    def create_writer(self) -> SinkWriter:
+        return _CollectWriter(self.results)
+
+
+class _PrintWriter(SinkWriter):
+    def write(self, value, timestamp=None) -> None:
+        print(value)
+
+
+class PrintSink(Sink):
+    def create_writer(self) -> SinkWriter:
+        return _PrintWriter()
+
+
+# ---------------------------------------------------------------------------
+# FileSink with two-phase commit (FileSink + compaction analogue, simplified:
+# one part file per epoch, moved into place on commit)
+# ---------------------------------------------------------------------------
+
+@dataclasses.dataclass
+class _PendingFile:
+    temp_path: str
+    final_path: str
+
+
+class _FileWriter(SinkWriter):
+    def __init__(self, directory: str, prefix: str):
+        self.directory = directory
+        self.prefix = prefix
+        self._epoch = 0
+        self._tmp = None
+        self._fh = None
+        os.makedirs(directory, exist_ok=True)
+        self._open_epoch_file()
+
+    def _open_epoch_file(self):
+        fd, self._tmp = tempfile.mkstemp(prefix=f".{self.prefix}-inprogress-", dir=self.directory)
+        self._fh = os.fdopen(fd, "w")
+
+    def write(self, value, timestamp=None) -> None:
+        self._fh.write(f"{value}\n")
+
+    def prepare_commit(self) -> List[_PendingFile]:
+        self._fh.flush()
+        self._fh.close()
+        final = os.path.join(self.directory, f"{self.prefix}-part-{self._epoch}")
+        pending = [_PendingFile(self._tmp, final)]
+        self._epoch += 1
+        self._open_epoch_file()
+        return pending
+
+    def close(self) -> None:
+        if self._fh and not self._fh.closed:
+            self._fh.close()
+            if os.path.exists(self._tmp) and os.path.getsize(self._tmp) == 0:
+                os.unlink(self._tmp)
+
+
+class _FileCommitter(Committer):
+    def commit(self, committables: List[_PendingFile]) -> None:
+        for p in committables:
+            if os.path.exists(p.temp_path):
+                os.replace(p.temp_path, p.final_path)
+
+
+class FileSink(Sink):
+    def __init__(self, directory: str, prefix: str = "out"):
+        self.directory = directory
+        self.prefix = prefix
+
+    def create_writer(self) -> SinkWriter:
+        return _FileWriter(self.directory, self.prefix)
+
+    def create_committer(self) -> Optional[Committer]:
+        return _FileCommitter()

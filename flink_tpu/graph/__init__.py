@@ -1,0 +1,1 @@
+"""Graph translation: Transformation DAG → StreamGraph → JobGraph."""

@@ -1,0 +1,162 @@
+"""Transformation DAG: API calls record transformation nodes.
+
+Capability parity with the reference's G1/G2/G3 pipeline
+(flink-core .../api/dag/Transformation.java:110 →
+StreamGraphGenerator.java:253 → StreamingJobGraphGenerator.java:134):
+user API calls append `Transformation` nodes; the planner groups chainable
+transformations into fused *steps* (the analogue of operator chains: a chain
+compiles into ONE jitted device program) and cuts chains at keyBy
+redistribution points (the analogue of a network shuffle — here a key-group
+routed exchange feeding the next step).
+
+The three reference layers collapse into two here because XLA replaces
+runtime operator fusion: Transformation (logical) → StepGraph (physical,
+already chained).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import itertools
+from typing import Any, Callable, Dict, List, Optional
+
+_ids = itertools.count(1)
+
+
+@dataclasses.dataclass
+class Transformation:
+    """One logical node (Transformation.java:110): op kind + config + inputs."""
+
+    kind: str                      # 'source'|'map'|'flat_map'|'filter'|'key_by'|
+                                   # 'window_aggregate'|'reduce'|'process'|'sink'|'union'
+    name: str
+    inputs: List["Transformation"]
+    config: Dict[str, Any] = dataclasses.field(default_factory=dict)
+    parallelism: Optional[int] = None
+    max_parallelism: Optional[int] = None
+    uid: Optional[str] = None      # stable id for state remapping (S10 savepoints)
+
+    def __post_init__(self):
+        self.id = next(_ids)
+        if self.uid is None:
+            self.uid = f"{self.kind}-{self.id}"
+
+    def __hash__(self):
+        return self.id
+
+    def __repr__(self):
+        return f"Transformation#{self.id}({self.kind}:{self.name})"
+
+
+# chain-breaking kinds: a keyBy repartition or any stateful keyed op boundary
+REDISTRIBUTING = {"key_by", "rebalance", "broadcast", "rescale", "global"}
+
+
+@dataclasses.dataclass
+class Step:
+    """A fused pipeline stage (the reference's operator chain /
+    StreamingJobGraphGenerator.isChainable:1730 analogue).
+
+    `chain` is the list of record-local transformations (map/flatMap/filter)
+    fused into one program; `terminal` is the stage's stateful/boundary op
+    (window aggregate, sink) if any; `partitioning` describes how records
+    enter this step ('forward' or 'key_group')."""
+
+    chain: List[Transformation]
+    terminal: Optional[Transformation]
+    partitioning: str
+    key_selector: Optional[Callable] = None
+    upstream: Optional["Step"] = None
+
+    @property
+    def name(self) -> str:
+        parts = [t.name for t in self.chain]
+        if self.terminal is not None:
+            parts.append(self.terminal.name)
+        return " -> ".join(parts) or "empty-step"
+
+    @property
+    def uid(self) -> str:
+        if self.terminal is not None:
+            return self.terminal.uid
+        return self.chain[-1].uid if self.chain else "step"
+
+
+@dataclasses.dataclass
+class StepGraph:
+    """Physical plan: linear pipeline of steps (fan-in/fan-out beyond union
+    is represented as multiple sources feeding one step)."""
+
+    source: Transformation
+    steps: List[Step]
+
+    def describe(self) -> str:
+        lines = [f"source: {self.source.name}"]
+        for i, s in enumerate(self.steps):
+            lines.append(f"step[{i}] ({s.partitioning}): {s.name}")
+        return "\n".join(lines)
+
+
+def plan(sink_transform: Transformation) -> StepGraph:
+    """Translate the transformation DAG rooted at `sink_transform` into a
+    StepGraph: walk source→sink, fusing chainable ops, cutting at keyBy.
+
+    Mirrors StreamGraphGenerator.generate:253 + createJobGraph chaining in
+    one pass (chains = fused steps; shuffles = key_group exchanges).
+    """
+    # linearize (v0 supports linear topologies + union at source side)
+    order: List[Transformation] = []
+    node = sink_transform
+    while True:
+        order.append(node)
+        if not node.inputs:
+            break
+        if len(node.inputs) > 1:
+            raise NotImplementedError("multi-input topologies arrive with connect/join support")
+        node = node.inputs[0]
+    order.reverse()
+    if order[0].kind != "source":
+        raise ValueError("pipeline must start at a source")
+
+    source = order[0]
+    steps: List[Step] = []
+    chain: List[Transformation] = []
+    partitioning = "forward"
+    key_selector = None
+
+    def cut(terminal: Optional[Transformation]):
+        nonlocal chain, partitioning, key_selector
+        steps.append(
+            Step(
+                chain=chain,
+                terminal=terminal,
+                partitioning=partitioning,
+                key_selector=key_selector,
+                upstream=steps[-1] if steps else None,
+            )
+        )
+        chain = []
+        partitioning = "forward"
+        key_selector = None
+
+    for t in order[1:]:
+        if t.kind in ("map", "flat_map", "filter", "process"):
+            chain.append(t)
+        elif t.kind == "key_by":
+            # repartition point: close current chain as a stateless step if
+            # nonempty, then start the keyed step
+            if chain:
+                cut(None)
+            partitioning = "key_group"
+            key_selector = t.config["key_selector"]
+        elif t.kind in ("window_aggregate", "reduce", "sink", "process_keyed"):
+            cut(t)
+        elif t.kind in REDISTRIBUTING:
+            if chain:
+                cut(None)
+            partitioning = "rebalance"
+        else:
+            raise NotImplementedError(f"transformation kind {t.kind}")
+    if chain:
+        cut(None)
+    return StepGraph(source=source, steps=steps)

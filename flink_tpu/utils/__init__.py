@@ -1,0 +1,3 @@
+"""Shared utilities."""
+
+from flink_tpu.utils.arrays import obj_array

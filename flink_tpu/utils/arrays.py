@@ -1,0 +1,17 @@
+"""Array helpers."""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import numpy as np
+
+
+def obj_array(items: Sequence) -> np.ndarray:
+    """1-D object ndarray of arbitrary Python values. (np.asarray(...,
+    dtype=object) would build a 2-D array from a list of equal-length
+    tuples — records must stay scalar elements.)"""
+    arr = np.empty(len(items), dtype=object)
+    if len(items):
+        arr[:] = list(items)
+    return arr
