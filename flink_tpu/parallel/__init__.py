@@ -1,0 +1,2 @@
+"""Parallel execution over a device mesh: key-group sharding (the DP axis),
+on-device keyBy all-to-all (the shuffle), psum merges (global windows)."""

@@ -1,0 +1,47 @@
+"""Device-mesh helpers.
+
+The parallelism mapping (SURVEY.md §2.7): the reference's data parallelism —
+N subtasks over disjoint key-group ranges (ExecutionVertex per subtask,
+KeyGroupRangeAssignment.java:63) — becomes ONE mesh axis ("shards"); each
+device owns a contiguous key-group range. keyBy shuffles
+(KeyGroupStreamPartitioner + Netty N1/N2) become `all_to_all` collectives
+over ICI inside shard_map programs (ops/exchange.py); global-window merges
+(Nexmark Q7) become `psum`. Rescaling = remapping key-group ranges onto a
+different mesh size at restore (state/columnar snapshots are keyed by
+key group, not device).
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from flink_tpu.core.keygroups import KeyGroupRange, key_group_range_for_operator
+
+SHARD_AXIS = "shards"
+
+
+def build_mesh(num_shards: Optional[int] = None, axis_name: str = SHARD_AXIS) -> Mesh:
+    devices = jax.devices()
+    n = num_shards or len(devices)
+    if n > len(devices):
+        raise ValueError(f"requested {n} shards but only {len(devices)} devices")
+    return Mesh(np.array(devices[:n]), (axis_name,))
+
+
+def shard_ranges(mesh: Mesh, max_parallelism: int, axis_name: str = SHARD_AXIS) -> List[KeyGroupRange]:
+    """Key-group range per shard (the reference's operator-index ranges)."""
+    n = mesh.shape[axis_name]
+    return [key_group_range_for_operator(max_parallelism, n, i) for i in range(n)]
+
+
+def sharded(mesh: Mesh, *axes) -> NamedSharding:
+    """NamedSharding partitioning the given leading axes."""
+    return NamedSharding(mesh, P(*axes))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
