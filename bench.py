@@ -1,0 +1,183 @@
+"""Benchmark: Yahoo-Streaming-Benchmark-style keyed sliding-window count.
+
+Workload (BASELINE.json config 2): events keyed by campaign (dense int
+keys), 10s windows sliding by 1s, event-time with bounded out-of-orderness,
+watermark advanced per step batch. The device path runs the columnar
+TpuWindowOperator (scatter-combine ingest + segment-reduce fire,
+flink_tpu/runtime/tpu_window_operator.py); the baseline is an optimized
+single-core CPU implementation of the same slice-decomposed algorithm
+(np.bincount segment sums — a *stronger* baseline than the per-record
+oracle, standing in for the reference's JVM WindowOperator which cannot be
+built in this offline image; see BASELINE.md protocol note).
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", ...extras}.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import threading
+import time
+
+import numpy as np
+
+# Watchdog: the axon TPU relay is single-client; if backend init wedges,
+# emit a sentinel result instead of hanging the driver forever.
+def _watchdog(seconds=900):
+    def fire():
+        print(json.dumps({
+            "metric": "ysb_sliding_count_tuples_per_sec",
+            "value": 0.0,
+            "unit": "tuples/s/chip",
+            "vs_baseline": 0.0,
+            "error": "device backend init timed out",
+        }), flush=True)
+        os._exit(0)
+
+    t = threading.Timer(seconds, fire)
+    t.daemon = True
+    t.start()
+    return t
+
+
+NUM_KEYS = 8192
+WINDOW_MS = 10_000
+SLIDE_MS = 1_000
+BATCH = 1 << 17            # 131072 events per step
+EVENTS_PER_SEC_SIM = 400_000  # simulated event-time density: events/sec of stream time
+OOO_MS = 500               # out-of-orderness jitter
+WM_DELAY_MS = 1_000
+
+
+def make_batches(num_batches: int, seed: int = 7):
+    """Pre-generate the whole workload (host memory) so generation cost is
+    excluded from both measurements equally."""
+    rng = np.random.default_rng(seed)
+    batches = []
+    t_cursor = 0.0
+    ms_per_batch = BATCH / EVENTS_PER_SEC_SIM * 1000.0
+    for _ in range(num_batches):
+        keys = rng.integers(0, NUM_KEYS, size=BATCH).astype(np.int64)
+        base = t_cursor + np.sort(rng.random(BATCH)) * ms_per_batch
+        jitter = rng.integers(-OOO_MS, 1, size=BATCH)
+        ts = np.maximum(base.astype(np.int64) + jitter, 0)
+        vals = np.ones(BATCH, dtype=np.float32)
+        wm = int(base[-1]) - WM_DELAY_MS
+        batches.append((keys, vals, ts, wm))
+        t_cursor += ms_per_batch
+    return batches
+
+
+# ---------------------------------------------------------------------------
+# device run
+# ---------------------------------------------------------------------------
+
+def run_device(batches, warmup: int = 2):
+    from flink_tpu.api.windowing.assigners import SlidingEventTimeWindows
+    from flink_tpu.runtime.tpu_window_operator import TpuWindowOperator
+    import jax
+
+    def new_op():
+        return TpuWindowOperator(
+            SlidingEventTimeWindows.of(WINDOW_MS, SLIDE_MS),
+            "count",
+            key_capacity=NUM_KEYS,
+            num_slices=32,
+            dense_int_keys=True,
+            columnar_output=True,
+            batch_pad=BATCH,
+        )
+
+    # warmup/compile on a throwaway operator
+    op = new_op()
+    for keys, vals, ts, wm in batches[:warmup]:
+        op.process_batch(keys, vals, ts)
+        op.process_watermark(wm)
+    jax.block_until_ready(op.state.count)
+
+    op = new_op()
+    fire_times = []
+    orig_emit = op._emit_window
+
+    def timed_emit(j, *, touch_mask):
+        t0 = time.perf_counter()
+        orig_emit(j, touch_mask=touch_mask)
+        fire_times.append(time.perf_counter() - t0)
+
+    op._emit_window = timed_emit
+
+    t0 = time.perf_counter()
+    n = 0
+    for keys, vals, ts, wm in batches:
+        op.process_batch(keys, vals, ts)
+        op.process_watermark(wm)
+        n += len(keys)
+    jax.block_until_ready(op.state.count)
+    elapsed = time.perf_counter() - t0
+    p99_fire_ms = (
+        float(np.percentile(np.asarray(fire_times) * 1000, 99)) if fire_times else 0.0
+    )
+    total_emitted = sum(len(np.flatnonzero(m)) if hasattr(m, "any") else 0
+                        for _, _, (m, _r), _ in op.output) if op.output else 0
+    return n / elapsed, p99_fire_ms, total_emitted
+
+
+# ---------------------------------------------------------------------------
+# CPU baseline: same slice-decomposed algorithm, single core, numpy
+# ---------------------------------------------------------------------------
+
+def run_cpu(batches):
+    S = 32
+    spw = WINDOW_MS // SLIDE_MS
+    counts = np.zeros((NUM_KEYS, S), dtype=np.int64)
+    fired_upto = None
+    emitted = 0
+
+    t0 = time.perf_counter()
+    n = 0
+    for keys, vals, ts, wm in batches:
+        s_abs = ts // SLIDE_MS
+        flat = keys * S + (s_abs % S)
+        counts += np.bincount(flat, minlength=NUM_KEYS * S).reshape(NUM_KEYS, S)
+        n += len(keys)
+        # fire windows whose end-1 <= wm
+        j_hi = (wm + 1 - WINDOW_MS) // SLIDE_MS
+        j_lo = fired_upto + 1 if fired_upto is not None else j_hi - 1
+        for j in range(j_lo, j_hi + 1):
+            pos = np.arange(j, j + spw) % S
+            win = counts[:, pos].sum(axis=1)
+            emitted += int((win > 0).sum())
+            # purge the slice leaving the live range (ring reuse)
+            counts[:, j % S] = 0
+        fired_upto = max(j_hi, fired_upto) if fired_upto is not None else j_hi
+    elapsed = time.perf_counter() - t0
+    return n / elapsed, emitted
+
+
+def main():
+    num_batches = int(os.environ.get("BENCH_BATCHES", "24"))
+    wd = _watchdog(int(os.environ.get("BENCH_WATCHDOG_S", "900")))
+    batches = make_batches(num_batches)
+
+    cpu_tps, _ = run_cpu(batches)
+    dev_tps, p99_fire_ms, _ = run_device(batches)
+    wd.cancel()
+
+    print(json.dumps({
+        "metric": "ysb_sliding_count_tuples_per_sec",
+        "value": round(dev_tps, 1),
+        "unit": "tuples/s/chip",
+        "vs_baseline": round(dev_tps / cpu_tps, 3),
+        "cpu_baseline_tuples_per_sec": round(cpu_tps, 1),
+        "p99_window_fire_ms": round(p99_fire_ms, 3),
+        "events": num_batches * BATCH,
+        "num_keys": NUM_KEYS,
+        "window_ms": WINDOW_MS,
+        "slide_ms": SLIDE_MS,
+    }), flush=True)
+
+
+if __name__ == "__main__":
+    main()
