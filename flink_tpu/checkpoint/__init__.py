@@ -1,0 +1,9 @@
+"""Checkpointing & recovery: step-aligned snapshots, storage, restart strategies."""
+
+from flink_tpu.checkpoint.storage import (
+    CheckpointStorage,
+    FsCheckpointStorage,
+    MemoryCheckpointStorage,
+)
+from flink_tpu.checkpoint.coordinator import CheckpointCoordinator
+from flink_tpu.checkpoint.restart import restart_strategy_from_config

@@ -1,0 +1,83 @@
+"""Checkpoint coordinator: step-aligned consistent snapshots.
+
+The reference coordinates checkpoints with barriers injected at sources and
+aligned across channels (CheckpointCoordinator.java:567 triggerCheckpoint →
+barrier flow → per-operator snapshots → acks → completePendingCheckpoint
+:1359 → notifyCheckpointComplete). In the stepped runtime, a "barrier" is
+simply a step boundary: between two device steps the whole pipeline is
+quiescent, so alignment is free and a checkpoint is:
+
+  1. capture source positions (splits + reader offsets) and every stateful
+     runner's snapshot (device state pulled to host),
+  2. persist atomically to CheckpointStorage,
+  3. on success, notifyCheckpointComplete → 2PC sinks commit their epoch
+     (Committer.java:39 semantics).
+
+Exactly-once = replayable source positions + state snapshot + transactional
+sinks, identical contract to the reference (SURVEY.md §5 checkpoint/resume).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Callable, List, Optional, Tuple
+
+from flink_tpu.checkpoint.storage import CheckpointStorage
+
+
+class CheckpointCoordinator:
+    def __init__(
+        self,
+        storage: CheckpointStorage,
+        interval_ms: int,
+        max_retained: int = 3,
+        clock: Callable[[], float] = time.monotonic,
+    ):
+        self.storage = storage
+        self.interval_s = interval_ms / 1000.0
+        self.max_retained = max_retained
+        self._clock = clock
+        self._last_trigger = clock()
+        self._next_id = 1
+        self.num_completed = 0
+        self._on_complete: List[Callable[[int], None]] = []
+
+    def register_on_complete(self, fn: Callable[[int], None]) -> None:
+        self._on_complete.append(fn)
+
+    def set_next_id(self, next_id: int) -> None:
+        self._next_id = max(self._next_id, next_id)
+
+    def due(self) -> bool:
+        return self.interval_s > 0 and (self._clock() - self._last_trigger) >= self.interval_s
+
+    def maybe_trigger(self, capture_fn: Callable[[], dict]) -> Optional[int]:
+        if not self.due():
+            return None
+        return self.trigger(capture_fn)
+
+    def trigger(self, capture_fn: Callable[[], dict]) -> int:
+        cid = self._next_id
+        data = capture_fn()
+        data["checkpoint_id"] = cid
+        self.storage.save(cid, data)
+        self._next_id += 1
+        self._last_trigger = self._clock()
+        self.num_completed += 1
+        for fn in self._on_complete:
+            fn(cid)
+        self._retain()
+        return cid
+
+    def _retain(self) -> None:
+        cps = self.storage.list_checkpoints()
+        while len(cps) > self.max_retained:
+            cid, _ = cps.pop(0)
+            self.storage.discard(cid)
+
+    def latest_snapshot(self) -> Optional[dict]:
+        latest = self.storage.latest()
+        if latest is None:
+            return None
+        _cid, handle = latest
+        return self.storage.load(handle)

@@ -1,0 +1,97 @@
+"""Checkpoint storage (CheckpointStorage SPI analogue:
+runtime/state/filesystem/FsCheckpointStorageAccess.java:43 and the JM-heap
+MemoryBackendCheckpointStorageAccess).
+
+A checkpoint is one dict (numpy arrays + plain data), written atomically
+(temp file + rename) under <dir>/chk-<id>/; the `_metadata` name and
+completed-marker protocol mirror the reference's checkpoint layout. Device
+arrays must already be pulled to host by the snapshot capture."""
+
+from __future__ import annotations
+
+import os
+import pickle
+import re
+import shutil
+import tempfile
+from typing import Dict, List, Optional, Tuple
+
+
+class CheckpointStorage:
+    def save(self, checkpoint_id: int, data: dict) -> str:
+        raise NotImplementedError
+
+    def load(self, handle: str) -> dict:
+        raise NotImplementedError
+
+    def list_checkpoints(self) -> List[Tuple[int, str]]:
+        """Sorted (id, handle) of COMPLETE checkpoints."""
+        raise NotImplementedError
+
+    def latest(self) -> Optional[Tuple[int, str]]:
+        cps = self.list_checkpoints()
+        return cps[-1] if cps else None
+
+    def discard(self, checkpoint_id: int) -> None:
+        pass
+
+
+class MemoryCheckpointStorage(CheckpointStorage):
+    def __init__(self):
+        self._store: Dict[int, bytes] = {}
+
+    def save(self, checkpoint_id: int, data: dict) -> str:
+        self._store[checkpoint_id] = pickle.dumps(data, protocol=pickle.HIGHEST_PROTOCOL)
+        return f"mem:{checkpoint_id}"
+
+    def load(self, handle: str) -> dict:
+        return pickle.loads(self._store[int(handle.split(":", 1)[1])])
+
+    def list_checkpoints(self) -> List[Tuple[int, str]]:
+        return [(i, f"mem:{i}") for i in sorted(self._store)]
+
+    def discard(self, checkpoint_id: int) -> None:
+        self._store.pop(checkpoint_id, None)
+
+
+class FsCheckpointStorage(CheckpointStorage):
+    _DIR_RE = re.compile(r"^chk-(\d+)$")
+
+    def __init__(self, directory: str):
+        self.directory = directory
+        os.makedirs(directory, exist_ok=True)
+
+    def _chk_dir(self, checkpoint_id: int) -> str:
+        return os.path.join(self.directory, f"chk-{checkpoint_id}")
+
+    def save(self, checkpoint_id: int, data: dict) -> str:
+        chk = self._chk_dir(checkpoint_id)
+        os.makedirs(chk, exist_ok=True)
+        final = os.path.join(chk, "_metadata")
+        fd, tmp = tempfile.mkstemp(dir=chk, prefix=".inprogress-")
+        try:
+            with os.fdopen(fd, "wb") as f:
+                pickle.dump(data, f, protocol=pickle.HIGHEST_PROTOCOL)
+            os.replace(tmp, final)  # atomic completion marker
+        finally:
+            if os.path.exists(tmp):
+                os.unlink(tmp)
+        return final
+
+    def load(self, handle: str) -> dict:
+        with open(handle, "rb") as f:
+            return pickle.load(f)
+
+    def list_checkpoints(self) -> List[Tuple[int, str]]:
+        out = []
+        for name in os.listdir(self.directory):
+            m = self._DIR_RE.match(name)
+            if not m:
+                continue
+            meta = os.path.join(self.directory, name, "_metadata")
+            if os.path.exists(meta):
+                out.append((int(m.group(1)), meta))
+        return sorted(out)
+
+    def discard(self, checkpoint_id: int) -> None:
+        shutil.rmtree(self._chk_dir(checkpoint_id), ignore_errors=True)

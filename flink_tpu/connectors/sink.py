@@ -22,8 +22,10 @@ class SinkWriter:
         for i, v in enumerate(values):
             self.write(v, None if timestamps is None else int(timestamps[i]))
 
-    def prepare_commit(self) -> List[Any]:
-        """Returns committables for the current epoch (2PC phase 1)."""
+    def prepare_commit(self, epoch_id: str = "final") -> List[Any]:
+        """Returns committables for the current epoch (2PC phase 1).
+        `epoch_id` identifies the checkpoint epoch: committable naming must
+        be a pure function of it so replay after recovery is idempotent."""
         return []
 
     def flush(self) -> None:
@@ -94,7 +96,6 @@ class _FileWriter(SinkWriter):
     def __init__(self, directory: str, prefix: str):
         self.directory = directory
         self.prefix = prefix
-        self._epoch = 0
         self._tmp = None
         self._fh = None
         os.makedirs(directory, exist_ok=True)
@@ -107,12 +108,14 @@ class _FileWriter(SinkWriter):
     def write(self, value, timestamp=None) -> None:
         self._fh.write(f"{value}\n")
 
-    def prepare_commit(self) -> List[_PendingFile]:
+    def prepare_commit(self, epoch_id: str = "final") -> List[_PendingFile]:
+        """Part-file name is a pure function of epoch_id (the checkpoint id),
+        so a replayed epoch atomically overwrites its own part file —
+        exactly-once via idempotent rename."""
         self._fh.flush()
         self._fh.close()
-        final = os.path.join(self.directory, f"{self.prefix}-part-{self._epoch}")
+        final = os.path.join(self.directory, f"{self.prefix}-part-{epoch_id}")
         pending = [_PendingFile(self._tmp, final)]
-        self._epoch += 1
         self._open_epoch_file()
         return pending
 

@@ -41,6 +41,12 @@ class WatermarkGenerator:
         """Returns the watermark to emit at a periodic checkpoint, or None."""
         return None
 
+    def snapshot(self) -> dict:
+        return {}
+
+    def restore(self, snap: dict) -> None:
+        pass
+
 
 class BoundedOutOfOrdernessWatermarks(WatermarkGenerator):
     """watermark = maxTimestamp - outOfOrderness - 1
@@ -65,6 +71,12 @@ class BoundedOutOfOrdernessWatermarks(WatermarkGenerator):
             if m > self._max_ts:
                 self._max_ts = m
         return self.on_periodic_emit()
+
+    def snapshot(self) -> dict:
+        return {"max_ts": self._max_ts}
+
+    def restore(self, snap: dict) -> None:
+        self._max_ts = snap["max_ts"]
 
 
 class MonotonousTimestampsWatermarks(BoundedOutOfOrdernessWatermarks):

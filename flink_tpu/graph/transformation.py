@@ -118,6 +118,13 @@ def plan(sink_transform: Transformation) -> StepGraph:
     if order[0].kind != "source":
         raise ValueError("pipeline must start at a source")
 
+    # stabilize auto-generated uids by topological position so state restores
+    # across identically-built pipelines (users set .uid() for evolving jobs,
+    # the reference's operator-UID remapping contract, S10)
+    for pos, t in enumerate(order):
+        if t.uid == f"{t.kind}-{t.id}":
+            t.uid = f"{t.kind}@{pos}"
+
     source = order[0]
     steps: List[Step] = []
     chain: List[Transformation] = []

@@ -303,12 +303,12 @@ class SinkRunner(StepRunner):
     def on_batch(self, values: np.ndarray, timestamps: np.ndarray) -> None:
         self.writer.write_batch(values, timestamps)
 
-    def commit_epoch(self) -> None:
+    def commit_epoch(self, epoch_id: str = "final") -> None:
         if self.committer is not None:
-            self.committer.commit(self.writer.prepare_commit())
+            self.committer.commit(self.writer.prepare_commit(epoch_id))
 
     def on_end(self) -> None:
-        self.commit_epoch()
+        self.commit_epoch("final")
         self.writer.close()
 
 
@@ -336,63 +336,155 @@ def build_runners(graph: StepGraph, config: Configuration) -> List[StepRunner]:
     return runners
 
 
+class JobCancelledException(Exception):
+    pass
+
+
+class JobRuntime:
+    """One running attempt of a job: the stepped loop plus the
+    checkpoint-capture/restore surface (task-side checkpointing, §3.4
+    analogue — here capture happens between steps so alignment is free)."""
+
+    def __init__(self, graph: StepGraph, config: Configuration):
+        self.graph = graph
+        self.config = config
+        source_cfg = graph.source.config
+        self.source = source_cfg["source"]
+        strategy: Optional[WatermarkStrategy] = source_cfg.get("watermark_strategy")
+        self.generator = strategy.create_generator() if strategy else None
+        self.assigner = strategy.timestamp_assigner if strategy else None
+        self.runners = build_runners(graph, config)
+        self.head = self.runners[0]
+        self.enumerator = self.source.create_enumerator()
+        self.reader = self.source.create_reader()
+        self.current_split = None
+        self.records_in = 0
+        self.source_done = False
+
+    # -- checkpoint surface ----------------------------------------------
+    def capture(self) -> dict:
+        runner_snaps = {}
+        for r in self.runners:
+            snap = r.snapshot()
+            if snap:
+                runner_snaps[getattr(r, "uid", f"runner-{id(r)}")] = snap
+        return {
+            "source": {
+                "pending_splits": self.enumerator.snapshot(),
+                "current_split": self.current_split,
+                "reader_position": self.reader.snapshot_position(),
+                "done": self.source_done,
+            },
+            "generator": self.generator.snapshot() if self.generator else None,
+            "runners": runner_snaps,
+            "records_in": self.records_in,
+        }
+
+    def restore(self, snap: dict) -> None:
+        src = snap["source"]
+        self.enumerator.restore(src["pending_splits"])
+        self.current_split = src["current_split"]
+        self.source_done = src["done"]
+        if self.current_split is not None:
+            self.reader.add_split(self.current_split)
+            self.reader.restore_position(src["reader_position"])
+        if self.generator is not None and snap["generator"] is not None:
+            self.generator.restore(snap["generator"])
+        for r in self.runners:
+            uid = getattr(r, "uid", None)
+            if uid is not None and uid in snap["runners"]:
+                r.restore(snap["runners"][uid])
+        self.records_in = snap["records_in"]
+
+    def commit_sinks(self, checkpoint_id: int) -> None:
+        for r in self.runners:
+            if isinstance(r, SinkRunner):
+                r.commit_epoch(str(checkpoint_id))
+
+    # -- the loop ---------------------------------------------------------
+    def run(
+        self,
+        coordinator=None,
+        cancel_check: Optional[Callable[[], bool]] = None,
+        savepoint_request: Optional[Callable[[], Optional[str]]] = None,
+    ) -> None:
+        batch_size = self.config.get(ExecutionOptions.BATCH_SIZE)
+        if coordinator is not None:
+            coordinator.register_on_complete(self.commit_sinks)
+        if self.current_split is None and not self.source_done:
+            self.current_split = self.enumerator.next_split()
+            if self.current_split is not None:
+                self.reader.add_split(self.current_split)
+            else:
+                self.source_done = True
+
+        while not self.source_done:
+            if cancel_check is not None and cancel_check():
+                raise JobCancelledException()
+            batch = self.reader.poll_batch(batch_size)
+            if batch is None:
+                self.current_split = self.enumerator.next_split()
+                if self.current_split is None:
+                    self.source_done = True
+                    break
+                self.reader.add_split(self.current_split)
+                continue
+            values = batch.values
+            ts = batch.timestamps
+            if self.assigner is not None:
+                ts = np.asarray(
+                    [self.assigner(v, int(t)) for v, t in zip(values, ts)], dtype=np.int64
+                )
+            self.records_in += len(batch)
+            self.head.on_batch(values, ts)
+            if self.generator is not None:
+                wm = (
+                    self.generator.on_batch_np(ts)
+                    if hasattr(self.generator, "on_batch_np")
+                    else None
+                )
+                if wm is None:
+                    for v, t in zip(values, ts):
+                        self.generator.on_event(v, int(t))
+                    wm = self.generator.on_periodic_emit()
+                if wm is not None and wm > MIN_WATERMARK:
+                    self.head.on_watermark(wm)
+            # step boundary: checkpoints/savepoints align here for free
+            if coordinator is not None:
+                coordinator.maybe_trigger(self.capture)
+            if savepoint_request is not None:
+                path = savepoint_request()
+                if path is not None:
+                    self._write_savepoint(path)
+
+        # end of input: watermark jumps to +inf, firing all remaining windows
+        self.head.on_watermark(MAX_WATERMARK - 1)
+        self.head.on_end()
+
+    def _write_savepoint(self, path: str) -> None:
+        from flink_tpu.checkpoint.storage import FsCheckpointStorage
+
+        data = self.capture()
+        data["savepoint"] = True
+        FsCheckpointStorage(path).save(0, data)
+
+
 class LocalPipelineExecutor:
-    """Single-host, single-shard execution (LocalExecutor/MiniCluster
-    analogue, flink-clients LocalExecutor.java:49). The sharded executor in
-    flink_tpu/parallel extends this over a device mesh."""
+    """Single-host execution (LocalExecutor/MiniCluster analogue,
+    flink-clients LocalExecutor.java:49); one synchronous attempt, no
+    recovery — fault tolerance lives in runtime/minicluster.py."""
 
     def __init__(self, config: Optional[Configuration] = None):
         self.config = config or Configuration()
 
     def execute(self, graph: StepGraph, job_name: str = "job") -> JobExecutionResult:
-        batch_size = self.config.get(ExecutionOptions.BATCH_SIZE)
-        source_cfg = graph.source.config
-        source = source_cfg["source"]
-        strategy: Optional[WatermarkStrategy] = source_cfg.get("watermark_strategy")
-
-        runners = build_runners(graph, self.config)
-        head = runners[0]
-
-        enumerator = source.create_enumerator()
-        reader = source.create_reader()
-        generator = strategy.create_generator() if strategy else None
-        assigner = strategy.timestamp_assigner if strategy else None
-
-        records_in = 0
+        runtime = JobRuntime(graph, self.config)
         t0 = time.perf_counter()
-        split = enumerator.next_split()
-        if split is not None:
-            reader.add_split(split)
-        while split is not None:
-            batch = reader.poll_batch(batch_size)
-            if batch is None:
-                split = enumerator.next_split()
-                if split is not None:
-                    reader.add_split(split)
-                continue
-            values = batch.values
-            ts = batch.timestamps
-            if assigner is not None:
-                ts = np.asarray(
-                    [assigner(v, int(t)) for v, t in zip(values, ts)], dtype=np.int64
-                )
-            records_in += len(batch)
-            head.on_batch(values, ts)
-            if generator is not None:
-                wm = generator.on_batch_np(ts) if hasattr(generator, "on_batch_np") else None
-                if wm is None:
-                    for v, t in zip(values, ts):
-                        generator.on_event(v, int(t))
-                    wm = generator.on_periodic_emit()
-                if wm is not None and wm > MIN_WATERMARK:
-                    head.on_watermark(wm)
-        # end of input: watermark jumps to +inf, firing all remaining windows
-        head.on_watermark(MAX_WATERMARK - 1)
-        head.on_end()
+        runtime.run()
         runtime_ms = (time.perf_counter() - t0) * 1000
         return JobExecutionResult(
             job_name=job_name,
             runtime_ms=runtime_ms,
-            records_in=records_in,
-            metrics={"records_in": records_in},
+            records_in=runtime.records_in,
+            metrics={"records_in": runtime.records_in},
         )
