@@ -32,6 +32,7 @@ class CheckpointCoordinator:
         interval_ms: int,
         max_retained: int = 3,
         clock: Callable[[], float] = time.monotonic,
+        traces=None,
     ):
         self.storage = storage
         self.interval_s = interval_ms / 1000.0
@@ -41,6 +42,7 @@ class CheckpointCoordinator:
         self._next_id = 1
         self.num_completed = 0
         self._on_complete: List[Callable[[int], None]] = []
+        self.traces = traces  # TraceRegistry; checkpoint lifecycle spans (O2)
 
     def register_on_complete(self, fn: Callable[[int], None]) -> None:
         self._on_complete.append(fn)
@@ -58,6 +60,7 @@ class CheckpointCoordinator:
 
     def trigger(self, capture_fn: Callable[[], dict]) -> int:
         cid = self._next_id
+        span = self.traces.span("checkpointing", "Checkpoint") if self.traces else None
         data = capture_fn()
         data["checkpoint_id"] = cid
         self.storage.save(cid, data)
@@ -67,6 +70,8 @@ class CheckpointCoordinator:
         for fn in self._on_complete:
             fn(cid)
         self._retain()
+        if span is not None:
+            self.traces.report(span.set_attribute("checkpointId", cid).end())
         return cid
 
     def _retain(self) -> None:

@@ -1,0 +1,11 @@
+"""Observability: metrics, traces, latency tracking (reference layers O1-O4)."""
+
+from flink_tpu.metrics.registry import (
+    Counter,
+    Gauge,
+    Histogram,
+    Meter,
+    MetricGroup,
+    MetricRegistry,
+)
+from flink_tpu.metrics.traces import Span, SpanBuilder, TraceReporter, LoggingTraceReporter

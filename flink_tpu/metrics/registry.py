@@ -1,0 +1,228 @@
+"""Metric types, hierarchical groups, registry, reporters.
+
+Capability parity with the reference metrics stack (flink-metrics-core
+MetricGroup.java:37, runtime/metrics/MetricRegistryImpl.java:74, reporter
+modules under flink-metrics/*): Counter/Gauge/Meter/Histogram registered in
+scoped groups (job → task → operator), reported by pluggable reporters —
+Prometheus text exposition, logging, and an in-memory reporter for tests.
+The built-in runtime gauges (records in/out, busy/ingest time, watermark
+lag; TaskIOMetricGroup.java:48 analogue) are registered by the executor.
+"""
+
+from __future__ import annotations
+
+import math
+import threading
+import time
+from collections import deque
+from typing import Any, Callable, Dict, List, Optional
+
+
+class Counter:
+    def __init__(self):
+        self._value = 0
+
+    def inc(self, n: int = 1) -> None:
+        self._value += n
+
+    @property
+    def count(self) -> int:
+        return self._value
+
+    def value(self):
+        return self._value
+
+
+class Gauge:
+    def __init__(self, fn: Callable[[], Any]):
+        self._fn = fn
+
+    def value(self):
+        return self._fn()
+
+
+class Meter:
+    """Rate over a sliding 60s window + lifetime count (MeterView analogue)."""
+
+    def __init__(self, clock=time.monotonic):
+        self._clock = clock
+        self._events = deque()  # (t, n)
+        self._count = 0
+
+    def mark(self, n: int = 1) -> None:
+        now = self._clock()
+        self._events.append((now, n))
+        self._count += n
+        self._trim(now)
+
+    def _trim(self, now: float) -> None:
+        while self._events and now - self._events[0][0] > 60.0:
+            self._events.popleft()
+
+    @property
+    def count(self) -> int:
+        return self._count
+
+    def rate(self) -> float:
+        now = self._clock()
+        self._trim(now)
+        if not self._events:
+            return 0.0
+        span = max(now - self._events[0][0], 1e-9)
+        return sum(n for _, n in self._events) / span
+
+    def value(self):
+        return self.rate()
+
+
+class Histogram:
+    """Reservoir histogram with quantiles (DescriptiveStatisticsHistogram
+    analogue; bounded ring reservoir)."""
+
+    def __init__(self, size: int = 1024):
+        self._values = deque(maxlen=size)
+        self._count = 0
+
+    def update(self, value: float) -> None:
+        self._values.append(float(value))
+        self._count += 1
+
+    @property
+    def count(self) -> int:
+        return self._count
+
+    def quantile(self, q: float) -> float:
+        if not self._values:
+            return float("nan")
+        vals = sorted(self._values)
+        idx = min(int(q * len(vals)), len(vals) - 1)
+        return vals[idx]
+
+    def stats(self) -> Dict[str, float]:
+        if not self._values:
+            return {"count": 0}
+        vals = sorted(self._values)
+        return {
+            "count": self._count,
+            "min": vals[0],
+            "max": vals[-1],
+            "mean": sum(vals) / len(vals),
+            "p50": vals[len(vals) // 2],
+            "p95": vals[min(int(0.95 * len(vals)), len(vals) - 1)],
+            "p99": vals[min(int(0.99 * len(vals)), len(vals) - 1)],
+        }
+
+    def value(self):
+        return self.stats()
+
+
+class MetricGroup:
+    """Hierarchical scope (job.task.operator...) registering named metrics."""
+
+    def __init__(self, registry: "MetricRegistry", scope: tuple):
+        self._registry = registry
+        self.scope = scope
+
+    def add_group(self, name: str) -> "MetricGroup":
+        return MetricGroup(self._registry, self.scope + (name,))
+
+    def counter(self, name: str) -> Counter:
+        return self._registry._register(self.scope, name, Counter())
+
+    def gauge(self, name: str, fn: Callable[[], Any]) -> Gauge:
+        return self._registry._register(self.scope, name, Gauge(fn))
+
+    def meter(self, name: str) -> Meter:
+        return self._registry._register(self.scope, name, Meter())
+
+    def histogram(self, name: str, size: int = 1024) -> Histogram:
+        return self._registry._register(self.scope, name, Histogram(size))
+
+    def metric_identifier(self, name: str) -> str:
+        return ".".join(self.scope + (name,))
+
+
+class MetricRegistry:
+    def __init__(self):
+        self._metrics: Dict[str, Any] = {}
+        self._lock = threading.Lock()
+        self._reporters: List["Reporter"] = []
+
+    def group(self, *scope: str) -> MetricGroup:
+        return MetricGroup(self, tuple(scope))
+
+    def _register(self, scope: tuple, name: str, metric):
+        key = ".".join(scope + (name,))
+        with self._lock:
+            existing = self._metrics.get(key)
+            if existing is not None and type(existing) is type(metric):
+                return existing
+            self._metrics[key] = metric
+        return metric
+
+    def all_metrics(self) -> Dict[str, Any]:
+        with self._lock:
+            return dict(self._metrics)
+
+    def add_reporter(self, reporter: "Reporter") -> None:
+        self._reporters.append(reporter)
+
+    def report(self) -> None:
+        snapshot = self.all_metrics()
+        for r in self._reporters:
+            r.report(snapshot)
+
+
+class Reporter:
+    def report(self, metrics: Dict[str, Any]) -> None:
+        raise NotImplementedError
+
+
+class InMemoryReporter(Reporter):
+    def __init__(self):
+        self.last: Dict[str, Any] = {}
+
+    def report(self, metrics: Dict[str, Any]) -> None:
+        self.last = {k: m.value() for k, m in metrics.items()}
+
+
+class LoggingReporter(Reporter):
+    def __init__(self, logger=None):
+        import logging
+
+        self._log = logger or logging.getLogger("flink_tpu.metrics")
+
+    def report(self, metrics: Dict[str, Any]) -> None:
+        for k, m in sorted(metrics.items()):
+            self._log.info("%s = %s", k, m.value())
+
+
+def prometheus_text(metrics: Dict[str, Any]) -> str:
+    """Prometheus text exposition format (flink-metrics-prometheus
+    PrometheusReporter analogue — here as an encoding; the REST server
+    exposes it at /metrics)."""
+
+    def sanitize(name: str) -> str:
+        return "".join(c if c.isalnum() or c == "_" else "_" for c in name)
+
+    lines = []
+    for key, metric in sorted(metrics.items()):
+        name = sanitize(key)
+        val = metric.value()
+        if isinstance(metric, Histogram):
+            for stat, v in val.items():
+                if not (isinstance(v, float) and math.isnan(v)):
+                    lines.append(f'{name}{{stat="{stat}"}} {v}')
+        elif isinstance(val, (int, float)) and not isinstance(val, bool):
+            lines.append(f"{name} {val}")
+    return "\n".join(lines) + "\n"
+
+
+class PrometheusReporter(Reporter):
+    """Holds the latest exposition text; served by the REST endpoint."""
+
+    def __init__(self):
+        self.text = ""
+
+    def report(self, metrics: Dict[str, Any]) -> None:
+        self.text = prometheus_text(metrics)

@@ -1,0 +1,86 @@
+"""Spans and trace reporting (reference: flink-metrics-core traces/Span.java,
+SpanBuilder.java, reporter/TraceReporter.java; used by checkpoint/recovery
+lifecycles via DefaultCheckpointStatsTracker).
+
+Checkpoint trigger/complete and job restart paths emit spans; reporters are
+pluggable (logging, in-memory; OTel-wire export would slot in the same SPI)."""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Any, Dict, List, Optional
+
+
+@dataclasses.dataclass
+class Span:
+    scope: str
+    name: str
+    start_ts_ms: float
+    end_ts_ms: float
+    attributes: Dict[str, Any]
+
+    @property
+    def duration_ms(self) -> float:
+        return self.end_ts_ms - self.start_ts_ms
+
+
+class SpanBuilder:
+    def __init__(self, scope: str, name: str, clock=time.time):
+        self._scope = scope
+        self._name = name
+        self._clock = clock
+        self._start = clock() * 1000
+        self._end: Optional[float] = None
+        self._attrs: Dict[str, Any] = {}
+
+    def set_attribute(self, key: str, value) -> "SpanBuilder":
+        self._attrs[key] = value
+        return self
+
+    def set_start(self, ts_ms: float) -> "SpanBuilder":
+        self._start = ts_ms
+        return self
+
+    def end(self) -> Span:
+        return Span(self._scope, self._name, self._start, self._clock() * 1000, dict(self._attrs))
+
+
+class TraceReporter:
+    def report_span(self, span: Span) -> None:
+        raise NotImplementedError
+
+
+class InMemoryTraceReporter(TraceReporter):
+    def __init__(self):
+        self.spans: List[Span] = []
+
+    def report_span(self, span: Span) -> None:
+        self.spans.append(span)
+
+
+class LoggingTraceReporter(TraceReporter):
+    def __init__(self, logger=None):
+        import logging
+
+        self._log = logger or logging.getLogger("flink_tpu.traces")
+
+    def report_span(self, span: Span) -> None:
+        self._log.info(
+            "span %s/%s %.2fms %s", span.scope, span.name, span.duration_ms, span.attributes
+        )
+
+
+class TraceRegistry:
+    def __init__(self):
+        self._reporters: List[TraceReporter] = []
+
+    def add_reporter(self, reporter: TraceReporter) -> None:
+        self._reporters.append(reporter)
+
+    def span(self, scope: str, name: str) -> SpanBuilder:
+        return SpanBuilder(scope, name)
+
+    def report(self, span: Span) -> None:
+        for r in self._reporters:
+            r.report_span(span)

@@ -33,6 +33,7 @@ from flink_tpu.ops.aggregators import resolve
 from flink_tpu.runtime.oracle_window_operator import OracleWindowOperator
 from flink_tpu.runtime.tpu_window_operator import TpuWindowOperator
 from flink_tpu.runtime.timers import InternalTimerService
+from flink_tpu.metrics.registry import MetricRegistry
 from flink_tpu.state.heap import HeapKeyedStateBackend, value_state
 from flink_tpu.utils.arrays import obj_array
 from flink_tpu.core.keygroups import KeyGroupRange
@@ -52,6 +53,10 @@ class JobExecutionResult:
 
 class StepRunner:
     downstream: Optional["StepRunner"] = None
+
+    def register_metrics(self, group) -> None:
+        # operator-scope IO metrics (TaskIOMetricGroup.java:48 analogue)
+        self.records_in_counter = group.counter("numRecordsIn")
 
     def on_batch(self, values: np.ndarray, timestamps: np.ndarray) -> None:
         raise NotImplementedError
@@ -186,6 +191,18 @@ class WindowStepRunner(StepRunner):
             )
             ts = np.asarray([t for (_k, _w, _r, t) in out], dtype=np.int64)
             self.downstream.on_batch(vals, ts)
+
+    def register_metrics(self, group) -> None:
+        super().register_metrics(group)
+        group.gauge("numLateRecordsDropped", lambda: self.op.num_late_records_dropped)
+        group.gauge(
+            "currentWatermark",
+            lambda: getattr(
+                self.op,
+                "current_watermark",
+                getattr(getattr(self.op, "timer_service", None), "current_watermark", 0),
+            ),
+        )
 
     def snapshot(self) -> dict:
         return {"operator": self.op.snapshot()}
@@ -336,6 +353,13 @@ def build_runners(graph: StepGraph, config: Configuration) -> List[StepRunner]:
     return runners
 
 
+def register_runner_metrics(runners: List[StepRunner], registry: MetricRegistry) -> None:
+    for i, r in enumerate(runners):
+        r.register_metrics(
+            registry.group("job", "operator", getattr(r, "uid", f"chain-{i}"))
+        )
+
+
 class JobCancelledException(Exception):
     pass
 
@@ -345,7 +369,8 @@ class JobRuntime:
     checkpoint-capture/restore surface (task-side checkpointing, §3.4
     analogue — here capture happens between steps so alignment is free)."""
 
-    def __init__(self, graph: StepGraph, config: Configuration):
+    def __init__(self, graph: StepGraph, config: Configuration,
+                 registry: Optional[MetricRegistry] = None):
         self.graph = graph
         self.config = config
         source_cfg = graph.source.config
@@ -360,6 +385,16 @@ class JobRuntime:
         self.current_split = None
         self.records_in = 0
         self.source_done = False
+        # observability (O1/O3): job-scope throughput, busy-ratio, step latency
+        self.registry = registry or MetricRegistry()
+        register_runner_metrics(self.runners, self.registry)
+        job_group = self.registry.group("job")
+        self.records_meter = job_group.meter("numRecordsInPerSecond")
+        self.step_latency = job_group.histogram("stepLatencyMs")
+        self._busy_time = 0.0
+        self._loop_time = 1e-9
+        job_group.gauge("busyTimeRatio", lambda: self._busy_time / self._loop_time)
+        job_group.gauge("numRecordsIn", lambda: self.records_in)
 
     # -- checkpoint surface ----------------------------------------------
     def capture(self) -> dict:
@@ -419,6 +454,7 @@ class JobRuntime:
                 self.source_done = True
 
         while not self.source_done:
+            loop_t0 = time.perf_counter()
             if cancel_check is not None and cancel_check():
                 raise JobCancelledException()
             batch = self.reader.poll_batch(batch_size)
@@ -428,6 +464,7 @@ class JobRuntime:
                     self.source_done = True
                     break
                 self.reader.add_split(self.current_split)
+                self._loop_time += time.perf_counter() - loop_t0
                 continue
             values = batch.values
             ts = batch.timestamps
@@ -436,6 +473,8 @@ class JobRuntime:
                     [self.assigner(v, int(t)) for v, t in zip(values, ts)], dtype=np.int64
                 )
             self.records_in += len(batch)
+            self.records_meter.mark(len(batch))
+            busy_t0 = time.perf_counter()
             self.head.on_batch(values, ts)
             if self.generator is not None:
                 wm = (
@@ -449,6 +488,9 @@ class JobRuntime:
                     wm = self.generator.on_periodic_emit()
                 if wm is not None and wm > MIN_WATERMARK:
                     self.head.on_watermark(wm)
+            step_dt = time.perf_counter() - busy_t0
+            self._busy_time += step_dt
+            self.step_latency.update(step_dt * 1000)
             # step boundary: checkpoints/savepoints align here for free
             if coordinator is not None:
                 coordinator.maybe_trigger(self.capture)
@@ -456,6 +498,7 @@ class JobRuntime:
                 path = savepoint_request()
                 if path is not None:
                     self._write_savepoint(path)
+            self._loop_time += time.perf_counter() - loop_t0
 
         # end of input: watermark jumps to +inf, firing all remaining windows
         self.head.on_watermark(MAX_WATERMARK - 1)

@@ -136,12 +136,20 @@ class MiniCluster:
         config: Configuration,
         savepoint_restore_path: Optional[str],
     ) -> None:
+        from flink_tpu.metrics.registry import MetricRegistry
+        from flink_tpu.metrics.traces import TraceRegistry
+
+        client.metrics = MetricRegistry()
+        client.traces = TraceRegistry()
         interval = config.get(CheckpointingOptions.INTERVAL_MS)
         chk_dir = config.get(CheckpointingOptions.DIRECTORY)
         storage = FsCheckpointStorage(chk_dir) if chk_dir else MemoryCheckpointStorage()
         coordinator = (
             CheckpointCoordinator(
-                storage, interval, config.get(CheckpointingOptions.MAX_RETAINED)
+                storage,
+                interval,
+                config.get(CheckpointingOptions.MAX_RETAINED),
+                traces=client.traces,
             )
             if interval > 0
             else None
@@ -162,7 +170,7 @@ class MiniCluster:
             restore_snap = sp_storage.load(latest[1])
 
         while True:
-            runtime = JobRuntime(graph, config)
+            runtime = JobRuntime(graph, config, registry=client.metrics)
             try:
                 if restore_snap is not None:
                     runtime.restore(restore_snap)

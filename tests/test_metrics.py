@@ -1,0 +1,110 @@
+"""Observability tests: metric types, registry/groups, reporters, runtime
+gauges, checkpoint spans (reference O1-O3)."""
+
+import time
+
+import numpy as np
+
+from flink_tpu.api.datastream import StreamExecutionEnvironment
+from flink_tpu.api.windowing.assigners import TumblingEventTimeWindows
+from flink_tpu.checkpoint.coordinator import CheckpointCoordinator
+from flink_tpu.checkpoint.storage import MemoryCheckpointStorage
+from flink_tpu.config import CheckpointingOptions, Configuration, ExecutionOptions
+from flink_tpu.connectors.source import Batch, DataGeneratorSource
+from flink_tpu.core.watermarks import WatermarkStrategy
+from flink_tpu.metrics.registry import (
+    Counter,
+    Histogram,
+    InMemoryReporter,
+    Meter,
+    MetricRegistry,
+    prometheus_text,
+)
+from flink_tpu.metrics.traces import InMemoryTraceReporter, TraceRegistry
+from flink_tpu.runtime.minicluster import JobStatus, MiniCluster
+from flink_tpu.utils.arrays import obj_array
+
+
+def test_metric_types():
+    c = Counter()
+    c.inc()
+    c.inc(4)
+    assert c.count == 5
+
+    clock = [0.0]
+    m = Meter(clock=lambda: clock[0])
+    m.mark(10)
+    clock[0] = 2.0
+    m.mark(10)
+    assert m.count == 20
+    assert m.rate() == 10.0  # 20 events over 2s
+
+    h = Histogram(size=100)
+    for i in range(100):
+        h.update(i)
+    stats = h.stats()
+    assert stats["min"] == 0 and stats["max"] == 99
+    assert 45 <= stats["p50"] <= 55
+    assert stats["p99"] >= 95
+
+
+def test_registry_groups_and_prometheus():
+    reg = MetricRegistry()
+    g = reg.group("job", "operator", "window@2")
+    c = g.counter("numRecordsIn")
+    c.inc(7)
+    g.gauge("watermark", lambda: 123)
+    rep = InMemoryReporter()
+    reg.add_reporter(rep)
+    reg.report()
+    assert rep.last["job.operator.window@2.numRecordsIn"] == 7
+    assert rep.last["job.operator.window@2.watermark"] == 123
+    text = prometheus_text(reg.all_metrics())
+    assert "job_operator_window_2_numRecordsIn 7" in text
+
+    # re-registration returns the same metric (idempotent)
+    assert reg.group("job", "operator", "window@2").counter("numRecordsIn") is c
+
+
+def test_runtime_metrics_and_checkpoint_spans(tmp_path):
+    config = Configuration()
+    config.set(ExecutionOptions.BATCH_SIZE, 100)
+    config.set(CheckpointingOptions.INTERVAL_MS, 1)
+    config.set(CheckpointingOptions.DIRECTORY, str(tmp_path / "chk"))
+
+    def gen(idx: np.ndarray) -> Batch:
+        values = [(int(i % 5), 1.0, int(i * 10)) for i in idx]
+        return Batch(obj_array(values), (idx * 10).astype(np.int64))
+
+    env = StreamExecutionEnvironment(config)
+    stream = env.from_source(
+        DataGeneratorSource(gen, count=1000),
+        watermark_strategy=WatermarkStrategy.for_monotonous_timestamps(),
+    )
+    sink = (
+        stream.key_by(lambda x: x[0])
+        .window(TumblingEventTimeWindows.of(1000))
+        .count()
+        .collect()
+    )
+    client = env.execute_async("metrics-job")
+    spans = InMemoryTraceReporter()
+    # traces registry is created by the cluster; attach reporter promptly
+    deadline = time.time() + 10
+    while not hasattr(client, "traces") and time.time() < deadline:
+        time.sleep(0.005)
+    client.traces.add_reporter(spans)
+    assert client.wait(60) == JobStatus.FINISHED
+
+    rep = InMemoryReporter()
+    client.metrics.add_reporter(rep)
+    client.metrics.report()
+    assert rep.last["job.numRecordsIn"] == 1000
+    assert rep.last["job.numRecordsInPerSecond"] > 0
+    assert 0 < rep.last["job.busyTimeRatio"] <= 1.0
+    win_key = next(k for k in rep.last if k.endswith("numLateRecordsDropped"))
+    assert rep.last[win_key] == 0
+    assert rep.last["job.stepLatencyMs"]["count"] >= 10
+    # checkpoint spans were reported (attached early enough to catch some)
+    assert any(s.name == "Checkpoint" for s in spans.spans)
+    assert all(s.duration_ms >= 0 for s in spans.spans)
