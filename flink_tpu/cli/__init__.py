@@ -1,0 +1,3 @@
+"""Command-line client (reference: flink-clients CliFrontend.java:93)."""
+
+from flink_tpu.cli.frontend import main

@@ -1,0 +1,3 @@
+from flink_tpu.cli.frontend import main
+
+raise SystemExit(main())

@@ -1,0 +1,223 @@
+"""REST endpoint + minimal web dashboard.
+
+Capability parity with the reference's web monitor / REST stack
+(runtime/rest handlers, WebMonitorEndpoint.java:224, RestClusterClient
+submission, the Angular dashboard O5 — here a dependency-free single-page
+view). Endpoints:
+
+  GET  /                      → HTML dashboard (jobs + metrics, auto-refresh)
+  GET  /overview              → cluster overview JSON
+  GET  /jobs                  → [{id, name, status}]
+  GET  /jobs/<id>             → job detail JSON
+  PATCH/POST /jobs/<id>/cancel→ cancel
+  POST /jobs/<id>/savepoints  → {"target-directory": dir} → trigger savepoint
+  GET  /jobs/<id>/metrics     → metrics JSON
+  GET  /metrics               → Prometheus text exposition (all jobs)
+  POST /jars/run              → {"module": "/path/script.py", "entry": "main"}
+                                application-mode submission: the script builds
+                                an env and returns it (or calls execute_async)
+
+Implementation: stdlib http.server (threaded), JSON payloads.
+"""
+
+from __future__ import annotations
+
+import importlib.util
+import json
+import threading
+import uuid
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+
+from flink_tpu.metrics.registry import prometheus_text
+from flink_tpu.runtime.minicluster import JobStatus, MiniCluster
+
+
+_DASHBOARD_HTML = """<!DOCTYPE html>
+<html><head><title>flink-tpu dashboard</title>
+<meta http-equiv="refresh" content="2">
+<style>
+ body { font-family: monospace; margin: 2em; background:#101418; color:#d8dee9; }
+ table { border-collapse: collapse; margin-top: 1em; }
+ td, th { border: 1px solid #3b4252; padding: 6px 12px; text-align: left; }
+ th { background: #2e3440; }
+ .RUNNING { color: #a3be8c; } .FINISHED { color: #81a1c1; }
+ .FAILED { color: #bf616a; } .CANCELED, .RESTARTING { color: #ebcb8b; }
+ h1 { font-size: 1.3em; }
+</style></head>
+<body>
+<h1>flink-tpu — streaming on TPU</h1>
+<div id="overview">{overview}</div>
+<table><tr><th>job id</th><th>name</th><th>status</th><th>records in</th>
+<th>restarts</th></tr>{rows}</table>
+</body></html>"""
+
+
+def _job_row(client) -> str:
+    return (
+        f"<tr><td>{client.job_id}</td><td>{client.job_name}</td>"
+        f"<td class='{client.status().value}'>{client.status().value}</td>"
+        f"<td>{client.records_in}</td><td>{client.num_restarts}</td></tr>"
+    )
+
+
+class _Handler(BaseHTTPRequestHandler):
+    cluster: MiniCluster = None  # set by RestServer
+
+    # -- plumbing ---------------------------------------------------------
+    def log_message(self, fmt, *args):  # quiet
+        pass
+
+    def _send(self, code: int, body: bytes, content_type="application/json"):
+        self.send_response(code)
+        self.send_header("Content-Type", content_type)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _json(self, code: int, obj):
+        self._send(code, json.dumps(obj).encode())
+
+    def _read_body(self) -> dict:
+        length = int(self.headers.get("Content-Length", 0))
+        if length == 0:
+            return {}
+        return json.loads(self.rfile.read(length))
+
+    def _job(self, job_id: str):
+        return self.cluster.jobs.get(job_id)
+
+    # -- GET --------------------------------------------------------------
+    def do_GET(self):
+        parts = [p for p in self.path.split("?")[0].split("/") if p]
+        if not parts:
+            rows = "".join(_job_row(c) for c in self.cluster.jobs.values())
+            overview = f"{len(self.cluster.jobs)} jobs"
+            html = _DASHBOARD_HTML.replace("{rows}", rows).replace("{overview}", overview)
+            return self._send(200, html.encode(), "text/html")
+        if parts == ["overview"]:
+            by_status = {}
+            for c in self.cluster.jobs.values():
+                by_status[c.status().value] = by_status.get(c.status().value, 0) + 1
+            return self._json(200, {"jobs": len(self.cluster.jobs), "by_status": by_status})
+        if parts == ["jobs"]:
+            return self._json(
+                200,
+                {
+                    "jobs": [
+                        {"id": c.job_id, "name": c.job_name, "status": c.status().value}
+                        for c in self.cluster.jobs.values()
+                    ]
+                },
+            )
+        if parts == ["metrics"]:
+            text = ""
+            for c in self.cluster.jobs.values():
+                if hasattr(c, "metrics"):
+                    text += prometheus_text(c.metrics.all_metrics())
+            return self._send(200, text.encode(), "text/plain; version=0.0.4")
+        if len(parts) >= 2 and parts[0] == "jobs":
+            client = self._job(parts[1])
+            if client is None:
+                return self._json(404, {"error": f"unknown job {parts[1]}"})
+            if len(parts) == 2:
+                return self._json(
+                    200,
+                    {
+                        "id": client.job_id,
+                        "name": client.job_name,
+                        "status": client.status().value,
+                        "records_in": client.records_in,
+                        "num_restarts": client.num_restarts,
+                        "error": repr(client.error) if client.error else None,
+                    },
+                )
+            if parts[2] == "metrics":
+                if not hasattr(client, "metrics"):
+                    return self._json(200, {})
+                out = {}
+                for k, m in client.metrics.all_metrics().items():
+                    v = m.value()
+                    out[k] = v if isinstance(v, (int, float, dict)) else str(v)
+                return self._json(200, out)
+        self._json(404, {"error": f"no route {self.path}"})
+
+    # -- POST/PATCH -------------------------------------------------------
+    def do_POST(self):
+        parts = [p for p in self.path.split("?")[0].split("/") if p]
+        if parts == ["jars", "run"]:
+            body = self._read_body()
+            try:
+                client = _run_application(self.cluster, body["module"], body.get("entry", "main"))
+            except Exception as e:  # noqa: BLE001 — surface to caller
+                return self._json(400, {"error": repr(e)})
+            return self._json(200, {"jobid": client.job_id})
+        if len(parts) == 3 and parts[0] == "jobs":
+            client = self._job(parts[1])
+            if client is None:
+                return self._json(404, {"error": f"unknown job {parts[1]}"})
+            if parts[2] == "cancel":
+                client.cancel()
+                return self._json(202, {"status": "cancelling"})
+            if parts[2] == "savepoints":
+                body = self._read_body()
+                target = body.get("target-directory")
+                if not target:
+                    return self._json(400, {"error": "target-directory required"})
+                try:
+                    path = client.trigger_savepoint(target)
+                except TimeoutError as e:
+                    return self._json(409, {"error": str(e)})
+                return self._json(200, {"location": path})
+        self._json(404, {"error": f"no route {self.path}"})
+
+    do_PATCH = do_POST
+
+
+def _run_application(cluster: MiniCluster, module_path: str, entry: str):
+    """Application-mode submission: import the script, call its entry — the
+    entry must return a JobClient (via env.execute_async()) or a
+    StreamExecutionEnvironment (which we then submit)."""
+    spec = importlib.util.spec_from_file_location(f"flink_tpu_app_{uuid.uuid4().hex}", module_path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    fn = getattr(mod, entry)
+    result = fn()
+    from flink_tpu.api.datastream import StreamExecutionEnvironment
+    from flink_tpu.graph.transformation import plan
+    from flink_tpu.runtime.minicluster import JobClient
+
+    if isinstance(result, JobClient):
+        cluster.jobs.setdefault(result.job_id, result)
+        return result
+    if isinstance(result, StreamExecutionEnvironment):
+        if len(result._sinks) != 1:
+            raise RuntimeError("application must define exactly one sink")
+        return cluster.submit(plan(result._sinks[0]), result.config)
+    raise TypeError(f"{entry}() must return JobClient or StreamExecutionEnvironment")
+
+
+class RestServer:
+    """Threaded REST server bound to a MiniCluster (WebMonitorEndpoint)."""
+
+    def __init__(self, cluster: Optional[MiniCluster] = None, port: int = 0):
+        self.cluster = cluster or MiniCluster.get_shared()
+        handler = type("BoundHandler", (_Handler,), {"cluster": self.cluster})
+        self._httpd = ThreadingHTTPServer(("127.0.0.1", port), handler)
+        self.port = self._httpd.server_port
+        self._thread: Optional[threading.Thread] = None
+
+    @property
+    def url(self) -> str:
+        return f"http://127.0.0.1:{self.port}"
+
+    def start(self) -> "RestServer":
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, name="rest-server", daemon=True
+        )
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._httpd.shutdown()
+        self._httpd.server_close()

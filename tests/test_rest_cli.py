@@ -1,0 +1,157 @@
+"""REST endpoint + CLI tests (reference: REST handlers + CliFrontend)."""
+
+import json
+import textwrap
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+from flink_tpu.runtime.minicluster import JobStatus, MiniCluster
+from flink_tpu.runtime.rest import RestServer
+
+
+@pytest.fixture()
+def cluster_server():
+    cluster = MiniCluster()
+    server = RestServer(cluster).start()
+    yield cluster, server
+    server.stop()
+
+
+def _get(url):
+    with urllib.request.urlopen(url, timeout=30) as r:
+        return r.status, r.read()
+
+
+def _post(url, body=None):
+    data = json.dumps(body).encode() if body is not None else b""
+    req = urllib.request.Request(url, data=data, method="POST")
+    req.add_header("Content-Type", "application/json")
+    with urllib.request.urlopen(req, timeout=30) as r:
+        return r.status, json.loads(r.read())
+
+
+def _app_script(tmp_path, count=500, sleep=0.0):
+    script = tmp_path / "app.py"
+    script.write_text(textwrap.dedent(f"""
+        import time
+        import numpy as np
+        from flink_tpu.api.datastream import StreamExecutionEnvironment
+        from flink_tpu.api.windowing.assigners import TumblingEventTimeWindows
+        from flink_tpu.config import Configuration, ExecutionOptions
+        from flink_tpu.connectors.sink import CollectSink
+        from flink_tpu.connectors.source import Batch, DataGeneratorSource
+        from flink_tpu.core.watermarks import WatermarkStrategy
+        from flink_tpu.utils.arrays import obj_array
+
+        def gen(idx):
+            time.sleep({sleep})
+            values = [(int(i % 3), 1.0, int(i * 10)) for i in idx]
+            return Batch(obj_array(values), (idx * 10).astype(np.int64))
+
+        def main():
+            config = Configuration()
+            config.set(ExecutionOptions.BATCH_SIZE, 50)
+            env = StreamExecutionEnvironment(config)
+            stream = env.from_source(
+                DataGeneratorSource(gen, count={count}),
+                watermark_strategy=WatermarkStrategy.for_monotonous_timestamps(),
+            )
+            (stream.key_by(lambda x: x[0])
+                .window(TumblingEventTimeWindows.of(1000))
+                .count()
+                .sink_to(CollectSink()))
+            return env
+    """))
+    return str(script)
+
+
+def test_rest_submit_list_info_metrics(cluster_server, tmp_path):
+    cluster, server = cluster_server
+    status, out = _post(f"{server.url}/jars/run", {"module": _app_script(tmp_path)})
+    assert status == 200
+    job_id = out["jobid"]
+
+    client = cluster.jobs[job_id]
+    assert client.wait(60) == JobStatus.FINISHED
+
+    status, body = _get(f"{server.url}/jobs")
+    jobs = json.loads(body)["jobs"]
+    assert any(j["id"] == job_id and j["status"] == "FINISHED" for j in jobs)
+
+    status, body = _get(f"{server.url}/jobs/{job_id}")
+    detail = json.loads(body)
+    assert detail["records_in"] == 500
+    assert detail["error"] is None
+
+    status, body = _get(f"{server.url}/jobs/{job_id}/metrics")
+    metrics = json.loads(body)
+    assert metrics["job.numRecordsIn"] == 500
+
+    status, body = _get(f"{server.url}/metrics")
+    assert b"job_numRecordsIn 500" in body
+
+    status, body = _get(f"{server.url}/overview")
+    assert json.loads(body)["by_status"]["FINISHED"] >= 1
+
+    status, body = _get(server.url + "/")
+    assert b"flink-tpu" in body and job_id.encode() in body
+
+
+def test_rest_cancel_and_savepoint(cluster_server, tmp_path):
+    cluster, server = cluster_server
+    status, out = _post(
+        f"{server.url}/jars/run", {"module": _app_script(tmp_path, count=50_000, sleep=0.01)}
+    )
+    job_id = out["jobid"]
+    client = cluster.jobs[job_id]
+    deadline = time.time() + 30
+    while client.records_in < 200 and time.time() < deadline:
+        time.sleep(0.01)
+
+    status, out = _post(
+        f"{server.url}/jobs/{job_id}/savepoints",
+        {"target-directory": str(tmp_path / "sp")},
+    )
+    assert status == 200
+    assert (tmp_path / "sp").exists()
+
+    status, out = _post(f"{server.url}/jobs/{job_id}/cancel")
+    assert status == 202
+    assert client.wait(30) == JobStatus.CANCELED
+
+
+def test_rest_404s(cluster_server):
+    _cluster, server = cluster_server
+    with pytest.raises(urllib.error.HTTPError) as e:
+        _get(f"{server.url}/jobs/nonexistent")
+    assert e.value.code == 404
+
+
+def test_cli_embedded_run(tmp_path, capsys):
+    from flink_tpu.cli.frontend import main
+
+    rc = main(["run", _app_script(tmp_path), "--entry", "main"])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "finished with status FINISHED" in out
+
+
+def test_cli_against_rest(cluster_server, tmp_path, capsys):
+    _cluster, server = cluster_server
+    from flink_tpu.cli.frontend import main
+
+    rc = main(["run", _app_script(tmp_path), "--address", server.url])
+    assert rc == 0
+    job_id = json.loads(capsys.readouterr().out)["jobid"]
+
+    rc = main(["list", "--address", server.url])
+    assert rc == 0
+    assert job_id in capsys.readouterr().out
+
+    time.sleep(0.3)
+    rc = main(["info", job_id, "--address", server.url])
+    assert rc == 0
+    assert '"status"' in capsys.readouterr().out
