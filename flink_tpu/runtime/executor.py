@@ -162,7 +162,11 @@ class WindowStepRunner(StepRunner):
 
     def on_batch(self, values: np.ndarray, timestamps: np.ndarray) -> None:
         if self.device:
-            keys = obj_array([self.key_selector(v) for v in values])
+            raw_keys = [self.key_selector(v) for v in values]
+            # typed key columns (int/str) unlock the native C++ dictionary
+            keys = np.asarray(raw_keys)
+            if keys.ndim != 1 or keys.dtype.kind not in "iuUS":
+                keys = obj_array(raw_keys)
             if self._needs_value:
                 nums = np.asarray([self.value_fn(v) for v in values], dtype=np.float32)
             else:  # pure-count aggregates ignore the value column

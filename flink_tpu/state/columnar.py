@@ -26,12 +26,19 @@ from flink_tpu.ops import segment_ops
 class KeyDictionary:
     """Raw key -> dense row id. `dense_int` mode skips the dict entirely for
     pre-densified integer keys (sources that emit key ids, e.g. benchmark
-    generators and the keyBy shuffle's re-densified output)."""
+    generators and the keyBy shuffle's re-densified output).
+
+    Batches of int64 or string keys take the native C++ open-addressing path
+    (native/flink_tpu_native.cpp KeyDict via utils/native_bridge) — the host
+    equivalent of the reference's native state-store key handling; arbitrary
+    Python keys fall back to a dict loop."""
 
     def __init__(self, dense_int: bool = False):
         self.dense_int = dense_int
         self._map: Dict[Any, int] = {}
         self._keys: List[Any] = []
+        self._native = None
+        self._native_mode: str = ""  # '', 'i64', 'bytes', 'off' (fallback)
 
     def __len__(self) -> int:
         return len(self._keys)
@@ -39,6 +46,59 @@ class KeyDictionary:
     @property
     def num_ids(self) -> int:
         return len(self._keys)
+
+    # -- native fast paths -------------------------------------------------
+    def _try_native(self, keys: np.ndarray):
+        """Returns (ids, size) via the C++ dict, or None to fall back."""
+        if self._native_mode == "off":
+            return None
+        from flink_tpu.utils import native_bridge
+
+        as_i64 = as_bytes = None
+        if keys.dtype != object and np.issubdtype(keys.dtype, np.integer):
+            mode, as_i64 = "i64", np.ascontiguousarray(keys, dtype=np.int64)
+        else:
+            try:
+                as_bytes = np.asarray(keys, dtype=np.bytes_)
+                mode = "bytes"
+            except (TypeError, UnicodeEncodeError, ValueError):
+                return None
+        if self._native_mode and self._native_mode != mode:
+            return None  # mixed key types: stay on the generic path
+        if self._native is None:
+            if native_bridge.get_lib() is None:
+                self._native_mode = "off"
+                return None
+            self._native = native_bridge.NativeKeyDict(string_mode=(mode == "bytes"))
+            self._native_mode = mode
+            if self._keys:  # restore path: re-seed the table in id order
+                if mode == "i64":
+                    self._native.lookup_or_insert_i64(
+                        np.asarray(self._keys, dtype=np.int64)
+                    )
+                else:
+                    seed = np.asarray(self._keys, dtype=np.bytes_)
+                    self._bytes_width = max(((seed.dtype.itemsize + 7) // 8) * 8, 24)
+                    self._native.lookup_or_insert_bytes(
+                        seed.astype(f"S{self._bytes_width}")
+                    )
+        if mode == "i64":
+            ids, new, size = self._native.lookup_or_insert_i64(as_i64)
+        else:
+            # fixed-width byte keys: one width for the dictionary's lifetime
+            # (padding with NULs is consistent; a longer key than the chosen
+            # width cannot be represented -> permanent fallback)
+            if not hasattr(self, "_bytes_width"):
+                self._bytes_width = max(((as_bytes.dtype.itemsize + 7) // 8) * 8, 24)
+            if as_bytes.dtype.itemsize > self._bytes_width:
+                self._native_mode = "off"
+                self._native = None
+                return None
+            as_bytes = as_bytes.astype(f"S{self._bytes_width}")
+            ids, new, size = self._native.lookup_or_insert_bytes(as_bytes)
+        if new.any():
+            self._keys.extend(keys[new])
+        return ids, size
 
     def lookup_or_insert(self, keys: np.ndarray) -> Tuple[np.ndarray, int]:
         """Map a batch of raw keys to dense ids, inserting unseen keys.
@@ -49,7 +109,12 @@ class KeyDictionary:
             if hi > len(self._keys):
                 self._keys.extend(range(len(self._keys), hi))
             return ids, len(self._keys)
+        native = self._try_native(keys)
+        if native is not None:
+            return native[0], native[1]
         m = self._map
+        if not m and self._keys:  # fell back after native use: rebuild map
+            m = self._map = {k: i for i, k in enumerate(self._keys)}
         out = np.empty(len(keys), dtype=np.int32)
         for i, k in enumerate(keys):
             kid = m.get(k)

@@ -1,0 +1,265 @@
+// Native host-runtime components for flink_tpu.
+//
+// The reference keeps its hot host-side structures native: off-heap
+// MemorySegments (flink-core .../core/memory/MemorySegment.java:70), the
+// Netty buffer pool (NetworkBufferPool.java:63), JNI LSM state stores
+// (frocksdbjni / forstjni), and Cython record coders
+// (flink-python fn_execution/coder_impl_fast.pyx). This module provides the
+// TPU-native equivalents for the host half of the pipeline — everything
+// between the wire/file format and the device arrays:
+//
+//   1. KeyDict     — batch open-addressing key dictionary: raw keys -> dense
+//                    device row ids (the host half of keyBy; the device half
+//                    is the all-to-all in ops/exchange.py).
+//   2. csv codec   — delimited text -> columnar (int64 key-ish column,
+//                    double value column, int64 timestamp column).
+//   3. SegmentRing — fixed-size-segment SPSC ring: bounded ingest queue
+//                    between a producer (network/file thread) and the step
+//                    loop; "no free segment" is the backpressure signal
+//                    (LocalBufferPool exhaustion analogue).
+//
+// C ABI only (ctypes binding in flink_tpu/utils/native_bridge.py).
+
+#include <cstdint>
+#include <cstdlib>
+#include <cstring>
+#include <vector>
+
+extern "C" {
+
+// ===========================================================================
+// 1. KeyDict
+// ===========================================================================
+
+struct KeyDict {
+  // open addressing, power-of-two capacity, linear probing
+  std::vector<int64_t> hashes;     // slot -> key hash (or EMPTY)
+  std::vector<int32_t> ids;        // slot -> dense id
+  std::vector<int64_t> int_keys;   // id -> int key (int mode)
+  std::vector<int64_t> str_off;    // id -> offset into arena (str mode)
+  std::vector<int32_t> str_len;    // id -> length
+  std::vector<char> arena;         // string storage
+  int64_t mask = 0;
+  int64_t size = 0;
+  bool string_mode = false;
+};
+
+static const int64_t EMPTY = INT64_MIN;
+
+static inline uint64_t mix64(uint64_t x) {
+  x ^= x >> 33;
+  x *= 0xff51afd7ed558ccdULL;
+  x ^= x >> 33;
+  x *= 0xc4ceb9fe1a85ec53ULL;
+  x ^= x >> 33;
+  return x;
+}
+
+static inline uint64_t hash_bytes(const char* data, int64_t len) {
+  // FNV-1a 64 then mixed; sufficient dispersion for a dictionary
+  uint64_t h = 1469598103934665603ULL;
+  for (int64_t i = 0; i < len; i++) {
+    h ^= (unsigned char)data[i];
+    h *= 1099511628211ULL;
+  }
+  return mix64(h);
+}
+
+static void kd_rehash(KeyDict* kd, int64_t new_cap) {
+  std::vector<int64_t> old_hashes = std::move(kd->hashes);
+  std::vector<int32_t> old_ids = std::move(kd->ids);
+  kd->hashes.assign(new_cap, EMPTY);
+  kd->ids.assign(new_cap, -1);
+  kd->mask = new_cap - 1;
+  for (size_t i = 0; i < old_hashes.size(); i++) {
+    if (old_hashes[i] == EMPTY) continue;
+    uint64_t slot = (uint64_t)old_hashes[i] & kd->mask;
+    while (kd->hashes[slot] != EMPTY) slot = (slot + 1) & kd->mask;
+    kd->hashes[slot] = old_hashes[i];
+    kd->ids[slot] = old_ids[i];
+  }
+}
+
+KeyDict* kd_new(int64_t initial_capacity, int string_mode) {
+  KeyDict* kd = new KeyDict();
+  int64_t cap = 64;
+  while (cap < initial_capacity * 2) cap <<= 1;
+  kd->hashes.assign(cap, EMPTY);
+  kd->ids.assign(cap, -1);
+  kd->mask = cap - 1;
+  kd->string_mode = string_mode != 0;
+  return kd;
+}
+
+void kd_free(KeyDict* kd) { delete kd; }
+
+int64_t kd_size(KeyDict* kd) { return kd->size; }
+
+static inline void kd_maybe_grow(KeyDict* kd) {
+  if (kd->size * 10 >= (kd->mask + 1) * 7) kd_rehash(kd, (kd->mask + 1) * 2);
+}
+
+// int64 keys -> dense ids; out_new[i]=1 when the key was first seen in this
+// call (caller appends those keys to its id->key list in lane order).
+int64_t kd_lookup_or_insert_i64(KeyDict* kd, const int64_t* keys, int64_t n,
+                                int32_t* out_ids, uint8_t* out_new) {
+  for (int64_t i = 0; i < n; i++) {
+    kd_maybe_grow(kd);
+    int64_t h = (int64_t)mix64((uint64_t)keys[i]);
+    if (h == EMPTY) h = 0;
+    uint64_t slot = (uint64_t)h & kd->mask;
+    for (;;) {
+      if (kd->hashes[slot] == EMPTY) {
+        kd->hashes[slot] = h;
+        int32_t id = (int32_t)kd->size++;
+        kd->ids[slot] = id;
+        kd->int_keys.push_back(keys[i]);
+        out_ids[i] = id;
+        out_new[i] = 1;
+        break;
+      }
+      if (kd->hashes[slot] == h && kd->int_keys[kd->ids[slot]] == keys[i]) {
+        out_ids[i] = kd->ids[slot];
+        out_new[i] = 0;
+        break;
+      }
+      slot = (slot + 1) & kd->mask;
+    }
+  }
+  return kd->size;
+}
+
+// fixed-width (numpy 'S<w>') byte keys; trailing NULs are part of the key
+// (numpy pads consistently, so equality is well-defined).
+int64_t kd_lookup_or_insert_fixed(KeyDict* kd, const char* data, int64_t width,
+                                  int64_t n, int32_t* out_ids, uint8_t* out_new) {
+  for (int64_t i = 0; i < n; i++) {
+    kd_maybe_grow(kd);
+    const char* key = data + i * width;
+    int64_t h = (int64_t)hash_bytes(key, width);
+    if (h == EMPTY) h = 0;
+    uint64_t slot = (uint64_t)h & kd->mask;
+    for (;;) {
+      if (kd->hashes[slot] == EMPTY) {
+        kd->hashes[slot] = h;
+        int32_t id = (int32_t)kd->size++;
+        kd->ids[slot] = id;
+        kd->str_off.push_back((int64_t)kd->arena.size());
+        kd->str_len.push_back((int32_t)width);
+        kd->arena.insert(kd->arena.end(), key, key + width);
+        out_ids[i] = id;
+        out_new[i] = 1;
+        break;
+      }
+      int32_t id = kd->ids[slot];
+      if (kd->hashes[slot] == h && kd->str_len[id] == (int32_t)width &&
+          memcmp(kd->arena.data() + kd->str_off[id], key, width) == 0) {
+        out_ids[i] = id;
+        out_new[i] = 0;
+        break;
+      }
+      slot = (slot + 1) & kd->mask;
+    }
+  }
+  return kd->size;
+}
+
+// ===========================================================================
+// 2. CSV codec: "key,value,timestamp\n" lines -> columns
+// ===========================================================================
+
+// Parses up to max_rows lines; returns rows parsed. key column is written as
+// fixed-width bytes (width = key_width, truncated/padded).
+int64_t codec_parse_csv(const char* data, int64_t len, int64_t max_rows,
+                        char* out_keys, int64_t key_width, double* out_values,
+                        int64_t* out_timestamps) {
+  int64_t row = 0;
+  int64_t pos = 0;
+  while (pos < len && row < max_rows) {
+    // field 1: key
+    int64_t start = pos;
+    while (pos < len && data[pos] != ',' && data[pos] != '\n') pos++;
+    if (pos >= len || data[pos] != ',') {  // malformed: skip line
+      while (pos < len && data[pos] != '\n') pos++;
+      pos++;
+      continue;
+    }
+    int64_t klen = pos - start;
+    if (klen > key_width) klen = key_width;
+    memcpy(out_keys + row * key_width, data + start, klen);
+    if (klen < key_width) memset(out_keys + row * key_width + klen, 0, key_width - klen);
+    pos++;  // skip comma
+
+    // field 2: value (double)
+    char* endp = nullptr;
+    out_values[row] = strtod(data + pos, &endp);
+    pos = endp - data;
+    if (pos < len && data[pos] == ',') {
+      pos++;
+      out_timestamps[row] = strtoll(data + pos, &endp, 10);
+      pos = endp - data;
+    } else {
+      out_timestamps[row] = 0;
+    }
+    while (pos < len && data[pos] != '\n') pos++;
+    pos++;  // skip newline
+    row++;
+  }
+  return row;
+}
+
+// ===========================================================================
+// 3. SegmentRing: bounded SPSC queue of fixed-size segments
+// ===========================================================================
+
+struct SegmentRing {
+  char* memory;
+  int64_t segment_size;
+  int64_t num_segments;
+  std::vector<int64_t> lengths;  // payload length per segment
+  volatile int64_t head = 0;     // consumer cursor
+  volatile int64_t tail = 0;     // producer cursor
+};
+
+SegmentRing* ring_new(int64_t segment_size, int64_t num_segments) {
+  SegmentRing* r = new SegmentRing();
+  r->memory = (char*)malloc(segment_size * num_segments);
+  r->segment_size = segment_size;
+  r->num_segments = num_segments;
+  r->lengths.assign(num_segments, 0);
+  return r;
+}
+
+void ring_free(SegmentRing* r) {
+  free(r->memory);
+  delete r;
+}
+
+// returns 1 on success, 0 when full (backpressure: producer must wait)
+int ring_offer(SegmentRing* r, const char* data, int64_t len) {
+  if (len > r->segment_size) return 0;
+  if (r->tail - r->head >= r->num_segments) return 0;  // full
+  int64_t slot = r->tail % r->num_segments;
+  memcpy(r->memory + slot * r->segment_size, data, len);
+  r->lengths[slot] = len;
+  __sync_synchronize();
+  r->tail++;
+  return 1;
+}
+
+// returns payload length (>0), or -1 when empty
+int64_t ring_poll(SegmentRing* r, char* out, int64_t out_cap) {
+  if (r->head >= r->tail) return -1;
+  int64_t slot = r->head % r->num_segments;
+  int64_t len = r->lengths[slot];
+  if (len > out_cap) return -2;
+  memcpy(out, r->memory + slot * r->segment_size, len);
+  __sync_synchronize();
+  r->head++;
+  return len;
+}
+
+int64_t ring_available(SegmentRing* r) { return r->tail - r->head; }
+int64_t ring_free_segments(SegmentRing* r) { return r->num_segments - (r->tail - r->head); }
+
+}  // extern "C"

@@ -1,0 +1,118 @@
+"""Native C++ host-runtime tests: key dictionary, CSV codec, segment ring
+(native/flink_tpu_native.cpp via ctypes)."""
+
+import numpy as np
+import pytest
+
+from flink_tpu.utils import native_bridge
+
+pytestmark = pytest.mark.skipif(
+    native_bridge.get_lib() is None, reason="native toolchain unavailable"
+)
+
+
+def test_native_keydict_i64():
+    kd = native_bridge.NativeKeyDict()
+    keys = np.array([5, 7, 5, 9, 7, 5], dtype=np.int64)
+    ids, new, size = kd.lookup_or_insert_i64(keys)
+    assert size == 3
+    assert list(new) == [True, True, False, True, False, False]
+    assert ids[0] == ids[2] == ids[5]
+    assert ids[1] == ids[4]
+    assert len({ids[0], ids[1], ids[3]}) == 3
+    # second batch: stable ids
+    ids2, new2, size2 = kd.lookup_or_insert_i64(np.array([9, 11], dtype=np.int64))
+    assert ids2[0] == ids[3] and new2[0] == False  # noqa: E712
+    assert size2 == 4
+
+
+def test_native_keydict_growth_and_stability():
+    kd = native_bridge.NativeKeyDict()
+    keys = np.arange(100_000, dtype=np.int64) * 7919  # force rehashes
+    ids, new, size = kd.lookup_or_insert_i64(keys)
+    assert size == 100_000
+    assert new.all()
+    ids2, new2, _ = kd.lookup_or_insert_i64(keys)
+    assert not new2.any()
+    assert (ids == ids2).all()
+
+
+def test_native_keydict_bytes():
+    kd = native_bridge.NativeKeyDict(string_mode=True)
+    keys = np.array([b"alpha", b"beta", b"alpha", b"gamma"], dtype="S8")
+    ids, new, size = kd.lookup_or_insert_bytes(keys)
+    assert size == 3
+    assert ids[0] == ids[2]
+    assert list(new) == [True, True, False, True]
+
+
+def test_python_keydict_uses_native_and_matches_fallback():
+    from flink_tpu.state.columnar import KeyDictionary
+
+    native = KeyDictionary()
+    fallback = KeyDictionary()
+    fallback._native_mode = "off"
+
+    rng = np.random.default_rng(0)
+    for _ in range(3):
+        batch = np.asarray([f"user-{rng.integers(0, 50)}" for _ in range(200)])
+        ids_n, size_n = native.lookup_or_insert(batch)
+        ids_f, size_f = fallback.lookup_or_insert(batch)
+        assert size_n == size_f
+        assert (ids_n == ids_f).all()
+    assert native._native_mode == "bytes"
+    assert [str(k) for k in native._keys] == [str(k) for k in fallback._keys]
+
+
+def test_python_keydict_int_native_path():
+    from flink_tpu.state.columnar import KeyDictionary
+
+    d = KeyDictionary()
+    ids, size = d.lookup_or_insert(np.array([100, 200, 100], dtype=np.int64))
+    assert d._native_mode == "i64"
+    assert size == 2 and ids[0] == ids[2]
+    assert d.key_at(int(ids[1])) == 200
+
+
+def test_keydict_snapshot_restore_reseeds_native():
+    from flink_tpu.state.columnar import KeyDictionary
+
+    d = KeyDictionary()
+    d.lookup_or_insert(np.asarray(["a", "b", "c"]))
+    snap = d.snapshot()
+    d2 = KeyDictionary.restore(snap)
+    ids, size = d2.lookup_or_insert(np.asarray(["c", "d"]))
+    assert size == 4
+    assert ids[0] == 2  # stable id across restore
+
+
+def test_csv_codec():
+    data = b"alpha,1.5,1000\nbeta,2.25,2000\nalpha,3,3000\n"
+    keys, vals, ts, rows = native_bridge.parse_csv(data, max_rows=10)
+    assert rows == 3
+    assert keys[0].rstrip(b"\x00") == b"alpha"
+    assert list(vals) == [1.5, 2.25, 3.0]
+    assert list(ts) == [1000, 2000, 3000]
+
+
+def test_csv_codec_skips_malformed():
+    data = b"good,1,10\nmalformed-no-comma\nalso,2,20\n"
+    keys, vals, ts, rows = native_bridge.parse_csv(data, max_rows=10)
+    assert rows == 2
+    assert list(ts) == [10, 20]
+
+
+def test_segment_ring_backpressure():
+    ring = native_bridge.SegmentRing(segment_size=64, num_segments=4)
+    assert ring.poll() is None
+    for i in range(4):
+        assert ring.offer(f"seg-{i}".encode())
+    assert not ring.offer(b"overflow")  # full = backpressure
+    assert ring.free_segments() == 0
+    assert ring.poll() == b"seg-0"
+    assert ring.offer(b"seg-4")  # space reclaimed
+    out = []
+    while (item := ring.poll()) is not None:
+        out.append(item)
+    assert out == [b"seg-1", b"seg-2", b"seg-3", b"seg-4"]
+    assert not ring.offer(b"x" * 100)  # larger than a segment
