@@ -97,7 +97,8 @@ class KeyDictionary:
             as_bytes = as_bytes.astype(f"S{self._bytes_width}")
             ids, new, size = self._native.lookup_or_insert_bytes(as_bytes)
         if new.any():
-            self._keys.extend(keys[new])
+            # tolist(): plain Python scalars, not np.int64/np.str_ (user-facing)
+            self._keys.extend(keys[new].tolist())
         return ids, size
 
     def lookup_or_insert(self, keys: np.ndarray) -> Tuple[np.ndarray, int]:
