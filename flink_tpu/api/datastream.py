@@ -121,6 +121,32 @@ class DataStream:
         fn = fn.filter if hasattr(fn, "filter") else fn
         return self._derive("filter", name, {"fn": fn})
 
+    def async_map(
+        self,
+        fn: Callable,
+        *,
+        capacity: int = 100,
+        timeout_ms: Optional[float] = None,
+        ordered: bool = True,
+        retry=None,
+        name: str = "async_map",
+    ) -> "DataStream":
+        """Async I/O with bounded concurrency (AsyncDataStream.orderedWait /
+        unorderedWait semantics; AsyncWaitOperator analogue)."""
+        from flink_tpu.runtime.async_io import NO_RETRY
+
+        return self._derive(
+            "async_map",
+            name,
+            {
+                "fn": fn,
+                "capacity": capacity,
+                "timeout_ms": timeout_ms,
+                "ordered": ordered,
+                "retry": retry or NO_RETRY,
+            },
+        )
+
     # -- partitioning ------------------------------------------------------
     def key_by(self, key_selector: Callable, name: str = "key_by") -> "KeyedStream":
         sel = as_key_selector(key_selector)

@@ -158,6 +158,7 @@ class WindowStepRunner(StepRunner):
                 emit_late_to_side_output=cfg["side_output_late"],
             )
             self.device = False
+        self.processing_time = not assigner.is_event_time
         self.uid = t.uid
 
     def on_batch(self, values: np.ndarray, timestamps: np.ndarray) -> None:
@@ -173,10 +174,17 @@ class WindowStepRunner(StepRunner):
                 nums = np.zeros(len(values), dtype=np.float32)
             self.op.process_batch(keys, nums, timestamps)
         else:
+            if self.processing_time:
+                # PT windows: assignment & timers use wall clock, not event ts
+                now = int(time.time() * 1000)
+                timestamps = np.full(len(values), now, dtype=np.int64)
             for v, ts in zip(values, timestamps):
                 self.op.process_record(
                     self.key_selector(v), self.value_fn(v), int(ts)
                 )
+            if self.processing_time:
+                self.op.advance_processing_time(int(time.time() * 1000))
+                self._drain()
 
     def on_watermark(self, watermark: int) -> None:
         self.op.process_watermark(watermark)
@@ -348,6 +356,10 @@ def build_runners(graph: StepGraph, config: Configuration) -> List[StepRunner]:
             runners.append(KeyedReduceRunner(step, config))
         elif kind == "process_keyed":
             runners.append(KeyedProcessRunner(step, config))
+        elif kind == "async_map":
+            from flink_tpu.runtime.async_io import AsyncMapRunner
+
+            runners.append(AsyncMapRunner(step.terminal, config))
         elif kind == "sink":
             runners.append(SinkRunner(step))
         else:
