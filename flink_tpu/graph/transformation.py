@@ -156,7 +156,9 @@ def plan(sink_transform: Transformation) -> StepGraph:
                 cut(None)
             partitioning = "key_group"
             key_selector = t.config["key_selector"]
-        elif t.kind in ("window_aggregate", "reduce", "sink", "process_keyed", "async_map"):
+        elif t.kind in (
+            "window_aggregate", "reduce", "sink", "process_keyed", "async_map", "cep",
+        ):
             cut(t)
         elif t.kind in REDISTRIBUTING:
             if chain:

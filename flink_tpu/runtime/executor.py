@@ -322,6 +322,37 @@ class KeyedProcessRunner(StepRunner):
         self.timers.restore(snap["timers"])
 
 
+class CepRunner(StepRunner):
+    """Keyed CEP pattern-matching step (CepOperator.java:83 analogue)."""
+
+    def __init__(self, step: Step, config: Configuration):
+        from flink_tpu.cep.operator import CepOperator
+
+        t = step.terminal
+        self.key_selector = t.config["key_selector"]
+        self.op = CepOperator(t.config["pattern"], t.config.get("select_fn"))
+        self.uid = t.uid
+
+    def on_batch(self, values: np.ndarray, timestamps: np.ndarray) -> None:
+        for v, ts in zip(values, timestamps):
+            self.op.process_record(self.key_selector(v), v, int(ts))
+
+    def on_watermark(self, watermark: int) -> None:
+        self.op.process_watermark(watermark)
+        out = self.op.drain_output()
+        if out and self.downstream:
+            vals = obj_array([r for (_k, _w, r, _t) in out])
+            ts = np.asarray([t for (_k, _w, _r, t) in out], dtype=np.int64)
+            self.downstream.on_batch(vals, ts)
+        super().on_watermark(watermark)
+
+    def snapshot(self) -> dict:
+        return {"operator": self.op.snapshot()}
+
+    def restore(self, snap: dict) -> None:
+        self.op.restore(snap["operator"])
+
+
 class SinkRunner(StepRunner):
     def __init__(self, step: Step):
         sink = step.terminal.config["sink"]
@@ -360,6 +391,8 @@ def build_runners(graph: StepGraph, config: Configuration) -> List[StepRunner]:
             from flink_tpu.runtime.async_io import AsyncMapRunner
 
             runners.append(AsyncMapRunner(step.terminal, config))
+        elif kind == "cep":
+            runners.append(CepRunner(step, config))
         elif kind == "sink":
             runners.append(SinkRunner(step))
         else:

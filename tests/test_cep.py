@@ -1,0 +1,168 @@
+"""CEP tests: pattern semantics against the NFA and the keyed operator
+(reference: flink-cep NFA/CepOperator behavior)."""
+
+import pytest
+
+from flink_tpu.cep import CepOperator, Pattern, pattern_stream
+from flink_tpu.cep.nfa import NFA
+
+
+def run_nfa(pattern, events):
+    """events: (value, ts) fed in order; returns completed matches."""
+    nfa = NFA(pattern)
+    runs = []
+    matches = []
+    for value, ts in events:
+        runs, m = nfa.advance(runs, value, ts)
+        matches.extend(m)
+    return matches
+
+
+def test_strict_next():
+    p = (
+        Pattern.begin("a").where(lambda e: e == "a")
+        .next("b").where(lambda e: e == "b")
+    )
+    assert len(run_nfa(p, [("a", 1), ("b", 2)])) == 1
+    assert len(run_nfa(p, [("a", 1), ("x", 2), ("b", 3)])) == 0  # strict broken
+    assert len(run_nfa(p, [("a", 1), ("a", 2), ("b", 3)])) == 1  # second 'a' matches
+
+
+def test_relaxed_followed_by():
+    p = (
+        Pattern.begin("a").where(lambda e: e == "a")
+        .followed_by("b").where(lambda e: e == "b")
+    )
+    assert len(run_nfa(p, [("a", 1), ("x", 2), ("b", 3)])) == 1
+    # two a's then b: both runs complete (a1->b, a2->b)
+    assert len(run_nfa(p, [("a", 1), ("a", 2), ("b", 3)])) == 2
+
+
+def test_three_stage_and_binding():
+    p = (
+        Pattern.begin("start").where(lambda e: e[0] == "s")
+        .followed_by("mid").where(lambda e: e[0] == "m")
+        .followed_by("end").where(lambda e: e[0] == "e")
+    )
+    matches = run_nfa(p, [(("s", 1), 1), (("m", 2), 2), (("e", 3), 3)])
+    assert len(matches) == 1
+    assert matches[0]["start"] == [("s", 1)]
+    assert matches[0]["mid"] == [("m", 2)]
+    assert matches[0]["end"] == [("e", 3)]
+
+
+def test_times_quantifier():
+    p = (
+        Pattern.begin("a").where(lambda e: e == "a").times(3)
+        .followed_by("b").where(lambda e: e == "b")
+    )
+    matches = run_nfa(p, [("a", 1), ("a", 2), ("a", 3), ("b", 4)])
+    assert any(len(m["a"]) == 3 for m in matches)
+    assert len(run_nfa(p, [("a", 1), ("a", 2), ("b", 3)])) == 0
+
+
+def test_one_or_more_greedy_growth():
+    p = (
+        Pattern.begin("nums").where(lambda e: isinstance(e, int)).one_or_more()
+        .followed_by("stop").where(lambda e: e == "stop")
+    )
+    matches = run_nfa(p, [(1, 1), (2, 2), ("stop", 3)])
+    # runs: [1], [2], [1,2] each followed by stop
+    collected = sorted(tuple(m["nums"]) for m in matches)
+    assert (1,) in collected and (2,) in collected and (1, 2) in collected
+
+
+def test_within_prunes_old_runs():
+    p = (
+        Pattern.begin("a").where(lambda e: e == "a")
+        .followed_by("b").where(lambda e: e == "b")
+        .within(10)
+    )
+    assert len(run_nfa(p, [("a", 0), ("b", 5)])) == 1
+    assert len(run_nfa(p, [("a", 0), ("b", 50)])) == 0  # timed out
+
+
+def test_optional_stage():
+    p = (
+        Pattern.begin("a").where(lambda e: e == "a")
+        .followed_by("opt").where(lambda e: e == "o").optional()
+        .followed_by("b").where(lambda e: e == "b")
+    )
+    with_opt = run_nfa(p, [("a", 1), ("o", 2), ("b", 3)])
+    without = run_nfa(p, [("a", 1), ("b", 2)])
+    assert any(m["opt"] == ["o"] for m in with_opt)
+    assert any(m["opt"] == [] for m in without)
+
+
+def test_cep_operator_event_time_ordering():
+    """Out-of-order events are buffered and NFA-fed in timestamp order on
+    watermark (CepOperator event-time contract)."""
+    p = (
+        Pattern.begin("a").where(lambda e: e[1] == "a")
+        .next("b").where(lambda e: e[1] == "b")
+    )
+    op = CepOperator(p)
+    # arrive out of order: b(ts2) before a(ts1)
+    op.process_record("k", ("k", "b"), 20)
+    op.process_record("k", ("k", "a"), 10)
+    op.process_watermark(100)
+    out = op.drain_output()
+    assert len(out) == 1
+    assert out[0][2]["a"] == [("k", "a")]
+
+
+def test_cep_operator_keys_isolated():
+    p = (
+        Pattern.begin("a").where(lambda e: e[1] == "a")
+        .next("b").where(lambda e: e[1] == "b")
+    )
+    op = CepOperator(p)
+    op.process_record("k1", ("k1", "a"), 1)
+    op.process_record("k2", ("k2", "b"), 2)  # b without a: no match
+    op.process_record("k1", ("k1", "b"), 3)
+    op.process_watermark(100)
+    out = op.drain_output()
+    assert len(out) == 1 and out[0][0] == "k1"
+
+
+def test_cep_snapshot_restore():
+    p = (
+        Pattern.begin("a").where(lambda e: e == "a")
+        .followed_by("b").where(lambda e: e == "b")
+    )
+    op = CepOperator(p)
+    op.process_record("k", "a", 1)
+    op.process_watermark(5)
+    snap = op.snapshot()
+
+    op2 = CepOperator(p)
+    op2.restore(snap)
+    op2.process_record("k", "b", 10)
+    op2.process_watermark(20)
+    assert len(op2.drain_output()) == 1
+
+
+def test_cep_end_to_end():
+    from flink_tpu.api.datastream import StreamExecutionEnvironment
+    from flink_tpu.core.watermarks import WatermarkStrategy
+
+    env = StreamExecutionEnvironment.get_execution_environment()
+    # (user, action, ts): detect login -> purchase per user
+    events = [
+        ("u1", "login", 100), ("u2", "login", 200), ("u1", "browse", 300),
+        ("u1", "purchase", 400), ("u2", "logout", 500),
+    ]
+    stream = env.from_collection(
+        events,
+        timestamp_fn=lambda e: e[2],
+        watermark_strategy=WatermarkStrategy.for_monotonous_timestamps(),
+    )
+    p = (
+        Pattern.begin("login").where(lambda e: e[1] == "login")
+        .followed_by("purchase").where(lambda e: e[1] == "purchase")
+    )
+    keyed = stream.key_by(lambda e: e[0])
+    result = pattern_stream(keyed, p, select_fn=lambda m: m["login"][0][0])
+    sink = result.collect()
+    env.execute()
+    assert sink.results == ["u1"]
