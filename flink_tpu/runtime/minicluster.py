@@ -96,6 +96,69 @@ class JobClient:
             return path
         return None
 
+    # -- queryable state (S13: KvStateServer/ClientProxy analogue) ---------
+    def query_state(self, uid: str, key) -> dict:
+        """Point lookup into the RUNNING job's keyed state. Safe without
+        locks: device state arrays are immutable (replaced atomically per
+        step) and heap tables are only read here.
+
+        Returns, per operator type:
+          device window op : {"slices": {abs_slice: {field: value, count}},
+                              "watermark": wm}
+          oracle window op / keyed ops : {"states": {name: {repr(ns): value}},
+                              "watermark": wm}
+          rolling reduce   : {"value": current}
+        """
+        runtime = getattr(self, "_runtime", None)
+        if runtime is None:
+            raise RuntimeError("job has no running attempt")
+        import numpy as np
+
+        for r in runtime.runners:
+            if getattr(r, "uid", None) != uid:
+                continue
+            op = getattr(r, "op", None)
+            if op is not None and hasattr(op, "state") and hasattr(op.state, "keydict"):
+                state = op.state
+                kd = state.keydict
+                if kd.dense_int:
+                    kid = int(key) if int(key) < len(kd) else None
+                else:
+                    kid = kd._map.get(key)
+                    if kid is None and key in kd._keys:
+                        kid = kd._keys.index(key)
+                if kid is None:
+                    return {"slices": {}, "watermark": op.current_watermark}
+                count = np.asarray(state.count)[kid]
+                acc = {k: np.asarray(v)[kid] for k, v in state.acc.items()}
+                f = state.frontiers
+                slices = {}
+                if f.min_used is not None:
+                    lo = f.min_used if f.purged_to is None else max(f.purged_to, f.min_used)
+                    for s in range(lo, f.max_used + 1):
+                        pos = s % state.S
+                        if count[pos] > 0:
+                            entry = {name: arr[pos].item() for name, arr in acc.items()}
+                            entry["count"] = int(count[pos])
+                            slices[s] = entry
+                return {"slices": slices, "watermark": op.current_watermark}
+            if op is not None and hasattr(op, "state"):  # oracle/heap ops
+                backend = op.state
+                backend.set_current_key(key)
+                states = {}
+                for name in backend.descriptors:
+                    for ns in backend.namespaces_for_key(name, key):
+                        states.setdefault(name, {})[repr(ns)] = backend.get(name, ns)
+                wm = getattr(op, "timer_service", None)
+                return {
+                    "states": states,
+                    "watermark": wm.current_watermark if wm else None,
+                }
+            if hasattr(r, "state"):  # KeyedReduceRunner et al.
+                r.state.set_current_key(key)
+                return {"value": r.state.get("rolling")}
+        raise KeyError(f"no queryable operator {uid!r}")
+
 
 class MiniCluster:
     _shared: Optional["MiniCluster"] = None
@@ -171,6 +234,7 @@ class MiniCluster:
 
         while True:
             runtime = JobRuntime(graph, config, registry=client.metrics)
+            client._runtime = runtime  # queryable-state surface (S13)
             try:
                 if restore_snap is not None:
                     runtime.restore(restore_snap)

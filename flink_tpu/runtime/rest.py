@@ -140,6 +140,22 @@ class _Handler(BaseHTTPRequestHandler):
                     v = m.value()
                     out[k] = v if isinstance(v, (int, float, dict)) else str(v)
                 return self._json(200, out)
+            if parts[2] == "state" and len(parts) == 4:
+                # queryable state (S13): /jobs/<id>/state/<uid>?key=K
+                from urllib.parse import parse_qs, urlparse
+
+                qs = parse_qs(urlparse(self.path).query)
+                if "key" not in qs:
+                    return self._json(400, {"error": "key query param required"})
+                raw = qs["key"][0]
+                key: object = int(raw) if raw.lstrip("-").isdigit() else raw
+                try:
+                    result = client.query_state(parts[3], key)
+                except KeyError as e:
+                    return self._json(404, {"error": str(e)})
+                except RuntimeError as e:
+                    return self._json(409, {"error": str(e)})
+                return self._json(200, _jsonable(result))
         self._json(404, {"error": f"no route {self.path}"})
 
     # -- POST/PATCH -------------------------------------------------------
@@ -172,6 +188,19 @@ class _Handler(BaseHTTPRequestHandler):
         self._json(404, {"error": f"no route {self.path}"})
 
     do_PATCH = do_POST
+
+
+def _jsonable(obj):
+    """Best-effort JSON coercion (int dict keys -> str, numpy scalars)."""
+    if isinstance(obj, dict):
+        return {str(k): _jsonable(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        return [_jsonable(v) for v in obj]
+    if hasattr(obj, "item"):
+        return obj.item()
+    if isinstance(obj, (int, float, str, bool)) or obj is None:
+        return obj
+    return repr(obj)
 
 
 def _run_application(cluster: MiniCluster, module_path: str, entry: str):

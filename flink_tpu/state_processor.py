@@ -1,0 +1,163 @@
+"""State Processor API: offline read / transform / bootstrap of savepoints.
+
+Capability parity with flink-state-processing-api (SavepointReader.java:59,
+SavepointWriter.java:62): load a savepoint, enumerate operators, read keyed
+state (both heap-operator snapshots and device columnar-window snapshots),
+transform or bootstrap state, and write a new savepoint that jobs can
+restore from (operator-uid remapping contract, SURVEY §2.6 S10).
+"""
+
+from __future__ import annotations
+
+import copy
+from typing import Any, Callable, Dict, Iterator, List, Optional, Tuple
+
+import numpy as np
+
+from flink_tpu.checkpoint.storage import FsCheckpointStorage
+
+
+class SavepointReader:
+    def __init__(self, data: dict):
+        self.data = data
+
+    @staticmethod
+    def load(path: str) -> "SavepointReader":
+        storage = FsCheckpointStorage(path)
+        latest = storage.latest()
+        if latest is None:
+            raise FileNotFoundError(f"no savepoint/checkpoint under {path}")
+        return SavepointReader(storage.load(latest[1]))
+
+    # -- introspection ----------------------------------------------------
+    def operator_uids(self) -> List[str]:
+        return list(self.data.get("runners", {}).keys())
+
+    def records_in(self) -> int:
+        return self.data.get("records_in", 0)
+
+    def source_state(self) -> dict:
+        return self.data.get("source", {})
+
+    def _runner(self, uid: str) -> dict:
+        runners = self.data.get("runners", {})
+        if uid not in runners:
+            raise KeyError(f"no operator {uid!r}; have {list(runners)}")
+        return runners[uid]
+
+    # -- keyed state ------------------------------------------------------
+    def keyed_state(self, uid: str) -> Iterator[Tuple]:
+        """Yields state entries for the operator:
+
+        - heap-operator snapshots: (state_name, key, namespace, value)
+        - device columnar-window snapshots: (key, slice_index,
+          {field: value, 'count': n}) for every non-empty (key, slice) cell
+        """
+        snap = self._runner(uid)
+        op = snap.get("operator", snap)
+        if "columnar" in op or "sharded" in op:
+            yield from self._columnar_entries(op.get("columnar") or op.get("sharded"))
+        elif "state" in op:
+            for state_name, kg_tables in op["state"].items():
+                for _kg, entries in kg_tables.items():
+                    for (key, ns), value in entries.items():
+                        yield (state_name, key, ns, value)
+        else:
+            raise TypeError(f"operator {uid!r} snapshot has no readable keyed state")
+
+    def _columnar_entries(self, col: dict) -> Iterator[Tuple]:
+        count = np.asarray(col["count"])
+        acc = {k: np.asarray(v) for k, v in col["acc"].items()}
+        S = col["S"]
+        f = col["frontiers"]
+        if f["min_used"] is None:
+            return
+        lo = f["min_used"] if f["purged_to"] is None else max(f["purged_to"], f["min_used"])
+        hi = f["max_used"]
+        sharded = count.ndim == 3
+        keydicts = col.get("keydicts") or [col["keydict"]]
+        for shard, kd in enumerate(keydicts):
+            keys = kd["keys"]
+            cnt = count[shard] if sharded else count
+            for kid, key in enumerate(keys):
+                for s in range(lo, hi + 1):
+                    pos = s % S
+                    c = int(cnt[kid, pos])
+                    if c == 0:
+                        continue
+                    fields = {
+                        name: (arr[shard] if sharded else arr)[kid, pos].item()
+                        for name, arr in acc.items()
+                    }
+                    fields["count"] = c
+                    yield (key, s, fields)
+
+
+class SavepointWriter:
+    """Transforms an existing savepoint (or builds one from scratch) and
+    writes it in restorable form."""
+
+    def __init__(self, data: Optional[dict] = None):
+        self.data = copy.deepcopy(data) if data else {
+            "source": {
+                "pending_splits": [],
+                "current_split": None,
+                "reader_position": {},
+                "done": False,
+            },
+            "generator": None,
+            "runners": {},
+            "records_in": 0,
+            "savepoint": True,
+        }
+
+    @staticmethod
+    def from_reader(reader: SavepointReader) -> "SavepointWriter":
+        return SavepointWriter(reader.data)
+
+    def remove_operator(self, uid: str) -> "SavepointWriter":
+        self.data.get("runners", {}).pop(uid, None)
+        return self
+
+    def rename_operator(self, old_uid: str, new_uid: str) -> "SavepointWriter":
+        runners = self.data.get("runners", {})
+        if old_uid in runners:
+            runners[new_uid] = runners.pop(old_uid)
+        return self
+
+    def transform_heap_state(
+        self, uid: str, fn: Callable[[str, Any, Any, Any], Optional[Any]]
+    ) -> "SavepointWriter":
+        """fn(state_name, key, namespace, value) -> new value or None to
+        drop the entry."""
+        snap = self.data["runners"][uid]
+        op = snap.get("operator", snap)
+        for state_name, kg_tables in op["state"].items():
+            for kg, entries in list(kg_tables.items()):
+                new_entries = {}
+                for (key, ns), value in entries.items():
+                    out = fn(state_name, key, ns, value)
+                    if out is not None:
+                        new_entries[(key, ns)] = out
+                kg_tables[kg] = new_entries
+        return self
+
+    def transform_columnar_state(
+        self, uid: str, fn: Callable[[str, np.ndarray], np.ndarray]
+    ) -> "SavepointWriter":
+        """fn(field_name, array) -> new array (applied to each accumulator
+        field, including 'count'); shape must be preserved."""
+        snap = self.data["runners"][uid]
+        op = snap.get("operator", snap)
+        col = op.get("columnar") or op.get("sharded")
+        for name, arr in col["acc"].items():
+            out = np.asarray(fn(name, np.asarray(arr)))
+            if out.shape != np.asarray(arr).shape:
+                raise ValueError("columnar transform must preserve shape")
+            col["acc"][name] = out
+        col["count"] = np.asarray(fn("count", np.asarray(col["count"])))
+        return self
+
+    def write(self, path: str) -> str:
+        self.data["savepoint"] = True
+        return FsCheckpointStorage(path).save(0, self.data)
