@@ -134,7 +134,29 @@ class WindowStepRunner(StepRunner):
         self._needs_value = device_agg is None or any(
             f.source != ONE for f in device_agg.fields
         )
-        if use_device:
+        from flink_tpu.api.windowing.assigners import GlobalWindows
+        from flink_tpu.runtime.tpu_global_window_operator import (
+            TpuGlobalWindowOperator,
+            supported_trigger,
+        )
+
+        count_spec = supported_trigger(cfg.get("trigger"))
+        if (
+            isinstance(assigner, GlobalWindows)
+            and device_agg is not None
+            and count_spec is not None
+            and cfg.get("evictor") is None
+            and self.window_fn is None
+        ):
+            n, purging = count_spec
+            self.op = TpuGlobalWindowOperator(
+                device_agg,
+                count_n=n,
+                purging=purging,
+                key_capacity=config.get(ExecutionOptions.KEY_CAPACITY),
+            )
+            self.device = True
+        elif use_device:
             self.op = TpuWindowOperator(
                 assigner,
                 device_agg,

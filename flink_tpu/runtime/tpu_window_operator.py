@@ -61,6 +61,7 @@ class TpuWindowOperator:
         emit_late_to_side_output: bool = False,
         batch_pad: int = 256,
         columnar_output: bool = False,
+        ingest_kernel: str = "scatter",
     ):
         agg = resolve(aggregate)
         if agg is None:
@@ -98,6 +99,7 @@ class TpuWindowOperator:
             key_capacity=key_capacity,
             num_slices=num_slices,
             dense_int_keys=dense_int_keys,
+            ingest_kernel=ingest_kernel,
         )
 
         self.current_watermark = MIN_WATERMARK

@@ -166,6 +166,7 @@ class ColumnarWindowState:
         num_slices: int = 64,
         dense_int_keys: bool = False,
         device=None,
+        ingest_kernel: str = "scatter",
     ):
         self.agg = agg
         self.K = key_capacity
@@ -174,7 +175,12 @@ class ColumnarWindowState:
         self.keydict = KeyDictionary(dense_int_keys)
         self.frontiers = RingFrontiers()
         self.acc, self.count = segment_ops.init_state_arrays(agg, self.K, self.S)
-        self._ingest = segment_ops.make_ingest_fn(agg, track_touch=True)
+        if ingest_kernel == "sort":
+            from flink_tpu.ops.sorted_ingest import make_sorted_ingest_fn
+
+            self._ingest = make_sorted_ingest_fn(agg, track_touch=True)
+        else:
+            self._ingest = segment_ops.make_ingest_fn(agg, track_touch=True)
         self._fire = segment_ops.make_fire_fn(agg, masked=False)
         self._fire_masked = segment_ops.make_fire_fn(agg, masked=True)
         self._purge = segment_ops.make_purge_fn(agg, self.PURGE_CHUNK)
