@@ -113,6 +113,10 @@ class DataStream:
         fn = fn.map if hasattr(fn, "map") else fn
         return self._derive("map", name, {"fn": fn})
 
+    def map_with_timestamp(self, fn: Callable, name: str = "map_ts") -> "DataStream":
+        """map over (value, event_timestamp_ms) pairs."""
+        return self._derive("map_ts", name, {"fn": fn})
+
     def flat_map(self, fn: Callable, name: str = "flat_map") -> "DataStream":
         fn = fn.flat_map if hasattr(fn, "flat_map") else fn
         return self._derive("flat_map", name, {"fn": fn})

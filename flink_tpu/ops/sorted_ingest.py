@@ -58,10 +58,11 @@ def make_sorted_ingest_fn(agg: DeviceAggregator, *, track_touch: bool):
                kid: jnp.ndarray, spos: jnp.ndarray, vals: jnp.ndarray):
         K, S = count.shape
         B = kid.shape[0]
+        # int32 flat index: K*S must stay < 2^31 - 1 (checked at state init;
+        # 2^20 keys x 64 slices is well inside)
         valid = kid != INVALID_INDEX
-        flat = jnp.where(
-            valid, kid.astype(jnp.int64) * S + spos.astype(jnp.int64), jnp.int64(K) * S
-        )
+        sentinel = jnp.int32(K * S)
+        flat = jnp.where(valid, kid * jnp.int32(S) + spos, sentinel)
         order = jnp.argsort(flat)
         flat_s = flat[order]
         vals_s = vals[order]

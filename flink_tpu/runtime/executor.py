@@ -91,6 +91,8 @@ class ChainRunner(StepRunner):
             fn = t.config["fn"]
             if t.kind == "map":
                 vals = [fn(v) for v in vals]
+            elif t.kind == "map_ts":
+                vals = [fn(v, int(x)) for v, x in zip(vals, ts)]
             elif t.kind == "filter":
                 keep = [bool(fn(v)) for v in vals]
                 vals = [v for v, k in zip(vals, keep) if k]

@@ -1,0 +1,9 @@
+"""Table/SQL layer (reference: flink-table — Calcite parser T1, planner T2,
+runtime T3). A compact dialect covering the streaming-aggregation core:
+windowed GROUP BY over TUMBLE/HOP/SESSION, WHERE filters, and the standard
+aggregate functions, translated onto the DataStream plan (and therefore onto
+the device window operator — the same sliced-window execution the reference
+SQL runtime uses via tvf/slicing)."""
+
+from flink_tpu.table.table_env import TableEnvironment, TableSchema
+from flink_tpu.table.sql import parse_query

@@ -1,0 +1,250 @@
+"""SQL parser for the streaming-aggregation dialect.
+
+Reference: the Calcite-based parser (flink-sql-parser) + planner rewrite of
+group windows. Supported grammar (case-insensitive keywords):
+
+  SELECT <item> [, <item>]*
+  FROM <table>
+  [WHERE <expr>]
+  [GROUP BY <col> [, <col>]* [, <window>]]
+
+  <item>   := <col> | <agg>( <col> | * ) [AS <alias>]
+            | WINDOW_START [AS alias] | WINDOW_END [AS alias]
+  <agg>    := COUNT | SUM | MIN | MAX | AVG
+  <window> := TUMBLE(<time_col>, INTERVAL '<n>' <unit>)
+            | HOP(<time_col>, INTERVAL '<n>' <unit>, INTERVAL '<n>' <unit>)
+            | SESSION(<time_col>, INTERVAL '<n>' <unit>)
+  <expr>   := comparisons of columns and literals combined with AND / OR,
+              operators = != <> < <= > >=
+
+Hand-rolled recursive descent (no codegen: the reference compiles generated
+Java at runtime, we compile to closures over columnar plans — XLA is the
+codegen tier).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import re
+from typing import Any, Callable, List, Optional, Tuple
+
+_TOKEN_RE = re.compile(
+    r"\s*(?:(?P<num>\d+\.\d+|\d+)|(?P<str>'[^']*')|(?P<op><=|>=|!=|<>|=|<|>|\(|\)|,|\*)"
+    r"|(?P<word>[A-Za-z_][A-Za-z_0-9.]*))"
+)
+
+AGG_FUNCS = {"COUNT", "SUM", "MIN", "MAX", "AVG"}
+_UNIT_MS = {
+    "MILLISECOND": 1, "SECOND": 1000, "MINUTE": 60_000, "HOUR": 3_600_000,
+    "DAY": 86_400_000,
+}
+
+
+def _tokenize(sql: str) -> List[str]:
+    tokens, pos = [], 0
+    while pos < len(sql):
+        m = _TOKEN_RE.match(sql, pos)
+        if not m:
+            if sql[pos:].strip():
+                raise ValueError(f"cannot tokenize at: {sql[pos:pos+20]!r}")
+            break
+        tokens.append(m.group(0).strip())
+        pos = m.end()
+    return tokens
+
+
+@dataclasses.dataclass
+class SelectItem:
+    kind: str                 # 'column' | 'agg' | 'window_start' | 'window_end'
+    name: str                 # column name or agg arg ('*' for COUNT(*))
+    func: Optional[str] = None
+    alias: Optional[str] = None
+
+    @property
+    def output_name(self) -> str:
+        if self.alias:
+            return self.alias
+        if self.kind == "agg":
+            return f"{self.func.lower()}_{self.name if self.name != '*' else 'all'}"
+        return self.name if self.kind == "column" else self.kind
+
+
+@dataclasses.dataclass
+class WindowSpec:
+    kind: str                 # 'tumble' | 'hop' | 'session'
+    time_col: str
+    size_ms: int
+    slide_ms: Optional[int] = None  # hop only; for hop arg order: slide, size
+
+
+@dataclasses.dataclass
+class Query:
+    select: List[SelectItem]
+    table: str
+    where: Optional[Callable[[dict], bool]]
+    where_text: Optional[str]
+    group_by: List[str]
+    window: Optional[WindowSpec]
+
+
+class _Parser:
+    def __init__(self, tokens: List[str]):
+        self.tokens = tokens
+        self.i = 0
+
+    def peek(self) -> Optional[str]:
+        return self.tokens[self.i] if self.i < len(self.tokens) else None
+
+    def peek_upper(self) -> Optional[str]:
+        t = self.peek()
+        return t.upper() if t is not None else None
+
+    def next(self) -> str:
+        t = self.peek()
+        if t is None:
+            raise ValueError("unexpected end of query")
+        self.i += 1
+        return t
+
+    def expect(self, word: str) -> None:
+        t = self.next()
+        if t.upper() != word.upper():
+            raise ValueError(f"expected {word}, got {t!r}")
+
+    # -- grammar ----------------------------------------------------------
+    def query(self) -> Query:
+        self.expect("SELECT")
+        select = [self.select_item()]
+        while self.peek() == ",":
+            self.next()
+            select.append(self.select_item())
+        self.expect("FROM")
+        table = self.next()
+        where = where_text = None
+        if self.peek_upper() == "WHERE":
+            self.next()
+            where, where_text = self.where_expr()
+        group_by: List[str] = []
+        window = None
+        if self.peek_upper() == "GROUP":
+            self.next()
+            self.expect("BY")
+            while True:
+                if self.peek_upper() in ("TUMBLE", "HOP", "SESSION"):
+                    window = self.window_spec()
+                else:
+                    group_by.append(self.next())
+                if self.peek() == ",":
+                    self.next()
+                    continue
+                break
+        if self.peek() is not None:
+            raise ValueError(f"trailing tokens: {self.tokens[self.i:]}")
+        return Query(select, table, where, where_text, group_by, window)
+
+    def select_item(self) -> SelectItem:
+        t = self.next()
+        up = t.upper()
+        if up in AGG_FUNCS:
+            self.expect("(")
+            arg = self.next()
+            self.expect(")")
+            item = SelectItem("agg", arg, func=up)
+        elif up in ("WINDOW_START", "WINDOW_END"):
+            item = SelectItem(up.lower(), up.lower())
+        else:
+            item = SelectItem("column", t)
+        if self.peek_upper() == "AS":
+            self.next()
+            item.alias = self.next()
+        return item
+
+    def window_spec(self) -> WindowSpec:
+        kind = self.next().upper()
+        self.expect("(")
+        time_col = self.next()
+        self.expect(",")
+        first = self.interval()
+        if kind == "HOP":
+            self.expect(",")
+            second = self.interval()
+            self.expect(")")
+            # HOP(time, slide, size) — reference TVF argument order
+            return WindowSpec("hop", time_col, size_ms=second, slide_ms=first)
+        self.expect(")")
+        return WindowSpec(kind.lower(), time_col, size_ms=first)
+
+    def interval(self) -> int:
+        self.expect("INTERVAL")
+        lit = self.next()
+        if not (lit.startswith("'") and lit.endswith("'")):
+            raise ValueError(f"INTERVAL literal expected, got {lit!r}")
+        n = float(lit[1:-1])
+        unit = self.next().upper()
+        key = unit[:-1] if unit.endswith("S") and unit[:-1] in _UNIT_MS else unit
+        if key not in _UNIT_MS:
+            raise ValueError(f"unknown interval unit {unit!r}")
+        return int(n * _UNIT_MS[key])
+
+    # -- WHERE ------------------------------------------------------------
+    def where_expr(self) -> Tuple[Callable[[dict], bool], str]:
+        start = self.i
+        node = self.or_expr()
+        text = " ".join(self.tokens[start:self.i])
+        return node, text
+
+    def or_expr(self):
+        left = self.and_expr()
+        while self.peek_upper() == "OR":
+            self.next()
+            right = self.and_expr()
+            left = (lambda l, r: lambda row: l(row) or r(row))(left, right)
+        return left
+
+    def and_expr(self):
+        left = self.comparison()
+        while self.peek_upper() == "AND":
+            self.next()
+            right = self.comparison()
+            left = (lambda l, r: lambda row: l(row) and r(row))(left, right)
+        return left
+
+    def comparison(self):
+        if self.peek() == "(":
+            self.next()
+            inner = self.or_expr()
+            self.expect(")")
+            return inner
+        lhs = self.operand()
+        op = self.next()
+        rhs = self.operand()
+        ops = {
+            "=": lambda a, b: a == b,
+            "!=": lambda a, b: a != b,
+            "<>": lambda a, b: a != b,
+            "<": lambda a, b: a < b,
+            "<=": lambda a, b: a <= b,
+            ">": lambda a, b: a > b,
+            ">=": lambda a, b: a >= b,
+        }
+        if op not in ops:
+            raise ValueError(f"unknown comparison operator {op!r}")
+        fn = ops[op]
+        return lambda row: fn(lhs(row), rhs(row))
+
+    def operand(self):
+        t = self.next()
+        if t.startswith("'") and t.endswith("'"):
+            lit = t[1:-1]
+            return lambda row: lit
+        try:
+            num = float(t) if "." in t else int(t)
+            return lambda row: num
+        except ValueError:
+            pass
+        name = t
+        return lambda row: row[name]
+
+
+def parse_query(sql: str) -> Query:
+    return _Parser(_tokenize(sql)).query()

@@ -1,0 +1,210 @@
+"""TableEnvironment: SQL over registered streaming tables.
+
+Reference: TableEnvironment + the planner's translation of windowed GROUP BY
+queries into DataStream transformations (flink-table-planner; group windows
+lower onto the same WindowOperator machinery — here onto our device window
+operator via the DataStream API, giving SQL the sliced-window device path
+the reference SQL runtime gets from tvf/slicing).
+
+Rows are dicts keyed by schema field names. Single-aggregate queries with a
+device-resolvable function run on the TPU operator; multi-aggregate queries
+use a composite oracle AggregateFunction.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+from flink_tpu.api.datastream import DataStream, StreamExecutionEnvironment
+from flink_tpu.api.functions import AggregateFunction
+from flink_tpu.api.windowing.assigners import (
+    EventTimeSessionWindows,
+    SlidingEventTimeWindows,
+    TumblingEventTimeWindows,
+)
+from flink_tpu.connectors.sink import CollectSink, Sink
+from flink_tpu.core.watermarks import WatermarkStrategy
+from flink_tpu.table.sql import AGG_FUNCS, Query, SelectItem, parse_query
+
+_DEVICE_AGG = {"COUNT": "count", "SUM": "sum", "MIN": "min", "MAX": "max", "AVG": "mean"}
+
+
+@dataclasses.dataclass
+class TableSchema:
+    fields: List[str]
+    rowtime: Optional[str] = None          # event-time column (ms)
+    watermark_delay_ms: int = 0            # bounded out-of-orderness
+
+
+@dataclasses.dataclass
+class _Table:
+    stream: DataStream
+    schema: TableSchema
+
+
+class _MultiAgg(AggregateFunction):
+    """Composite accumulator for multi-aggregate SELECTs (oracle path)."""
+
+    def __init__(self, items: List[SelectItem]):
+        self.items = items
+
+    def create_accumulator(self):
+        return [
+            {"count": 0, "sum": 0.0, "min": float("inf"), "max": float("-inf")}
+            for _ in self.items
+        ]
+
+    def add(self, row, accs):
+        out = []
+        for item, acc in zip(self.items, accs):
+            v = 1.0 if item.name == "*" else float(row[item.name])
+            out.append({
+                "count": acc["count"] + 1,
+                "sum": acc["sum"] + (0 if item.name == "*" else v),
+                "min": min(acc["min"], v),
+                "max": max(acc["max"], v),
+            })
+        return out
+
+    def get_result(self, accs):
+        vals = []
+        for item, acc in zip(self.items, accs):
+            if item.func == "COUNT":
+                vals.append(acc["count"])
+            elif item.func == "SUM":
+                vals.append(acc["sum"])
+            elif item.func == "MIN":
+                vals.append(acc["min"])
+            elif item.func == "MAX":
+                vals.append(acc["max"])
+            elif item.func == "AVG":
+                vals.append(acc["sum"] / max(acc["count"], 1))
+        return tuple(vals)
+
+    def merge(self, a, b):
+        return [
+            {
+                "count": x["count"] + y["count"],
+                "sum": x["sum"] + y["sum"],
+                "min": min(x["min"], y["min"]),
+                "max": max(x["max"], y["max"]),
+            }
+            for x, y in zip(a, b)
+        ]
+
+
+class TableEnvironment:
+    def __init__(self, env: Optional[StreamExecutionEnvironment] = None):
+        self.env = env or StreamExecutionEnvironment.get_execution_environment()
+        self._tables: Dict[str, _Table] = {}
+
+    # -- registration -----------------------------------------------------
+    def register_table(self, name: str, stream: DataStream, schema: TableSchema) -> None:
+        self._tables[name] = _Table(stream, schema)
+
+    def from_rows(self, name: str, rows: Sequence[dict], schema: TableSchema) -> None:
+        """Register an in-memory table (fromValues analogue)."""
+        strategy = None
+        ts_fn = None
+        if schema.rowtime:
+            rt = schema.rowtime
+            ts_fn = lambda row: int(row[rt])  # noqa: E731
+            strategy = WatermarkStrategy.for_bounded_out_of_orderness(
+                schema.watermark_delay_ms
+            )
+        stream = self.env.from_collection(list(rows), timestamp_fn=ts_fn,
+                                          watermark_strategy=strategy)
+        self.register_table(name, stream, schema)
+
+    # -- queries ----------------------------------------------------------
+    def sql_query(self, sql: str) -> DataStream:
+        q = parse_query(sql)
+        if q.table not in self._tables:
+            raise KeyError(f"unknown table {q.table!r}; registered: {list(self._tables)}")
+        table = self._tables[q.table]
+        stream = table.stream
+
+        if q.where is not None:
+            pred = q.where
+            stream = stream.filter(pred, name=f"where[{q.where_text}]")
+
+        aggs = [i for i in q.select if i.kind == "agg"]
+        if not aggs:
+            # projection-only query
+            cols = [i for i in q.select if i.kind == "column"]
+            return stream.map(
+                lambda row, _cols=cols: {c.output_name: row[c.name] for c in _cols},
+                name="project",
+            )
+        if not q.group_by or q.window is None:
+            raise NotImplementedError(
+                "aggregate queries require GROUP BY with a TUMBLE/HOP/SESSION window"
+            )
+
+        group_cols = list(q.group_by)
+        key_fn = (
+            (lambda row, c=group_cols[0]: row[c])
+            if len(group_cols) == 1
+            else (lambda row, cs=tuple(group_cols): tuple(row[c] for c in cs))
+        )
+        assigner = self._assigner(q)
+        keyed = stream.key_by(key_fn, name=f"group_by[{','.join(group_cols)}]")
+        windowed = keyed.window(assigner)
+
+        if len(aggs) == 1 and aggs[0].func in _DEVICE_AGG:
+            item = aggs[0]
+            value_fn = None if item.name == "*" else (
+                lambda row, c=item.name: float(row[c])
+            )
+            result = windowed.aggregate(
+                _DEVICE_AGG[item.func], value_fn, name=f"sql_{item.func.lower()}"
+            )
+            extract = lambda r: (r,)  # noqa: E731
+        else:
+            result = windowed.aggregate(_MultiAgg(aggs), name="sql_multi_agg")
+            extract = lambda r: tuple(r)  # noqa: E731
+
+        # assemble output rows: group cols + aggregates + window bounds
+        # (emission timestamp = window.maxTimestamp ⇒ end = ts+1,
+        # start = end - size; session windows get end-only fidelity)
+        out_items = q.select
+        size_ms = q.window.size_ms
+
+        def to_row(rec, ts):
+            key, res = rec
+            agg_vals = list(extract(res))
+            row = {}
+            ai = 0
+            for item in out_items:
+                if item.kind == "column":
+                    if len(group_cols) == 1:
+                        row[item.output_name] = key
+                    else:
+                        row[item.output_name] = key[group_cols.index(item.name)]
+                elif item.kind == "agg":
+                    row[item.output_name] = agg_vals[ai]
+                    ai += 1
+                elif item.kind == "window_end":
+                    row[item.output_name] = ts + 1
+                elif item.kind == "window_start":
+                    row[item.output_name] = ts + 1 - size_ms
+            return row
+
+        return result.map_with_timestamp(to_row, name="sql_output")
+
+    def execute_sql_to_list(self, sql: str) -> List[dict]:
+        """Convenience: run the query to completion, return rows."""
+        sink = self.sql_query(sql).collect()
+        self.env.execute("sql-query")
+        return sink.results
+
+    def _assigner(self, q: Query):
+        w = q.window
+        if w.kind == "tumble":
+            return TumblingEventTimeWindows.of(w.size_ms)
+        if w.kind == "hop":
+            return SlidingEventTimeWindows.of(w.size_ms, w.slide_ms)
+        if w.kind == "session":
+            return EventTimeSessionWindows.with_gap(w.size_ms)
+        raise ValueError(w.kind)
