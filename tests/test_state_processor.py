@@ -141,8 +141,11 @@ def test_queryable_state_live(tmp_path):
             for r in client._runtime.runners
             if getattr(r, "uid", "").startswith("window_aggregate")
         )
-        # direct API
-        state = client.query_state(uid, 0)
+        # direct API (poll: a purge may race the first read)
+        state = {"slices": {}}
+        while not state["slices"] and time.time() < deadline:
+            state = client.query_state(uid, 0)
+            time.sleep(0.005)
         assert state["slices"], "expected live window state for key 0"
         assert all(e["count"] > 0 for e in state["slices"].values())
         # REST route
