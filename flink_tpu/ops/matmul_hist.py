@@ -1,0 +1,124 @@
+"""MXU histogram: segment aggregation as one-hot matmuls.
+
+The scatter that the reference performs per record
+(WindowOperator.processElement -> HeapAggregatingState.add, per-(key,window)
+hash-map mutation) is re-expressed as dense linear algebra so it lands on the
+TPU's systolic array instead of the (slow, serialized) scatter unit:
+
+    count[seg]   = sum_b  1[idx_b == seg]
+    sum[seg]     = sum_b  v_b * 1[idx_b == seg]
+
+with the segment id factored two-level, ``idx = hi * LANES + lo``:
+
+    H[hi, lo] = one_hot(hi_b)^T  @  one_hot(lo_b)        # [B,HI]x[B,LO] matmul
+
+One [B, HI] x [B, LO] contraction over the batch axis replaces B random
+scatters; HI*LO = num_segments. Counts run as int8 one-hots accumulating into
+int32 (exact); weighted sums run as bf16 with an optional two-term
+split-float pass that keeps f32-exact results (each bf16 product is exact
+because the one-hot factor is 0/1).
+
+Out-of-range segment ids (idx < 0 or >= num_segments) contribute nothing:
+their `hi` row matches no column of the iota, so they vanish from the
+product — this is the INVALID_INDEX drop semantics of segment_ops without
+any masking cost.
+
+The batch is processed in static chunks via lax.scan so the one-hot
+intermediates stay VMEM-sized.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+LANES = 128  # TPU lane width: the `lo` one-hot dimension
+
+
+def plan_segments(num_segments: int) -> Tuple[int, int]:
+    """Factor num_segments as HI * LANES (rounded up)."""
+    hi = -(-num_segments // LANES)
+    return hi, LANES
+
+
+def _one_hots(idx: jnp.ndarray, hi_n: int, dtype) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    hi = (idx // LANES).astype(jnp.int32)
+    lo = (idx % LANES).astype(jnp.int32)
+    oh_hi = (hi[:, None] == jnp.arange(hi_n, dtype=jnp.int32)[None, :]).astype(dtype)
+    oh_lo = (lo[:, None] == jnp.arange(LANES, dtype=jnp.int32)[None, :]).astype(dtype)
+    return oh_hi, oh_lo
+
+
+def _dot(a: jnp.ndarray, b: jnp.ndarray, out_dtype) -> jnp.ndarray:
+    return jax.lax.dot_general(
+        a, b, (((0,), (0,)), ((), ())), preferred_element_type=out_dtype
+    )
+
+
+def count_hist(idx: jnp.ndarray, num_segments: int, *, chunk: int = 8192) -> jnp.ndarray:
+    """int32[num_segments] counts of idx values; out-of-range ids dropped.
+
+    idx length must be a multiple of `chunk` (pad with -1).
+    """
+    hi_n, _ = plan_segments(num_segments)
+
+    def body(acc, ii):
+        oh_hi, oh_lo = _one_hots(ii, hi_n, jnp.int8)
+        return acc + _dot(oh_hi, oh_lo, jnp.int32), None
+
+    n = idx.shape[0] // chunk
+    acc, _ = jax.lax.scan(body, jnp.zeros((hi_n, LANES), jnp.int32), idx.reshape(n, chunk))
+    return acc.reshape(-1)[:num_segments]
+
+
+def weighted_hist(
+    idx: jnp.ndarray,
+    vals: jnp.ndarray,
+    num_segments: int,
+    *,
+    chunk: int = 8192,
+    exact: bool = True,
+) -> jnp.ndarray:
+    """f32[num_segments] per-segment sums of vals; out-of-range ids dropped.
+
+    exact=True splits each f32 value into two bf16 terms (v == hi + lo
+    exactly), doubling the matmul work but keeping f32-exact partial
+    products — parity with the reference's per-record double accumulation
+    for inputs representable as float32.
+    """
+    hi_n, _ = plan_segments(num_segments)
+
+    def body(acc, args):
+        ii, vv = args
+        oh_hi, oh_lo = _one_hots(ii, hi_n, jnp.bfloat16)
+        if exact:
+            v_hi = vv.astype(jnp.bfloat16)
+            v_lo = (vv - v_hi.astype(jnp.float32)).astype(jnp.bfloat16)
+            acc = acc + _dot(oh_hi * v_hi[:, None], oh_lo, jnp.float32)
+            acc = acc + _dot(oh_hi * v_lo[:, None], oh_lo, jnp.float32)
+        else:
+            acc = acc + _dot(oh_hi * vv[:, None].astype(jnp.bfloat16), oh_lo, jnp.float32)
+        return acc, None
+
+    n = idx.shape[0] // chunk
+    acc, _ = jax.lax.scan(
+        body, jnp.zeros((hi_n, LANES), jnp.float32), (idx.reshape(n, chunk), vals.reshape(n, chunk))
+    )
+    return acc.reshape(-1)[:num_segments]
+
+
+def pad_batch(arrs, n: int, chunk: int, fill_idx: int = -1):
+    """Host-side: pad (idx, *value arrays) up to a chunk multiple."""
+    padded = -(-max(n, 1) // chunk) * chunk
+    if padded == n:
+        return arrs, n
+    out = []
+    for i, a in enumerate(arrs):
+        fill = fill_idx if i == 0 else 0
+        pad = np.full(padded - n, fill, dtype=a.dtype)
+        out.append(np.concatenate([a, pad]))
+    return out, padded

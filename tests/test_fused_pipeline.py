@@ -1,0 +1,167 @@
+"""MXU matmul-histogram kernels + FusedWindowPipeline parity vs the oracle.
+
+The oracle is OracleWindowOperator — a per-record Python port of the
+reference's WindowOperator semantics (WindowOperator.java:293-575); the
+fused pipeline must produce identical fired (key, window, value) sets.
+"""
+
+import numpy as np
+import pytest
+
+from flink_tpu.api.windowing.assigners import (
+    SlidingEventTimeWindows,
+    TumblingEventTimeWindows,
+)
+from flink_tpu.ops import matmul_hist
+from flink_tpu.runtime.fused_window_pipeline import FusedWindowPipeline
+from flink_tpu.runtime.oracle_window_operator import OracleWindowOperator
+
+
+# ---------------------------------------------------------------------------
+# kernel-level: histograms vs np.bincount
+# ---------------------------------------------------------------------------
+
+def test_count_hist_matches_bincount():
+    rng = np.random.default_rng(0)
+    nseg = 1000
+    idx = rng.integers(0, nseg, 4096).astype(np.int32)
+    got = np.asarray(matmul_hist.count_hist(idx, nseg, chunk=512))
+    expect = np.bincount(idx, minlength=nseg)
+    np.testing.assert_array_equal(got, expect)
+
+
+def test_count_hist_drops_invalid_lanes():
+    idx = np.array([-1, 3, 3, -1, 7, 2000000], dtype=np.int32)
+    (idx_p,), _ = matmul_hist.pad_batch((idx,), len(idx), 8)
+    got = np.asarray(matmul_hist.count_hist(idx_p, 10, chunk=8))
+    expect = np.zeros(10, np.int64)
+    expect[3] = 2
+    expect[7] = 1
+    np.testing.assert_array_equal(got, expect)
+
+
+@pytest.mark.parametrize("exact", [True, False])
+def test_weighted_hist(exact):
+    rng = np.random.default_rng(1)
+    nseg = 300
+    idx = rng.integers(0, nseg, 2048).astype(np.int32)
+    vals = rng.normal(size=2048).astype(np.float32)
+    got = np.asarray(matmul_hist.weighted_hist(idx, vals, nseg, chunk=256, exact=exact))
+    expect = np.bincount(idx, weights=vals.astype(np.float64), minlength=nseg)
+    tol = 1e-4 if exact else 8e-2
+    np.testing.assert_allclose(got, expect, rtol=tol, atol=tol)
+
+
+def test_weighted_hist_exact_integer_values():
+    # integer-valued f32 sums must be bit-exact through the split-float path
+    rng = np.random.default_rng(2)
+    idx = rng.integers(0, 64, 1024).astype(np.int32)
+    vals = rng.integers(0, 1000, 1024).astype(np.float32)
+    got = np.asarray(matmul_hist.weighted_hist(idx, vals, 64, chunk=256, exact=True))
+    expect = np.bincount(idx, weights=vals, minlength=64).astype(np.float32)
+    np.testing.assert_array_equal(got, expect)
+
+
+# ---------------------------------------------------------------------------
+# pipeline parity vs oracle
+# ---------------------------------------------------------------------------
+
+def _run_oracle(assigner, agg_name, batches, wms):
+    from flink_tpu.ops.aggregators import resolve
+
+    op = OracleWindowOperator(assigner, resolve(agg_name).python_equivalent())
+    out = {}
+    for (kid, vals, ts), wm in zip(batches, wms):
+        for i in range(len(ts)):
+            v = 1.0 if vals is None else float(vals[i])
+            op.process_record(int(kid[i]), v, int(ts[i]))
+        op.process_watermark(wm)
+    for key, window, value, _ts in op.drain_output():
+        out[(key, window.start)] = value
+    return out, op.num_late_records_dropped
+
+
+def _run_fused(assigner, agg_name, batches, wms, **kw):
+    pipe = FusedWindowPipeline(assigner, agg_name, **kw)
+    out = {}
+    half = len(batches) // 2 or 1
+    for lo in range(0, len(batches), half):
+        for window, counts, fields in pipe.process_superbatch(
+            batches[lo : lo + half], wms[lo : lo + half]
+        ):
+            live = np.flatnonzero(counts > 0)
+            for k in live:
+                if agg_name == "count":
+                    out[(int(k), window.start)] = int(counts[k])
+                else:
+                    out[(int(k), window.start)] = float(fields["sum"][k])
+    return out, pipe.num_late_records_dropped
+
+
+def _workload(rng, nkeys, nbatches, batch, ooo_ms, rate_ms):
+    batches, wms = [], []
+    t = 10_000
+    for _ in range(nbatches):
+        kid = rng.integers(0, nkeys, batch).astype(np.int32)
+        base = t + np.sort(rng.integers(0, rate_ms, batch))
+        ts = np.maximum(base - rng.integers(0, ooo_ms, batch), 0).astype(np.int64)
+        vals = rng.integers(1, 50, batch).astype(np.float32)
+        batches.append((kid, vals, ts))
+        wms.append(int(base[-1]) - ooo_ms)
+        t += rate_ms
+    return batches, wms
+
+
+@pytest.mark.parametrize(
+    "assigner",
+    [
+        SlidingEventTimeWindows.of(10_000, 2_000),
+        TumblingEventTimeWindows.of(5_000),
+    ],
+)
+@pytest.mark.parametrize("agg", ["count", "sum"])
+def test_fused_parity_random(assigner, agg):
+    rng = np.random.default_rng(7)
+    batches, wms = _workload(rng, nkeys=13, nbatches=8, batch=96, ooo_ms=900, rate_ms=4_000)
+    expect, late_o = _run_oracle(assigner, agg, batches, wms)
+    got, late_f = _run_fused(
+        assigner, agg, batches, wms,
+        key_capacity=13, nsb=8, chunk=32, fires_per_step=8, out_rows=64,
+    )
+    assert late_f == late_o
+    assert set(got) == set(expect)
+    for k in expect:
+        assert got[k] == pytest.approx(expect[k]), k
+
+
+def test_fused_late_records_dropped():
+    assigner = TumblingEventTimeWindows.of(1_000)
+    pipe = FusedWindowPipeline(assigner, "count", key_capacity=4, nsb=4, chunk=8)
+    kid = np.zeros(8, np.int32)
+    ts = np.full(8, 5_500, np.int64)
+    res = pipe.process_superbatch([(kid, None, ts)], [7_999])
+    assert [r[0].start for r in res] == [5_000]
+    # watermark is now 7999 >= window-end 7000 - 1: slice 5 is expired
+    res2 = pipe.process_superbatch([(kid, None, np.full(8, 5_600, np.int64))], [8_000])
+    assert res2 == []
+    assert pipe.num_late_records_dropped == 8
+
+
+def test_fused_snapshot_restore_roundtrip():
+    assigner = SlidingEventTimeWindows.of(4_000, 2_000)
+    rng = np.random.default_rng(3)
+    batches, wms = _workload(rng, nkeys=5, nbatches=6, batch=32, ooo_ms=500, rate_ms=1_500)
+
+    pipe = FusedWindowPipeline(assigner, "count", key_capacity=5, nsb=4, chunk=16,
+                               fires_per_step=4)
+    out_a = list(pipe.process_superbatch(batches[:3], wms[:3]))
+    snap = pipe.snapshot()
+
+    pipe2 = FusedWindowPipeline(assigner, "count", key_capacity=5, nsb=4, chunk=16,
+                                fires_per_step=4)
+    pipe2.restore(snap)
+    out_b = pipe2.process_superbatch(batches[3:], wms[3:])
+    out_b2 = pipe.process_superbatch(batches[3:], wms[3:])
+    assert [(w.start, list(c)) for w, c, _ in out_b] == [
+        (w.start, list(c)) for w, c, _ in out_b2
+    ]
