@@ -1,0 +1,6 @@
+#!/usr/bin/env bash
+# Start the JobManager (Dispatcher + JobMaster + blob server) — the analogue
+# of the reference's bin/jobmanager.sh.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+exec python -m flink_tpu.runtime.cluster jobmanager "$@"

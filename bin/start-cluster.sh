@@ -1,0 +1,21 @@
+#!/usr/bin/env bash
+# Local cluster: one JobManager + N TaskManagers (default 2) on this host —
+# the analogue of the reference's bin/start-cluster.sh.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+N_TM="${1:-2}"
+PORT="${FLINK_TPU_JM_PORT:-6123}"
+LOGDIR="${FLINK_TPU_LOG_DIR:-/tmp/flink_tpu_logs}"
+mkdir -p "$LOGDIR"
+python -m flink_tpu.runtime.cluster jobmanager --port "$PORT" \
+  --checkpoint-dir "${FLINK_TPU_CHECKPOINT_DIR:-/tmp/flink_tpu_checkpoints}" \
+  --checkpoint-interval "${FLINK_TPU_CHECKPOINT_INTERVAL:-10}" \
+  > "$LOGDIR/jobmanager.log" 2>&1 &
+echo $! > "$LOGDIR/jobmanager.pid"
+sleep 1
+for i in $(seq 1 "$N_TM"); do
+  python -m flink_tpu.runtime.cluster taskmanager --jobmanager "127.0.0.1:$PORT" \
+    > "$LOGDIR/taskmanager-$i.log" 2>&1 &
+  echo $! >> "$LOGDIR/taskmanagers.pid"
+done
+echo "cluster up: jobmanager 127.0.0.1:$PORT, $N_TM taskmanagers (logs in $LOGDIR)"

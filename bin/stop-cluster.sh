@@ -1,0 +1,7 @@
+#!/usr/bin/env bash
+set -euo pipefail
+LOGDIR="${FLINK_TPU_LOG_DIR:-/tmp/flink_tpu_logs}"
+for f in "$LOGDIR"/taskmanagers.pid "$LOGDIR"/jobmanager.pid; do
+  [ -f "$f" ] && while read -r pid; do kill "$pid" 2>/dev/null || true; done < "$f" && rm -f "$f"
+done
+echo "cluster stopped"

@@ -1,0 +1,6 @@
+#!/usr/bin/env bash
+# Start a TaskManager worker and register it with the JobManager.
+# Usage: taskmanager.sh --jobmanager host:6123 [--slots N]
+set -euo pipefail
+cd "$(dirname "$0")/.."
+exec python -m flink_tpu.runtime.cluster taskmanager "$@"

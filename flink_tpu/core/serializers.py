@@ -98,18 +98,33 @@ class TypeSerializerSnapshot:
     def from_dict(d: dict) -> "TypeSerializerSnapshot":
         return TypeSerializerSnapshot(d["class"], d.get("config", {}))
 
+    _ROW_FAMILY = ("RowSerializer", "DataclassSerializer")
+
     def resolve_compatibility(self, new_serializer: TypeSerializer) -> str:
         new = new_serializer.snapshot()
-        if new.serializer_class != self.serializer_class:
+        row_to_row = (
+            self.serializer_class in self._ROW_FAMILY
+            and new.serializer_class in self._ROW_FAMILY
+        )
+        if new.serializer_class != self.serializer_class and not row_to_row:
             return INCOMPATIBLE
         if new.config == self.config:
             return COMPATIBLE_AS_IS
-        if self.serializer_class in ("RowSerializer", "DataclassSerializer"):
+        if row_to_row:
+            # wire-identical row<->dataclass (e.g. reading with the class
+            # gone) is as-is; otherwise fields migrate by name, recursing
+            # into nested rows
+            if (self.config["names"] == new.config["names"]
+                    and self.config["fields"] == new.config["fields"]):
+                return COMPATIBLE_AS_IS
             old_f = dict(zip(self.config["names"], self.config["fields"]))
             new_f = dict(zip(new.config["names"], new.config["fields"]))
-            # shared fields must keep their wire format
             for name in set(old_f) & set(new_f):
-                if old_f[name] != new_f[name]:
+                if old_f[name] == new_f[name]:
+                    continue
+                old_snap = TypeSerializerSnapshot.from_dict(old_f[name])
+                new_field = _restore_raw(TypeSerializerSnapshot.from_dict(new_f[name]))
+                if old_snap.resolve_compatibility(new_field) == INCOMPATIBLE:
                     return INCOMPATIBLE
             return COMPATIBLE_AFTER_MIGRATION
         return INCOMPATIBLE
@@ -308,18 +323,28 @@ class RowSerializer(TypeSerializer):
         """Reader that consumes the OLD wire format and emits rows in the NEW
         field order (dropped fields skipped, added fields None)."""
         old_names = old.config["names"]
-        old_sers = [restore_serializer(TypeSerializerSnapshot.from_dict(d))
-                    for d in old.config["fields"]]
         new_index = {n: i for i, n in enumerate(self.names)}
+        # per old field: a reader that consumes the OLD wire bytes; shared
+        # fields whose own schema evolved get a nested migrating reader
+        readers = []
+        for n, fdict in zip(old_names, old.config["fields"]):
+            fsnap = TypeSerializerSnapshot.from_dict(fdict)
+            idx = new_index.get(n)
+            if idx is not None and self.fields[idx].snapshot().to_dict() != fdict:
+                verdict = fsnap.resolve_compatibility(self.fields[idx])
+                if verdict == COMPATIBLE_AFTER_MIGRATION:
+                    readers.append(self.fields[idx].migrating_reader(fsnap))
+                    continue
+            readers.append(restore_serializer(fsnap).read)
 
         def read(inp: io.BytesIO):
             mask = read_varint(inp)
             out_vals: List[Any] = [_MISSING] * len(self.names)
-            for i, (n, s) in enumerate(zip(old_names, old_sers)):
+            for i, (n, rd) in enumerate(zip(old_names, readers)):
                 if mask & (1 << i):
                     v = None
                 else:
-                    v = s.read(inp)
+                    v = rd(inp)
                 if n in new_index:
                     out_vals[new_index[n]] = v
             return self._finish(out_vals)
@@ -399,6 +424,9 @@ def restore_serializer(snap: TypeSerializerSnapshot) -> TypeSerializer:
         return _RESTORERS[snap.serializer_class](snap.config)
     except KeyError:
         raise ValueError(f"unknown serializer snapshot {snap.serializer_class}")
+
+
+_restore_raw = restore_serializer  # internal alias (compat resolution)
 
 
 # ---------------------------------------------------------------------------

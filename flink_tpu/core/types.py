@@ -62,6 +62,12 @@ class TypeInformation:
         if isinstance(hint, np.dtype) or (isinstance(hint, type) and issubclass(hint, np.generic)):
             return NumpyTypeInfo(np.dtype(hint))
         origin = typing.get_origin(hint)
+        if origin is typing.Union:
+            args = [a for a in typing.get_args(hint) if a is not type(None)]
+            if len(args) == 1:
+                # Optional[X] ≡ X: the row null-mask already encodes None
+                return TypeInformation.of(args[0])
+            return Types.PICKLED
         if origin in (tuple,):
             args = typing.get_args(hint)
             if Ellipsis in args:  # variadic tuple[X, ...]: no fixed arity

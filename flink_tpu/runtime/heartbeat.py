@@ -1,0 +1,83 @@
+"""Bidirectional heartbeats with timeout-based failure detection.
+
+Analogue of runtime/heartbeat/HeartbeatManagerImpl.java:49: a monitor tracks
+last-seen times per target, a sender thread pings peers via a callable, and
+targets silent for longer than the timeout are reported dead exactly once.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable, Dict, Optional
+
+
+class HeartbeatManager:
+    def __init__(
+        self,
+        *,
+        interval: float = 1.0,
+        timeout: float = 5.0,
+        on_dead: Optional[Callable[[str], None]] = None,
+    ):
+        self.interval = interval
+        self.timeout = timeout
+        self.on_dead = on_dead
+        self._targets: Dict[str, dict] = {}
+        self._lock = threading.Lock()
+        self._running = True
+        self._thread = threading.Thread(target=self._loop, name="heartbeat", daemon=True)
+        self._thread.start()
+
+    def monitor(self, target_id: str, ping: Optional[Callable[[], None]] = None) -> None:
+        """Track a target; `ping` (optional) is invoked every interval — an
+        exception or silence past the timeout marks the target dead."""
+        with self._lock:
+            self._targets[target_id] = {"last": time.monotonic(), "ping": ping, "dead": False}
+
+    def unmonitor(self, target_id: str) -> None:
+        with self._lock:
+            self._targets.pop(target_id, None)
+
+    def receive_heartbeat(self, target_id: str) -> None:
+        with self._lock:
+            t = self._targets.get(target_id)
+            if t is not None:
+                t["last"] = time.monotonic()
+                t["dead"] = False
+
+    def is_alive(self, target_id: str) -> bool:
+        with self._lock:
+            t = self._targets.get(target_id)
+            return t is not None and not t["dead"]
+
+    def _loop(self) -> None:
+        while self._running:
+            now = time.monotonic()
+            with self._lock:
+                items = list(self._targets.items())
+            for tid, t in items:
+                if t["dead"]:
+                    continue
+                ping = t["ping"]
+                if ping is not None:
+                    try:
+                        ping()
+                        self.receive_heartbeat(tid)
+                        continue
+                    except Exception:
+                        pass  # treat like silence; timeout decides
+                if now - t["last"] > self.timeout:
+                    with self._lock:
+                        if t["dead"]:
+                            continue
+                        t["dead"] = True
+                    if self.on_dead is not None:
+                        try:
+                            self.on_dead(tid)
+                        except Exception:
+                            pass
+            time.sleep(self.interval)
+
+    def stop(self) -> None:
+        self._running = False

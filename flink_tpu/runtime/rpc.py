@@ -1,0 +1,238 @@
+"""Control-plane RPC: location-transparent endpoint calls over TCP.
+
+The analogue of the reference's actor RPC (flink-rpc-akka/.../PekkoRpcService.java:86,
+PekkoInvocationHandler.java:71): named endpoints expose public methods;
+remote callers hold a gateway proxy whose attribute calls serialize the
+invocation, ship it over a framed TCP connection, and return the result (or
+re-raise the remote exception). Each endpoint executes ALL invocations on
+one dedicated main thread — the single-threaded actor discipline that the
+reference enforces with MainThreadValidatorUtil (MainThreadValidatorUtil.java:35)
+— so endpoint state needs no locks.
+
+Wire format: 4-byte big-endian length + pickle of
+(endpoint, method, args, kwargs) / (ok, payload). This is the DCN control
+plane; the data plane (record batches, credits) lives in dataplane.py.
+"""
+
+from __future__ import annotations
+
+import pickle
+import socket
+import socketserver
+import struct
+import threading
+import traceback
+import uuid
+from concurrent.futures import Future
+from typing import Any, Callable, Dict, Optional
+
+
+def _send_frame(sock: socket.socket, payload: bytes) -> None:
+    sock.sendall(struct.pack(">I", len(payload)) + payload)
+
+
+def _recv_frame(sock: socket.socket) -> Optional[bytes]:
+    hdr = b""
+    while len(hdr) < 4:
+        chunk = sock.recv(4 - len(hdr))
+        if not chunk:
+            return None
+        hdr += chunk
+    (n,) = struct.unpack(">I", hdr)
+    buf = bytearray()
+    while len(buf) < n:
+        chunk = sock.recv(min(1 << 20, n - len(buf)))
+        if not chunk:
+            return None
+        buf += chunk
+    return bytes(buf)
+
+
+class RpcEndpoint:
+    """Base class: public methods become remotely callable; all invocations
+    (local or remote) run on the endpoint's single main thread."""
+
+    def __init__(self, name: Optional[str] = None):
+        self.name = name or f"{type(self).__name__}-{uuid.uuid4().hex[:8]}"
+        self._inbox: "list" = []
+        self._cv = threading.Condition()
+        self._running = True
+        self._main_thread = threading.Thread(
+            target=self._main_loop, name=f"rpc-main-{self.name}", daemon=True
+        )
+        self._main_thread.start()
+
+    # -- main-thread discipline --------------------------------------------
+    def validate_main_thread(self) -> None:
+        assert threading.current_thread() is self._main_thread, (
+            f"endpoint {self.name} state touched off the main thread"
+        )
+
+    def run_in_main_thread(self, fn: Callable, *args, **kwargs) -> Future:
+        f: Future = Future()
+        with self._cv:
+            if not self._running:
+                f.set_exception(RuntimeError(f"endpoint {self.name} stopped"))
+                return f
+            self._inbox.append((fn, args, kwargs, f))
+            self._cv.notify()
+        return f
+
+    def _main_loop(self) -> None:
+        while True:
+            with self._cv:
+                while self._running and not self._inbox:
+                    self._cv.wait(timeout=0.2)
+                if not self._running and not self._inbox:
+                    return
+                fn, args, kwargs, fut = self._inbox.pop(0)
+            try:
+                fut.set_result(fn(*args, **kwargs))
+            except BaseException as e:  # noqa: BLE001 — forwarded to caller
+                fut.set_exception(e)
+
+    def stop(self) -> None:
+        with self._cv:
+            self._running = False
+            self._cv.notify_all()
+
+    # called by the server
+    def _invoke(self, method: str, args, kwargs):
+        fn = getattr(self, method, None)
+        if fn is None or method.startswith("_"):
+            raise AttributeError(f"{self.name} has no rpc method {method!r}")
+        return self.run_in_main_thread(fn, *args, **kwargs)
+
+
+class RpcService:
+    """Hosts endpoints on one TCP port; builds gateways to remote services."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0):
+        self._endpoints: Dict[str, RpcEndpoint] = {}
+        self._lock = threading.Lock()
+        service = self
+
+        class Handler(socketserver.BaseRequestHandler):
+            def handle(self):
+                while True:
+                    frame = _recv_frame(self.request)
+                    if frame is None:
+                        return
+                    try:
+                        endpoint, method, args, kwargs = pickle.loads(frame)
+                        with service._lock:
+                            ep = service._endpoints.get(endpoint)
+                        if ep is None:
+                            raise LookupError(f"no endpoint {endpoint!r}")
+                        result = ep._invoke(method, args, kwargs).result()
+                        reply = (True, result)
+                    except BaseException as e:  # noqa: BLE001 — shipped back
+                        reply = (False, (type(e).__name__, str(e), traceback.format_exc()))
+                    try:
+                        _send_frame(self.request, pickle.dumps(reply))
+                    except OSError:
+                        return
+
+        class Server(socketserver.ThreadingTCPServer):
+            allow_reuse_address = True
+            daemon_threads = True
+
+        self._server = Server((host, port), Handler)
+        self.host, self.port = self._server.server_address
+        self._thread = threading.Thread(
+            target=self._server.serve_forever, name=f"rpc-srv-{self.port}", daemon=True
+        )
+        self._thread.start()
+
+    @property
+    def address(self) -> str:
+        return f"{self.host}:{self.port}"
+
+    def register(self, endpoint: RpcEndpoint) -> None:
+        with self._lock:
+            self._endpoints[endpoint.name] = endpoint
+
+    def unregister(self, name: str) -> None:
+        with self._lock:
+            self._endpoints.pop(name, None)
+
+    def gateway(self, address: str, endpoint: str, timeout: float = 10.0) -> "RpcGateway":
+        return RpcGateway(address, endpoint, timeout)
+
+    def stop(self) -> None:
+        with self._lock:
+            eps = list(self._endpoints.values())
+        for ep in eps:
+            ep.stop()
+        self._server.shutdown()
+        self._server.server_close()
+
+
+class RemoteRpcError(RuntimeError):
+    def __init__(self, exc_type: str, message: str, remote_traceback: str):
+        super().__init__(f"{exc_type}: {message}")
+        self.exc_type = exc_type
+        self.remote_traceback = remote_traceback
+
+
+class RpcGateway:
+    """Dynamic proxy: gateway.method(*a, **kw) → remote invocation.
+
+    One TCP connection per gateway, serialized calls (matching the
+    per-endpoint ordering guarantee of the reference's actor mailbox)."""
+
+    def __init__(self, address: str, endpoint: str, timeout: float = 10.0):
+        self._address = address
+        self._endpoint = endpoint
+        self._timeout = timeout
+        self._sock: Optional[socket.socket] = None
+        self._lock = threading.Lock()
+
+    def _connect(self) -> socket.socket:
+        if self._sock is None:
+            host, port = self._address.rsplit(":", 1)
+            self._sock = socket.create_connection((host, int(port)), timeout=self._timeout)
+        return self._sock
+
+    def close(self) -> None:
+        with self._lock:
+            if self._sock is not None:
+                try:
+                    self._sock.close()
+                finally:
+                    self._sock = None
+
+    def __getattr__(self, method: str):
+        if method.startswith("_"):
+            raise AttributeError(method)
+
+        def call(*args, **kwargs):
+            with self._lock:
+                sock = self._connect()
+                try:
+                    _send_frame(sock, pickle.dumps((self._endpoint, method, args, kwargs)))
+                    frame = _recv_frame(sock)
+                except OSError:
+                    self.close()
+                    raise
+                if frame is None:
+                    self.close()
+                    raise ConnectionError(f"rpc connection to {self._address} closed")
+            ok, payload = pickle.loads(frame)
+            if ok:
+                return payload
+            raise RemoteRpcError(*payload)
+
+        return call
+
+    def call_async(self, method: str, *args, **kwargs) -> Future:
+        f: Future = Future()
+
+        def run():
+            try:
+                f.set_result(getattr(self, method)(*args, **kwargs))
+            except BaseException as e:  # noqa: BLE001
+                f.set_exception(e)
+
+        threading.Thread(target=run, daemon=True).start()
+        return f

@@ -141,3 +141,47 @@ def test_dataclass_snapshot_restores_as_row_when_class_gone():
     data = s.serialize(Click("u", 2, 0.5))
     restored = restore_serializer(TypeSerializerSnapshot.from_dict(s.snapshot().to_dict()))
     assert restored.deserialize(data) == ("u", 2, 0.5)
+
+
+def test_read_blob_with_class_gone_row_reader():
+    # dataclass-written blob read by a wire-identical RowSerializer (class gone)
+    v1 = TypeInformation.of(Click).serializer()
+    blob = write_typed_blob([Click("u", 1, 2.0)], v1)
+    row = Types.ROW(["user", "count", "score"],
+                    [Types.STRING, Types.LONG, Types.DOUBLE]).serializer()
+    assert read_typed_blob(blob, row) == [("u", 1, 2.0)]
+    # and via the snapshot-restored serializer itself
+    restored = restore_serializer(TypeSerializerSnapshot.from_dict(blob["snapshot"]))
+    assert read_typed_blob(blob, restored) == [("u", 1, 2.0)]
+
+
+def test_nested_row_evolution():
+    inner_v1 = Types.ROW(["x"], [Types.LONG])
+    outer_v1 = Types.ROW(["k", "inner"], [Types.STRING, inner_v1]).serializer()
+    blob = write_typed_blob([("a", (7,))], outer_v1)
+
+    inner_v2 = Types.ROW(["x", "y"], [Types.LONG, Types.DOUBLE])
+    outer_v2 = Types.ROW(["k", "inner"], [Types.STRING, inner_v2]).serializer()
+    assert read_typed_blob(blob, outer_v2) == [("a", (7, None))]
+
+    # nested retype is still incompatible
+    inner_bad = Types.ROW(["x"], [Types.DOUBLE])
+    outer_bad = Types.ROW(["k", "inner"], [Types.STRING, inner_bad]).serializer()
+    with pytest.raises(ValueError, match="incompatible"):
+        read_typed_blob(blob, outer_bad)
+
+
+def test_optional_hint_unwraps():
+    import typing
+
+    assert TypeInformation.of(typing.Optional[float]) is Types.DOUBLE
+
+    @dataclasses.dataclass
+    class WithOpt:
+        a: typing.Optional[int]
+        b: str
+
+    ti = TypeInformation.of(WithOpt)
+    assert ti.types == [Types.LONG, Types.STRING]
+    s = ti.serializer()
+    assert s.deserialize(s.serialize(WithOpt(None, "z"))) == WithOpt(None, "z")
