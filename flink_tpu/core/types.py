@@ -61,8 +61,10 @@ class TypeInformation:
             return Types.BYTES
         if isinstance(hint, np.dtype) or (isinstance(hint, type) and issubclass(hint, np.generic)):
             return NumpyTypeInfo(np.dtype(hint))
+        import types as _pytypes
+
         origin = typing.get_origin(hint)
-        if origin is typing.Union:
+        if origin is typing.Union or origin is getattr(_pytypes, "UnionType", ()):
             args = [a for a in typing.get_args(hint) if a is not type(None)]
             if len(args) == 1:
                 # Optional[X] ≡ X: the row null-mask already encodes None

@@ -218,6 +218,9 @@ class JobManagerEndpoint(RpcEndpoint):
         return slots
 
     def _try_schedule(self, job: _JobState) -> None:
+        if job.status not in ("CREATED", "RESTARTING"):
+            return  # already scheduled (e.g. a TM registration raced the
+            # delayed-restart thread) or terminal
         slots = self._free_slots()
         if len(slots) < job.parallelism:
             return  # WaitingForResources (AdaptiveScheduler state analogue)
@@ -323,10 +326,16 @@ class JobManagerEndpoint(RpcEndpoint):
             handles = job.pending.pop(checkpoint_id)
             step = job.pending_target.pop(checkpoint_id)
             if self._storage is not None:
-                handle = self._storage.save(
+                self._storage.save(
                     checkpoint_id, {"job": job_id, "shards": handles, "step": step}
                 )
             job.completed.append((checkpoint_id, handles, step))
+            # retain a bounded history in JM memory (durable copies live in
+            # checkpoint storage); discard superseded ones
+            while len(job.completed) > 3:
+                old_id, _, _ = job.completed.pop(0)
+                if self._storage is not None:
+                    self._storage.discard(old_id)
 
     def decline_checkpoint(self, job_id: str, attempt: int, shard: int,
                            checkpoint_id: int, reason: str) -> None:
@@ -505,6 +514,19 @@ class _ShardTask:
 
                 step += 1
                 self.current_step = step
+
+            # checkpoints targeted past the end of the stream cannot form a
+            # cut any more: decline so the JM's pending entry resolves
+            with self._cp_lock:
+                leftover, self._cp_requests = self._cp_requests, []
+            for cp_id, target in leftover:
+                try:
+                    self.jm.decline_checkpoint(
+                        self.job_id, self.attempt, self.shard, cp_id,
+                        f"stream ended at step {step} before target {target}",
+                    )
+                except Exception:
+                    pass
 
             if not self.cancelled.is_set():
                 op.process_watermark(MAX_WATERMARK)

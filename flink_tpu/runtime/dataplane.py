@@ -26,32 +26,20 @@ from __future__ import annotations
 import pickle
 import socket
 import socketserver
-import struct
 import threading
 from collections import deque
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
+from flink_tpu.runtime.rpc import _recv_frame, _send_frame
+
 
 def _send_msg(sock: socket.socket, obj) -> None:
-    payload = pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL)
-    sock.sendall(struct.pack(">I", len(payload)) + payload)
+    _send_frame(sock, pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL))
 
 
 def _recv_msg(sock: socket.socket):
-    hdr = b""
-    while len(hdr) < 4:
-        chunk = sock.recv(4 - len(hdr))
-        if not chunk:
-            return None
-        hdr += chunk
-    (n,) = struct.unpack(">I", hdr)
-    buf = bytearray()
-    while len(buf) < n:
-        chunk = sock.recv(min(1 << 20, n - len(buf)))
-        if not chunk:
-            return None
-        buf += chunk
-    return pickle.loads(bytes(buf))
+    frame = _recv_frame(sock)
+    return None if frame is None else pickle.loads(frame)
 
 
 class InputChannel:

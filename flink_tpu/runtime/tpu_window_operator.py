@@ -62,6 +62,8 @@ class TpuWindowOperator:
         batch_pad: int = 256,
         columnar_output: bool = False,
         ingest_kernel: str = "scatter",
+        hot_key_capacity: Optional[int] = None,
+        cold_tier_dir: Optional[str] = None,
     ):
         agg = resolve(aggregate)
         if agg is None:
@@ -93,6 +95,21 @@ class TpuWindowOperator:
             need = self.spw + self.lateness_slices + 2 * self.spw + 16
             num_slices = 1 << (need - 1).bit_length()
         self.S = num_slices
+
+        # hot/cold key split (S3/S4 analogue): dense ids below
+        # hot_key_capacity live as device columns; the overflow aggregates
+        # into the native spill store (state/cold_tier.py)
+        self.hot_key_capacity = hot_key_capacity
+        self.cold_tier = None
+        if hot_key_capacity is not None:
+            if allowed_lateness > 0:
+                raise ValueError(
+                    "hot/cold key tiering does not support allowed_lateness yet"
+                )
+            from flink_tpu.state.cold_tier import ColdKeyTier
+
+            self.cold_tier = ColdKeyTier(agg, self.S, directory=cold_tier_dir)
+            key_capacity = min(key_capacity, hot_key_capacity)
 
         self.state = ColumnarWindowState(
             agg,
@@ -215,6 +232,19 @@ class TpuWindowOperator:
         # 3. dense key ids (grow capacity first so the scatter shape is right)
         kid = np.full(len(ts), segment_ops.INVALID_INDEX, dtype=np.int64)
         ids, required = self.state.keydict.lookup_or_insert(keys[keep])
+        if self.cold_tier is not None and required > self.hot_key_capacity:
+            # overflow ids take the host/LSM path with ABSOLUTE slices
+            cold = ids >= self.hot_key_capacity
+            if cold.any():
+                keep_idx = np.flatnonzero(keep)
+                cold_rows = keep_idx[cold]
+                self.cold_tier.ingest(
+                    (ids[cold] - self.hot_key_capacity).astype(np.int64),
+                    s_abs[cold_rows],
+                    np.asarray(vals[cold_rows], dtype=np.float32),
+                )
+                ids = np.where(cold, segment_ops.INVALID_INDEX, ids)
+            required = min(required, self.hot_key_capacity)
         self.state.ensure_key_capacity(required)
         kid[keep] = ids
 
@@ -232,6 +262,12 @@ class TpuWindowOperator:
             kid == segment_ops.INVALID_INDEX, segment_ops.INVALID_INDEX, kid
         ).astype(np.int32)
         self.state.ingest(kid32, s_abs, vals)
+        if self.cold_tier is not None:
+            # cold-only slices must still advance the used-slice frontiers
+            f = self.state.frontiers
+            lo, hi = int(s_abs[:n][keep].min()), int(s_abs[:n][keep].max())
+            f.min_used = lo if f.min_used is None else min(f.min_used, lo)
+            f.max_used = hi if f.max_used is None else max(f.max_used, hi)
 
         # 5. fire-cursor init/advance bookkeeping
         live_slices = s_abs[:n][keep]
@@ -326,17 +362,42 @@ class TpuWindowOperator:
             range(start_slice, start_slice + self.spw), touch_mask=touch_mask
         )
         mask_np = np.asarray(mask)
-        if not mask_np.any():
-            return
         ts = window.max_timestamp()
+        keydict = self.state.keydict
+
+        cold_emit = None
+        if self.cold_tier is not None:
+            n_cold = max(0, keydict.num_ids - self.hot_key_capacity)
+            if n_cold:
+                c_res, c_cnt = self.cold_tier.fire(
+                    n_cold, range(start_slice, start_slice + self.spw)
+                )
+                live = np.flatnonzero(c_cnt > 0)
+                if live.size:
+                    cold_emit = (live, c_res[live])
+
+        if not mask_np.any() and cold_emit is None:
+            return
         idxs = np.flatnonzero(mask_np)
         result_np = np.asarray(result)
         if self.columnar_output:
+            if cold_emit is not None:
+                idxs = np.concatenate([idxs, cold_emit[0] + self.hot_key_capacity])
+                result_np = np.concatenate(
+                    [result_np[np.flatnonzero(mask_np)], cold_emit[1]]
+                ) if mask_np.any() else cold_emit[1]
+                self.output.append((None, window, (idxs, result_np), ts))
+                return
             self.output.append((None, window, (idxs, result_np[idxs]), ts))
             return
-        keydict = self.state.keydict
         for i in idxs:
             self.output.append((keydict.key_at(int(i)), window, result_np[i].item(), ts))
+        if cold_emit is not None:
+            for ci, cv in zip(cold_emit[0], cold_emit[1]):
+                self.output.append(
+                    (keydict.key_at(int(ci) + self.hot_key_capacity), window,
+                     cv.item(), ts)
+                )
 
     def drain_output(self) -> List[Tuple[Any, Any, Any, int]]:
         out = self.output
@@ -354,6 +415,7 @@ class TpuWindowOperator:
             "fire_cursor": self.fire_cursor,
             "future": [(k, float(v), int(t)) for k, v, t in self._future],
             "num_late_dropped": self.num_late_records_dropped,
+            "cold": self.cold_tier.snapshot() if self.cold_tier is not None else None,
         }
 
     def restore(self, snap: dict) -> None:
@@ -362,5 +424,7 @@ class TpuWindowOperator:
         self.fire_cursor = snap["fire_cursor"]
         self._future = list(snap["future"])
         self.num_late_records_dropped = snap["num_late_dropped"]
+        if snap.get("cold") is not None and self.cold_tier is not None:
+            self.cold_tier.restore(snap["cold"])
         self._pending = []
         self.output = []

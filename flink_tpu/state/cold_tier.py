@@ -1,0 +1,161 @@
+"""Cold-key state tier: per-(key, slice) accumulator rows in the native
+spill store, for key cardinalities beyond the device's HBM columns.
+
+The role RocksDB/ForSt play in the reference (state larger than memory,
+S3/S4: RocksDBKeyedStateBackend / ForStKeyedStateBackend): the hot
+`key_capacity` dense ids stay as device columns (state/columnar.py); ids
+past it aggregate host-side into the batched C++ LSM
+(native/spill_store.cpp via utils/native_bridge.NativeSpillStore).
+
+Layout: store key = s_abs * (1<<32) + cold_kid (absolute slice, so ring
+reuse never aliases history); value = one f64 per accumregator field plus
+the count. All accesses are batched multi-get/multi-put — the ForSt
+batching pattern (ForStGeneralMultiGetOperation.java).
+
+A pure-python dict fallback keeps capability without a compiler.
+"""
+
+from __future__ import annotations
+
+import tempfile
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from flink_tpu.ops.aggregators import DeviceAggregator, ONE
+
+_COMBINE = {
+    "add": lambda a, b: a + b,
+    "min": np.minimum,
+    "max": np.maximum,
+}
+
+_SLICE_SHIFT = np.uint64(32)
+
+
+class _PyStoreFallback:
+    """dict-backed stand-in with the NativeSpillStore surface."""
+
+    def __init__(self, width: int):
+        self.width = width
+        self._d: Dict[int, bytes] = {}
+
+    def put_batch(self, keys, values):
+        values = np.ascontiguousarray(values).reshape(len(keys), self.width)
+        for k, v in zip(keys.tolist(), values):
+            self._d[int(k)] = v.tobytes()
+
+    def get_batch(self, keys):
+        out = np.zeros((len(keys), self.width), dtype=np.uint8)
+        found = np.zeros(len(keys), dtype=bool)
+        for i, k in enumerate(keys.tolist()):
+            b = self._d.get(int(k))
+            if b is not None:
+                out[i] = np.frombuffer(b, dtype=np.uint8)
+                found[i] = True
+        return out, found
+
+    def flush(self):
+        return 0
+
+    def compact(self):
+        return 0
+
+    def checkpoint(self) -> str:
+        import base64
+        import pickle
+
+        return "py:" + base64.b64encode(pickle.dumps(self._d)).decode()
+
+    def restore(self, manifest: str) -> None:
+        import base64
+        import pickle
+
+        self._d = pickle.loads(base64.b64decode(manifest[3:]))  # full replace
+
+
+class ColdKeyTier:
+    """Host/LSM accumulator rows for cold dense key ids."""
+
+    def __init__(self, agg: DeviceAggregator, ring_slices: int,
+                 directory: Optional[str] = None):
+        self.agg = agg
+        self.S = ring_slices
+        self.fields = list(agg.fields)
+        self.width = (len(self.fields) + 1) * 8  # f64 per field + count
+        self.dir = directory or tempfile.mkdtemp(prefix="flink_tpu_cold_")
+        try:
+            from flink_tpu.utils.native_bridge import NativeSpillStore
+
+            self.store = NativeSpillStore(self.width, self.dir)
+            self.native = True
+        except (RuntimeError, OSError):
+            self.store = _PyStoreFallback(self.width)
+            self.native = False
+        self.num_cold_rows_written = 0
+
+    # ------------------------------------------------------------------
+    def _store_keys(self, cold_kid: np.ndarray, s_abs: np.ndarray) -> np.ndarray:
+        return (s_abs.astype(np.uint64) << _SLICE_SHIFT) | cold_kid.astype(np.uint64)
+
+    def ingest(self, cold_kid: np.ndarray, s_abs: np.ndarray, vals: np.ndarray) -> None:
+        """Aggregate a batch of cold-key records into the store (read-combine
+        -write on the unique (key, slice) cells)."""
+        if len(cold_kid) == 0:
+            return
+        skeys = self._store_keys(cold_kid, s_abs)
+        uniq, inverse = np.unique(skeys, return_inverse=True)
+        rows = np.zeros((len(uniq), len(self.fields) + 1), dtype=np.float64)
+        for fi, f in enumerate(self.fields):
+            src = np.ones(len(vals)) if f.source == ONE else vals.astype(np.float64)
+            if f.scatter == "add":
+                np.add.at(rows[:, fi], inverse, src)
+            elif f.scatter == "min":
+                rows[:, fi] = np.asarray(f.identity, dtype=np.float64)
+                np.minimum.at(rows[:, fi], inverse, src)
+            else:
+                rows[:, fi] = np.asarray(f.identity, dtype=np.float64)
+                np.maximum.at(rows[:, fi], inverse, src)
+        np.add.at(rows[:, -1], inverse, 1.0)
+
+        old, found = self.store.get_batch(uniq)
+        old_rows = old.view(np.float64).reshape(len(uniq), len(self.fields) + 1)
+        for fi, f in enumerate(self.fields):
+            rows[found, fi] = _COMBINE[f.scatter](rows[found, fi], old_rows[found, fi])
+        rows[found, -1] += old_rows[found, -1]
+        self.store.put_batch(uniq, rows.view(np.uint8))
+        self.num_cold_rows_written += len(uniq)
+
+    def fire(self, num_cold: int, slice_range) -> Tuple[np.ndarray, np.ndarray]:
+        """Combine a window's slices for every cold key.
+        Returns (result[num_cold] of agg.result_dtype, counts[num_cold])."""
+        nf = len(self.fields)
+        acc = np.tile(
+            np.asarray([f.identity for f in self.fields], dtype=np.float64),
+            (max(num_cold, 1), 1),
+        )
+        counts = np.zeros(max(num_cold, 1), dtype=np.float64)
+        if num_cold == 0:
+            return acc[:0, 0], counts[:0]
+        ckids = np.arange(num_cold, dtype=np.uint64)
+        for s in slice_range:
+            skeys = self._store_keys(ckids, np.full(num_cold, s, dtype=np.int64))
+            vals, found = self.store.get_batch(skeys)
+            rows = vals.view(np.float64).reshape(num_cold, nf + 1)
+            for fi, f in enumerate(self.fields):
+                acc[found, fi] = _COMBINE[f.scatter](acc[found, fi], rows[found, fi])
+            counts[found] += rows[found, -1]
+        fields = {f.name: acc[:, fi].astype(f.dtype) for fi, f in enumerate(self.fields)}
+        result = np.asarray(self.agg.extract(fields), dtype=self.agg.result_dtype)
+        return result, counts
+
+    # ------------------------------------------------------------------
+    def snapshot(self) -> dict:
+        return {"manifest": self.store.checkpoint(), "dir": self.dir,
+                "native": self.native}
+
+    def restore(self, snap: dict) -> None:
+        self.store.restore(snap["manifest"])
+
+    def compact(self) -> None:
+        self.store.compact()

@@ -17,7 +17,11 @@ from typing import Optional, Tuple
 import numpy as np
 
 _REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
-_SRC = os.path.join(_REPO_ROOT, "native", "flink_tpu_native.cpp")
+_SRCS = [
+    os.path.join(_REPO_ROOT, "native", "flink_tpu_native.cpp"),
+    os.path.join(_REPO_ROOT, "native", "spill_store.cpp"),
+]
+_SRC = _SRCS[0]
 _LIB = os.path.join(_REPO_ROOT, "native", "libflink_tpu_native.so")
 
 _lock = threading.Lock()
@@ -28,7 +32,7 @@ _load_failed = False
 def _build() -> bool:
     try:
         subprocess.run(
-            ["g++", "-O3", "-shared", "-fPIC", "-std=c++17", "-o", _LIB, _SRC],
+            ["g++", "-O3", "-shared", "-fPIC", "-std=c++17", "-o", _LIB, *_SRCS],
             check=True,
             capture_output=True,
             timeout=120,
@@ -48,12 +52,12 @@ def get_lib() -> Optional[ctypes.CDLL]:
         if _lib is not None or _load_failed:
             return _lib
         try:
-            if not os.path.exists(_SRC):
+            if not all(os.path.exists(src) for src in _SRCS):
                 _load_failed = True
                 return None
             if (
                 not os.path.exists(_LIB)
-                or os.path.getmtime(_LIB) < os.path.getmtime(_SRC)
+                or os.path.getmtime(_LIB) < max(os.path.getmtime(s) for s in _SRCS)
             ):
                 if not _build():
                     _load_failed = True
@@ -96,6 +100,25 @@ def _declare(lib: ctypes.CDLL) -> None:
     lib.ring_available.argtypes = [c.c_void_p]
     lib.ring_free_segments.restype = c.c_int64
     lib.ring_free_segments.argtypes = [c.c_void_p]
+    lib.ss_create.restype = c.c_void_p
+    lib.ss_create.argtypes = [c.c_int64, c.c_char_p]
+    lib.ss_free.argtypes = [c.c_void_p]
+    lib.ss_mem_entries.restype = c.c_int64
+    lib.ss_mem_entries.argtypes = [c.c_void_p]
+    lib.ss_num_runs.restype = c.c_int64
+    lib.ss_num_runs.argtypes = [c.c_void_p]
+    lib.ss_put_batch.argtypes = [c.c_void_p, c.c_void_p, c.c_void_p, c.c_int64]
+    lib.ss_get_batch.restype = c.c_int64
+    lib.ss_get_batch.argtypes = [c.c_void_p, c.c_void_p, c.c_void_p, c.c_void_p, c.c_int64]
+    lib.ss_flush.restype = c.c_int64
+    lib.ss_flush.argtypes = [c.c_void_p]
+    lib.ss_compact.restype = c.c_int64
+    lib.ss_compact.argtypes = [c.c_void_p]
+    lib.ss_manifest.restype = c.c_int64
+    lib.ss_manifest.argtypes = [c.c_void_p, c.c_void_p, c.c_int64]
+    lib.ss_restore.restype = c.c_int64
+    lib.ss_restore.argtypes = [c.c_void_p, c.c_char_p, c.c_int64]
+    lib.ss_clear.argtypes = [c.c_void_p]
 
 
 class NativeKeyDict:
@@ -204,3 +227,83 @@ class SegmentRing:
 
     def free_segments(self) -> int:
         return self._lib.ring_free_segments(self._handle)
+
+
+class NativeSpillStore:
+    """Batched u64 -> fixed-width-bytes store over the C++ LSM
+    (native/spill_store.cpp); the host spill tier for state beyond HBM."""
+
+    def __init__(self, value_width: int, directory: str):
+        lib = get_lib()
+        if lib is None:
+            raise RuntimeError("native library unavailable")
+        os.makedirs(directory, exist_ok=True)
+        self._lib = lib
+        self.width = value_width
+        self.dir = directory
+        self._handle = lib.ss_create(value_width, directory.encode())
+
+    def __del__(self):
+        if getattr(self, "_handle", None):
+            self._lib.ss_free(self._handle)
+            self._handle = None
+
+    def put_batch(self, keys: np.ndarray, values: np.ndarray) -> None:
+        keys = np.ascontiguousarray(keys, dtype=np.uint64)
+        values = np.ascontiguousarray(values)
+        assert values.nbytes == len(keys) * self.width
+        self._lib.ss_put_batch(
+            self._handle, keys.ctypes.data, values.ctypes.data, len(keys)
+        )
+
+    def get_batch(self, keys: np.ndarray) -> Tuple[np.ndarray, np.ndarray]:
+        """Returns (values uint8[n, width], found bool[n])."""
+        keys = np.ascontiguousarray(keys, dtype=np.uint64)
+        out = np.zeros((len(keys), self.width), dtype=np.uint8)
+        found = np.zeros(len(keys), dtype=np.uint8)
+        self._lib.ss_get_batch(
+            self._handle, keys.ctypes.data, out.ctypes.data, found.ctypes.data, len(keys)
+        )
+        return out, found.astype(bool)
+
+    def flush(self) -> int:
+        rid = self._lib.ss_flush(self._handle)
+        if rid < 0:
+            raise OSError(f"spill flush failed in {self.dir}")
+        return rid
+
+    def compact(self) -> int:
+        rid = self._lib.ss_compact(self._handle)
+        if rid < 0:
+            raise OSError(f"spill compact failed in {self.dir}")
+        return rid
+
+    @property
+    def mem_entries(self) -> int:
+        return self._lib.ss_mem_entries(self._handle)
+
+    @property
+    def num_runs(self) -> int:
+        return self._lib.ss_num_runs(self._handle)
+
+    def checkpoint(self) -> str:
+        """Flush and return the manifest (newline-joined immutable run file
+        names) — successive checkpoints share unchanged runs."""
+        self.flush()
+        cap = 1 << 16
+        while True:
+            buf = ctypes.create_string_buffer(cap)
+            n = self._lib.ss_manifest(self._handle, buf, cap)
+            if n >= 0:
+                return buf.raw[:n].decode()
+            cap = -n + 1
+
+    def clear(self) -> None:
+        self._lib.ss_clear(self._handle)
+
+    def restore(self, manifest: str) -> None:
+        """Replace the store's contents with the manifest's runs (rollback)."""
+        m = manifest.encode()
+        n = self._lib.ss_restore(self._handle, m, len(m))
+        if n < 0:
+            raise OSError(f"spill restore failed from manifest in {self.dir}")

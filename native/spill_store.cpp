@@ -1,0 +1,344 @@
+// Host-side spill store: log-structured u64 -> fixed-width-value state,
+// batched API, immutable sorted runs with incremental checkpoints.
+//
+// The TPU-native counterpart of the reference's native state backends
+// (frocksdbjni: flink-statebackend-rocksdb, loaded via JNI; ForSt:
+// flink-statebackend-forst/.../ForStStateBackend.java:936): keyed state
+// that exceeds HBM spills here. Mirrors their architecture at the scale
+// this framework needs:
+//  - memtable (open addressing) in front of immutable sorted runs on disk
+//    (the LSM shape; runs ~ SST files),
+//  - batched multi-get/multi-put (ForStGeneralMultiGetOperation.java's
+//    batching is the access pattern the device pipeline wants),
+//  - per-run min/max + bloom filters to skip runs on lookup,
+//  - checkpoints = flush + manifest of immutable run files, so successive
+//    checkpoints share unchanged runs (RocksIncrementalSnapshotStrategy.java:71
+//    shared-file dedup),
+//  - full compaction folding all runs into one (newest wins).
+//
+// Values are fixed width per store (columnar accumulator rows); keys are
+// u64 (callers densify via KeyDict and fold namespaces in).
+
+#include <algorithm>
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <string>
+#include <vector>
+
+extern "C" {
+
+static inline uint64_t mix64(uint64_t x) {
+  x ^= x >> 33;
+  x *= 0xff51afd7ed558ccdULL;
+  x ^= x >> 33;
+  x *= 0xc4ceb9fe1a85ec53ULL;
+  x ^= x >> 33;
+  return x;
+}
+
+struct Run {
+  std::string path;
+  std::vector<uint64_t> keys;   // sorted
+  std::vector<char> values;     // keys.size() * width
+  std::vector<uint64_t> bloom;  // bitset
+  uint64_t min_key = 0, max_key = 0;
+};
+
+struct SpillStore {
+  int64_t width;
+  std::string dir;
+  uint64_t next_run_id = 1;
+  // memtable: open addressing keys -> index into mem_vals
+  std::vector<uint64_t> slots;      // key+1, 0 = empty
+  std::vector<int64_t> slot_idx;
+  std::vector<uint64_t> mem_keys;
+  std::vector<char> mem_vals;
+  std::vector<Run*> runs;           // oldest .. newest
+};
+
+static void st_rehash(SpillStore* st, size_t cap) {
+  std::vector<uint64_t> slots(cap, 0);
+  std::vector<int64_t> idx(cap, -1);
+  size_t mask = cap - 1;
+  for (size_t i = 0; i < st->mem_keys.size(); i++) {
+    uint64_t k = st->mem_keys[i];
+    size_t p = mix64(k) & mask;
+    while (slots[p] != 0) p = (p + 1) & mask;
+    slots[p] = k + 1;
+    idx[p] = (int64_t)i;
+  }
+  st->slots.swap(slots);
+  st->slot_idx.swap(idx);
+}
+
+SpillStore* ss_create(int64_t width, const char* dir) {
+  auto* st = new SpillStore();
+  st->width = width;
+  st->dir = dir;
+  st->slots.assign(1024, 0);
+  st->slot_idx.assign(1024, -1);
+  return st;
+}
+
+void ss_free(SpillStore* st) {
+  for (auto* r : st->runs) delete r;
+  delete st;
+}
+
+int64_t ss_mem_entries(SpillStore* st) { return (int64_t)st->mem_keys.size(); }
+int64_t ss_num_runs(SpillStore* st) { return (int64_t)st->runs.size(); }
+
+void ss_put_batch(SpillStore* st, const uint64_t* keys, const char* vals, int64_t n) {
+  size_t need = st->mem_keys.size() + (size_t)n;
+  if (need * 2 >= st->slots.size()) {
+    size_t cap = st->slots.size();
+    while (need * 2 >= cap) cap *= 2;
+    st_rehash(st, cap);
+  }
+  size_t mask = st->slots.size() - 1;
+  for (int64_t i = 0; i < n; i++) {
+    uint64_t k = keys[i];
+    size_t p = mix64(k) & mask;
+    while (true) {
+      if (st->slots[p] == 0) {
+        st->slots[p] = k + 1;
+        st->slot_idx[p] = (int64_t)st->mem_keys.size();
+        st->mem_keys.push_back(k);
+        st->mem_vals.insert(st->mem_vals.end(), vals + i * st->width,
+                            vals + (i + 1) * st->width);
+        break;
+      }
+      if (st->slots[p] == k + 1) {  // overwrite in place
+        std::memcpy(&st->mem_vals[st->slot_idx[p] * st->width],
+                    vals + i * st->width, st->width);
+        break;
+      }
+      p = (p + 1) & mask;
+    }
+  }
+}
+
+static bool run_get(const Run* r, uint64_t k, int64_t width, char* out) {
+  if (r->keys.empty() || k < r->min_key || k > r->max_key) return false;
+  uint64_t h = mix64(k);
+  size_t nbits = r->bloom.size() * 64;
+  if (nbits) {
+    if (!(r->bloom[(h % nbits) >> 6] & (1ULL << ((h % nbits) & 63)))) return false;
+    uint64_t h2 = mix64(h);
+    if (!(r->bloom[(h2 % nbits) >> 6] & (1ULL << ((h2 % nbits) & 63)))) return false;
+  }
+  auto it = std::lower_bound(r->keys.begin(), r->keys.end(), k);
+  if (it == r->keys.end() || *it != k) return false;
+  size_t i = (size_t)(it - r->keys.begin());
+  std::memcpy(out, &r->values[i * width], width);
+  return true;
+}
+
+int64_t ss_get_batch(SpillStore* st, const uint64_t* keys, char* out,
+                     uint8_t* found, int64_t n) {
+  size_t mask = st->slots.size() - 1;
+  int64_t hits = 0;
+  for (int64_t i = 0; i < n; i++) {
+    uint64_t k = keys[i];
+    found[i] = 0;
+    size_t p = mix64(k) & mask;
+    while (st->slots[p] != 0) {
+      if (st->slots[p] == k + 1) {
+        std::memcpy(out + i * st->width, &st->mem_vals[st->slot_idx[p] * st->width],
+                    st->width);
+        found[i] = 1;
+        hits++;
+        break;
+      }
+      p = (p + 1) & mask;
+    }
+    if (found[i]) continue;
+    for (auto it = st->runs.rbegin(); it != st->runs.rend(); ++it) {  // newest first
+      if (run_get(*it, k, st->width, out + i * st->width)) {
+        found[i] = 1;
+        hits++;
+        break;
+      }
+    }
+  }
+  return hits;
+}
+
+static void build_bloom(Run* r) {
+  size_t nbits = std::max<size_t>(64, r->keys.size() * 10);
+  r->bloom.assign((nbits + 63) / 64, 0);
+  nbits = r->bloom.size() * 64;
+  for (uint64_t k : r->keys) {
+    uint64_t h = mix64(k), h2 = mix64(h);
+    r->bloom[(h % nbits) >> 6] |= 1ULL << ((h % nbits) & 63);
+    r->bloom[(h2 % nbits) >> 6] |= 1ULL << ((h2 % nbits) & 63);
+  }
+}
+
+static bool write_run(SpillStore* st, Run* r) {
+  FILE* f = std::fopen(r->path.c_str(), "wb");
+  if (!f) return false;
+  uint64_t n = r->keys.size(), w = (uint64_t)st->width;
+  bool ok = std::fwrite(&n, 8, 1, f) == 1 && std::fwrite(&w, 8, 1, f) == 1;
+  if (ok && n) {
+    ok = std::fwrite(r->keys.data(), 8, n, f) == n &&
+         std::fwrite(r->values.data(), 1, n * w, f) == n * w;
+  }
+  std::fclose(f);
+  return ok;
+}
+
+// Flush the memtable into a new immutable sorted run. Returns run id, 0 if
+// empty, -1 on I/O error.
+int64_t ss_flush(SpillStore* st) {
+  if (st->mem_keys.empty()) return 0;
+  auto* r = new Run();
+  uint64_t id = st->next_run_id++;
+  r->path = st->dir + "/run-" + std::to_string(id) + ".spill";
+  std::vector<size_t> order(st->mem_keys.size());
+  for (size_t i = 0; i < order.size(); i++) order[i] = i;
+  std::sort(order.begin(), order.end(), [&](size_t a, size_t b) {
+    return st->mem_keys[a] < st->mem_keys[b];
+  });
+  r->keys.reserve(order.size());
+  r->values.reserve(order.size() * st->width);
+  for (size_t i : order) {
+    r->keys.push_back(st->mem_keys[i]);
+    r->values.insert(r->values.end(), &st->mem_vals[i * st->width],
+                     &st->mem_vals[(i + 1) * st->width]);
+  }
+  r->min_key = r->keys.front();
+  r->max_key = r->keys.back();
+  build_bloom(r);
+  if (!write_run(st, r)) {
+    delete r;
+    st->next_run_id--;
+    return -1;
+  }
+  st->runs.push_back(r);
+  st->mem_keys.clear();
+  st->mem_vals.clear();
+  st_rehash(st, 1024);
+  return (int64_t)id;
+}
+
+// Fold all runs + memtable into one new run (newest value wins).
+int64_t ss_compact(SpillStore* st) {
+  ss_flush(st);
+  if (st->runs.size() <= 1) return 0;
+  auto* merged = new Run();
+  uint64_t id = st->next_run_id++;
+  merged->path = st->dir + "/run-" + std::to_string(id) + ".spill";
+  // collect newest-first, keep first occurrence of each key
+  std::vector<std::pair<uint64_t, const char*>> entries;
+  for (auto it = st->runs.rbegin(); it != st->runs.rend(); ++it) {
+    Run* r = *it;
+    for (size_t i = 0; i < r->keys.size(); i++) {
+      entries.emplace_back(r->keys[i], &r->values[i * st->width]);
+    }
+  }
+  std::stable_sort(entries.begin(), entries.end(),
+                   [](const auto& a, const auto& b) { return a.first < b.first; });
+  for (size_t i = 0; i < entries.size(); i++) {
+    if (i > 0 && entries[i].first == merged->keys.back()) continue;  // newer kept
+    merged->keys.push_back(entries[i].first);
+    merged->values.insert(merged->values.end(), entries[i].second,
+                          entries[i].second + st->width);
+  }
+  if (merged->keys.empty()) {
+    delete merged;
+    st->next_run_id--;
+    return 0;
+  }
+  merged->min_key = merged->keys.front();
+  merged->max_key = merged->keys.back();
+  build_bloom(merged);
+  if (!write_run(st, merged)) {
+    delete merged;
+    st->next_run_id--;
+    return -1;
+  }
+  for (auto* r : st->runs) delete r;   // files stay on disk for old manifests
+  st->runs.clear();
+  st->runs.push_back(merged);
+  return (int64_t)id;
+}
+
+// Write the current run list into `out` as \n-joined ids (after a flush this
+// fully describes the store — the checkpoint manifest).
+int64_t ss_manifest(SpillStore* st, char* out, int64_t cap) {
+  std::string m;
+  for (auto* r : st->runs) {
+    size_t slash = r->path.rfind('/');
+    m += r->path.substr(slash + 1);
+    m += "\n";
+  }
+  if ((int64_t)m.size() > cap) return -(int64_t)m.size();
+  std::memcpy(out, m.data(), m.size());
+  return (int64_t)m.size();
+}
+
+// Drop all in-memory state (memtable + run index); run files stay on disk
+// for manifests that still reference them.
+void ss_clear(SpillStore* st) {
+  st->mem_keys.clear();
+  st->mem_vals.clear();
+  st_rehash(st, 1024);
+  for (auto* r : st->runs) delete r;
+  st->runs.clear();
+}
+
+// Load runs (oldest..newest order of the manifest) from disk, REPLACING the
+// store's current contents (restore is a rollback, not a merge).
+int64_t ss_restore(SpillStore* st, const char* manifest, int64_t len) {
+  ss_clear(st);
+  std::string m(manifest, (size_t)len);
+  size_t pos = 0;
+  while (pos < m.size()) {
+    size_t nl = m.find('\n', pos);
+    if (nl == std::string::npos) nl = m.size();
+    std::string name = m.substr(pos, nl - pos);
+    pos = nl + 1;
+    if (name.empty()) continue;
+    auto* r = new Run();
+    r->path = st->dir + "/" + name;
+    FILE* f = std::fopen(r->path.c_str(), "rb");
+    if (!f) {
+      delete r;
+      return -1;
+    }
+    uint64_t n = 0, w = 0;
+    if (std::fread(&n, 8, 1, f) != 1 || std::fread(&w, 8, 1, f) != 1 ||
+        (int64_t)w != st->width) {
+      std::fclose(f);
+      delete r;
+      return -1;
+    }
+    r->keys.resize(n);
+    r->values.resize(n * w);
+    if (n && (std::fread(r->keys.data(), 8, n, f) != n ||
+              std::fread(r->values.data(), 1, n * w, f) != n * w)) {
+      std::fclose(f);
+      delete r;
+      return -1;
+    }
+    std::fclose(f);
+    if (n) {
+      r->min_key = r->keys.front();
+      r->max_key = r->keys.back();
+    }
+    build_bloom(r);
+    // track max run id so new flushes don't collide with restored files
+    size_t dash = name.find('-');
+    size_t dot = name.find('.');
+    if (dash != std::string::npos && dot != std::string::npos) {
+      uint64_t id = std::stoull(name.substr(dash + 1, dot - dash - 1));
+      if (id >= st->next_run_id) st->next_run_id = id + 1;
+    }
+    st->runs.push_back(r);
+  }
+  return (int64_t)st->runs.size();
+}
+
+}  // extern "C"

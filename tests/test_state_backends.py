@@ -1,0 +1,236 @@
+"""State backend tier: native spill store (S3/S4 analogue), cold-key tier in
+the device operator, changelog backend (S5), spillable heap (S6)."""
+
+import numpy as np
+import pytest
+
+from flink_tpu.api.windowing.assigners import SlidingEventTimeWindows, TumblingEventTimeWindows
+from flink_tpu.core.keygroups import KeyGroupRange
+from flink_tpu.ops.aggregators import resolve
+from flink_tpu.runtime.oracle_window_operator import OracleWindowOperator
+from flink_tpu.runtime.tpu_window_operator import TpuWindowOperator
+from flink_tpu.state.changelog import ChangelogKeyedStateBackend, FsStateChangelog
+from flink_tpu.state.cold_tier import ColdKeyTier
+from flink_tpu.state.heap import HeapKeyedStateBackend, reducing_state, value_state
+from flink_tpu.state.spillable import SpillableKeyedStateBackend
+
+
+# ---------------------------------------------------------------------------
+# native spill store
+# ---------------------------------------------------------------------------
+
+def test_native_spill_store_roundtrip(tmp_path):
+    pytest.importorskip("ctypes")
+    from flink_tpu.utils.native_bridge import NativeSpillStore, get_lib
+
+    if get_lib() is None:
+        pytest.skip("no compiler for the native library")
+    st = NativeSpillStore(8, str(tmp_path))
+    keys = np.arange(500, dtype=np.uint64)
+    vals = np.arange(500, dtype=np.float64).view(np.uint8).reshape(500, 8)
+    st.put_batch(keys, vals)
+    st.flush()
+    # overwrite after flush: memtable wins over runs
+    st.put_batch(np.array([7], np.uint64), np.array([700.0]).view(np.uint8).reshape(1, 8))
+    out, found = st.get_batch(np.array([7, 450, 9999], np.uint64))
+    assert found.tolist() == [True, True, False]
+    assert out[:2].view(np.float64).ravel().tolist() == [700.0, 450.0]
+
+    manifest = st.checkpoint()
+    st2 = NativeSpillStore(8, str(tmp_path))
+    st2.restore(manifest)
+    out, found = st2.get_batch(np.array([7, 450], np.uint64))
+    assert found.all()
+    assert out.view(np.float64).ravel().tolist() == [700.0, 450.0]
+    st2.compact()
+    assert st2.num_runs == 1
+    out, _ = st2.get_batch(np.array([7], np.uint64))
+    assert out.view(np.float64).ravel().tolist() == [700.0]
+
+
+# ---------------------------------------------------------------------------
+# cold-key tier + hot/cold window operator parity
+# ---------------------------------------------------------------------------
+
+def test_cold_tier_aggregates_and_fires():
+    tier = ColdKeyTier(resolve("sum"), ring_slices=8)
+    tier.ingest(np.array([0, 1, 0]), np.array([3, 3, 4], np.int64),
+                np.array([1.0, 2.0, 3.0], np.float32))
+    tier.ingest(np.array([0]), np.array([3], np.int64), np.array([10.0], np.float32))
+    res, counts = tier.fire(2, range(3, 5))
+    assert res.tolist() == [14.0, 2.0]
+    assert counts.tolist() == [3.0, 1.0]
+    res, counts = tier.fire(2, range(5, 7))  # empty slices
+    assert counts.tolist() == [0.0, 0.0]
+
+
+@pytest.mark.parametrize("agg", ["sum", "count"])
+def test_hot_cold_operator_parity(agg):
+    assigner = SlidingEventTimeWindows.of(4000, 2000)
+    rng = np.random.default_rng(11)
+    n_keys = 40  # far beyond the hot capacity of 8
+
+    hot_cold = TpuWindowOperator(assigner, agg, key_capacity=64,
+                                 hot_key_capacity=8, num_slices=32)
+    oracle = OracleWindowOperator(assigner, resolve(agg).python_equivalent())
+
+    for step in range(10):
+        keys = np.asarray([f"k{v}" for v in rng.integers(0, n_keys, 64)], dtype=object)
+        vals = rng.integers(1, 9, 64).astype(np.float32)
+        ts = (step * 1000 + rng.integers(0, 1000, 64)).astype(np.int64)
+        hot_cold.process_batch(keys, vals, ts)
+        for i in range(64):
+            oracle.process_record(keys[i], float(vals[i]), int(ts[i]))
+        wm = step * 1000 + 500
+        hot_cold.process_watermark(wm)
+        oracle.process_watermark(wm)
+    hot_cold.process_watermark((1 << 62))
+    oracle.process_watermark((1 << 62))
+
+    got = {(k, w.start): v for k, w, v, _ in hot_cold.drain_output()}
+    want = {(k, w.start): v for k, w, v, _ in oracle.drain_output()}
+    assert got == want
+    assert hot_cold.cold_tier.num_cold_rows_written > 0  # the tier was used
+
+
+def test_hot_cold_snapshot_restore():
+    assigner = TumblingEventTimeWindows.of(1000)
+    op = TpuWindowOperator(assigner, "sum", key_capacity=16, hot_key_capacity=4)
+    keys = np.asarray([f"k{i}" for i in range(12)], dtype=object)
+    op.process_batch(keys, np.ones(12, np.float32), np.full(12, 100, np.int64))
+    snap = op.snapshot()
+
+    op2 = TpuWindowOperator(assigner, "sum", key_capacity=16, hot_key_capacity=4,
+                            cold_tier_dir=op.cold_tier.dir)
+    op2.restore(snap)
+    op2.process_batch(keys[:3], np.ones(3, np.float32), np.full(3, 200, np.int64))
+    op2.process_watermark(5000)
+    got = {k: v for k, _, v, _ in op2.drain_output()}
+    assert got == {f"k{i}": (2.0 if i < 3 else 1.0) for i in range(12)}
+
+
+# ---------------------------------------------------------------------------
+# changelog backend (S5)
+# ---------------------------------------------------------------------------
+
+def _heap():
+    b = HeapKeyedStateBackend(KeyGroupRange(0, 127), 128)
+    b.register(value_state("v"))
+    b.register(reducing_state("r", lambda a, c: a + c))
+    return b
+
+
+def test_changelog_checkpoint_is_cheap_and_restores():
+    cb = ChangelogKeyedStateBackend(_heap())
+    cb.set_current_key("a")
+    cb.put("v", 1)
+    cb.add("r", 10)
+    cp1 = cb.checkpoint()          # pre-materialization: journal only
+    cb.add("r", 5)
+    cb.set_current_key("b")
+    cb.put("v", 2)
+    cp2 = cb.checkpoint()
+
+    r = ChangelogKeyedStateBackend(_heap(), FsStateChangelog(cp1["log_dir"]))
+    r.restore(cp1)
+    r.set_current_key("a")
+    assert r.get("v") == 1 and r.get("r") == 10
+    r.set_current_key("b")
+    assert r.get("v") is None
+
+    r2 = ChangelogKeyedStateBackend(_heap(), FsStateChangelog(cp2["log_dir"]))
+    r2.restore(cp2)
+    r2.set_current_key("a")
+    assert r2.get("r") == 15
+    r2.set_current_key("b")
+    assert r2.get("v") == 2
+
+
+def test_changelog_materialize_truncates_and_still_restores():
+    log = FsStateChangelog(segment_bytes=64)  # tiny segments to force rolls
+    cb = ChangelogKeyedStateBackend(_heap(), log)
+    for i in range(30):
+        cb.set_current_key(f"k{i % 3}")
+        cb.add("r", i)
+    cb.materialize()
+    n_after = len(log.read_from(0))
+    cb.set_current_key("k0")
+    cb.add("r", 1000)
+    cp = cb.checkpoint()
+
+    r = ChangelogKeyedStateBackend(_heap(), FsStateChangelog(cp["log_dir"]))
+    r._materialized = None
+    r.restore(cp)
+    r.set_current_key("k0")
+    assert r.get("r") == sum(range(0, 30, 3)) + 1000
+    assert n_after < 30  # truncation dropped covered segments
+
+
+# ---------------------------------------------------------------------------
+# spillable heap (S6)
+# ---------------------------------------------------------------------------
+
+def test_spillable_backend_spills_and_faults(tmp_path):
+    sb = SpillableKeyedStateBackend(
+        HeapKeyedStateBackend(KeyGroupRange(0, 127), 128),
+        max_entries_in_memory=20,
+        spill_dir=str(tmp_path),
+    )
+    sb.register(value_state("v"))
+    for i in range(100):
+        sb.set_current_key(f"key-{i}")
+        sb.put("v", i)
+    assert sb.num_spills > 0
+    assert sb._mem_entries() <= 20 + 10  # roughly bounded (current kg stays)
+
+    # faulting back: every value still readable
+    for i in range(100):
+        sb.set_current_key(f"key-{i}")
+        assert sb.get("v") == i
+    assert sb.num_faults > 0
+
+    # snapshot sees everything; restore into a fresh backend matches
+    snap = sb.snapshot()
+    sb2 = SpillableKeyedStateBackend(
+        HeapKeyedStateBackend(KeyGroupRange(0, 127), 128),
+        max_entries_in_memory=1000,
+    )
+    sb2.register(value_state("v"))
+    sb2.restore(snap)
+    sb2.set_current_key("key-42")
+    assert sb2.get("v") == 42
+
+
+def test_native_restore_replaces_not_merges(tmp_path):
+    from flink_tpu.utils.native_bridge import NativeSpillStore, get_lib
+
+    if get_lib() is None:
+        pytest.skip("no compiler")
+    st = NativeSpillStore(8, str(tmp_path))
+    st.put_batch(np.array([1], np.uint64), np.array([10.0]).view(np.uint8).reshape(1, 8))
+    manifest = st.checkpoint()
+    # post-checkpoint mutation must vanish on rollback
+    st.put_batch(np.array([1], np.uint64), np.array([99.0]).view(np.uint8).reshape(1, 8))
+    st.put_batch(np.array([2], np.uint64), np.array([2.0]).view(np.uint8).reshape(1, 8))
+    st.restore(manifest)
+    out, found = st.get_batch(np.array([1, 2], np.uint64))
+    assert found.tolist() == [True, False]
+    assert out[0].view(np.float64)[0] == 10.0
+
+
+def test_changelog_checkpoint_after_restore_still_describes_state():
+    cb = ChangelogKeyedStateBackend(_heap())
+    cb.set_current_key("a")
+    cb.add("r", 10)
+    cp = cb.checkpoint()
+
+    r = ChangelogKeyedStateBackend(_heap(), FsStateChangelog(cp["log_dir"]))
+    r.restore(cp)
+    cp2 = r.checkpoint()          # checkpoint OF the restored backend
+    r.set_current_key("a")
+    r.add("r", 5)
+
+    r2 = ChangelogKeyedStateBackend(_heap(), FsStateChangelog(cp2["log_dir"]))
+    r2.restore(cp2)
+    r2.set_current_key("a")
+    assert r2.get("r") == 10      # post-cp2 writes excluded, baseline kept
