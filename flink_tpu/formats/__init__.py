@@ -1,0 +1,12 @@
+"""Record formats (K2 analogue of flink-formats): pluggable encoders/
+decoders used by the file source/sink.
+
+In-repo: CSV (native C++ codec path, native/flink_tpu_native.cpp
+codec_parse_csv), JSON lines, Avro binary (self-contained reader/writer for
+the core type subset — the reference vendors flink-avro), raw bytes.
+Parquet/ORC are gated on pyarrow being installed (the reference ships them
+as separate format jars; this image has no pyarrow, so the registration
+degrades with a clear error instead of an import crash).
+"""
+
+from flink_tpu.formats.registry import FORMATS, get_format  # noqa: F401

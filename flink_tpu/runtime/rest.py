@@ -116,6 +116,23 @@ class _Handler(BaseHTTPRequestHandler):
                 if hasattr(c, "metrics"):
                     text += prometheus_text(c.metrics.all_metrics())
             return self._send(200, text.encode(), "text/plain; version=0.0.4")
+        if parts == ["flamegraph"]:
+            # on-demand thread sampling (JobVertexFlameGraphHandler analogue);
+            # ?duration=0.5&filter=task samples live process threads
+            from urllib.parse import parse_qs, urlparse
+
+            from flink_tpu.metrics.flamegraph import flame_graph
+
+            q = parse_qs(urlparse(self.path).query)
+            try:
+                duration = min(max(float(q.get("duration", ["0.3"])[0]), 0.01), 10.0)
+                hz = min(max(float(q.get("hz", ["50"])[0]), 1.0), 1000.0)
+            except ValueError:
+                return self._json(400, {"error": "duration/hz must be numbers"})
+            return self._json(200, flame_graph(
+                duration_s=duration, hz=hz,
+                thread_filter=(q.get("filter", [None])[0]),
+            ))
         if len(parts) >= 2 and parts[0] == "jobs":
             client = self._job(parts[1])
             if client is None:

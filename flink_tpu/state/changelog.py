@@ -151,12 +151,16 @@ class ChangelogKeyedStateBackend:
             "log_dir": self.log.dir,
         }
 
-    def materialize(self) -> None:
-        """Fold the journal into a full snapshot; truncate covered segments
-        (the periodic materialization of the changelog backend)."""
+    def materialize(self, truncate_upto: Optional[int] = None) -> None:
+        """Fold the journal into a full snapshot. Truncation is driven by
+        checkpoint subsumption (the reference truncates DSTL only once no
+        retained checkpoint references the range): pass the log offset of
+        the oldest checkpoint still retained; entries below min(it, the new
+        materialization) are dropped. Default: keep everything."""
         self._materialized = self.inner.snapshot()
         self._materialized_offset = self.log.offset
-        self.log.truncate(self._materialized_offset)
+        if truncate_upto is not None:
+            self.log.truncate(min(truncate_upto, self._materialized_offset))
 
     def restore(self, checkpoint: dict,
                 descriptors: Optional[Dict[str, StateDescriptor]] = None) -> None:

@@ -1,0 +1,125 @@
+"""Model inference in SQL / streams: the `flink-models` analogue (T5).
+
+The reference exposes SQL `ML_PREDICT` against model endpoints through a
+provider SPI (flink-table-common/.../ml/PredictRuntimeProvider.java:26,
+AsyncPredictRuntimeProvider.java:26; OpenAI/Triton providers call external
+services, runtime operator flink-table-runtime/.../ml/MLPredictRunner.java).
+
+TPU-native twist: the natural provider here is not a remote endpoint but a
+**JAX model running on the same chips as the pipeline** — features come out
+of the stream as columns, inference is one jitted batched call, outputs
+rejoin the row. Remote-endpoint providers fit the same SPI (implement
+`predict_batch` with an HTTP call); an async wrapper pairs with the
+AsyncWaitOperator analogue (runtime/async_io.py) the way
+AsyncPredictRuntimeProvider pairs with AsyncWaitOperator in the reference.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, List, Optional, Sequence
+
+import numpy as np
+
+
+class PredictRuntimeProvider:
+    """SPI: batched model inference over feature columns."""
+
+    feature_cols: List[str]
+    output_names: List[str]
+
+    def predict_batch(self, features: np.ndarray) -> np.ndarray:
+        """[B, n_features] -> [B, n_outputs]."""
+        raise NotImplementedError
+
+    def predict_row(self, row: dict) -> Dict[str, float]:
+        feats = np.asarray([[float(row[c]) for c in self.feature_cols]], dtype=np.float32)
+        out = np.asarray(self.predict_batch(feats))
+        return {name: out[0, i].item() for i, name in enumerate(self.output_names)}
+
+
+class JaxModelProvider(PredictRuntimeProvider):
+    """A jitted JAX model as the inference runtime.
+
+    `apply(params, features[B, F]) -> [B, O]`; batches are padded to powers
+    of two so jit compiles a handful of shapes, and the compiled executables
+    are shared across calls (the MLPredictRunner role, on-device).
+    """
+
+    def __init__(
+        self,
+        apply: Callable,
+        params,
+        feature_cols: Sequence[str],
+        output_names: Sequence[str],
+        *,
+        min_pad: int = 16,
+    ):
+        import jax
+
+        self.feature_cols = list(feature_cols)
+        self.output_names = list(output_names)
+        self.params = params
+        self.min_pad = min_pad
+        self._fn = jax.jit(apply)
+
+    def predict_batch(self, features: np.ndarray) -> np.ndarray:
+        n = len(features)
+        padded = self.min_pad
+        while padded < n:
+            padded *= 2
+        if padded != n:
+            features = np.concatenate(
+                [features, np.zeros((padded - n, features.shape[1]), features.dtype)]
+            )
+        out = np.asarray(self._fn(self.params, features))
+        return out[:n]
+
+
+class FnModelProvider(PredictRuntimeProvider):
+    """Plain-python/numpy provider (external-endpoint stand-in)."""
+
+    def __init__(self, fn: Callable[[np.ndarray], np.ndarray],
+                 feature_cols: Sequence[str], output_names: Sequence[str]):
+        self.fn = fn
+        self.feature_cols = list(feature_cols)
+        self.output_names = list(output_names)
+
+    def predict_batch(self, features: np.ndarray) -> np.ndarray:
+        return np.asarray(self.fn(features))
+
+
+class BatchingPredictor:
+    """Per-stream adapter: buffers rows to amortize device dispatches while
+    preserving 1:1 row order (the micro-batching the device wants; flushed
+    by the runner at watermark/step boundaries)."""
+
+    def __init__(self, provider: PredictRuntimeProvider, max_batch: int = 1024):
+        self.provider = provider
+        self.max_batch = max_batch
+        self._rows: List[dict] = []
+        self._out: List[dict] = []
+
+    def offer(self, row: dict) -> None:
+        self._rows.append(row)
+        if len(self._rows) >= self.max_batch:
+            self.flush()
+
+    def flush(self) -> None:
+        if not self._rows:
+            return
+        rows, self._rows = self._rows, []
+        feats = np.asarray(
+            [[float(r[c]) for c in self.provider.feature_cols] for r in rows],
+            dtype=np.float32,
+        )
+        preds = np.asarray(self.provider.predict_batch(feats))
+        for i, r in enumerate(rows):
+            out = dict(r)
+            for j, name in enumerate(self.provider.output_names):
+                out[name] = preds[i, j].item()
+            self._out.append(out)
+
+    def drain(self) -> List[dict]:
+        self.flush()
+        out, self._out = self._out, []
+        return out

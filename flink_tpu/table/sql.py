@@ -55,10 +55,11 @@ def _tokenize(sql: str) -> List[str]:
 
 @dataclasses.dataclass
 class SelectItem:
-    kind: str                 # 'column' | 'agg' | 'window_start' | 'window_end'
-    name: str                 # column name or agg arg ('*' for COUNT(*))
+    kind: str                 # 'column' | 'agg' | 'window_start' | 'window_end' | 'ml_predict'
+    name: str                 # column name or agg arg ('*' for COUNT(*)); model name for ml_predict
     func: Optional[str] = None
     alias: Optional[str] = None
+    args: Optional[List[str]] = None   # ml_predict feature columns
 
     @property
     def output_name(self) -> str:
@@ -150,6 +151,18 @@ class _Parser:
             arg = self.next()
             self.expect(")")
             item = SelectItem("agg", arg, func=up)
+        elif up == "ML_PREDICT":
+            # ML_PREDICT(model, feature_col [, feature_col...]) — the SQL
+            # model-inference function (T5; reference: ML_PREDICT TVF via
+            # PredictRuntimeProvider.java:26)
+            self.expect("(")
+            model = self.next()
+            feats: List[str] = []
+            while self.peek() == ",":
+                self.next()
+                feats.append(self.next())
+            self.expect(")")
+            item = SelectItem("ml_predict", model, args=feats)
         elif up in ("WINDOW_START", "WINDOW_END"):
             item = SelectItem(up.lower(), up.lower())
         else:

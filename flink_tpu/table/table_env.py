@@ -98,8 +98,13 @@ class TableEnvironment:
     def __init__(self, env: Optional[StreamExecutionEnvironment] = None):
         self.env = env or StreamExecutionEnvironment.get_execution_environment()
         self._tables: Dict[str, _Table] = {}
+        self._models: Dict[str, Any] = {}
 
     # -- registration -----------------------------------------------------
+    def register_model(self, name: str, provider) -> None:
+        """Register a PredictRuntimeProvider for SQL ML_PREDICT (T5)."""
+        self._models[name] = provider
+
     def register_table(self, name: str, stream: DataStream, schema: TableSchema) -> None:
         self._tables[name] = _Table(stream, schema)
 
@@ -130,12 +135,51 @@ class TableEnvironment:
             stream = stream.filter(pred, name=f"where[{q.where_text}]")
 
         aggs = [i for i in q.select if i.kind == "agg"]
+        preds = [i for i in q.select if i.kind == "ml_predict"]
         if not aggs:
-            # projection-only query
+            # projection (+ optional model inference) query
             cols = [i for i in q.select if i.kind == "column"]
+            if preds:
+                providers = []
+                for item in preds:
+                    if item.name not in self._models:
+                        raise KeyError(
+                            f"unknown model {item.name!r}; registered: {list(self._models)}"
+                        )
+                    providers.append((item, self._models[item.name]))
+
+                def infer(row, _cols=cols, _providers=providers):
+                    out = {c.output_name: row[c.name] for c in _cols}
+                    for item, provider in _providers:
+                        # SQL args map POSITIONALLY onto the provider's
+                        # declared feature columns
+                        args = item.args or provider.feature_cols
+                        if len(args) != len(provider.feature_cols):
+                            raise ValueError(
+                                f"ML_PREDICT({item.name}, ...) got {len(args)} "
+                                f"features, model wants {len(provider.feature_cols)}"
+                            )
+                        pred = provider.predict_row({
+                            fc: row[arg]
+                            for fc, arg in zip(provider.feature_cols, args)
+                        })
+                        if len(provider.output_names) == 1:
+                            out[item.alias or item.output_name] = pred[
+                                provider.output_names[0]
+                            ]
+                        else:
+                            out.update(pred)
+                    return out
+
+                return stream.map(infer, name="ml_predict")
             return stream.map(
                 lambda row, _cols=cols: {c.output_name: row[c.name] for c in _cols},
                 name="project",
+            )
+        if preds:
+            raise NotImplementedError(
+                "ML_PREDICT inside windowed aggregate queries is not supported; "
+                "apply it in a follow-up projection query"
             )
         if not q.group_by or q.window is None:
             raise NotImplementedError(

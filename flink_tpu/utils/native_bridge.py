@@ -119,6 +119,7 @@ def _declare(lib: ctypes.CDLL) -> None:
     lib.ss_restore.restype = c.c_int64
     lib.ss_restore.argtypes = [c.c_void_p, c.c_char_p, c.c_int64]
     lib.ss_clear.argtypes = [c.c_void_p]
+    lib.ss_set_next_run_id.argtypes = [c.c_void_p, c.c_int64]
 
 
 class NativeKeyDict:
@@ -242,6 +243,17 @@ class NativeSpillStore:
         self.width = value_width
         self.dir = directory
         self._handle = lib.ss_create(value_width, directory.encode())
+        # never reuse run ids of files already on disk (old manifests may
+        # still reference them)
+        max_id = 0
+        for name in os.listdir(directory):
+            if name.startswith("run-") and name.endswith(".spill"):
+                try:
+                    max_id = max(max_id, int(name[4:-6]))
+                except ValueError:
+                    pass
+        if max_id:
+            lib.ss_set_next_run_id(self._handle, max_id + 1)
 
     def __del__(self):
         if getattr(self, "_handle", None):

@@ -86,6 +86,12 @@ void ss_free(SpillStore* st) {
   delete st;
 }
 
+// Callers opening a pre-existing directory set this past the largest run id
+// on disk so new flushes never clobber files referenced by old manifests.
+void ss_set_next_run_id(SpillStore* st, int64_t id) {
+  if ((uint64_t)id > st->next_run_id) st->next_run_id = (uint64_t)id;
+}
+
 int64_t ss_mem_entries(SpillStore* st) { return (int64_t)st->mem_keys.size(); }
 int64_t ss_num_runs(SpillStore* st) { return (int64_t)st->runs.size(); }
 

@@ -108,3 +108,28 @@ def test_runtime_metrics_and_checkpoint_spans(tmp_path):
     # checkpoint spans were reported (attached early enough to catch some)
     assert any(s.name == "Checkpoint" for s in spans.spans)
     assert all(s.duration_ms >= 0 for s in spans.spans)
+
+
+def test_flamegraph_sampling_and_tree():
+    import threading
+    import time
+
+    from flink_tpu.metrics.flamegraph import flame_graph
+
+    stop = threading.Event()
+
+    def busy_loop():
+        while not stop.is_set():
+            sum(i * i for i in range(500))
+
+    t = threading.Thread(target=busy_loop, name="hot-task-thread", daemon=True)
+    t.start()
+    try:
+        fg = flame_graph(duration_s=0.3, hz=100, thread_filter="hot-task")
+        assert fg["samples"] > 0
+        names = {c["name"] for c in fg["tree"]["children"]}
+        assert "hot-task-thread" in names
+        flat = str(fg["folded"])
+        assert "busy_loop" in flat
+    finally:
+        stop.set()

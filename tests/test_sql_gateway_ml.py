@@ -1,0 +1,95 @@
+"""SQL gateway (T4) + ML_PREDICT model inference (T5)."""
+
+import numpy as np
+import pytest
+
+from flink_tpu.table.gateway import SqlGateway, SqlGatewayClient
+from flink_tpu.table.ml import BatchingPredictor, FnModelProvider, JaxModelProvider
+from flink_tpu.table.table_env import TableEnvironment, TableSchema
+
+
+def test_ml_predict_in_sql_with_jax_model():
+    import jax.numpy as jnp
+
+    tenv = TableEnvironment()
+    tenv.from_rows(
+        "clicks",
+        [{"user": "a", "x1": 1.0, "x2": 2.0},
+         {"user": "b", "x1": 3.0, "x2": 1.0}],
+        TableSchema(["user", "x1", "x2"]),
+    )
+    # linear model y = w . x + b on device
+    params = {"w": jnp.asarray([2.0, 0.5]), "b": jnp.asarray(1.0)}
+    tenv.register_model(
+        "scorer",
+        JaxModelProvider(
+            lambda p, feats: (feats @ p["w"] + p["b"])[:, None],
+            params, ["x1", "x2"], ["score"],
+        ),
+    )
+    rows = tenv.execute_sql_to_list(
+        "SELECT user, ML_PREDICT(scorer, x1, x2) AS score FROM clicks"
+    )
+    got = {r["user"]: r["score"] for r in rows}
+    assert got == {"a": pytest.approx(4.0), "b": pytest.approx(7.5)}
+
+
+def test_ml_predict_unknown_model_errors():
+    tenv = TableEnvironment()
+    tenv.from_rows("t", [{"x": 1.0}], TableSchema(["x"]))
+    with pytest.raises(KeyError, match="unknown model"):
+        tenv.sql_query("SELECT ML_PREDICT(nope, x) AS y FROM t")
+
+
+def test_batching_predictor_preserves_order():
+    prov = FnModelProvider(lambda f: f.sum(axis=1, keepdims=True), ["x"], ["y"])
+    bp = BatchingPredictor(prov, max_batch=4)
+    for i in range(10):
+        bp.offer({"x": float(i), "tag": i})
+    out = bp.drain()
+    assert [r["tag"] for r in out] == list(range(10))
+    assert [r["y"] for r in out] == [float(i) for i in range(10)]
+
+
+def test_gateway_session_lifecycle_windowed_query():
+    gw = SqlGateway()
+    try:
+        client = SqlGatewayClient(gw.address)
+        sh = client.open_session()
+        rows = [
+            {"word": w, "n": 1, "ts": t}
+            for t, w in enumerate(["a", "b", "a", "a", "b", "c"] * 4)
+        ]
+        client.register_table(sh, "words", ["word", "n", "ts"], rows,
+                              time_col="ts", watermark_delay_ms=0)
+        res = client.execute(
+            sh,
+            "SELECT word, SUM(n) AS total FROM words "
+            "GROUP BY word, TUMBLE(ts, INTERVAL '1' SECOND)",
+        )
+        got = {r["word"]: r["total"] for r in res}
+        assert got == {"a": 12.0, "b": 8.0, "c": 4.0}
+
+        # error surface: bad SQL reported via operation status
+        with pytest.raises(RuntimeError, match="unknown table"):
+            client.execute(sh, "SELECT x FROM missing")
+        client.close_session(sh)
+        with pytest.raises(RuntimeError):
+            client.execute(sh, "SELECT word FROM words")
+    finally:
+        gw.stop()
+
+
+def test_gateway_ml_predict_via_server_side_model():
+    gw = SqlGateway()
+    try:
+        client = SqlGatewayClient(gw.address)
+        sh = client.open_session()
+        client.register_table(sh, "t", ["x"], [{"x": 2.0}, {"x": 5.0}])
+        gw.session_env(sh).register_model(
+            "doubler", FnModelProvider(lambda f: f * 2, ["x"], ["y"])
+        )
+        res = client.execute(sh, "SELECT x, ML_PREDICT(doubler, x) AS y FROM t")
+        assert sorted((r["x"], r["y"]) for r in res) == [(2.0, 4.0), (5.0, 10.0)]
+    finally:
+        gw.stop()
