@@ -113,6 +113,12 @@ class DataStream:
         fn = fn.map if hasattr(fn, "map") else fn
         return self._derive("map", name, {"fn": fn})
 
+    def map_batch(self, fn: Callable, name: str = "map_batch") -> "DataStream":
+        """1:1 transform over the whole step batch at once (list -> list of
+        equal length) — the amortization point for device inference."""
+        t = Transformation("map_batch", name, [self.transform], {"fn": fn})
+        return DataStream(self.env, t)
+
     def map_with_timestamp(self, fn: Callable, name: str = "map_ts") -> "DataStream":
         """map over (value, event_timestamp_ms) pairs."""
         return self._derive("map_ts", name, {"fn": fn})

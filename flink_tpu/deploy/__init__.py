@@ -1,0 +1,1 @@
+"""Deployment descriptors (Y2/Y3): Kubernetes manifests, YARN gating."""

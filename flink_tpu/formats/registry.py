@@ -135,10 +135,11 @@ class AvroFormat(Format):
         self.schema = schema
 
     @staticmethod
-    def infer_schema(row: dict) -> dict:
+    def infer_schema(rows) -> dict:
+        """Schema over ALL rows: fields missing in some rows (or ever None)
+        become nullable unions, so heterogeneous rows neither crash mid-write
+        nor lose columns."""
         def ftype(v):
-            if v is None:
-                return ["null", "string"]
             if isinstance(v, bool):
                 return "boolean"
             if isinstance(v, int):
@@ -149,11 +150,28 @@ class AvroFormat(Format):
                 return "bytes"
             return "string"
 
-        return {
-            "type": "record",
-            "name": "Row",
-            "fields": [{"name": k, "type": ftype(v)} for k, v in row.items()],
-        }
+        seen: Dict[str, Optional[str]] = {}
+        nullable = set()
+        order: List[str] = []
+        for r in rows:
+            for k, v in r.items():
+                if k not in seen:
+                    seen[k] = None
+                    order.append(k)
+                if v is None:
+                    nullable.add(k)
+                elif seen[k] is None:
+                    seen[k] = ftype(v)
+        n = len(list(rows)) if not isinstance(rows, list) else len(rows)
+        for r in rows:
+            for k in order:
+                if k not in r:
+                    nullable.add(k)
+        fields = []
+        for k in order:
+            t = seen[k] or "string"
+            fields.append({"name": k, "type": ["null", t] if k in nullable else t})
+        return {"type": "record", "name": "Row", "fields": fields}
 
     def _write_value(self, out, ftype, value):
         if isinstance(ftype, list):  # union: write the branch index, then value
@@ -176,7 +194,7 @@ class AvroFormat(Format):
 
     def write(self, rows, out):
         rows = list(rows)
-        schema = self.schema or (self.infer_schema(rows[0]) if rows else
+        schema = self.schema or (self.infer_schema(rows) if rows else
                                  {"type": "record", "name": "Row", "fields": []})
         header = json.dumps(schema).encode()
         out.write(self.MAGIC)

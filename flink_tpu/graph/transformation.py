@@ -147,7 +147,7 @@ def plan(sink_transform: Transformation) -> StepGraph:
         key_selector = None
 
     for t in order[1:]:
-        if t.kind in ("map", "map_ts", "flat_map", "filter", "process"):
+        if t.kind in ("map", "map_ts", "map_batch", "flat_map", "filter", "process"):
             chain.append(t)
         elif t.kind == "key_by":
             # repartition point: close current chain as a stateless step if

@@ -88,6 +88,39 @@ class DistributedJobSpec:
         return pickle.loads(b)
 
 
+def merge_shard_snapshots(handles: Dict[int, dict]) -> dict:
+    """Fold per-shard snapshots into one logical-state snapshot for
+    rescaling: heap tables union by key group (disjoint by construction,
+    the StateAssignmentOperation analogue), timers concatenate, the
+    collect-sink results concatenate. Each new shard restores from this and
+    filters to its own KeyGroupRange (state/heap.py restore)."""
+    merged_op: dict = {"state": {}, "timers": {"event": [], "proc": [], "watermark": None}}
+    results: list = []
+    for shard in sorted(handles):
+        snap = handles[shard]
+        op = snap["operator"]
+        if "columnar" in op:
+            raise ValueError(
+                "device-operator snapshots re-shard by key group inside the "
+                "sharded device state, not via heap-table merge; rescaling "
+                "device jobs is not supported yet"
+            )
+        for name, table in op.get("state", {}).items():
+            dst = merged_op["state"].setdefault(name, {})
+            for kg, entries in table.items():
+                dst.setdefault(kg, {}).update(entries)
+        t = op.get("timers")
+        if t is not None:
+            merged_op["timers"]["event"].extend(t.get("event", []))
+            merged_op["timers"]["proc"].extend(t.get("proc", []))
+            wm = t.get("watermark")
+            cur = merged_op["timers"]["watermark"]
+            merged_op["timers"]["watermark"] = wm if cur is None else min(cur, wm)
+        results.extend(snap.get("results", []))
+    step = handles[min(handles)]["step"]
+    return {"operator": merged_op, "results": results, "step": step, "merged": True}
+
+
 @dataclass
 class _JobState:
     job_id: str
@@ -95,6 +128,7 @@ class _JobState:
     parallelism: int
     spec_name: str
     status: str = "CREATED"            # CREATED/RUNNING/RESTARTING/FINISHED/FAILED/CANCELED
+    requested_parallelism: int = 0
     attempt: int = 0
     assignment: Dict[int, str] = field(default_factory=dict)   # shard -> tm_id
     finished: Dict[int, list] = field(default_factory=dict)    # shard -> results
@@ -121,6 +155,7 @@ class JobManagerEndpoint(RpcEndpoint):
         restart_delay: float = 0.2,
         heartbeat_interval: float = 0.5,
         heartbeat_timeout: float = 3.0,
+        adaptive: bool = True,
     ):
         super().__init__(name="jobmanager")
         self.rpc = rpc
@@ -129,6 +164,7 @@ class JobManagerEndpoint(RpcEndpoint):
         rpc.register(self.blob)
         self.checkpoint_interval = checkpoint_interval
         self.restart_attempts = restart_attempts
+        self.adaptive = adaptive
         self.restart_delay = restart_delay
         self._storage = FsCheckpointStorage(checkpoint_dir) if checkpoint_dir else None
         self._tms: Dict[str, dict] = {}
@@ -140,6 +176,24 @@ class JobManagerEndpoint(RpcEndpoint):
         if checkpoint_interval > 0:
             threading.Thread(target=self._checkpoint_loop, daemon=True,
                              name="checkpoint-trigger").start()
+        # periodic scheduling retry: jobs parked in RESTARTING (e.g. a deploy
+        # hit a dead-but-undetected worker) get re-attempted without needing
+        # a registration event
+        self._stopped = threading.Event()
+        threading.Thread(target=self._schedule_loop, daemon=True,
+                         name="schedule-retry").start()
+
+    def _schedule_loop(self) -> None:
+        while not self._stopped.wait(max(self.restart_delay, 0.2)):
+            try:
+                self.run_in_main_thread(self._try_schedule_all).result(timeout=30)
+            except Exception:
+                pass
+
+    def stop(self) -> None:
+        self._stopped.set()
+        self.heartbeats.stop()
+        super().stop()
 
     # ---- TaskExecutor registration / liveness (M5/M8/M10 scope) ----------
     def register_task_executor(self, tm_id: str, rpc_address: str,
@@ -179,7 +233,10 @@ class JobManagerEndpoint(RpcEndpoint):
         blob_key = self.blob.put(spec_bytes)
         spec = DistributedJobSpec.from_bytes(spec_bytes)
         job_id = uuid.uuid4().hex[:16]
-        self._jobs[job_id] = _JobState(job_id, blob_key, parallelism, spec.name)
+        self._jobs[job_id] = _JobState(
+            job_id, blob_key, parallelism, spec.name,
+            requested_parallelism=parallelism,
+        )
         self._try_schedule(self._jobs[job_id])
         return job_id
 
@@ -223,12 +280,40 @@ class JobManagerEndpoint(RpcEndpoint):
             # delayed-restart thread) or terminal
         slots = self._free_slots()
         if len(slots) < job.parallelism:
-            return  # WaitingForResources (AdaptiveScheduler state analogue)
+            # AdaptiveScheduler semantics: a restarting job with a completed
+            # checkpoint scales DOWN to the available slots rather than
+            # waiting (Executing->Restarting->Executing with lower
+            # parallelism, scheduler/adaptive/AdaptiveScheduler.java:192);
+            # state re-shards by key-group range on restore
+            if not (self.adaptive and slots and job.completed
+                    and job.status == "RESTARTING"):
+                return  # WaitingForResources
+            job.parallelism = len(slots)
+        elif (self.adaptive and job.status == "RESTARTING" and job.completed
+              and len(slots) > job.parallelism):
+            job.parallelism = min(len(slots), job.requested_parallelism)
         restore = None
         restore_step = 0
         if job.completed:
             cp_id, handles, step = job.completed[-1]
             restore, restore_step = handles, step
+            if set(handles) != set(range(job.parallelism)):
+                # parallelism changed since the checkpoint: re-shard
+                try:
+                    merged = merge_shard_snapshots(handles)
+                except ValueError:
+                    # unmergeable (device) snapshots: keep the checkpointed
+                    # parallelism and wait for enough slots instead
+                    job.parallelism = len(handles)
+                    if len(slots) < job.parallelism:
+                        return
+                    merged = None
+                if merged is not None:
+                    restore = {
+                        shard: (merged if shard == 0
+                                else {**merged, "results": []})
+                        for shard in range(job.parallelism)
+                    }
         job.attempt += 1
         job.assignment = {shard: slots[shard] for shard in range(job.parallelism)}
         peers = {
@@ -436,7 +521,27 @@ class _ShardTask:
         op = self._make_operator()
         results: list = []
         if self.restore is not None:
-            op.restore(self.restore["operator"])
+            op_snap = self.restore["operator"]
+            if self.restore.get("merged"):
+                # rescaled restore: keep only timers whose key falls in this
+                # shard's key-group range (state filters itself by range)
+                from flink_tpu.core.keygroups import assign_to_key_group
+
+                kg_range = key_group_range_for_operator(
+                    self.spec.max_parallelism, P, self.shard
+                )
+                t = op_snap["timers"]
+                op_snap = {
+                    "state": op_snap["state"],
+                    "timers": {
+                        "event": [e for e in t["event"] if kg_range.contains(
+                            assign_to_key_group(e[1], self.spec.max_parallelism))],
+                        "proc": [e for e in t["proc"] if kg_range.contains(
+                            assign_to_key_group(e[1], self.spec.max_parallelism))],
+                        "watermark": t["watermark"],
+                    },
+                }
+            op.restore(op_snap)
             # the collect-sink is stateful: outputs emitted before the
             # checkpoint are part of the cut (post-checkpoint emissions of
             # the failed attempt are discarded and re-fired on replay)

@@ -97,6 +97,11 @@ class ChainRunner(StepRunner):
                 keep = [bool(fn(v)) for v in vals]
                 vals = [v for v, k in zip(vals, keep) if k]
                 ts = [x for x, k in zip(ts, keep) if k]
+            elif t.kind == "map_batch":
+                # whole-batch transform (amortized device dispatch: model
+                # inference, vectorized UDFs)
+                vals = list(fn(vals))
+                assert len(vals) == len(ts), "map_batch must be 1:1"
             elif t.kind == "flat_map":
                 new_vals, new_ts = [], []
                 for v, x in zip(vals, ts):

@@ -1,0 +1,95 @@
+"""External resource framework: accelerator discovery SPI (Y4).
+
+Analogue of flink-core/.../externalresource/ExternalResourceDriver.java +
+the GPU driver (flink-external-resources/flink-external-resource-gpu/...
+GPUDriver.java), surfaced to operators via
+RuntimeContext.getExternalResourceInfos. The first-class driver here is the
+TPU one: it reports the chips jax sees (id, platform, kind, memory stats,
+process/slice indices) — the information a task needs to pin itself to an
+accelerator.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List
+
+
+class ExternalResourceInfo:
+    def __init__(self, properties: Dict[str, Any]):
+        self._props = dict(properties)
+
+    def get_property(self, key: str, default=None):
+        return self._props.get(key, default)
+
+    @property
+    def properties(self) -> Dict[str, Any]:
+        return dict(self._props)
+
+    def __repr__(self):
+        return f"ExternalResourceInfo({self._props})"
+
+
+class ExternalResourceDriver:
+    name: str = ""
+
+    def retrieve_resource_info(self, amount: int) -> List[ExternalResourceInfo]:
+        raise NotImplementedError
+
+
+class TpuDriver(ExternalResourceDriver):
+    """Discovers TPU (or whatever accelerator jax is bound to) chips."""
+
+    name = "tpu"
+
+    def retrieve_resource_info(self, amount: int) -> List[ExternalResourceInfo]:
+        import jax
+
+        out = []
+        for d in jax.devices()[: amount if amount > 0 else None]:
+            props: Dict[str, Any] = {
+                "id": d.id,
+                "platform": d.platform,
+                "device_kind": getattr(d, "device_kind", "unknown"),
+                "process_index": getattr(d, "process_index", 0),
+            }
+            try:
+                stats = d.memory_stats() or {}
+                if "bytes_limit" in stats:
+                    props["memory_bytes"] = stats["bytes_limit"]
+            except Exception:
+                pass
+            out.append(ExternalResourceInfo(props))
+        return out
+
+
+class GpuDriver(ExternalResourceDriver):
+    """GPU discovery stub (GPUDriver.java analogue): reads indices from
+    CUDA_VISIBLE_DEVICES when present; this image has no GPUs."""
+
+    name = "gpu"
+
+    def retrieve_resource_info(self, amount: int) -> List[ExternalResourceInfo]:
+        import os
+
+        visible = os.environ.get("CUDA_VISIBLE_DEVICES", "")
+        ids = [v for v in visible.split(",") if v.strip()]
+        return [ExternalResourceInfo({"index": v}) for v in ids[:amount or None]]
+
+
+_DRIVERS: Dict[str, ExternalResourceDriver] = {}
+
+
+def register_driver(driver: ExternalResourceDriver) -> None:
+    _DRIVERS[driver.name] = driver
+
+
+def get_external_resource_infos(name: str, amount: int = 0) -> List[ExternalResourceInfo]:
+    """RuntimeContext.getExternalResourceInfos analogue."""
+    driver = _DRIVERS.get(name)
+    if driver is None:
+        raise KeyError(f"no external resource driver {name!r} (have {sorted(_DRIVERS)})")
+    return driver.retrieve_resource_info(amount)
+
+
+register_driver(TpuDriver())
+register_driver(GpuDriver())

@@ -148,30 +148,38 @@ class TableEnvironment:
                         )
                     providers.append((item, self._models[item.name]))
 
-                def infer(row, _cols=cols, _providers=providers):
-                    out = {c.output_name: row[c.name] for c in _cols}
-                    for item, provider in _providers:
-                        # SQL args map POSITIONALLY onto the provider's
-                        # declared feature columns
-                        args = item.args or provider.feature_cols
-                        if len(args) != len(provider.feature_cols):
-                            raise ValueError(
-                                f"ML_PREDICT({item.name}, ...) got {len(args)} "
-                                f"features, model wants {len(provider.feature_cols)}"
-                            )
-                        pred = provider.predict_row({
-                            fc: row[arg]
-                            for fc, arg in zip(provider.feature_cols, args)
-                        })
-                        if len(provider.output_names) == 1:
-                            out[item.alias or item.output_name] = pred[
-                                provider.output_names[0]
-                            ]
-                        else:
-                            out.update(pred)
-                    return out
+                for item, provider in providers:
+                    # SQL args map POSITIONALLY onto the provider's features
+                    args = item.args or provider.feature_cols
+                    if len(args) != len(provider.feature_cols):
+                        raise ValueError(
+                            f"ML_PREDICT({item.name}, ...) got {len(args)} "
+                            f"features, model wants {len(provider.feature_cols)}"
+                        )
 
-                return stream.map(infer, name="ml_predict")
+                def infer_batch(rows, _cols=cols, _providers=providers):
+                    # whole-batch inference: ONE device dispatch per provider
+                    # per step batch (MLPredictRunner batching, on-device)
+                    import numpy as _np
+
+                    outs = [{c.output_name: r[c.name] for c in _cols} for r in rows]
+                    for item, provider in _providers:
+                        args = item.args or provider.feature_cols
+                        feats = _np.asarray(
+                            [[float(r[a]) for a in args] for r in rows],
+                            dtype=_np.float32,
+                        )
+                        preds = _np.asarray(provider.predict_batch(feats))
+                        single = len(provider.output_names) == 1
+                        for i, o in enumerate(outs):
+                            if single:
+                                o[item.alias or item.output_name] = preds[i, 0].item()
+                            else:
+                                for j, nm in enumerate(provider.output_names):
+                                    o[nm] = preds[i, j].item()
+                    return outs
+
+                return stream.map_batch(infer_batch, name="ml_predict")
             return stream.map(
                 lambda row, _cols=cols: {c.output_name: row[c.name] for c in _cols},
                 name="project",

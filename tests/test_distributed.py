@@ -254,6 +254,7 @@ def test_cluster_checkpoint_failover_exactly_once(tmp_path):
         svc_jm, checkpoint_dir=str(tmp_path / "chk"),
         restart_attempts=3, restart_delay=0.2,
         heartbeat_interval=0.2, heartbeat_timeout=1.5,
+        adaptive=False,  # this test pins parallelism: replacement TM joins
     )
     spec = _make_spec(n_steps=40, batch=30)
 
@@ -318,3 +319,81 @@ class _SlowList(list):
     def __getitem__(self, i):
         time.sleep(self.delay)
         return super().__getitem__(i)
+
+
+def _partition_invariant_spec(n_steps=30, batch=60, n_keys=9):
+    """Source whose per-step UNION of shard batches is the same for any
+    parallelism (the split-redistribution contract rescaling relies on)."""
+
+    def source_factory(shard, num_shards):
+        out = []
+        for s in range(n_steps):
+            rng = np.random.default_rng(1000 + s)     # per-STEP determinism
+            keys = np.asarray([f"k{v}" for v in rng.integers(0, n_keys, batch)],
+                              dtype=object)
+            vals = np.ones(batch, dtype=np.float64)
+            ts = (s * 1000 + rng.integers(0, 1000, batch)).astype(np.int64)
+            sl = slice(shard, None, num_shards)
+            out.append((keys[sl], vals[sl], ts[sl], s * 1000 + 500))
+        return out
+
+    return DistributedJobSpec(
+        name="rescale-job",
+        source_factory=source_factory,
+        assigner=TumblingEventTimeWindows.of(2000),
+        aggregate="sum",
+        max_parallelism=16,
+    )
+
+
+def test_cluster_rescales_down_after_tm_loss(tmp_path):
+    """Lose a TM with no replacement: the adaptive scheduler restarts the
+    job at parallelism 1 from the checkpoint, re-sharding state by
+    key-group; results stay exact."""
+    svc_jm = RpcService()
+    jm = JobManagerEndpoint(
+        svc_jm, checkpoint_dir=str(tmp_path / "chk"),
+        restart_attempts=3, restart_delay=0.3,
+        heartbeat_interval=0.2, heartbeat_timeout=1.2,
+    )
+    spec = _partition_invariant_spec()
+    orig_factory = spec.source_factory
+
+    def slow_factory(shard, num_shards):
+        return _SlowList(orig_factory(shard, num_shards), delay=0.1)
+
+    spec.source_factory = slow_factory
+
+    svc1, svc2 = RpcService(), RpcService()
+    te1 = TaskExecutorEndpoint(svc1, slots=1)
+    te1.connect(svc_jm.address)
+    te2 = TaskExecutorEndpoint(svc2, slots=1)
+    te2.connect(svc_jm.address)
+    client = svc_jm.gateway(svc_jm.address, "jobmanager")
+    job_id = client.submit_job(spec.to_bytes(), 2)
+
+    deadline = time.time() + 30
+    while time.time() < deadline:
+        if client.trigger_checkpoint(job_id) and client.job_status(job_id)["checkpoints"]:
+            break
+        time.sleep(0.3)
+    assert client.job_status(job_id)["checkpoints"]
+    te2.stop()
+    svc2.stop()        # no replacement: must downscale to te1 alone
+
+    deadline = time.time() + 90
+    while time.time() < deadline:
+        st = client.job_status(job_id)
+        if st["status"] in ("FINISHED", "FAILED"):
+            break
+        time.sleep(0.3)
+    assert st["status"] == "FINISHED", st
+    assert st["restarts"] >= 1
+    got = _collect(client.job_result(job_id))
+    want = _expected(_partition_invariant_spec(), 1)
+    assert got == want
+
+    te1.stop()
+    jm.heartbeats.stop()
+    svc_jm.stop()
+    svc1.stop()

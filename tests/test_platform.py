@@ -1,0 +1,180 @@
+"""Platform layer: filesystem abstraction (C4), managed memory (D13),
+DataStream V2 (C9), external resources (Y4), K8s descriptor (Y2), docs (X1),
+adaptive rescale snapshot merge."""
+
+import json
+
+import numpy as np
+import pytest
+
+from flink_tpu.api.v2 import ExecutionEnvironment, OneInputStreamProcessFunction
+from flink_tpu.core.fs import MemoryFileSystem, get_file_system, register_file_system
+from flink_tpu.deploy.kubernetes import KubernetesClusterDescriptor, YarnClusterDescriptor
+from flink_tpu.runtime.cluster import merge_shard_snapshots
+from flink_tpu.runtime.external_resources import get_external_resource_infos
+from flink_tpu.runtime.memory import MemoryManager, MemoryReservationError
+
+
+# ---------------------------------------------------------------------------
+# filesystem
+# ---------------------------------------------------------------------------
+
+def test_local_fs_atomic_write_and_listing(tmp_path):
+    fs = get_file_system(f"file://{tmp_path}/a.txt")
+    fs.write(f"file://{tmp_path}/a.txt", b"hello")
+    assert fs.read(f"file://{tmp_path}/a.txt") == b"hello"
+    fs.write(str(tmp_path / "b.txt"), b"x")  # plain path = file scheme
+    assert len(fs.list(str(tmp_path))) == 2
+    fs.delete(str(tmp_path / "b.txt"))
+    assert not fs.exists(str(tmp_path / "b.txt"))
+
+
+def test_memory_fs_object_semantics():
+    fs = MemoryFileSystem()
+    register_file_system("testmem", fs)
+    fs.write("testmem://bucket/chk/1/_metadata", b"meta")
+    fs.write("testmem://bucket/chk/1/part-0", b"data")
+    assert fs.exists("testmem://bucket/chk/1")
+    assert len(fs.list("testmem://bucket/chk/1")) == 2
+    with pytest.raises(IsADirectoryError):
+        fs.delete("testmem://bucket/chk/1")
+    fs.delete("testmem://bucket/chk/1", recursive=True)
+    assert not fs.exists("testmem://bucket/chk/1")
+
+
+def test_unknown_scheme_lists_registered():
+    with pytest.raises(ValueError, match="registered"):
+        get_file_system("s3://bucket/x")
+
+
+# ---------------------------------------------------------------------------
+# managed memory
+# ---------------------------------------------------------------------------
+
+def test_memory_manager_budget_and_attribution():
+    mm = MemoryManager(100 << 20)
+    mm.reserve("state-columns", 60 << 20)
+    mm.reserve("exchange-rings", 30 << 20)
+    with pytest.raises(MemoryReservationError, match="state-columns"):
+        mm.reserve("spill-memtable", 20 << 20)
+    mm.release("exchange-rings")
+    mm.reserve("spill-memtable", 20 << 20)
+    assert mm.available() == 20 << 20
+    split = mm.split_by_weights({"state": 3, "python": 1})
+    assert split["state"] == 75 << 20
+
+
+def test_memory_manager_for_device():
+    mm = MemoryManager.for_device()
+    assert mm.budget > 1 << 30  # something sane regardless of backend
+
+
+# ---------------------------------------------------------------------------
+# DataStream V2
+# ---------------------------------------------------------------------------
+
+def test_v2_process_pipeline():
+    env = ExecutionEnvironment.get_instance()
+
+    class Tokenize(OneInputStreamProcessFunction):
+        def process_record(self, record, output, ctx):
+            for w in record.split():
+                output.collect((w, 1))
+
+    class CountState(OneInputStreamProcessFunction):
+        def __init__(self):
+            self.counts = {}
+
+        def process_record(self, record, output, ctx):
+            w, n = record
+            self.counts[w] = self.counts.get(w, 0) + n
+            output.collect((w, self.counts[w]))
+
+    sink = (
+        env.from_collection(["a b a", "b a"])
+        .process(Tokenize())
+        .key_by(lambda t: t[0])
+        .process(CountState())
+        .collect_to_list()
+    )
+    env.execute("v2-wordcount")
+    finals = {}
+    for w, c in sink.results:
+        finals[w] = max(finals.get(w, 0), c)
+    assert finals == {"a": 3, "b": 2}
+
+
+def test_v2_plain_function_shorthand():
+    env = ExecutionEnvironment.get_instance()
+    sink = env.from_collection([1, 2, 3]).process(lambda x: [x * 10]).collect_to_list()
+    env.execute("v2-map")
+    assert sorted(sink.results) == [10, 20, 30]
+
+
+# ---------------------------------------------------------------------------
+# external resources / deploy / docs
+# ---------------------------------------------------------------------------
+
+def test_tpu_external_resource_discovery():
+    infos = get_external_resource_infos("tpu")
+    assert len(infos) >= 1
+    assert infos[0].get_property("platform") is not None
+
+
+def test_unknown_resource_driver():
+    with pytest.raises(KeyError, match="no external resource driver"):
+        get_external_resource_infos("fpga")
+
+
+def test_k8s_manifests_shape():
+    desc = KubernetesClusterDescriptor(
+        "wordcount", taskmanagers=3, slots_per_tm=2,
+        tpu_type="v5litepod-8", tpu_chips_per_tm=4,
+    )
+    doc = json.loads(desc.render())
+    kinds = [m["kind"] for m in doc["items"]]
+    assert kinds == ["Service", "Deployment", "Deployment"]
+    tm = doc["items"][2]
+    assert tm["spec"]["replicas"] == 3
+    tpl = tm["spec"]["template"]["spec"]
+    assert tpl["nodeSelector"]["cloud.google.com/gke-tpu-accelerator"] == "v5litepod-8"
+    assert tpl["containers"][0]["resources"]["limits"]["google.com/tpu"] == 4
+    jm_args = doc["items"][1]["spec"]["template"]["spec"]["containers"][0]["args"]
+    assert "jobmanager" in jm_args
+
+
+def test_yarn_descriptor_gated():
+    with pytest.raises(NotImplementedError, match="Hadoop"):
+        YarnClusterDescriptor()
+
+
+def test_docs_generation_covers_options():
+    from flink_tpu.docs.generate import collect_options, render_markdown
+
+    opts = collect_options()
+    assert len(opts) >= 10
+    md = render_markdown()
+    assert "| Key |" in md and "pipeline" in md
+
+
+# ---------------------------------------------------------------------------
+# rescale snapshot merge
+# ---------------------------------------------------------------------------
+
+def test_merge_shard_snapshots_unions_key_groups():
+    handles = {
+        0: {"operator": {"state": {"w": {1: {("a", None): 5}}},
+                          "timers": {"event": [(10, "a", None)], "proc": [],
+                                     "watermark": 100}},
+            "results": [("a", (0, 10), 5, 9)], "step": 7},
+        1: {"operator": {"state": {"w": {9: {("b", None): 3}}},
+                          "timers": {"event": [(20, "b", None)], "proc": [],
+                                     "watermark": 90}},
+            "results": [("b", (0, 10), 3, 9)], "step": 7},
+    }
+    merged = merge_shard_snapshots(handles)
+    assert merged["operator"]["state"]["w"] == {1: {("a", None): 5}, 9: {("b", None): 3}}
+    assert len(merged["operator"]["timers"]["event"]) == 2
+    assert merged["operator"]["timers"]["watermark"] == 90
+    assert merged["step"] == 7 and merged["merged"] is True
+    assert len(merged["results"]) == 2
