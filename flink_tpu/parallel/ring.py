@@ -1,0 +1,114 @@
+"""Ring collectives over ICI: bandwidth-optimal merges for large state.
+
+The long-context scaling patterns of ML systems (ring attention: rotate
+blocks around the ICI ring with `ppermute`, overlap compute with the
+transfer) applied to this framework's big dimension — per-key window state.
+`psum` is latency-optimal for small merges; for LARGE per-shard state
+(wide accumulator panels, big top-k candidate sets) the bandwidth-optimal
+form is the classic ring: reduce-scatter then all-gather, each step moving
+1/n of the state to a neighbor, n-1 times — total bytes on the wire
+2·(n-1)/n·|state| regardless of n.
+
+Used for: global-window merges whose combined state is too wide for one
+psum (Nexmark Q7-style global aggregates over huge key panels), and as the
+ring-attention-shaped primitive for future sequence-sharded operators.
+All functions run inside `shard_map`/`pmap` bodies with a named mesh axis.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def _ring_perm(n: int, shift: int = 1):
+    return [(i, (i + shift) % n) for i in range(n)]
+
+
+def ring_reduce_scatter(x: jnp.ndarray, axis_name: str, combine=jnp.add) -> jnp.ndarray:
+    """x: [n, chunk, ...] per shard (chunked along the shard axis). Returns
+    this shard's fully-combined chunk [chunk, ...].
+
+    n-1 ppermute steps; step k sends the partial for chunk (me - k - 1) to
+    the right neighbor, which folds its own contribution in — the first
+    half of a ring all-reduce.
+    """
+    n = jax.lax.psum(1, axis_name)
+    me = jax.lax.axis_index(axis_name)
+
+    def step(k, carry):
+        x, send = carry
+        recv = jax.lax.ppermute(send, axis_name, _ring_perm(n))
+        # fold my contribution for the chunk now arriving:
+        # after k+1 hops the travelling partial is for chunk (me - k - 2)
+        idx = (me - k - 2) % n
+        mine = jax.lax.dynamic_index_in_dim(x, idx, 0, keepdims=False)
+        return x, combine(recv, mine)
+
+    send0 = jax.lax.dynamic_index_in_dim(x, (me - 1) % n, 0, keepdims=False)
+    _, out = jax.lax.fori_loop(0, n - 1, step, (x, send0))
+    # after n-1 steps the accumulated partial sitting here is chunk (me - n) % n == me
+    return out
+
+
+def ring_all_gather(chunk: jnp.ndarray, axis_name: str) -> jnp.ndarray:
+    """Inverse phase: every shard ends with all chunks stacked [n, ...]."""
+    n = jax.lax.psum(1, axis_name)
+    me = jax.lax.axis_index(axis_name)
+    out0 = jnp.zeros((n,) + chunk.shape, chunk.dtype)
+    out0 = jax.lax.dynamic_update_index_in_dim(out0, chunk, me, 0)
+
+    def step(k, carry):
+        out, send = carry
+        recv = jax.lax.ppermute(send, axis_name, _ring_perm(n))
+        idx = (me - k - 1) % n
+        out = jax.lax.dynamic_update_index_in_dim(out, recv, idx, 0)
+        return out, recv
+
+    out, _ = jax.lax.fori_loop(0, n - 1, step, (out0, chunk))
+    return out
+
+
+def ring_all_reduce(x: jnp.ndarray, axis_name: str, combine=jnp.add) -> jnp.ndarray:
+    """Bandwidth-optimal all-reduce of x (identical shape on every shard):
+    chunk along dim 0 (padded to n), reduce-scatter, all-gather, unpad."""
+    n = jax.lax.psum(1, axis_name)
+    rows = x.shape[0]
+    pad = (-rows) % n
+    if pad:
+        x = jnp.concatenate([x, jnp.zeros((pad,) + x.shape[1:], x.dtype)])
+    chunks = x.reshape((n, x.shape[0] // n) + x.shape[1:])
+    mine = ring_reduce_scatter(chunks, axis_name, combine)
+    full = ring_all_gather(mine, axis_name)
+    full = full.reshape((x.shape[0],) + x.shape[1:])
+    return full[:rows]
+
+
+def ring_global_topk(values: jnp.ndarray, k: int, axis_name: str):
+    """Global top-k across shards by rotating candidate sets around the ring
+    and re-selecting at each hop (k values travel, not the whole panel) —
+    the Nexmark-Q5-style hot-items merge at ring cost O(n·k).
+
+    values: this shard's scores [m]. Returns (topk_values[k], topk_shard[k])
+    replicated on every shard.
+    """
+    n = jax.lax.psum(1, axis_name)
+    me = jax.lax.axis_index(axis_name)
+    v, _ = jax.lax.top_k(values, min(k, values.shape[0]))
+    if v.shape[0] < k:
+        v = jnp.concatenate([v, jnp.full(k - v.shape[0], -jnp.inf, v.dtype)])
+    src = jnp.full((k,), me, jnp.int32)
+
+    def step(_, carry):
+        best_v, best_s, trav_v, trav_s = carry
+        # rotate each shard's ORIGINAL candidate set around the ring (merged
+        # sets would double-count values already folded in)
+        trav_v = jax.lax.ppermute(trav_v, axis_name, _ring_perm(n))
+        trav_s = jax.lax.ppermute(trav_s, axis_name, _ring_perm(n))
+        allv = jnp.concatenate([best_v, trav_v])
+        alls = jnp.concatenate([best_s, trav_s])
+        nv, idx = jax.lax.top_k(allv, k)
+        return nv, alls[idx], trav_v, trav_s
+
+    best_v, best_s, _, _ = jax.lax.fori_loop(0, n - 1, step, (v, src, v, src))
+    return best_v, best_s
