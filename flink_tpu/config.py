@@ -220,6 +220,19 @@ class ExecutionOptions:
     KEY_CAPACITY = (
         ConfigOptions.key("execution.state.key-capacity").int_type().default_value(1 << 16)
     ).with_description("Initial per-shard distinct-key capacity of device columnar state; grows by doubling.")
+    FUSED_WINDOWS = (
+        ConfigOptions.key("execution.window.fused").bool_type().default_value(True)
+    ).with_description(
+        "Select the fused superscan window operator (one compiled dispatch per "
+        "superbatch) for eligible event-time window aggregates; fall back to the "
+        "per-step device operator when off or ineligible."
+    )
+    SUPERBATCH_STEPS = (
+        ConfigOptions.key("execution.window.superbatch-steps").int_type().default_value(32)
+    ).with_description(
+        "Steps buffered per fused-window dispatch; higher amortizes host-device "
+        "round trips, lower reduces emission latency."
+    )
 
 
 class CheckpointingOptions:

@@ -1,0 +1,477 @@
+"""FusedWindowOperator: the product-path driver of FusedWindowPipeline.
+
+Round 1 left the fused superscan as a bench-only side-car; this adapter
+makes it the operator the executor actually selects (the swap boundary the
+reference models as WindowOperatorBuilder.java:79 choosing
+buildAsyncWindowOperator :472). It presents the same operator surface as
+TpuWindowOperator — process_batch / process_watermark / drain_output /
+snapshot / restore — while internally buffering steps and dispatching one
+compiled T-step superscan per superbatch.
+
+Two host-side layers de-brittle the raw pipeline (whose planner rejects
+batches spanning > nsb slices, > fires_per_step fires per step, > out_rows
+fires per dispatch, or slices beyond the ring):
+
+- StepNormalizer splits raw (batch, watermark) steps into planner-safe
+  steps: slice-span splitting (adds commute, so splitting a batch at the
+  same watermark is semantics-preserving), intermediate-watermark
+  insertion so no step fires more than fires_per_step windows (the
+  watermark is a lower bound; staging its advance is always safe), and
+  ring-overflow hold-back (records too far in the future wait on host
+  until the purge frontier opens ring space — the fused sibling of
+  TpuWindowOperator._future).
+- The dispatch grouper packs normalized steps into fixed-T superbatches
+  (padding with empty steps so ONE executable serves every dispatch) and
+  cuts a dispatch early before planned fires exceed out_rows.
+
+Watermark visibility: emissions materialize when a superbatch resolves, so
+the operator exposes `emitted_watermark` — the watermark downstream may
+safely observe (everything at or below it has been emitted). The step
+runner forwards min(watermark, emitted_watermark), which preserves the
+no-late-data contract downstream (a delayed watermark is always correct).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from flink_tpu.api.windowing.assigners import WindowAssigner
+from flink_tpu.core.time import MAX_WATERMARK, MIN_WATERMARK
+from flink_tpu.ops.aggregators import ONE, VALUE, resolve
+from flink_tpu.runtime.fused_window_pipeline import FusedWindowPipeline
+from flink_tpu.state.columnar import KeyDictionary
+
+
+def _ceil_div(a: int, b: int) -> int:
+    return -((-a) // b)
+
+
+@dataclasses.dataclass
+class _Step:
+    """One planner-safe step: a (possibly empty) batch plus the watermark in
+    effect after it, annotated with how many windows its advance fires."""
+
+    kid: np.ndarray
+    vals: Optional[np.ndarray]
+    ts: np.ndarray
+    wm: int
+    n_fires: int
+
+
+class StepNormalizer:
+    """Host-side simulation of the fused planner's frontier state, used to
+    pre-split raw steps so `stage_superbatch` never raises. Mirrors the
+    geometry formulas of FusedWindowPipeline exactly (same fire/purge
+    frontier math); divergence would be a planner error, so the pipeline's
+    own checks stay on as assertions."""
+
+    def __init__(self, pipe: FusedWindowPipeline):
+        self.p = pipe
+        self.wm = MIN_WATERMARK
+        self.fire_cursor: Optional[int] = None
+        self.max_seen: Optional[int] = None
+        self.min_used: Optional[int] = None
+        self.purged_to: Optional[int] = None
+        # far-future records held until the ring can take them
+        self._future: List[Tuple[np.ndarray, Optional[np.ndarray], np.ndarray]] = []
+        self.num_future_held = 0
+
+    # geometry delegates (identical formulas; single source of truth)
+    def _j_fired_upto(self, wm: int) -> int:
+        return self.p._j_fired_upto(wm)
+
+    def _min_live_slice(self, wm: int) -> int:
+        return self.p._min_live_slice(wm)
+
+    def _slice_of(self, ts: np.ndarray) -> np.ndarray:
+        return self.p._slice_of(np.asarray(ts, dtype=np.int64))
+
+    def _fire_wm(self, j: int) -> int:
+        """Smallest watermark at which window j fires."""
+        return self.p.offset + j * self.p.slide_ms + self.p.size_ms - 1
+
+    # ------------------------------------------------------------------
+    def push(self, kid: np.ndarray, vals: Optional[np.ndarray], ts: np.ndarray) -> List[_Step]:
+        """Normalize one data batch (no watermark advance)."""
+        out: List[_Step] = []
+        self._append_data(out, kid, vals, ts)
+        return out
+
+    def advance(self, wm: int) -> List[_Step]:
+        """Normalize one watermark advance into fire-bounded steps, then
+        re-inject any held future records the purge made room for."""
+        out: List[_Step] = []
+        if wm <= self.wm:
+            return out
+        p = self.p
+        while True:
+            n_fires = 0
+            j_hi = self._j_fired_upto(wm)
+            step_wm = wm
+            if self.fire_cursor is not None and self.max_seen is not None:
+                cap = min(j_hi, self.p._j_newest(self.max_seen))
+                n_fires = max(0, cap - self.fire_cursor + 1)
+                if n_fires > p.F:
+                    # stage the advance: fire exactly F windows this step
+                    cap = self.fire_cursor + p.F - 1
+                    step_wm = min(wm, self._fire_wm(cap))
+                    n_fires = p.F
+            out.append(_Step(
+                np.empty(0, np.int32), None, np.empty(0, np.int64), step_wm, n_fires
+            ))
+            self._commit_wm(step_wm, n_fires)
+            if step_wm >= wm:
+                break
+        self._drain_future(out)
+        return out
+
+    def pad_step(self) -> _Step:
+        return _Step(np.empty(0, np.int32), None, np.empty(0, np.int64), self.wm, 0)
+
+    def end_steps(self) -> List[_Step]:
+        """End of input: fire everything still buffered (MAX_WATERMARK)."""
+        return self.advance(MAX_WATERMARK - 1)
+
+    # ------------------------------------------------------------------
+    def _commit_wm(self, wm: int, n_fires: int) -> None:
+        if wm <= self.wm:
+            return
+        j_hi = self._j_fired_upto(wm)
+        if self.fire_cursor is not None and j_hi >= self.fire_cursor:
+            self.fire_cursor = j_hi + 1
+        new_min_live = self._min_live_slice(wm)
+        self.purged_to = (
+            new_min_live if self.purged_to is None else max(self.purged_to, new_min_live)
+        )
+        self.wm = wm
+
+    def _append_data(self, out: List[_Step], kid, vals, ts) -> None:
+        p = self.p
+        n = len(ts)
+        if n == 0:
+            return
+        s_abs = self._slice_of(ts)
+        keep = np.ones(n, dtype=bool)
+        if self.wm > MIN_WATERMARK:
+            keep = s_abs >= self._min_live_slice(self.wm)  # late records: the
+            # pipeline drops/counts them itself; they must not affect splits
+        if not keep.any():
+            out.append(_Step(np.asarray(kid, np.int32), vals, np.asarray(ts, np.int64),
+                             self.wm, 0))
+            return
+
+        # ring-overflow hold-back: a record at slice s needs the full span
+        # [oldest-live-slice, s] resident. Before the first watermark the
+        # oldest live slice is the smallest slice ever ACCEPTED (min_used),
+        # not this batch's min — otherwise a far-future batch would alias
+        # cells still owned by earlier data (TpuWindowOperator._ring_floor)
+        floor = int(s_abs[keep].min())
+        if self.min_used is not None:
+            floor = min(floor, self.min_used)
+        if self.wm > MIN_WATERMARK:
+            floor = max(floor, self._min_live_slice(self.wm))
+        if self.purged_to is not None:
+            floor = max(floor, self.purged_to)
+        limit = floor + p.S - p.NSB
+        over = keep & (s_abs >= limit)
+        if over.any():
+            idx = np.flatnonzero(over)
+            self._future.append((
+                np.asarray(kid)[idx],
+                None if vals is None else np.asarray(vals)[idx],
+                np.asarray(ts)[idx],
+            ))
+            self.num_future_held += len(idx)
+            sel = ~over
+            kid, ts = np.asarray(kid)[sel], np.asarray(ts)[sel]
+            vals = None if vals is None else np.asarray(vals)[sel]
+            s_abs, keep = s_abs[sel], keep[sel]
+            if len(ts) == 0:
+                return
+
+        # slice-span splitting: sub-steps each touching < nsb distinct slices
+        smin = int(s_abs[keep].min())
+        group = np.where(keep, (s_abs - smin) // p.NSB, 0)
+        for gval in np.unique(group):
+            sel = group == gval
+            out.append(_Step(
+                np.asarray(kid)[sel].astype(np.int32),
+                None if vals is None else np.asarray(vals)[sel],
+                np.asarray(ts)[sel].astype(np.int64),
+                self.wm, 0,
+            ))
+        smax = int(s_abs[keep].max())
+        self.max_seen = smax if self.max_seen is None else max(self.max_seen, smax)
+        self.min_used = smin if self.min_used is None else min(self.min_used, smin)
+        cand = self.p._j_oldest(smin)
+        if self.wm > MIN_WATERMARK:
+            cand = max(cand, self._j_fired_upto(self.wm) + 1)
+        self.fire_cursor = cand if self.fire_cursor is None else min(self.fire_cursor, cand)
+
+    def _drain_future(self, out: List[_Step]) -> None:
+        if not self._future:
+            return
+        fut, self._future = self._future, []
+        self.num_future_held = 0
+        for kid, vals, ts in fut:
+            self._append_data(out, kid, vals, ts)  # still-unfit rows re-buffer
+
+    # ------------------------------------------------------------------
+    def snapshot(self) -> dict:
+        return {
+            "wm": self.wm,
+            "fire_cursor": self.fire_cursor,
+            "max_seen": self.max_seen,
+            "min_used": self.min_used,
+            "purged_to": self.purged_to,
+            "future": [
+                (k.tolist(), None if v is None else v.tolist(), t.tolist())
+                for k, v, t in self._future
+            ],
+        }
+
+    def restore(self, snap: dict) -> None:
+        self.wm = snap["wm"]
+        self.fire_cursor = snap["fire_cursor"]
+        self.max_seen = snap["max_seen"]
+        self.min_used = snap.get("min_used")
+        self.purged_to = snap["purged_to"]
+        self._future = [
+            (np.asarray(k, np.int32),
+             None if v is None else np.asarray(v, np.float32),
+             np.asarray(t, np.int64))
+            for k, v, t in snap["future"]
+        ]
+        self.num_future_held = sum(len(t) for _, _, t in self._future)
+
+
+class FusedWindowOperator:
+    """Operator-boundary adapter: same surface as TpuWindowOperator, fused
+    superbatch execution underneath. One outstanding dispatch is kept in
+    flight (resolve of dispatch i overlaps device execution of i+1)."""
+
+    def __init__(
+        self,
+        assigner: WindowAssigner,
+        aggregate,
+        *,
+        key_capacity: int = 1 << 12,
+        superbatch_steps: int = 32,
+        dense_int_keys: bool = False,
+        num_slices: Optional[int] = None,
+        nsb: int = 4,
+        fires_per_step: int = 4,
+        out_rows: int = 256,
+        chunk: int = 4096,
+    ):
+        self.agg = resolve(aggregate)
+        if self.agg is None:
+            raise ValueError(f"aggregate {aggregate!r} has no device form")
+        self.pipe = FusedWindowPipeline(
+            assigner, self.agg,
+            key_capacity=key_capacity, num_slices=num_slices, nsb=nsb,
+            fires_per_step=fires_per_step, out_rows=out_rows, chunk=chunk,
+        )
+        self.T = superbatch_steps
+        self.keydict = KeyDictionary(dense_int_keys)
+        self.norm = StepNormalizer(self.pipe)
+        self._steps: List[_Step] = []
+        self._inflight: Optional[tuple] = None  # (DeferredEmissions, wm)
+        self.output: List[Tuple[Any, Any, Any, int]] = []
+        self.emitted_watermark = MIN_WATERMARK
+        self.current_watermark = MIN_WATERMARK
+        self._needs_value = any(f.source == VALUE for f in self.agg.fields)
+
+    # ------------------------------------------------------------------
+    def process_record(self, key, value, timestamp: int) -> None:
+        self.process_batch(
+            np.asarray([key]),
+            np.asarray([0.0 if value is None else value], np.float32),
+            np.asarray([timestamp], np.int64),
+        )
+
+    def process_batch(self, keys: np.ndarray, values: np.ndarray,
+                      timestamps: np.ndarray) -> None:
+        if len(timestamps) == 0:
+            return
+        ids, required = self.keydict.lookup_or_insert(np.asarray(keys))
+        self.pipe.ensure_key_capacity(required)
+        vals = np.asarray(values, np.float32) if self._needs_value else None
+        self._steps.extend(
+            self.norm.push(ids.astype(np.int32), vals,
+                           np.asarray(timestamps, np.int64))
+        )
+        self._maybe_dispatch()
+
+    def process_watermark(self, watermark: int) -> None:
+        if watermark <= self.current_watermark:
+            return
+        self.current_watermark = watermark
+        steps = self.norm.advance(watermark)
+        # a single-step advance rides the preceding data step (the pipeline
+        # fires after ingesting step t's batch, so batch-then-advance in one
+        # step is exactly the executor's batch-then-watermark order)
+        if (
+            steps
+            and self._steps
+            and self._steps[-1].n_fires == 0
+            and len(steps[0].ts) == 0
+        ):
+            self._steps[-1].wm = steps[0].wm
+            self._steps[-1].n_fires = steps[0].n_fires
+            steps = steps[1:]
+        self._steps.extend(steps)
+        if watermark >= MAX_WATERMARK - 1:
+            self.flush_all()
+        else:
+            self._maybe_dispatch()
+
+    def advance_processing_time(self, time: int) -> None:
+        pass  # event-time only
+
+    # ------------------------------------------------------------------
+    def _maybe_dispatch(self) -> None:
+        while len(self._steps) >= self.T:
+            self._dispatch(self._take_group())
+
+    def flush_all(self) -> None:
+        """Dispatch every buffered step and resolve all in-flight output.
+        Tail groups pad to the next power of two instead of T, so snapshots
+        mid-superbatch compile at most log2(T) extra executable shapes
+        instead of paying a full T-step dispatch per checkpoint."""
+        while len(self._steps) >= self.T:
+            self._dispatch(self._take_group())
+        while self._steps:
+            self._dispatch(self._take_group(tail=True))
+        self._resolve_inflight()
+
+    def _take_group(self, tail: bool = False) -> List[_Step]:
+        group: List[_Step] = []
+        fires = 0
+        while self._steps and len(group) < self.T:
+            s = self._steps[0]
+            if fires + s.n_fires > self.pipe.R and group:
+                break  # out_rows budget: cut the dispatch early
+            fires += s.n_fires
+            group.append(self._steps.pop(0))
+        target = (1 << max(len(group) - 1, 0).bit_length()) if tail else self.T
+        while len(group) < target:
+            group.append(self.norm.pad_step())  # bounded executable shapes
+        return group
+
+    def _dispatch(self, group: List[_Step]) -> None:
+        batches = [(s.kid, s.vals, s.ts) for s in group]
+        wms = [s.wm for s in group]
+        d = self.pipe.process_superbatch(batches, wms, defer=True)
+        self._resolve_inflight()
+        self._inflight = (d, group[-1].wm)
+
+    def _resolve_inflight(self) -> None:
+        if self._inflight is None:
+            return
+        d, wm = self._inflight
+        self._inflight = None
+        for window, counts, fields in d.resolve():
+            self._emit(window, counts, fields)
+        if wm > self.emitted_watermark:
+            self.emitted_watermark = wm
+
+    def _emit(self, window, counts, fields) -> None:
+        counts = np.asarray(counts)[: len(self.keydict)]
+        live = np.flatnonzero(counts > 0)
+        if live.size == 0:
+            return
+        fdict: Dict[str, Any] = {}
+        for f in self.agg.fields:
+            if f.source == ONE:
+                fdict[f.name] = counts
+            else:
+                fdict[f.name] = np.asarray(fields[f.name])[: len(self.keydict)]
+        result = np.asarray(self.agg.extract(fdict))
+        ts = window.max_timestamp()
+        keys = self.keydict.keys_for(live)
+        for k, i in zip(keys, live):
+            self.output.append((k, window, result[i].item(), ts))
+
+    def drain_output(self) -> List[Tuple[Any, Any, Any, int]]:
+        out = self.output
+        self.output = []
+        return out
+
+    def query_state_for(self, key) -> Dict[int, Dict[str, Any]]:
+        """Point lookup (queryable state): {abs_slice: {field..., count}}
+        for one key, folding device ring cells, buffered steps, and
+        held-back future records into one consistent view."""
+        kid = self.keydict.lookup(key)
+        if kid is None:
+            return {}
+        pipe = self.pipe
+        count = np.asarray(pipe._count)[kid]
+        acc = {k: np.asarray(v)[kid] for k, v in pipe._state.items()}
+        slices: Dict[int, Dict[str, Any]] = {}
+        lo = pipe.purged_to if pipe.purged_to is not None else pipe.min_used_slice
+        hi = pipe.max_seen_slice
+        if lo is not None and hi is not None:
+            for s in range(lo, hi + 1):
+                pos = s % pipe.S
+                if count[pos] > 0:
+                    entry = {name: arr[pos].item() for name, arr in acc.items()}
+                    entry["count"] = int(count[pos])
+                    slices[s] = entry
+        combine = {"add": lambda a, b: a + b, "min": min, "max": max}
+        pending = [(s.kid, s.vals, s.ts) for s in self._steps] + self.norm._future
+        for kid_arr, val_arr, ts_arr in pending:
+            sel = np.flatnonzero(np.asarray(kid_arr) == kid)
+            for i in sel:
+                s = int((int(ts_arr[i]) - pipe.offset) // pipe.g)
+                entry = slices.setdefault(s, {"count": 0})
+                entry["count"] = entry.get("count", 0) + 1
+                for f in self.agg.fields:
+                    if f.source != VALUE:
+                        continue
+                    v = float(val_arr[i]) if val_arr is not None else 1.0
+                    entry[f.name] = combine[f.scatter](entry.get(f.name, f.identity), v)
+        return slices
+
+    # ------------------------------------------------------------------
+    @property
+    def num_late_records_dropped(self) -> int:
+        return self.pipe.num_late_records_dropped
+
+    def snapshot(self) -> dict:
+        # flush buffered steps so keyed state lives in exactly one place
+        # (the device arrays); fires this triggers land in "output" below
+        # and ride the checkpoint, so they are re-emitted after restore
+        # rather than lost (their fire_cursor has already advanced)
+        self.flush_all()
+        return {
+            "pipe": self.pipe.snapshot(),
+            "keydict": self.keydict.snapshot(),
+            "normalizer": self.norm.snapshot(),
+            # self-describing metadata so offline tools (state processor)
+            # can fold not-yet-dispatched steps into (key, slice) cells
+            "fields": [
+                (f.name, f.scatter, f.identity, f.source, np.dtype(f.dtype).str)
+                for f in self.agg.fields
+            ],
+            "geometry": {"g": self.pipe.g, "offset": self.pipe.offset},
+            # resolved-but-undrained emissions: their fires are already
+            # committed in device state, so dropping them at restore would
+            # lose output — they ride the checkpoint instead
+            "output": list(self.output),
+            "emitted_watermark": self.emitted_watermark,
+            "current_watermark": self.current_watermark,
+        }
+
+    def restore(self, snap: dict) -> None:
+        self.pipe.restore(snap["pipe"])
+        self.keydict = KeyDictionary.restore(snap["keydict"])
+        self.norm.restore(snap["normalizer"])
+        self._steps = []
+        self.emitted_watermark = snap["emitted_watermark"]
+        self.current_watermark = snap["current_watermark"]
+        self._inflight = None
+        self.output = list(snap["output"])

@@ -100,9 +100,9 @@ class FusedWindowPipeline:
         if agg is None:
             raise ValueError(f"aggregate {aggregate!r} has no device form")
         for f in agg.fields:
-            if f.scatter != "add":
+            if f.scatter not in ("add", "min", "max"):
                 raise ValueError(
-                    f"fused pipeline supports add-combining fields only; "
+                    f"fused pipeline supports add/min/max-combining fields; "
                     f"{f.name!r} uses {f.scatter!r} (use TpuWindowOperator)"
                 )
         if assigner.slice_ms is None or not assigner.is_event_time:
@@ -131,7 +131,7 @@ class FusedWindowPipeline:
         import jax.numpy as jnp
 
         self._state: Dict[str, Any] = {
-            f.name: jnp.zeros((self.K, self.S), jnp.dtype(f.dtype))
+            f.name: jnp.full((self.K, self.S), f.identity, jnp.dtype(f.dtype))
             for f in agg.fields
             if f.source == VALUE
         }
@@ -146,6 +146,31 @@ class FusedWindowPipeline:
         self.num_late_records_dropped = 0
 
         self._fn_cache: Dict[Tuple[int, int], Any] = {}
+
+    def ensure_key_capacity(self, required: int) -> None:
+        """Grow the key dimension (next pow2) when the dictionary outgrows K;
+        existing rows keep their accumulators, new rows start at identity.
+        The superscan executable is per-K (cache-keyed), so growth costs one
+        recompile — amortized by doubling, like the columnar backend's
+        ensure_key_capacity."""
+        if required <= self.K:
+            return
+        import jax.numpy as jnp
+
+        new_k = 1 << (required - 1).bit_length()
+        pad = new_k - self.K
+        self._state = {
+            f.name: jnp.concatenate(
+                [self._state[f.name],
+                 jnp.full((pad, self.S), f.identity, jnp.dtype(f.dtype))]
+            )
+            for f in self.agg.fields
+            if f.source == VALUE
+        }
+        self._count = jnp.concatenate(
+            [self._count, jnp.zeros((pad, self.S), jnp.int32)]
+        )
+        self.K = new_k
 
     # ------------------------------------------------------------------
     # window geometry (identical formulas to TpuWindowOperator)
@@ -241,7 +266,7 @@ class FusedWindowPipeline:
         import jax.numpy as jnp
 
         T = len(batches)
-        B = max(len(b[2]) for b in batches)
+        B = max(max((len(b[2]) for b in batches), default=0), 1)
         B = -(-B // self.chunk) * self.chunk
 
         idx_h = np.full((T, B), -1, dtype=np.int32)
@@ -366,6 +391,7 @@ class FusedWindowPipeline:
 
         self._state = {k: jnp.asarray(v) for k, v in snap["state"].items()}
         self._count = jnp.asarray(snap["count"])
+        self.K = int(self._count.shape[0])  # capacity may have grown pre-snapshot
         self.watermark = snap["watermark"]
         self.fire_cursor = snap["fire_cursor"]
         self.purged_to = snap["purged_to"]
@@ -397,23 +423,38 @@ def _build_superscan(agg, K, S, NSB, F, R, SPW, chunk, exact, T, B):
 
     from flink_tpu.ops import matmul_hist
 
-    vfields = [(f.name, jnp.dtype(f.dtype)) for f in agg.fields if f.source == VALUE]
+    vfields = [
+        (f.name, jnp.dtype(f.dtype), f.scatter, f.identity)
+        for f in agg.fields
+        if f.source == VALUE
+    ]
     nseg = K * NSB
 
     def step(carry, args):
         state, count, outs, count_out = carry
         idx, vals, smin_pos, fire_pos, fire_valid, fire_row, purge_mask = args
 
-        # ingest: MXU histograms over (key, rel-slice) segments
+        # ingest: MXU histograms over (key, rel-slice) segments for
+        # add-combining fields; min/max fields scatter-combine (no matmul
+        # form exists for order statistics — the scatter unit is the cost
+        # of supporting them on the fused path at all)
         pc = matmul_hist.count_hist(idx, nseg, chunk=chunk).reshape(K, NSB)
         cols = (smin_pos + jnp.arange(NSB, dtype=jnp.int32)) % S
         count = count.at[:, cols].add(pc)
         new_state = {}
-        for name, dt in vfields:
-            ph = matmul_hist.weighted_hist(
-                idx, vals, nseg, chunk=chunk, exact=exact
-            ).reshape(K, NSB)
-            new_state[name] = state[name].at[:, cols].add(ph.astype(dt))
+        for name, dt, scatter, ident in vfields:
+            if scatter == "add":
+                ph = matmul_hist.weighted_hist(
+                    idx, vals, nseg, chunk=chunk, exact=exact
+                ).reshape(K, NSB)
+                new_state[name] = state[name].at[:, cols].add(ph.astype(dt))
+            else:
+                kid = idx // NSB
+                srel = idx % NSB
+                col = (smin_pos + srel) % S
+                safe_kid = jnp.where(idx >= 0, kid, K)  # OOB rows drop
+                upd = getattr(state[name].at[safe_kid, col], scatter)
+                new_state[name] = upd(vals.astype(dt), mode="drop")
         state = new_state if vfields else state
 
         # fire: combine the window's slice columns, write compact rows
@@ -428,9 +469,12 @@ def _build_superscan(agg, K, S, NSB, F, R, SPW, chunk, exact, T, B):
                 lambda b: b,
                 count_out,
             )
+            _COMBINE = {"add": lambda a: a.sum(axis=1),
+                        "min": lambda a: a.min(axis=1),
+                        "max": lambda a: a.max(axis=1)}
             new_outs = {}
-            for name, _ in vfields:
-                vrow = state[name][:, pos].sum(axis=1)
+            for name, _dt, scatter, _ident in vfields:
+                vrow = _COMBINE[scatter](state[name][:, pos])
                 new_outs[name] = jax.lax.cond(
                     fire_valid[f] > 0,
                     lambda b, vr=vrow, r=row: jax.lax.dynamic_update_index_in_dim(b, vr, r, 0),
@@ -444,12 +488,16 @@ def _build_superscan(agg, K, S, NSB, F, R, SPW, chunk, exact, T, B):
             bufs = write_fire(f, bufs)
         outs, count_out = bufs
 
-        # purge expired ring columns
+        # purge expired ring columns (reset to the field's identity)
         count = count * purge_mask[None, :]
         if vfields:
             state = {
-                name: state[name] * purge_mask[None, :].astype(dt)
-                for name, dt in vfields
+                name: jnp.where(
+                    purge_mask[None, :] > 0,
+                    state[name],
+                    jnp.asarray(ident, dt),
+                )
+                for name, dt, _scatter, ident in vfields
             }
         return (state, count, outs, count_out), None
 

@@ -118,15 +118,15 @@ class JobClient:
             if getattr(r, "uid", None) != uid:
                 continue
             op = getattr(r, "op", None)
+            if op is not None and hasattr(op, "query_state_for"):
+                # fused window operator folds ring + buffered views itself
+                return {
+                    "slices": op.query_state_for(key),
+                    "watermark": op.current_watermark,
+                }
             if op is not None and hasattr(op, "state") and hasattr(op.state, "keydict"):
                 state = op.state
-                kd = state.keydict
-                if kd.dense_int:
-                    kid = int(key) if int(key) < len(kd) else None
-                else:
-                    kid = kd._map.get(key)
-                    if kid is None and key in kd._keys:
-                        kid = kd._keys.index(key)
+                kid = state.keydict.lookup(key)
                 if kid is None:
                     return {"slices": {}, "watermark": op.current_watermark}
                 count = np.asarray(state.count)[kid]

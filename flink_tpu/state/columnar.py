@@ -129,6 +129,20 @@ class KeyDictionary:
     def key_at(self, kid: int):
         return self._keys[kid]
 
+    def lookup(self, key) -> Optional[int]:
+        """Dense id for a key, or None if never seen (read-only)."""
+        if self.dense_int:
+            k = int(key)
+            return k if 0 <= k < len(self._keys) else None
+        kid = self._map.get(key)
+        if kid is None and self._keys and not self._map:
+            # native-dict mode keeps _map empty; fall back to a scan
+            try:
+                kid = self._keys.index(key)
+            except ValueError:
+                kid = None
+        return kid
+
     def keys_for(self, kids: np.ndarray) -> List:
         ks = self._keys
         return [ks[int(i)] for i in kids]

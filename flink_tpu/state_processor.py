@@ -57,6 +57,8 @@ class SavepointReader:
         op = snap.get("operator", snap)
         if "columnar" in op or "sharded" in op:
             yield from self._columnar_entries(op.get("columnar") or op.get("sharded"))
+        elif "pipe" in op:
+            yield from self._fused_entries(op)
         elif "state" in op:
             for state_name, kg_tables in op["state"].items():
                 for _kg, entries in kg_tables.items():
@@ -91,6 +93,85 @@ class SavepointReader:
                     }
                     fields["count"] = c
                     yield (key, s, fields)
+
+    def pending_output(self, uid: str) -> List[Tuple]:
+        """Emissions resolved but not yet drained downstream at snapshot
+        time (fused operators fire due windows when the checkpoint flushes
+        their buffered steps; those rows ride the checkpoint and re-emit on
+        restore). Rows are (key, window, value, timestamp)."""
+        snap = self._runner(uid)
+        op = snap.get("operator", snap)
+        return list(op.get("output", []))
+
+    def _fused_entries(self, op: dict) -> Iterator[Tuple]:
+        """Fused window operator snapshots (runtime/fused_window_operator.py):
+        same (key, slice, fields) rows as columnar snapshots, reconstructed
+        from the superscan's ring state + the normalizer's frontiers."""
+        pipe = op["pipe"]
+        count = np.asarray(pipe["count"])
+        acc = {k: np.asarray(v).copy() for k, v in pipe["state"].items()}
+        count = count.copy()
+        S = count.shape[1]
+        keys = op["keydict"]["keys"]
+        g = op["geometry"]["g"]
+        offset = op["geometry"]["offset"]
+        specs = op["fields"]  # (name, scatter, identity, source, dtype)
+        combine = {"add": lambda a, b: a + b, "min": min, "max": max}
+
+        # fold held-back future records (beyond the device ring span) into
+        # host-side cells — they are part of the keyed state. Kept separate
+        # from the ring loop: their slices can exceed lo+S, and folding them
+        # through `pos = s % S` would alias live device cells.
+        extra: Dict[Tuple[int, int], Dict[str, Any]] = {}
+        buffered = [
+            (np.asarray(k), None if v is None else np.asarray(v), np.asarray(t))
+            for k, v, t in op.get("normalizer", {}).get("future", [])
+        ]
+        for kid_arr, val_arr, ts_arr in buffered:
+            for i in range(len(ts_arr)):
+                s = (int(ts_arr[i]) - offset) // g
+                cell = extra.setdefault((int(kid_arr[i]), s), {"count": 0})
+                cell["count"] += 1
+                for name, scatter, ident, source, _dt in specs:
+                    if source != "value":
+                        continue
+                    v = float(val_arr[i]) if val_arr is not None else 1.0
+                    cell[name] = combine[scatter](cell.get(name, ident), v)
+
+        # device ring cells: bounded by the ring frontiers (span < S)
+        lo = pipe.get("purged_to")
+        hi = pipe.get("max_seen_slice")
+        if lo is None:
+            lo = pipe.get("min_used_slice")
+        if lo is not None and hi is not None:
+            for kid, key in enumerate(keys):
+                for s in range(lo, hi + 1):
+                    pos = s % S
+                    c = int(count[kid, pos])
+                    cell = extra.pop((kid, s), None)
+                    if cell is not None:
+                        c += cell["count"]
+                    if c == 0:
+                        continue
+                    fields = {name: arr[kid, pos].item() for name, arr in acc.items()}
+                    if cell is not None:
+                        for name, scatter, ident, _src, _dt in specs:
+                            if name in fields:
+                                fields[name] = combine[scatter](
+                                    fields[name], cell.get(name, ident)
+                                )
+                    fields["count"] = c
+                    yield (key, s, fields)
+
+        # remaining host-only cells (slices outside the device span)
+        for (kid, s), cell in sorted(extra.items(), key=lambda kv: (kv[0][1], kv[0][0])):
+            fields = {
+                name: cell.get(name, ident)
+                for name, _scatter, ident, source, _dt in specs
+                if source == "value"
+            }
+            fields["count"] = cell["count"]
+            yield (keys[kid], s, fields)
 
 
 class SavepointWriter:
@@ -150,6 +231,38 @@ class SavepointWriter:
         snap = self.data["runners"][uid]
         op = snap.get("operator", snap)
         col = op.get("columnar") or op.get("sharded")
+        if col is None and "pipe" in op:
+            # fused-operator snapshot: fields live as pipe["state"] arrays.
+            # Held-back future records (raw values, not yet cells) get the
+            # transform applied to their value column when the aggregate has
+            # exactly one value-sourced field — elementwise fns distribute
+            # over add/min/max combining, which is the API's contract.
+            pipe = op["pipe"]
+            for name, arr in pipe["state"].items():
+                out = np.asarray(fn(name, np.asarray(arr)))
+                if out.shape != np.asarray(arr).shape:
+                    raise ValueError("columnar transform must preserve shape")
+                pipe["state"][name] = out
+            cnt = np.asarray(pipe["count"])
+            new_cnt = np.asarray(fn("count", cnt))
+            if new_cnt.shape != cnt.shape:
+                raise ValueError("columnar transform must preserve shape")
+            pipe["count"] = new_cnt
+            vfields = [s for s in op.get("fields", []) if s[3] == "value"]
+            fut = op.get("normalizer", {}).get("future", [])
+            if fut and len(vfields) > 1:
+                raise ValueError(
+                    "savepoint holds raw future records for a multi-value-field "
+                    "aggregate; per-field transforms cannot be applied to shared "
+                    "raw values — flush the job past them or drop them explicitly"
+                )
+            if fut and len(vfields) == 1:
+                fname = vfields[0][0]
+                op["normalizer"]["future"] = [
+                    (k, None if v is None else np.asarray(fn(fname, np.asarray(v))).tolist(), t)
+                    for k, v, t in fut
+                ]
+            return self
         for name, arr in col["acc"].items():
             out = np.asarray(fn(name, np.asarray(arr)))
             if out.shape != np.asarray(arr).shape:

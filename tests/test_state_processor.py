@@ -82,6 +82,9 @@ def test_savepoint_transform_and_restore(tmp_path):
     reader = SavepointReader.load(sp)
     uid = next(u for u in reader.operator_uids() if u.startswith("window_aggregate"))
     in_flight_sum = sum(e[2]["sum"] for e in reader.keyed_state(uid))
+    # windows already fired-but-undrained at snapshot time ride the
+    # checkpoint verbatim (the transform patches state, not emissions)
+    pending_sum = sum(v for _k, _w, v, _t in reader.pending_output(uid))
     records_at_sp = reader.records_in()
 
     writer = SavepointWriter.from_reader(reader)
@@ -100,7 +103,7 @@ def test_savepoint_transform_and_restore(tmp_path):
     assert client.wait(60) == JobStatus.FINISHED
     # resumed-job output total = post-savepoint records (1.0 each) + the
     # in-flight accumulators, which were patched x10 offline
-    expected = (4000 - records_at_sp) + 10 * in_flight_sum
+    expected = (4000 - records_at_sp) + 10 * in_flight_sum + pending_sum
     assert sum(v for _, v in sink.results) == pytest.approx(expected)
 
 
