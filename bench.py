@@ -259,8 +259,70 @@ def child_main(device_label: str, steps: int, batch: int, superbatch: int) -> No
     n_done += pn
     elapsed = time.perf_counter() - t_run0
 
-    _emit({"event": "result",
-           "result": partial_result(n_done, elapsed, fired, flush_ms, True)})
+    res = partial_result(n_done, elapsed, fired, flush_ms, True)
+    if os.environ.get("BENCH_API", "1") == "1":
+        try:
+            api_tps = run_api_path(batch, steps, superbatch)
+            res["api_path_tuples_per_sec"] = round(api_tps, 1)
+            res["api_vs_fused"] = round(api_tps / max(res["value"], 1e-9), 3)
+        except Exception as e:  # the headline number must survive an API-path bug
+            res["api_path_error"] = repr(e)[:200]
+    _emit({"event": "result", "result": res})
+
+
+def run_api_path(batch: int, steps: int, superbatch: int) -> float:
+    """The same YSB workload driven through the public DataStream API —
+    vectorized filter + projection chain, vectorized keyBy, fused window
+    operator, columnar emission. This measures the FRAMEWORK (source loop,
+    chain kernels, key dictionary, operator selection, emission), not just
+    the superscan kernel; the api_vs_fused ratio in the result JSON is the
+    framework overhead the round-1 verdict asked to close."""
+    from flink_tpu.api.datastream import StreamExecutionEnvironment
+    from flink_tpu.api.windowing.assigners import SlidingEventTimeWindows
+    from flink_tpu.config import Configuration, ExecutionOptions
+    from flink_tpu.connectors.source import Batch, DataGeneratorSource
+    from flink_tpu.core.watermarks import WatermarkStrategy
+
+    rng = np.random.default_rng(11)
+    n_total = steps * batch
+    ms_per_batch = (1 << 18) / EVENTS_PER_SEC_SIM * 1000.0
+
+    def gen(idx: np.ndarray) -> Batch:
+        # YSB shape: (campaign key, event type); ~1/3 of events survive the
+        # view filter. Columns are derived deterministically from idx.
+        lo = int(idx[0])
+        r = np.random.default_rng(lo)
+        keys = r.integers(0, NUM_KEYS, size=len(idx), dtype=np.int64)
+        etype = r.integers(0, 3, size=len(idx), dtype=np.int64)
+        base = lo / batch * ms_per_batch + np.sort(r.random(len(idx))) * (
+            ms_per_batch * len(idx) / batch
+        )
+        ts = np.maximum(base.astype(np.int64) - r.integers(0, OOO_MS, len(idx)), 0)
+        return Batch(np.stack([keys, etype], axis=1), ts)
+
+    conf = Configuration()
+    conf.set(ExecutionOptions.BATCH_SIZE, batch)
+    conf.set(ExecutionOptions.KEY_CAPACITY, NUM_KEYS)
+    conf.set(ExecutionOptions.SUPERBATCH_STEPS, superbatch)
+    conf.set(ExecutionOptions.COLUMNAR_OUTPUT, True)
+    env = StreamExecutionEnvironment.get_execution_environment(conf)
+    sink = (
+        env.from_source(
+            DataGeneratorSource(gen, count=n_total, num_splits=1),
+            watermark_strategy=WatermarkStrategy.for_bounded_out_of_orderness(WM_DELAY_MS),
+        )
+        .filter(lambda col: col[:, 1] == 0, vectorized=True)
+        .key_by(lambda col: col[:, 0], vectorized=True)
+        .window(SlidingEventTimeWindows.of(WINDOW_MS, SLIDE_MS))
+        .count()
+        .collect()
+    )
+    t0 = time.perf_counter()
+    result = env.execute("ysb-api")
+    elapsed = time.perf_counter() - t0
+    _emit({"event": "api_done", "windows_emitted": len(sink.results),
+           "records": result.records_in, "elapsed_s": round(elapsed, 2)})
+    return result.records_in / elapsed
 
 
 # ---------------------------------------------------------------------------

@@ -109,9 +109,15 @@ class DataStream:
         return DataStream(self.env, Transformation(kind, name, [self.transform], config))
 
     # -- record-local ops --------------------------------------------------
-    def map(self, fn: Callable, name: str = "map") -> "DataStream":
+    def map(self, fn: Callable, name: str = "map", vectorized: bool = False) -> "DataStream":
+        """Per-record transform. With vectorized=True, fn receives the whole
+        value column (numpy array) and must return an equal-length column —
+        the chain then executes as array ops instead of a Python loop (the
+        TPU-native form of operator chaining: the reference fuses chained
+        operators into direct calls, StreamingJobGraphGenerator.java:1730;
+        here a chain fuses into columnar kernels)."""
         fn = fn.map if hasattr(fn, "map") else fn
-        return self._derive("map", name, {"fn": fn})
+        return self._derive("map", name, {"fn": fn, "vectorized": vectorized})
 
     def map_batch(self, fn: Callable, name: str = "map_batch") -> "DataStream":
         """1:1 transform over the whole step batch at once (list -> list of
@@ -119,17 +125,25 @@ class DataStream:
         t = Transformation("map_batch", name, [self.transform], {"fn": fn})
         return DataStream(self.env, t)
 
-    def map_with_timestamp(self, fn: Callable, name: str = "map_ts") -> "DataStream":
-        """map over (value, event_timestamp_ms) pairs."""
-        return self._derive("map_ts", name, {"fn": fn})
+    def map_with_timestamp(self, fn: Callable, name: str = "map_ts",
+                           vectorized: bool = False) -> "DataStream":
+        """map over (value, event_timestamp_ms) pairs. Vectorized form:
+        fn(values_column, timestamps_column) -> values_column."""
+        return self._derive("map_ts", name, {"fn": fn, "vectorized": vectorized})
 
-    def flat_map(self, fn: Callable, name: str = "flat_map") -> "DataStream":
+    def flat_map(self, fn: Callable, name: str = "flat_map",
+                 vectorized: bool = False) -> "DataStream":
+        """1:N transform. Vectorized form: fn(values_column) returns
+        (out_values, source_index) where source_index[i] is the input row
+        out_values[i] came from (used to propagate timestamps)."""
         fn = fn.flat_map if hasattr(fn, "flat_map") else fn
-        return self._derive("flat_map", name, {"fn": fn})
+        return self._derive("flat_map", name, {"fn": fn, "vectorized": vectorized})
 
-    def filter(self, fn: Callable, name: str = "filter") -> "DataStream":
+    def filter(self, fn: Callable, name: str = "filter", vectorized: bool = False) -> "DataStream":
+        """Predicate filter. Vectorized form: fn(values_column) returns a
+        boolean mask over the column."""
         fn = fn.filter if hasattr(fn, "filter") else fn
-        return self._derive("filter", name, {"fn": fn})
+        return self._derive("filter", name, {"fn": fn, "vectorized": vectorized})
 
     def async_map(
         self,
@@ -158,9 +172,15 @@ class DataStream:
         )
 
     # -- partitioning ------------------------------------------------------
-    def key_by(self, key_selector: Callable, name: str = "key_by") -> "KeyedStream":
-        sel = as_key_selector(key_selector)
-        t = Transformation("key_by", name, [self.transform], {"key_selector": sel})
+    def key_by(self, key_selector: Callable, name: str = "key_by",
+               vectorized: bool = False) -> "KeyedStream":
+        """Partition by key. Vectorized form: key_selector(values_column)
+        returns the whole key column — keeps the hot ingest path columnar."""
+        sel = as_key_selector(key_selector) if not vectorized else key_selector
+        t = Transformation(
+            "key_by", name, [self.transform],
+            {"key_selector": sel, "vectorized": vectorized},
+        )
         return KeyedStream(self.env, t)
 
     # -- sinks -------------------------------------------------------------
@@ -202,10 +222,21 @@ class KeyedStream(DataStream):
     def window(self, assigner: WindowAssigner) -> "WindowedStream":
         return WindowedStream(self, assigner)
 
+    def _scalar_key_selector(self) -> Callable:
+        """Per-record view of the key selector (vectorized selectors are
+        adapted for the per-record oracle/CPU operators)."""
+        sel = self.key_selector
+        if self.transform.config.get("vectorized"):
+            import numpy as np
+
+            return lambda v: sel(np.asarray(v)[None, ...])[0]
+        return sel
+
     # rolling (non-windowed) keyed reduce — oracle/CPU path
     def reduce(self, fn: Callable, name: str = "keyed_reduce") -> "DataStream":
         t = Transformation(
-            "reduce", name, [self.transform], {"reduce_fn": fn, "key_selector": self.key_selector}
+            "reduce", name, [self.transform],
+            {"reduce_fn": fn, "key_selector": self._scalar_key_selector()},
         )
         return DataStream(self.env, t)
 
@@ -215,7 +246,7 @@ class KeyedStream(DataStream):
             "process_keyed",
             name,
             [self.transform],
-            {"process_fn": process_fn, "key_selector": self.key_selector},
+            {"process_fn": process_fn, "key_selector": self._scalar_key_selector()},
         )
         return DataStream(self.env, t)
 
@@ -249,7 +280,8 @@ class WindowedStream:
         self._side_output_late = True
         return self
 
-    def _agg_transform(self, aggregate, value_fn, window_fn, name) -> DataStream:
+    def _agg_transform(self, aggregate, value_fn, window_fn, name,
+                       value_vectorized: bool = False) -> DataStream:
         t = Transformation(
             "window_aggregate",
             name,
@@ -258,12 +290,14 @@ class WindowedStream:
                 "assigner": self._assigner,
                 "aggregate": aggregate,
                 "value_fn": value_fn,
+                "value_vectorized": value_vectorized,
                 "window_fn": window_fn,
                 "trigger": self._trigger,
                 "evictor": self._evictor,
                 "allowed_lateness": self._allowed_lateness,
                 "side_output_late": self._side_output_late,
                 "key_selector": self._keyed.key_selector,
+                "key_vectorized": self._keyed.transform.config.get("vectorized", False),
             },
         )
         return DataStream(self._keyed.env, t)
@@ -274,11 +308,14 @@ class WindowedStream:
         value_fn: Optional[Callable] = None,
         window_fn=None,
         name: str = "window_aggregate",
+        value_vectorized: bool = False,
     ) -> DataStream:
         """`aggregate` is a builtin name ('sum'/'count'/'min'/'max'/'mean'),
         a DeviceAggregator (device path), or an AggregateFunction (oracle).
-        `value_fn` extracts the numeric column for device aggregation."""
-        return self._agg_transform(aggregate, value_fn, window_fn, name)
+        `value_fn` extracts the numeric column for device aggregation; with
+        value_vectorized=True it maps the whole values column at once."""
+        return self._agg_transform(aggregate, value_fn, window_fn, name,
+                                   value_vectorized=value_vectorized)
 
     def reduce(self, fn: Callable, name: str = "window_reduce") -> DataStream:
         from flink_tpu.api.functions import ReduceAggregate

@@ -233,6 +233,13 @@ class ExecutionOptions:
         "Steps buffered per fused-window dispatch; higher amortizes host-device "
         "round trips, lower reduces emission latency."
     )
+    COLUMNAR_OUTPUT = (
+        ConfigOptions.key("execution.window.columnar-output").bool_type().default_value(False)
+    ).with_description(
+        "Emit window fires as packed (window, key-ids, values) rows instead of "
+        "one (key, value) row per key — emission cost becomes independent of "
+        "key cardinality (high-cardinality analytics sinks)."
+    )
 
 
 class CheckpointingOptions:

@@ -78,41 +78,76 @@ class StepRunner:
 
 class ChainRunner(StepRunner):
     """Fused stateless chain: map/filter/flat_map applied per batch
-    (OperatorChain ChainingOutput analogue; XLA-jittable chains are a later
-    optimization — semantic contract first)."""
+    (OperatorChain ChainingOutput analogue, StreamingJobGraphGenerator.java:1730).
+
+    Vectorized transforms (declared with vectorized=True at the API, plus
+    map_batch) execute as whole-column array ops — the chain stays columnar
+    end to end and a filter+projection before a window costs two numpy
+    kernels per step instead of a Python loop per record. Scalar transforms
+    fall back to per-record application; mixed chains switch representation
+    at segment boundaries."""
 
     def __init__(self, transforms: List[Transformation]):
         self.transforms = transforms
 
+    @staticmethod
+    def _to_column(vals) -> np.ndarray:
+        if isinstance(vals, np.ndarray):
+            return vals
+        arr = np.asarray(vals)
+        if arr.dtype.kind in "OUSifub" and arr.ndim == 1:
+            return arr
+        return obj_array(list(vals))
+
     def on_batch(self, values: np.ndarray, timestamps: np.ndarray) -> None:
-        vals: List = list(values)
-        ts: List[int] = list(timestamps)
+        vals = values
+        ts = np.asarray(timestamps, dtype=np.int64)
         for t in self.transforms:
+            if len(ts) == 0:
+                return
             fn = t.config["fn"]
+            vec = t.config.get("vectorized", False)
             if t.kind == "map":
-                vals = [fn(v) for v in vals]
+                if vec:
+                    vals = self._to_column(fn(vals))
+                else:
+                    vals = obj_array([fn(v) for v in vals])
             elif t.kind == "map_ts":
-                vals = [fn(v, int(x)) for v, x in zip(vals, ts)]
+                if vec:
+                    vals = self._to_column(fn(vals, ts))
+                else:
+                    vals = obj_array([fn(v, int(x)) for v, x in zip(vals, ts)])
             elif t.kind == "filter":
-                keep = [bool(fn(v)) for v in vals]
-                vals = [v for v, k in zip(vals, keep) if k]
-                ts = [x for x, k in zip(ts, keep) if k]
+                if vec:
+                    mask = np.asarray(fn(vals), dtype=bool)
+                else:
+                    mask = np.fromiter(
+                        (bool(fn(v)) for v in vals), dtype=bool, count=len(vals)
+                    )
+                vals = vals[mask]
+                ts = ts[mask]
             elif t.kind == "map_batch":
                 # whole-batch transform (amortized device dispatch: model
                 # inference, vectorized UDFs)
-                vals = list(fn(vals))
+                vals = self._to_column(fn(list(vals) if not vec else vals))
                 assert len(vals) == len(ts), "map_batch must be 1:1"
             elif t.kind == "flat_map":
-                new_vals, new_ts = [], []
-                for v, x in zip(vals, ts):
-                    for out in fn(v):
-                        new_vals.append(out)
-                        new_ts.append(x)
-                vals, ts = new_vals, new_ts
+                if vec:
+                    out, src_idx = fn(vals)
+                    vals = self._to_column(out)
+                    ts = ts[np.asarray(src_idx, dtype=np.int64)]
+                else:
+                    new_vals, new_ts = [], []
+                    for v, x in zip(vals, ts):
+                        for out in fn(v):
+                            new_vals.append(out)
+                            new_ts.append(int(x))
+                    vals = obj_array(new_vals)
+                    ts = np.asarray(new_ts, dtype=np.int64)
             else:
                 raise NotImplementedError(t.kind)
-        if vals and self.downstream:
-            self.downstream.on_batch(obj_array(vals), np.asarray(ts, dtype=np.int64))
+        if len(ts) and self.downstream:
+            self.downstream.on_batch(vals, ts)
 
 
 class WindowStepRunner(StepRunner):
@@ -124,7 +159,9 @@ class WindowStepRunner(StepRunner):
         assigner = cfg["assigner"]
         aggregate = cfg["aggregate"]
         self.key_selector = cfg["key_selector"]
+        self.key_vectorized = cfg.get("key_vectorized", False)
         self.value_fn = cfg.get("value_fn") or (lambda v: v)
+        self.value_vectorized = cfg.get("value_vectorized", False) and cfg.get("value_fn")
         self.window_fn = cfg.get("window_fn")
         device_agg = resolve(aggregate)
         use_device = (
@@ -186,6 +223,7 @@ class WindowStepRunner(StepRunner):
                 key_capacity=min(1 << 10, config.get(ExecutionOptions.KEY_CAPACITY)),
                 superbatch_steps=config.get(ExecutionOptions.SUPERBATCH_STEPS),
                 chunk=min(4096, max(256, 1 << (max(batch_size, 1) - 1).bit_length())),
+                columnar_output=config.get(ExecutionOptions.COLUMNAR_OUTPUT),
             )
             self.device = True
         elif use_device:
@@ -217,13 +255,23 @@ class WindowStepRunner(StepRunner):
 
     def on_batch(self, values: np.ndarray, timestamps: np.ndarray) -> None:
         if self.device:
-            raw_keys = [self.key_selector(v) for v in values]
+            if self.key_vectorized:
+                keys = np.asarray(self.key_selector(values))
+            else:
+                raw_keys = [self.key_selector(v) for v in values]
+                keys = np.asarray(raw_keys)
+                if keys.ndim != 1 or keys.dtype.kind not in "iuUS":
+                    keys = obj_array(raw_keys)
             # typed key columns (int/str) unlock the native C++ dictionary
-            keys = np.asarray(raw_keys)
-            if keys.ndim != 1 or keys.dtype.kind not in "iuUS":
-                keys = obj_array(raw_keys)
+            if keys.ndim != 1 or keys.dtype.kind not in "iuUSO":
+                keys = obj_array(list(keys))
             if self._needs_value:
-                nums = np.asarray([self.value_fn(v) for v in values], dtype=np.float32)
+                if self.value_vectorized:
+                    nums = np.asarray(self.value_fn(values), dtype=np.float32)
+                else:
+                    nums = np.asarray(
+                        [self.value_fn(v) for v in values], dtype=np.float32
+                    )
             else:  # pure-count aggregates ignore the value column
                 nums = np.zeros(len(values), dtype=np.float32)
             self.op.process_batch(keys, nums, timestamps)
@@ -232,10 +280,19 @@ class WindowStepRunner(StepRunner):
                 # PT windows: assignment & timers use wall clock, not event ts
                 now = int(time.time() * 1000)
                 timestamps = np.full(len(values), now, dtype=np.int64)
+            # vectorized selectors see a one-row column per record here
+            key_of = (
+                (lambda v: self.key_selector(np.asarray(v)[None, ...])[0])
+                if self.key_vectorized
+                else self.key_selector
+            )
+            val_of = (
+                (lambda v: self.value_fn(np.asarray(v)[None, ...])[0])
+                if self.value_vectorized
+                else self.value_fn
+            )
             for v, ts in zip(values, timestamps):
-                self.op.process_record(
-                    self.key_selector(v), self.value_fn(v), int(ts)
-                )
+                self.op.process_record(key_of(v), val_of(v), int(ts))
             if self.processing_time:
                 self.op.advance_processing_time(int(time.time() * 1000))
                 self._drain()
@@ -260,7 +317,10 @@ class WindowStepRunner(StepRunner):
         out = self.op.drain_output()
         if out and self.downstream:
             vals = obj_array(
-                [r if self.window_fn is not None else (k, r) for (k, _w, r, _t) in out]
+                [
+                    r if (self.window_fn is not None or k is None) else (k, r)
+                    for (k, _w, r, _t) in out
+                ]
             )
             ts = np.asarray([t for (_k, _w, _r, t) in out], dtype=np.int64)
             self.downstream.on_batch(vals, ts)

@@ -266,6 +266,7 @@ class FusedWindowOperator:
         fires_per_step: int = 4,
         out_rows: int = 256,
         chunk: int = 4096,
+        columnar_output: bool = False,
     ):
         self.agg = resolve(aggregate)
         if self.agg is None:
@@ -283,6 +284,7 @@ class FusedWindowOperator:
         self.output: List[Tuple[Any, Any, Any, int]] = []
         self.emitted_watermark = MIN_WATERMARK
         self.current_watermark = MIN_WATERMARK
+        self.columnar_output = columnar_output
         self._needs_value = any(f.source == VALUE for f in self.agg.fields)
 
     # ------------------------------------------------------------------
@@ -392,6 +394,12 @@ class FusedWindowOperator:
                 fdict[f.name] = np.asarray(fields[f.name])[: len(self.keydict)]
         result = np.asarray(self.agg.extract(fdict))
         ts = window.max_timestamp()
+        if self.columnar_output:
+            # one packed row per fire: (window, dense key ids, values) —
+            # emission cost stays O(1) rows regardless of key cardinality
+            # (map ids back through .keydict when raw keys are needed)
+            self.output.append((None, window, (window, live, result[live]), ts))
+            return
         keys = self.keydict.keys_for(live)
         for k, i in zip(keys, live):
             self.output.append((k, window, result[i].item(), ts))
