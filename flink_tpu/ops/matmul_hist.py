@@ -14,9 +14,10 @@ with the segment id factored two-level, ``idx = hi * LANES + lo``:
 
 One [B, HI] x [B, LO] contraction over the batch axis replaces B random
 scatters; HI*LO = num_segments. Counts run as int8 one-hots accumulating into
-int32 (exact); weighted sums run as bf16 with an optional two-term
-split-float pass that keeps f32-exact results (each bf16 product is exact
-because the one-hot factor is 0/1).
+int32 (exact); weighted sums run as bf16 with an optional THREE-term
+split-float pass (8+8+8 mantissa bits cover f32's 24) so each record's value
+enters the f32 accumulator without quantization — see weighted_hist for the
+precise exactness contract.
 
 Out-of-range segment ids (idx < 0 or >= num_segments) contribute nothing:
 their `hi` row matches no column of the iota, so they vanish from the
@@ -85,10 +86,20 @@ def weighted_hist(
 ) -> jnp.ndarray:
     """f32[num_segments] per-segment sums of vals; out-of-range ids dropped.
 
-    exact=True splits each f32 value into two bf16 terms (v == hi + lo
-    exactly), doubling the matmul work but keeping f32-exact partial
-    products — parity with the reference's per-record double accumulation
-    for inputs representable as float32.
+    Exactness contract (honest version):
+    - exact=True splits each f32 value into THREE bf16 terms, v == t0+t1+t2
+      bit-exactly for every finite f32 whose twice-reduced residual does not
+      underflow bf16's subnormal floor (all values with |v| >= ~2**-110,
+      and 0). Each bf16 x {0,1} one-hot product is exact, so every record's
+      value enters the f32 accumulator unquantized; the per-segment SUM is
+      then an f32 accumulation, equal to a per-record f32 sum up to
+      addition order. It is NOT f64 accumulation (the reference's
+      per-record path sums in double): results are bit-equal to the oracle
+      for integer-valued / short-mantissa payloads and f32-rounded
+      otherwise — the parity tests compare under f32 tolerance.
+    - exact=False uses a single bf16 term: ~8 mantissa bits per value,
+      3x less matmul work; for count-like payloads (small integers) it is
+      still exact.
     """
     hi_n, _ = plan_segments(num_segments)
 
@@ -96,10 +107,13 @@ def weighted_hist(
         ii, vv = args
         oh_hi, oh_lo = _one_hots(ii, hi_n, jnp.bfloat16)
         if exact:
-            v_hi = vv.astype(jnp.bfloat16)
-            v_lo = (vv - v_hi.astype(jnp.float32)).astype(jnp.bfloat16)
-            acc = acc + _dot(oh_hi * v_hi[:, None], oh_lo, jnp.float32)
-            acc = acc + _dot(oh_hi * v_lo[:, None], oh_lo, jnp.float32)
+            t0 = vv.astype(jnp.bfloat16)
+            r1 = vv - t0.astype(jnp.float32)
+            t1 = r1.astype(jnp.bfloat16)
+            r2 = r1 - t1.astype(jnp.float32)
+            t2 = r2.astype(jnp.bfloat16)
+            for t in (t0, t1, t2):
+                acc = acc + _dot(oh_hi * t[:, None], oh_lo, jnp.float32)
         else:
             acc = acc + _dot(oh_hi * vv[:, None].astype(jnp.bfloat16), oh_lo, jnp.float32)
         return acc, None

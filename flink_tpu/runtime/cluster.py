@@ -269,9 +269,20 @@ class JobManagerEndpoint(RpcEndpoint):
                 self._try_schedule(job)
 
     def _free_slots(self) -> List[str]:
+        """Slots not currently occupied by a deployed job. Counting total
+        capacity here would let two jobs (or a job racing its own restart)
+        oversubscribe a TM; the reference's slot pool likewise tracks
+        allocation state per slot (DeclarativeSlotPoolBridge)."""
+        used: Dict[str, int] = {}
+        for job in self._jobs.values():
+            if job.status == "RUNNING":
+                for tm_id in job.assignment.values():
+                    used[tm_id] = used.get(tm_id, 0) + 1
         slots = []
         for tm_id, tm in self._tms.items():
-            slots.extend([tm_id] * tm["slots"])
+            free = tm["slots"] - used.get(tm_id, 0)
+            if free > 0:
+                slots.extend([tm_id] * free)
         return slots
 
     def _try_schedule(self, job: _JobState) -> None:
@@ -462,6 +473,7 @@ class _ShardTask:
         self.restore = restore
         self.restore_step = restore_step
         self.cancelled = threading.Event()
+        self.done = threading.Event()
         self.current_step = restore_step
         self._cp_requests: List[Tuple[int, int]] = []   # (cp_id, target_step)
         self._cp_lock = threading.Lock()
@@ -475,7 +487,19 @@ class _ShardTask:
 
     def request_checkpoint(self, cp_id: int, target_step: int) -> None:
         with self._cp_lock:
-            self._cp_requests.append((cp_id, target_step))
+            if not self.done.is_set():
+                self._cp_requests.append((cp_id, target_step))
+                return
+        # the task loop has exited: a queued request would never be
+        # processed, leaving the JM's pending entry dangling forever —
+        # decline on the task's behalf instead
+        try:
+            self.jm.decline_checkpoint(
+                self.job_id, self.attempt, self.shard, cp_id,
+                "task already finished",
+            )
+        except Exception:
+            pass
 
     def _channel_id(self, src: int) -> str:
         return f"{self.job_id}/a{self.attempt}/{src}->{self.shard}"
@@ -487,6 +511,21 @@ class _ShardTask:
             if not self.cancelled.is_set():
                 try:
                     self.jm.task_failed(self.job_id, self.attempt, self.shard, repr(e))
+                except Exception:
+                    pass
+        finally:
+            # close the request_checkpoint race: anything still queued when
+            # the loop exits is declined here, and everything arriving later
+            # is declined inline by request_checkpoint (gated on `done`)
+            with self._cp_lock:
+                self.done.set()
+                leftover, self._cp_requests = self._cp_requests, []
+            for cp_id, target in leftover:
+                try:
+                    self.jm.decline_checkpoint(
+                        self.job_id, self.attempt, self.shard, cp_id,
+                        f"task exited before target step {target}",
+                    )
                 except Exception:
                     pass
 
@@ -620,19 +659,8 @@ class _ShardTask:
                 step += 1
                 self.current_step = step
 
-            # checkpoints targeted past the end of the stream cannot form a
-            # cut any more: decline so the JM's pending entry resolves
-            with self._cp_lock:
-                leftover, self._cp_requests = self._cp_requests, []
-            for cp_id, target in leftover:
-                try:
-                    self.jm.decline_checkpoint(
-                        self.job_id, self.attempt, self.shard, cp_id,
-                        f"stream ended at step {step} before target {target}",
-                    )
-                except Exception:
-                    pass
-
+            # checkpoints targeted past the end of the stream are declined
+            # by the `done` drain in _run_safe's finally block
             if not self.cancelled.is_set():
                 op.process_watermark(MAX_WATERMARK)
                 results.extend(op.drain_output())
@@ -698,6 +726,12 @@ class TaskExecutorEndpoint(RpcEndpoint):
         jm = self.rpc.gateway(jm_address, "jobmanager")
         task = _ShardTask(self, job_id, attempt, shard, parallelism, spec, jm,
                           peers, restore, restore_step)
+        # superseded attempts can never be checkpointed or resumed: drop
+        # them so restarts don't grow the task table without bound
+        self._tasks = {
+            k: t for k, t in self._tasks.items()
+            if not (k[0] == job_id and k[1] < attempt)
+        }
         self._tasks[(job_id, attempt, shard)] = task
         task.start()
         return True

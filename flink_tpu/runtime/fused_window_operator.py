@@ -101,21 +101,72 @@ class StepNormalizer:
         return out
 
     def advance(self, wm: int) -> List[_Step]:
-        """Normalize one watermark advance into fire-bounded steps, then
-        re-inject any held future records the purge made room for."""
+        """Normalize one watermark advance into fire-bounded steps.
+
+        Held-back future records are re-injected BETWEEN staged fire steps,
+        not after the loop: each staged step's watermark is additionally
+        capped so it never passes a held record's slice lifetime before the
+        purge frontier has opened ring space and the record was re-ingested
+        (a watermark jump past a held slice would reclassify on-time records
+        as late — the reference only drops records late on arrival,
+        WindowOperator.java:440-446)."""
         out: List[_Step] = []
         if wm <= self.wm:
             return out
         p = self.p
         while True:
+            target = wm
+            held_floor = self._held_min_slice()
+            if held_floor is not None:
+                # largest watermark at which slice `held_floor` is still
+                # live: _min_live_slice(w) <= held_floor  <=>
+                # w <= fire_wm(held_floor // sl) - 1
+                cap_wm = self._fire_wm(held_floor // p.sl) - 1
+                target = min(wm, max(cap_wm, self.wm))
             n_fires = 0
-            j_hi = self._j_fired_upto(wm)
-            step_wm = wm
+            j_hi = self._j_fired_upto(target)
+            step_wm = target
             if self.fire_cursor is not None and self.max_seen is not None:
                 cap = min(j_hi, self.p._j_newest(self.max_seen))
                 n_fires = max(0, cap - self.fire_cursor + 1)
                 if n_fires > p.F:
                     # stage the advance: fire exactly F windows this step
+                    cap = self.fire_cursor + p.F - 1
+                    step_wm = min(target, self._fire_wm(cap))
+                    n_fires = p.F
+            out.append(_Step(
+                np.empty(0, np.int32), None, np.empty(0, np.int64), step_wm, n_fires
+            ))
+            self._commit_wm(step_wm, n_fires)
+            held_before = self.num_future_held
+            self._drain_future(out)
+            if step_wm >= wm:
+                break
+            if step_wm >= target and target < wm:
+                # the held-record cap is the binding constraint; progress
+                # requires the drain to have re-ingested something. With
+                # S - NSB >= slide_slices (guaranteed by the default ring
+                # sizing) the drain always succeeds at the cap; the guard
+                # below only trips on pathological geometry, where the old
+                # behavior (advance past; records counted late) resumes.
+                if self.num_future_held >= held_before and \
+                        self._held_min_slice() == held_floor:
+                    out.extend(self._advance_uncapped(wm))
+                    break
+        return out
+
+    def _advance_uncapped(self, wm: int) -> List[_Step]:
+        """Fallback staged advance without the held-record cap."""
+        out: List[_Step] = []
+        p = self.p
+        while self.wm < wm:
+            n_fires = 0
+            j_hi = self._j_fired_upto(wm)
+            step_wm = wm
+            if self.fire_cursor is not None and self.max_seen is not None:
+                cap = min(j_hi, p._j_newest(self.max_seen))
+                n_fires = max(0, cap - self.fire_cursor + 1)
+                if n_fires > p.F:
                     cap = self.fire_cursor + p.F - 1
                     step_wm = min(wm, self._fire_wm(cap))
                     n_fires = p.F
@@ -123,13 +174,22 @@ class StepNormalizer:
                 np.empty(0, np.int32), None, np.empty(0, np.int64), step_wm, n_fires
             ))
             self._commit_wm(step_wm, n_fires)
-            if step_wm >= wm:
-                break
-        self._drain_future(out)
+            self._drain_future(out)
         return out
 
-    def pad_step(self) -> _Step:
-        return _Step(np.empty(0, np.int32), None, np.empty(0, np.int64), self.wm, 0)
+    def _held_min_slice(self) -> Optional[int]:
+        if not self._future:
+            return None
+        return min(int(self._slice_of(t).min()) for _, _, t in self._future)
+
+    def pad_step(self, wm: Optional[int] = None) -> _Step:
+        """An empty no-op step. `wm` defaults to the normalizer's committed
+        watermark but MUST be the enclosing group's last real step watermark
+        when steps remain queued behind the group (a pad stamped with a
+        future watermark would perform the whole jump in one step and
+        exceed fires_per_step)."""
+        w = self.wm if wm is None else wm
+        return _Step(np.empty(0, np.int32), None, np.empty(0, np.int64), w, 0)
 
     def end_steps(self) -> List[_Step]:
         """End of input: fire everything still buffered (MAX_WATERMARK)."""
@@ -190,6 +250,12 @@ class StepNormalizer:
             vals = None if vals is None else np.asarray(vals)[sel]
             s_abs, keep = s_abs[sel], keep[sel]
             if len(ts) == 0:
+                return
+            if not keep.any():
+                # only late rows survived the hold-back filter: ship them as
+                # a zero-fire step (the pipeline drops+counts them itself)
+                out.append(_Step(np.asarray(kid, np.int32), vals,
+                                 np.asarray(ts, np.int64), self.wm, 0))
                 return
 
         # slice-span splitting: sub-steps each touching < nsb distinct slices
@@ -360,8 +426,13 @@ class FusedWindowOperator:
             fires += s.n_fires
             group.append(self._steps.pop(0))
         target = (1 << max(len(group) - 1, 0).bit_length()) if tail else self.T
+        # pads carry the LAST REAL step's watermark, not the normalizer's
+        # committed one — steps still queued behind an early cut have lower
+        # watermarks, and a future-stamped pad would do the whole jump in
+        # one step and blow fires_per_step
+        pad_wm = group[-1].wm if group else None
         while len(group) < target:
-            group.append(self.norm.pad_step())  # bounded executable shapes
+            group.append(self.norm.pad_step(pad_wm))  # bounded executable shapes
         return group
 
     def _dispatch(self, group: List[_Step]) -> None:

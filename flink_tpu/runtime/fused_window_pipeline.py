@@ -305,7 +305,21 @@ class FusedWindowPipeline:
                 if purged_to is not None and smin < purged_to:
                     raise AssertionError("late-drop check should bound smin")
                 if max_seen is not None and max_seen - smin >= self.S:
-                    raise ValueError("slice ring too small for this skew")
+                    # Pre-watermark inverted skew: this batch's slices lie
+                    # >= S slices BELOW data already resident. Hold-back
+                    # (StepNormalizer) only bounds the future direction —
+                    # past-direction space never reopens (the purge frontier
+                    # moves forward), so this is a configuration limit, not
+                    # a transient: the resident span must fit the ring.
+                    raise ValueError(
+                        f"slice ring too small for this skew: batch slice "
+                        f"{smin} is {max_seen - smin} slices below the "
+                        f"newest resident slice {max_seen}, but the ring "
+                        f"holds only num_slices={self.S}. Raise "
+                        f"'execution.window.num-slices' above the expected "
+                        f"pre-watermark timestamp skew (in slices), or "
+                        f"advance the watermark sooner so old slices purge."
+                    )
                 srel = (s_abs - smin).astype(np.int32)
                 idx_h[t, :n] = np.where(
                     keep, np.asarray(kid, dtype=np.int64) * self.NSB + srel, -1

@@ -191,7 +191,12 @@ class RpcGateway:
     def _connect(self) -> socket.socket:
         if self._sock is None:
             host, port = self._address.rsplit(":", 1)
-            self._sock = socket.create_connection((host, int(port)), timeout=self._timeout)
+            sock = socket.create_connection((host, int(port)), timeout=self._timeout)
+            # the timeout guards CONNECT only: leaving it armed would make
+            # any invocation whose reply takes > timeout raise mid-frame and
+            # poison the connection for every later call on this gateway
+            sock.settimeout(None)
+            self._sock = sock
         return self._sock
 
     def close(self) -> None:

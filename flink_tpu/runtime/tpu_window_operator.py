@@ -337,6 +337,10 @@ class TpuWindowOperator:
             elif purge_to > purge_from:
                 self.state.purge_slices(list(range(purge_from, purge_to)))
         f.purged_to = new_min_live if f.purged_to is None else max(f.purged_to, new_min_live)
+        if self.cold_tier is not None:
+            # same retention cut for spilled rows: without it the LSM keeps
+            # every (key, slice) cell ever written and grows without bound
+            self.cold_tier.purge_below_slice(new_min_live)
 
         self.current_watermark = watermark
 

@@ -61,6 +61,16 @@ class _PyStoreFallback:
     def compact(self):
         return 0
 
+    def purge_below(self, threshold: int) -> int:
+        dead = [k for k in self._d if k < threshold]
+        for k in dead:
+            del self._d[k]
+        return len(dead)
+
+    @property
+    def mem_entries(self) -> int:
+        return len(self._d)
+
     def checkpoint(self) -> str:
         import base64
         import pickle
@@ -78,7 +88,9 @@ class ColdKeyTier:
     """Host/LSM accumulator rows for cold dense key ids."""
 
     def __init__(self, agg: DeviceAggregator, ring_slices: int,
-                 directory: Optional[str] = None):
+                 directory: Optional[str] = None,
+                 flush_threshold: int = 1 << 18,
+                 purge_granularity: Optional[int] = None):
         self.agg = agg
         self.S = ring_slices
         self.fields = list(agg.fields)
@@ -93,6 +105,14 @@ class ColdKeyTier:
             self.store = _PyStoreFallback(self.width)
             self.native = False
         self.num_cold_rows_written = 0
+        self.num_cold_rows_purged = 0
+        # memtable spills to a sorted run past this size (bounds host RSS)
+        self.flush_threshold = flush_threshold
+        # retention cuts batch up: purge once the slice frontier has moved
+        # this many slices past the last cut (a per-watermark purge would
+        # rewrite run files constantly)
+        self.purge_granularity = purge_granularity or max(ring_slices // 4, 16)
+        self._purged_to_slice: Optional[int] = None
 
     # ------------------------------------------------------------------
     def _store_keys(self, cold_kid: np.ndarray, s_abs: np.ndarray) -> np.ndarray:
@@ -125,6 +145,8 @@ class ColdKeyTier:
         rows[found, -1] += old_rows[found, -1]
         self.store.put_batch(uniq, rows.view(np.uint8))
         self.num_cold_rows_written += len(uniq)
+        if self.store.mem_entries >= self.flush_threshold:
+            self.store.flush()
 
     def fire(self, num_cold: int, slice_range) -> Tuple[np.ndarray, np.ndarray]:
         """Combine a window's slices for every cold key.
@@ -149,13 +171,28 @@ class ColdKeyTier:
         result = np.asarray(self.agg.extract(fields), dtype=self.agg.result_dtype)
         return result, counts
 
+    def purge_below_slice(self, frontier_slice: int) -> None:
+        """Retention cut: rows for slices below `frontier_slice` can never
+        fire again (every window containing them has fired and purged) —
+        delete them so the store tracks the live window span instead of the
+        whole stream history. Cuts are batched by `purge_granularity`."""
+        if (self._purged_to_slice is not None
+                and frontier_slice - self._purged_to_slice < self.purge_granularity):
+            return
+        self._purged_to_slice = frontier_slice
+        if frontier_slice <= 0:
+            return
+        threshold = int(np.uint64(frontier_slice) << _SLICE_SHIFT)
+        self.num_cold_rows_purged += self.store.purge_below(threshold)
+
     # ------------------------------------------------------------------
     def snapshot(self) -> dict:
         return {"manifest": self.store.checkpoint(), "dir": self.dir,
-                "native": self.native}
+                "native": self.native, "purged_to_slice": self._purged_to_slice}
 
     def restore(self, snap: dict) -> None:
         self.store.restore(snap["manifest"])
+        self._purged_to_slice = snap.get("purged_to_slice")
 
     def compact(self) -> None:
         self.store.compact()

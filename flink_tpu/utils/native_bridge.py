@@ -120,6 +120,8 @@ def _declare(lib: ctypes.CDLL) -> None:
     lib.ss_restore.argtypes = [c.c_void_p, c.c_char_p, c.c_int64]
     lib.ss_clear.argtypes = [c.c_void_p]
     lib.ss_set_next_run_id.argtypes = [c.c_void_p, c.c_int64]
+    lib.ss_purge_below.restype = c.c_int64
+    lib.ss_purge_below.argtypes = [c.c_void_p, c.c_uint64]
 
 
 class NativeKeyDict:
@@ -312,6 +314,14 @@ class NativeSpillStore:
 
     def clear(self) -> None:
         self._lib.ss_clear(self._handle)
+
+    def purge_below(self, threshold: int) -> int:
+        """Drop every entry with key < threshold (retention cut). Returns
+        entries dropped; raises on I/O error while rewriting a run."""
+        n = self._lib.ss_purge_below(self._handle, ctypes.c_uint64(threshold))
+        if n < 0:
+            raise OSError(f"spill purge failed in {self.dir}")
+        return int(n)
 
     def restore(self, manifest: str) -> None:
         """Replace the store's contents with the manifest's runs (rollback)."""

@@ -271,6 +271,73 @@ int64_t ss_compact(SpillStore* st) {
   return (int64_t)id;
 }
 
+// Delete every entry with key < threshold (the retention cut: callers fold
+// an absolute slice into the key's high bits, so an advancing watermark
+// frontier maps to a monotone key threshold). Whole runs strictly below the
+// threshold drop from the index; partially-below runs are rewritten as new
+// filtered run files. Old files stay on disk for manifests that still
+// reference them. Returns entries dropped, or -1 on I/O error.
+int64_t ss_purge_below(SpillStore* st, uint64_t threshold) {
+  int64_t dropped = 0;
+  if (!st->mem_keys.empty()) {
+    std::vector<uint64_t> keys;
+    std::vector<char> vals;
+    keys.reserve(st->mem_keys.size());
+    vals.reserve(st->mem_vals.size());
+    for (size_t i = 0; i < st->mem_keys.size(); i++) {
+      if (st->mem_keys[i] >= threshold) {
+        keys.push_back(st->mem_keys[i]);
+        vals.insert(vals.end(), &st->mem_vals[i * st->width],
+                    &st->mem_vals[(i + 1) * st->width]);
+      } else {
+        dropped++;
+      }
+    }
+    if (keys.size() != st->mem_keys.size()) {
+      st->mem_keys.swap(keys);
+      st->mem_vals.swap(vals);
+      size_t cap = 1024;
+      while (st->mem_keys.size() * 2 >= cap) cap *= 2;
+      st_rehash(st, cap);
+    }
+  }
+  std::vector<Run*> kept;
+  bool io_error = false;
+  for (auto* r : st->runs) {
+    if (r->keys.empty() || r->max_key < threshold) {
+      dropped += (int64_t)r->keys.size();
+      delete r;  // file stays on disk for old manifests
+      continue;
+    }
+    if (r->min_key >= threshold) {
+      kept.push_back(r);
+      continue;
+    }
+    auto it = std::lower_bound(r->keys.begin(), r->keys.end(), threshold);
+    size_t cut = (size_t)(it - r->keys.begin());
+    auto* nr = new Run();
+    uint64_t id = st->next_run_id++;
+    nr->path = st->dir + "/run-" + std::to_string(id) + ".spill";
+    nr->keys.assign(r->keys.begin() + cut, r->keys.end());
+    nr->values.assign(r->values.begin() + (long)(cut * st->width), r->values.end());
+    nr->min_key = nr->keys.front();
+    nr->max_key = nr->keys.back();
+    build_bloom(nr);
+    if (!write_run(st, nr)) {
+      delete nr;
+      st->next_run_id--;
+      kept.push_back(r);  // keep unfiltered data rather than lose it
+      io_error = true;
+      continue;
+    }
+    dropped += (int64_t)cut;
+    delete r;
+    kept.push_back(nr);
+  }
+  st->runs.swap(kept);
+  return io_error ? -1 : dropped;
+}
+
 // Write the current run list into `out` as \n-joined ids (after a flush this
 // fully describes the store — the checkpoint manifest).
 int64_t ss_manifest(SpillStore* st, char* out, int64_t cap) {

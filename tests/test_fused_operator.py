@@ -256,3 +256,100 @@ def test_executor_selects_fused_operator():
 
     # compare as multisets of (key, value) pairs — emission order may differ
     assert sorted(map(repr, fused_results)) == sorted(map(repr, base_results))
+
+
+# ---------------------------------------------------------------------------
+# advisor-regression tests (round 2 findings)
+# ---------------------------------------------------------------------------
+
+def test_fused_operator_heldback_survive_watermark_jump():
+    """A watermark jump past a held-back record's slice must re-inject the
+    record while its windows can still fire — not reclassify it as late
+    (the reference only drops records late ON ARRIVAL,
+    WindowOperator.java:440-446). Regression: advisor r2 high finding."""
+    assigner = TumblingEventTimeWindows.of(1_000)
+    steps = [
+        # ring S=8, nsb=2: slices 12/13 exceed limit 0+8-2=6 and are held
+        (np.array([1, 2, 2, 3]), None,
+         np.array([500, 12_400, 12_600, 13_100]), 900),
+        # jump straight past the held records' windows, no data in between
+        (np.array([], np.int64), None, np.array([], np.int64), 40_000),
+    ]
+    expect, el = _run_oracle(assigner, "count", steps)
+    got, gl = _run_fused(
+        assigner, "count", steps,
+        key_capacity=8, superbatch_steps=2, nsb=2, chunk=8, num_slices=8,
+    )
+    _assert_same(got, gl, expect, el)
+
+
+def test_fused_operator_end_of_input_flush_keeps_heldback():
+    """Same failure mode via the end-of-input MAX_WATERMARK flush (the
+    advance every _run_fused issues last)."""
+    assigner = TumblingEventTimeWindows.of(1_000)
+    steps = [
+        (np.array([1, 2]), None, np.array([500, 20_500]), None),
+    ]
+    expect, el = _run_oracle(assigner, "count", steps)
+    got, gl = _run_fused(
+        assigner, "count", steps,
+        key_capacity=8, superbatch_steps=2, nsb=2, chunk=8, num_slices=8,
+    )
+    _assert_same(got, gl, expect, el)
+
+
+def test_fused_operator_late_plus_future_batch():
+    """A batch containing ONLY late rows plus far-future (held-back) rows
+    must not crash on the empty on-time remainder. Regression: advisor r2
+    medium finding (_append_data empty-reduction)."""
+    assigner = TumblingEventTimeWindows.of(1_000)
+    steps = [
+        (np.array([1]), None, np.array([500]), 2_500),
+        # slice 0 is late (min_live=2); slice 30 is beyond the ring limit
+        (np.array([2, 3]), None, np.array([100, 30_000]), 31_000),
+    ]
+    expect, el = _run_oracle(assigner, "count", steps)
+    got, gl = _run_fused(
+        assigner, "count", steps,
+        key_capacity=8, superbatch_steps=2, nsb=2, chunk=8, num_slices=8,
+    )
+    _assert_same(got, gl, expect, el)
+
+
+def test_fused_operator_pad_watermark_after_early_cut():
+    """Pads appended after an out_rows early cut must carry the group's
+    last real step watermark: a pad stamped with the normalizer's committed
+    watermark performs the whole staged jump in one step and blows
+    fires_per_step. Regression: advisor r2 medium finding."""
+    assigner = TumblingEventTimeWindows.of(1_000)
+    keys = np.arange(16, dtype=np.int64)
+    ts = np.arange(16, dtype=np.int64) * 1_000 + 10
+    steps = [
+        (keys[:8], None, ts[:8], None),
+        (keys[8:], None, ts[8:], None),
+        (np.array([], np.int64), None, np.array([], np.int64), 20_000),
+    ]
+    expect, el = _run_oracle(assigner, "count", steps)
+    got, gl = _run_fused(
+        assigner, "count", steps,
+        key_capacity=32, superbatch_steps=8, nsb=8, chunk=8, num_slices=32,
+        fires_per_step=2, out_rows=4,
+    )
+    _assert_same(got, gl, expect, el)
+
+
+def test_fused_pipeline_inverted_skew_clear_error():
+    """Pre-watermark inverted skew (a batch >= num_slices BELOW resident
+    data) is a configuration limit the hold-back cannot absorb; it must
+    surface as an actionable error naming the config knob."""
+    assigner = TumblingEventTimeWindows.of(1_000)
+    op = FusedWindowOperator(
+        assigner, "count",
+        key_capacity=8, superbatch_steps=2, nsb=2, chunk=8, num_slices=8,
+    )
+    op.process_batch(np.array([1]), np.ones(1, np.float32),
+                     np.array([100_000], np.int64))
+    with pytest.raises(ValueError, match="num-slices"):
+        op.process_batch(np.array([2]), np.ones(1, np.float32),
+                         np.array([500], np.int64))
+        op.flush_all()
