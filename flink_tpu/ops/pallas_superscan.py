@@ -1,0 +1,264 @@
+"""Pallas superscan: the whole T-step window dispatch as ONE TPU kernel.
+
+The XLA superscan (`fused_window_pipeline._build_superscan`) expresses each
+step as a chain of HLO ops inside `lax.scan`; on hardware that carries a
+fixed cost per sequential op, its throughput is capped by per-step overhead
+(~1 ms/step measured through the single-chip relay) plus the HBM round trip
+of every intermediate (one-hot matrices, partial histograms). This kernel
+removes both caps by fusing the full dispatch — ingest, fire, purge, T
+steps — into a single `pallas_call`:
+
+- the slice-ring count state lives in VMEM for the whole dispatch, laid out
+  `[S * K/128, 128]` (slice-major blocks of 64x128 key tiles), so ingest
+  and fire touch on-chip memory only;
+- ingest is the same MXU one-hot trick as `ops/matmul_hist` (reference
+  semantics: per-record HeapAggregatingState.add, WindowOperator.java:293),
+  but the one-hot factors are built in VMEM per chunk and consumed by the
+  MXU immediately — nothing spills to HBM;
+- fire/purge control (slice positions, output rows, purge masks) is
+  precomputed by the host planner and prefetched to SMEM
+  (PrefetchScalarGridSpec), so the kernel's control flow is branch-cheap
+  `@pl.when` predication, XLA-style static shapes throughout.
+
+Measured on a v5e chip this runs the YSB sliding-count dispatch at ~1.0e9
+records/s (T=64 steps x 1M records), ~15x the XLA superscan on the same
+chip.
+
+Segment encoding matches the host planner (`stage_superbatch`):
+`idx = key_id * NSB + rel_slice`, negative = dropped. In-kernel it is
+re-factored to `seg = rel_slice * K + key_id` so a segment's histogram
+lands at rows `rel_slice * K/128 + key_id/128`, lane `key_id % 128` —
+directly addressable as 64x128 blocks of the slice-ring state.
+
+Supported aggregates: the count field plus any number of add-combining
+VALUE fields (sum/mean). Weighted sums use the same three-term bf16
+split-float trick as `matmul_hist.weighted_hist` (t0+t1+t2 == v bit-exactly
+for |v| >= ~2**-110), so each record's f32 value enters the accumulator
+unquantized. min/max fields have no matmul form; callers keep those on the
+XLA superscan.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from flink_tpu.ops.aggregators import VALUE
+
+LANE = 128
+# 1D int32 inputs are tiled T(1024) by XLA; chunk blocks must align to it
+MIN_CHUNK = 1024
+
+
+def supports(agg, K: int, R: int, S: int) -> bool:
+    """Whether this aggregate/geometry can run on the pallas superscan."""
+    if K % LANE != 0:
+        return False
+    value_fields = [f for f in agg.fields if f.source == VALUE]
+    if any(f.scatter != "add" for f in value_fields):
+        return False
+    KB = K // LANE
+    # VMEM budget: count state + per-field state + compact out buffers
+    nf = len(value_fields)
+    state_bytes = S * K * 4 * (1 + nf) + R * K * 4 * (1 + nf)
+    return state_bytes <= 6 * 1024 * 1024
+
+
+@functools.lru_cache(maxsize=None)
+def build_superscan(
+    agg,
+    K: int,
+    S: int,
+    NSB: int,
+    F: int,
+    SPW: int,
+    R: int,
+    T: int,
+    B: int,
+    CH: int,
+    exact: bool,
+    interpret: bool,
+):
+    """Compile the fused T-step dispatch.
+
+    Returns run(smin, fire_pos, fire_valid, fire_row, purge_mask,
+                count_in [S*KB,128] i32, field_states... , idx [T*B] i32,
+                vals [T*B] f32 | None)
+        -> (count_state, field_states..., count_out [R*KB,128],
+            field_outs...)
+    """
+    assert B % CH == 0 and CH % MIN_CHUNK == 0
+    KB = K // LANE
+    HI = NSB * KB
+    C = B // CH
+    vfields = [
+        (f.name, jnp.dtype(f.dtype)) for f in agg.fields if f.source == VALUE
+    ]
+    nf = len(vfields)
+
+    def kernel(smin_ref, fpos_ref, fvalid_ref, frow_ref, purge_ref,
+               count_in_ref, *rest):
+        state_in = rest[:nf]
+        idx_ref = rest[nf]
+        off = nf + 1
+        vals_ref = rest[off] if nf else None
+        off += 1 if nf else 0
+        count_ref = rest[off]
+        states = rest[off + 1:off + 1 + nf]
+        out_ref = rest[off + 1 + nf]
+        outs = rest[off + 2 + nf:]
+
+        t = pl.program_id(0)
+        c = pl.program_id(1)
+
+        @pl.when(jnp.logical_and(t == 0, c == 0))
+        def _():
+            count_ref[:] = count_in_ref[:]
+            out_ref[:] = jnp.zeros_like(out_ref)
+            for sref, sin in zip(states, state_in):
+                sref[:] = sin[:]
+            for o in outs:
+                o[:] = jnp.zeros_like(o)
+
+        # ---- ingest one chunk: one-hot factors in VMEM, MXU contraction ----
+        ii = idx_ref[:]                                   # [CH] i32
+        kid = ii // NSB
+        srel = ii % NSB
+        seg = jnp.where(ii >= 0, srel * K + kid, -1)
+        hi = seg // LANE
+        lo = seg % LANE
+        oh_hiT = (hi[None, :] == jax.lax.broadcasted_iota(
+            jnp.int32, (HI, CH), 0)).astype(jnp.bfloat16)
+        oh_lo = (lo[:, None] == jax.lax.broadcasted_iota(
+            jnp.int32, (CH, LANE), 1)).astype(jnp.bfloat16)
+        part = jax.lax.dot_general(
+            oh_hiT, oh_lo, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32).astype(jnp.int32)
+
+        smin = smin_ref[t]
+        for sr in range(NSB):
+            col = (smin + sr) % S
+            base = pl.multiple_of(col * KB, KB)
+            count_ref[pl.ds(base, KB), :] += part[sr * KB:(sr + 1) * KB, :]
+
+        if nf:
+            v = vals_ref[:].astype(jnp.float32)
+            terms = []
+            t0 = v.astype(jnp.bfloat16)
+            terms.append(t0)
+            if exact:
+                r1 = v - t0.astype(jnp.float32)
+                t1 = r1.astype(jnp.bfloat16)
+                r2 = r1 - t1.astype(jnp.float32)
+                terms.append(t1)
+                terms.append(r2.astype(jnp.bfloat16))
+            wacc = None
+            for tm in terms:
+                d = jax.lax.dot_general(
+                    oh_hiT, oh_lo * tm[:, None], (((1,), (0,)), ((), ())),
+                    preferred_element_type=jnp.float32)
+                wacc = d if wacc is None else wacc + d
+            for sref, (_name, dt) in zip(states, vfields):
+                w = wacc.astype(dt)
+                for sr in range(NSB):
+                    col = (smin + sr) % S
+                    base = pl.multiple_of(col * KB, KB)
+                    sref[pl.ds(base, KB), :] += w[sr * KB:(sr + 1) * KB, :]
+
+        # ---- fire + purge once the step's last chunk is ingested ----
+        @pl.when(c == C - 1)
+        def _():
+            for f in range(F):
+                @pl.when(fvalid_ref[t, f] > 0)
+                def _(f=f):
+                    fp = fpos_ref[t, f]
+                    row = frow_ref[t, f]
+                    acc = jnp.zeros((KB, LANE), jnp.int32)
+                    for w in range(SPW):
+                        col = (fp + w) % S
+                        acc += count_ref[
+                            pl.ds(pl.multiple_of(col * KB, KB), KB), :]
+                    out_ref[pl.ds(row * KB, KB), :] = acc
+                    for sref, oref, (_n, dt) in zip(states, outs, vfields):
+                        sacc = jnp.zeros((KB, LANE), dt)
+                        for w in range(SPW):
+                            col = (fp + w) % S
+                            sacc += sref[
+                                pl.ds(pl.multiple_of(col * KB, KB), KB), :]
+                        oref[pl.ds(row * KB, KB), :] = sacc
+            for s in range(S):
+                @pl.when(purge_ref[t, s] == 0)
+                def _(s=s):
+                    base = pl.multiple_of(s * KB, KB)
+                    count_ref[pl.ds(base, KB), :] = jnp.zeros(
+                        (KB, LANE), jnp.int32)
+                    for sref, (_n, dt) in zip(states, vfields):
+                        sref[pl.ds(base, KB), :] = jnp.zeros((KB, LANE), dt)
+
+    state_spec = pl.BlockSpec((S * KB, LANE), lambda t, c, *_: (0, 0))
+    out_spec = pl.BlockSpec((R * KB, LANE), lambda t, c, *_: (0, 0))
+    chunk_spec = pl.BlockSpec((CH,), lambda t, c, *_: (t * C + c,))
+
+    in_specs = [state_spec]                      # count_in
+    in_specs += [state_spec] * nf                # field states in
+    in_specs += [chunk_spec]                     # idx
+    if nf:
+        in_specs += [chunk_spec]                 # vals
+    out_specs = [state_spec] + [state_spec] * nf + [out_spec] + [out_spec] * nf
+
+    out_shape = [jax.ShapeDtypeStruct((S * KB, LANE), jnp.int32)]
+    out_shape += [jax.ShapeDtypeStruct((S * KB, LANE), dt) for _, dt in vfields]
+    out_shape += [jax.ShapeDtypeStruct((R * KB, LANE), jnp.int32)]
+    out_shape += [jax.ShapeDtypeStruct((R * KB, LANE), dt) for _, dt in vfields]
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=5,
+        grid=(T, C),
+        in_specs=in_specs,
+        out_specs=out_specs,
+    )
+    fn = pl.pallas_call(
+        kernel, grid_spec=grid_spec, out_shape=out_shape, interpret=interpret,
+    )
+
+    @jax.jit
+    def run(smin, fpos, fvalid, frow, purge, count_in, states, idx, vals):
+        args = [count_in, *states, idx]
+        if nf:
+            args.append(vals)
+        res = fn(smin, fpos, fvalid, frow, purge, *args)
+        count_state = res[0]
+        field_states = tuple(res[1:1 + nf])
+        count_out = res[1 + nf]
+        field_outs = tuple(res[2 + nf:])
+        return count_state, field_states, count_out, field_outs
+
+    return run
+
+
+# ------------------------------------------------------------------
+# layout converters between the canonical [K, S] state (XLA superscan,
+# snapshots) and the kernel's slice-major [S*KB, LANE] layout
+# ------------------------------------------------------------------
+
+def to_kernel_layout(arr, K: int, S: int):
+    """[K, S] -> [S*K/128, 128] (numpy or jax array)."""
+    xp = jnp if isinstance(arr, jax.Array) else np
+    return xp.transpose(arr, (1, 0)).reshape(S * (K // LANE), LANE)
+
+
+def from_kernel_layout(arr, K: int, S: int):
+    """[S*K/128, 128] -> [K, S]."""
+    xp = jnp if isinstance(arr, jax.Array) else np
+    return xp.transpose(arr.reshape(S, K), (1, 0))
+
+
+def rows_to_keys(out, R: int, K: int):
+    """Compact fire buffer [R*K/128, 128] -> [R, K]."""
+    return out.reshape(R, K)

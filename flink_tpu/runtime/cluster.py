@@ -490,16 +490,23 @@ class _ShardTask:
             if not self.done.is_set():
                 self._cp_requests.append((cp_id, target_step))
                 return
-        # the task loop has exited: a queued request would never be
+        # The task loop has exited: a queued request would never be
         # processed, leaving the JM's pending entry dangling forever —
-        # decline on the task's behalf instead
-        try:
-            self.jm.decline_checkpoint(
-                self.job_id, self.attempt, self.shard, cp_id,
-                "task already finished",
-            )
-        except Exception:
-            pass
+        # decline on the task's behalf. The decline must NOT run inline:
+        # request_checkpoint executes on the TM endpoint main thread while
+        # the JM main thread is blocked in its trigger RPC to us, so a
+        # synchronous jm.decline_checkpoint here is a circular RPC wait
+        # (JM-main -> TM-main -> JM-main) that deadlocks both processes.
+        def _decline():
+            try:
+                self.jm.decline_checkpoint(
+                    self.job_id, self.attempt, self.shard, cp_id,
+                    "task already finished",
+                )
+            except Exception:
+                pass
+
+        threading.Thread(target=_decline, daemon=True).start()
 
     def _channel_id(self, src: int) -> str:
         return f"{self.job_id}/a{self.attempt}/{src}->{self.shard}"
@@ -726,12 +733,17 @@ class TaskExecutorEndpoint(RpcEndpoint):
         jm = self.rpc.gateway(jm_address, "jobmanager")
         task = _ShardTask(self, job_id, attempt, shard, parallelism, spec, jm,
                           peers, restore, restore_step)
-        # superseded attempts can never be checkpointed or resumed: drop
-        # them so restarts don't grow the task table without bound
-        self._tasks = {
-            k: t for k, t in self._tasks.items()
-            if not (k[0] == job_id and k[1] < attempt)
-        }
+        # superseded attempts can never be checkpointed or resumed: cancel
+        # and drop them so restarts don't grow the task table without bound
+        # (a still-running old-attempt thread would otherwise be unreachable
+        # by cancel_task/stop once evicted)
+        keep = {}
+        for k, t in self._tasks.items():
+            if k[0] == job_id and k[1] < attempt:
+                t.cancelled.set()
+            else:
+                keep[k] = t
+        self._tasks = keep
         self._tasks[(job_id, attempt, shard)] = task
         task.start()
         return True

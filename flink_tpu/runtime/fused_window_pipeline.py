@@ -95,6 +95,8 @@ class FusedWindowPipeline:
         out_rows: int = 64,           # max fires per dispatch
         chunk: int = 8192,
         exact_sums: bool = True,
+        backend: str = "auto",        # 'auto' | 'xla' | 'pallas'
+        pallas_interpret: bool = False,
     ):
         agg = resolve(aggregate)
         if agg is None:
@@ -114,6 +116,10 @@ class FusedWindowPipeline:
         self.R = out_rows
         self.chunk = chunk
         self.exact_sums = exact_sums
+        self.backend = backend
+        self.pallas_interpret = pallas_interpret
+        self._pallas: Optional[bool] = None   # decided at first dispatch
+        self._kernel_layout = False           # states in pallas slice-major form
 
         self.g = assigner.slice_ms
         self.sl = assigner.slide_slices
@@ -147,6 +153,65 @@ class FusedWindowPipeline:
 
         self._fn_cache: Dict[Tuple[int, int], Any] = {}
 
+    # ------------------------------------------------------------------
+    # backend selection + state layout
+    # ------------------------------------------------------------------
+    def _use_pallas(self) -> bool:
+        """Decide (once) whether dispatches run on the fused pallas kernel.
+
+        'auto' picks pallas on a real TPU backend when the aggregate has a
+        matmul form (add-combining fields only) and the geometry fits VMEM;
+        everything else stays on the XLA superscan (which also serves the
+        shard_map/multi-chip path and CPU CI).
+        """
+        if self._pallas is None:
+            from flink_tpu.ops import pallas_superscan
+
+            if self.backend == "xla":
+                self._pallas = False
+            else:
+                ok = (
+                    pallas_superscan.supports(self.agg, self.K, self.R, self.S)
+                    and self.chunk % pallas_superscan.MIN_CHUNK == 0
+                )
+                if self.backend == "pallas":
+                    if not ok:
+                        raise ValueError(
+                            "pallas superscan does not support this "
+                            "aggregate/geometry (need add-combining fields, "
+                            "K%128==0, VMEM-sized state)"
+                        )
+                    self._pallas = True
+                else:
+                    import jax
+
+                    self._pallas = ok and jax.default_backend() == "tpu"
+        return self._pallas
+
+    def _to_kernel_layout(self) -> None:
+        if self._kernel_layout:
+            return
+        from flink_tpu.ops import pallas_superscan as ps
+
+        self._count = ps.to_kernel_layout(self._count, self.K, self.S)
+        self._state = {
+            k: ps.to_kernel_layout(v, self.K, self.S)
+            for k, v in self._state.items()
+        }
+        self._kernel_layout = True
+
+    def _to_canonical(self) -> None:
+        if not self._kernel_layout:
+            return
+        from flink_tpu.ops import pallas_superscan as ps
+
+        self._count = ps.from_kernel_layout(self._count, self.K, self.S)
+        self._state = {
+            k: ps.from_kernel_layout(v, self.K, self.S)
+            for k, v in self._state.items()
+        }
+        self._kernel_layout = False
+
     def ensure_key_capacity(self, required: int) -> None:
         """Grow the key dimension (next pow2) when the dictionary outgrows K;
         existing rows keep their accumulators, new rows start at identity.
@@ -155,6 +220,7 @@ class FusedWindowPipeline:
         ensure_key_capacity."""
         if required <= self.K:
             return
+        self._to_canonical()
         import jax.numpy as jnp
 
         new_k = 1 << (required - 1).bit_length()
@@ -171,6 +237,7 @@ class FusedWindowPipeline:
             [self._count, jnp.zeros((pad, self.S), jnp.int32)]
         )
         self.K = new_k
+        self._pallas = None  # geometry changed; re-decide backend
 
     # ------------------------------------------------------------------
     # window geometry (identical formulas to TpuWindowOperator)
@@ -229,25 +296,61 @@ class FusedWindowPipeline:
         import jax
         import jax.numpy as jnp
 
-        T = len(batches)
-        assert T == len(watermarks)
         if staged is not None:
             idx_d, vals_d, plan = staged
         else:
             idx_d, vals_d, plan = self.stage_superbatch(batches, watermarks)
+        T = len(batches) if batches is not None else int(plan[0].shape[0])
+        if watermarks is not None:
+            assert T == len(watermarks)
         (smin_pos, fire_pos, fire_valid, fire_row, purge_mask, fires) = plan
 
-        B = idx_d.shape[1]
-        run = self._superscan(T, B)
-        outs0 = {
-            f.name: jnp.zeros((self.R, self.K), jnp.dtype(f.dtype))
-            for f in self._value_fields
-        }
-        count_out0 = jnp.zeros((self.R, self.K), jnp.int32)
-        self._state, self._count, outs, count_out = run(
-            self._state, self._count, outs0, count_out0,
-            idx_d, vals_d, smin_pos, fire_pos, fire_valid, fire_row, purge_mask,
-        )
+        B = idx_d.shape[1] if idx_d.ndim == 2 else idx_d.shape[0] // T
+        if self._use_pallas():
+            from flink_tpu.ops import pallas_superscan as ps
+
+            self._to_kernel_layout()
+            run = ps.build_superscan(
+                self.agg, self.K, self.S, self.NSB, self.F, self.spw,
+                self.R, T, B, self.chunk, self.exact_sums,
+                self.pallas_interpret,
+            )
+            names = [f.name for f in self._value_fields]
+            idx_flat = idx_d if idx_d.ndim == 1 else idx_d.reshape(-1)
+            vals_flat = None
+            if self._needs_vals:
+                vals_flat = vals_d if vals_d.ndim == 1 else vals_d.reshape(-1)
+            count_state, field_states, count_out, field_outs = run(
+                smin_pos, fire_pos, fire_valid, fire_row, purge_mask,
+                self._count, tuple(self._state[n] for n in names),
+                idx_flat, vals_flat,
+            )
+            self._count = count_state
+            self._state = dict(zip(names, field_states))
+            count_out = ps.rows_to_keys(count_out, self.R, self.K)
+            outs = {
+                n: ps.rows_to_keys(o, self.R, self.K)
+                for n, o in zip(names, field_outs)
+            }
+        else:
+            self._to_canonical()
+            # the backend decision can legitimately flip between staging and
+            # dispatch (ensure_key_capacity growth, restore); re-shape staged
+            # inputs to the layout this backend expects
+            if idx_d.ndim == 1:
+                idx_d = idx_d.reshape(T, B)
+            if self._needs_vals and vals_d.ndim == 1:
+                vals_d = vals_d.reshape(T, B)
+            run = self._superscan(T, B)
+            outs0 = {
+                f.name: jnp.zeros((self.R, self.K), jnp.dtype(f.dtype))
+                for f in self._value_fields
+            }
+            count_out0 = jnp.zeros((self.R, self.K), jnp.int32)
+            self._state, self._count, outs, count_out = run(
+                self._state, self._count, outs0, count_out0,
+                idx_d, vals_d, smin_pos, fire_pos, fire_valid, fire_row, purge_mask,
+            )
 
         # read back only the rows actually fired (padded to a few stable
         # shapes so the slice executable is reused across dispatches)
@@ -375,8 +478,16 @@ class FusedWindowPipeline:
         self.min_used_slice = min_used
         self.max_seen_slice = max_seen
 
-        idx_d = jax.device_put(idx_h)
-        vals_d = jax.device_put(vals_h)
+        if self._use_pallas():
+            # the fused kernel consumes flat [T*B] chunk streams; flatten on
+            # host (free: idx_h is contiguous) so no device reshape is needed
+            idx_d = jax.device_put(idx_h.reshape(-1))
+            vals_d = jax.device_put(
+                vals_h.reshape(-1) if self._needs_vals else vals_h
+            )
+        else:
+            idx_d = jax.device_put(idx_h)
+            vals_d = jax.device_put(vals_h)
         plan = (
             jax.device_put(smin_pos),
             jax.device_put(fire_pos),
@@ -387,8 +498,118 @@ class FusedWindowPipeline:
         )
         return idx_d, vals_d, plan
 
+    def plan_superbatch(self, slice_bounds, watermarks):
+        """Host planning from per-step slice BOUNDS only — for callers that
+        stage the record stream themselves (e.g. the benchmark's on-device
+        generator, which synthesizes `idx = key_id * NSB + (slice - smin)`
+        directly in HBM and never ships per-record data over the host link).
+
+        slice_bounds: [(smin_abs, smax_abs)] per step — inclusive bounds on
+        the absolute slices the step's records can occupy. The caller must
+        guarantee no record falls outside its step's bounds and no record is
+        late (bounds below the live frontier raise here).
+
+        Returns (plan, smin_abs[int32 T]) where plan is staged-plan
+        compatible: pass `staged=(idx_dev, vals_dev, plan)` to
+        process_superbatch.
+        """
+        import jax
+
+        T = len(slice_bounds)
+        assert T == len(watermarks)
+        smin_pos = np.zeros(T, dtype=np.int32)
+        smin_abs = np.zeros(T, dtype=np.int32)
+        fire_pos = np.zeros((T, self.F), dtype=np.int32)
+        fire_valid = np.zeros((T, self.F), dtype=np.int32)
+        fire_row = np.zeros((T, self.F), dtype=np.int32)
+        purge_mask = np.ones((T, self.S), dtype=np.int32)
+        fires: List[_PlannedFire] = []
+
+        wm = self.watermark
+        fire_cursor = self.fire_cursor
+        purged_to = self.purged_to
+        min_used = self.min_used_slice
+        max_seen = self.max_seen_slice
+
+        for t, (smin, smax) in enumerate(slice_bounds):
+            if smax - smin >= self.NSB:
+                raise ValueError(
+                    f"step spans {smax - smin + 1} slices > nsb={self.NSB}"
+                )
+            if wm > MIN_WATERMARK and smin < self._min_live_slice(wm):
+                raise ValueError(
+                    "plan_superbatch requires a late-free schedule: step "
+                    f"{t} smin={smin} is below the live frontier "
+                    f"{self._min_live_slice(wm)}"
+                )
+            if max_seen is not None and max_seen - smin >= self.S:
+                raise ValueError(
+                    f"slice ring too small for this skew: {max_seen - smin} "
+                    f">= num_slices={self.S}"
+                )
+            smin_pos[t] = smin % self.S
+            smin_abs[t] = smin
+            min_used = smin if min_used is None else min(min_used, smin)
+            max_seen = smax if max_seen is None else max(max_seen, smax)
+            cand = self._j_oldest(smin)
+            if wm > MIN_WATERMARK:
+                cand = max(cand, self._j_fired_upto(wm) + 1)
+            fire_cursor = cand if fire_cursor is None else min(fire_cursor, cand)
+
+            new_wm = watermarks[t]
+            if new_wm > wm:
+                if fire_cursor is not None and max_seen is not None:
+                    hi = min(self._j_fired_upto(new_wm), self._j_newest(max_seen))
+                    slot = 0
+                    for j in range(fire_cursor, hi + 1):
+                        if slot >= self.F:
+                            raise ValueError(
+                                f"{hi + 1 - fire_cursor} windows fire in one "
+                                f"step > fires_per_step={self.F}"
+                            )
+                        if len(fires) >= self.R:
+                            raise ValueError(
+                                f"more than out_rows={self.R} fires per dispatch"
+                            )
+                        row = len(fires)
+                        fires.append(_PlannedFire(row, j, t))
+                        fire_pos[t, slot] = (j * self.sl) % self.S
+                        fire_valid[t, slot] = 1
+                        fire_row[t, slot] = row
+                        slot += 1
+                    if self._j_fired_upto(new_wm) >= fire_cursor:
+                        fire_cursor = self._j_fired_upto(new_wm) + 1
+                new_min_live = self._min_live_slice(new_wm)
+                if min_used is not None:
+                    lo = min_used if purged_to is None else max(purged_to, min_used)
+                    hi_p = min(new_min_live, max_seen + 1)
+                    if hi_p - lo >= self.S:
+                        purge_mask[t, :] = 0
+                    elif hi_p > lo:
+                        dead = (np.arange(lo, hi_p) % self.S).astype(np.int64)
+                        purge_mask[t, dead] = 0
+                purged_to = new_min_live if purged_to is None else max(purged_to, new_min_live)
+                wm = new_wm
+
+        self.watermark = wm
+        self.fire_cursor = fire_cursor
+        self.purged_to = purged_to
+        self.min_used_slice = min_used
+        self.max_seen_slice = max_seen
+
+        plan = (
+            jax.device_put(smin_pos),
+            jax.device_put(fire_pos),
+            jax.device_put(fire_valid),
+            jax.device_put(fire_row),
+            jax.device_put(purge_mask),
+            fires,
+        )
+        return plan, smin_abs
+
     # ------------------------------------------------------------------
     def snapshot(self) -> dict:
+        self._to_canonical()  # snapshots use the [K, S] layout across backends
         return {
             "state": {k: np.asarray(v) for k, v in self._state.items()},
             "count": np.asarray(self._count),
@@ -405,7 +626,9 @@ class FusedWindowPipeline:
 
         self._state = {k: jnp.asarray(v) for k, v in snap["state"].items()}
         self._count = jnp.asarray(snap["count"])
+        self._kernel_layout = False
         self.K = int(self._count.shape[0])  # capacity may have grown pre-snapshot
+        self._pallas = None
         self.watermark = snap["watermark"]
         self.fire_cursor = snap["fire_cursor"]
         self.purged_to = snap["purged_to"]

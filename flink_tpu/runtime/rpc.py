@@ -201,11 +201,16 @@ class RpcGateway:
 
     def close(self) -> None:
         with self._lock:
-            if self._sock is not None:
-                try:
-                    self._sock.close()
-                finally:
-                    self._sock = None
+            self._close_locked()
+
+    def _close_locked(self) -> None:
+        """Drop the cached socket; caller must hold self._lock (the lock is
+        non-reentrant, so call() error paths use this instead of close())."""
+        if self._sock is not None:
+            try:
+                self._sock.close()
+            finally:
+                self._sock = None
 
     def __getattr__(self, method: str):
         if method.startswith("_"):
@@ -218,10 +223,10 @@ class RpcGateway:
                     _send_frame(sock, pickle.dumps((self._endpoint, method, args, kwargs)))
                     frame = _recv_frame(sock)
                 except OSError:
-                    self.close()
+                    self._close_locked()
                     raise
                 if frame is None:
-                    self.close()
+                    self._close_locked()
                     raise ConnectionError(f"rpc connection to {self._address} closed")
             ok, payload = pickle.loads(frame)
             if ok:
