@@ -56,18 +56,20 @@ LANE = 128
 MIN_CHUNK = 1024
 
 
-def supports(agg, K: int, R: int, S: int) -> bool:
+def supports(agg, K: int, R: int, S: int, NSB: int, chunk: int) -> bool:
     """Whether this aggregate/geometry can run on the pallas superscan."""
-    if K % LANE != 0:
+    if K % LANE != 0 or chunk % MIN_CHUNK != 0:
         return False
     value_fields = [f for f in agg.fields if f.source == VALUE]
     if any(f.scatter != "add" for f in value_fields):
         return False
-    KB = K // LANE
-    # VMEM budget: count state + per-field state + compact out buffers
+    # VMEM budget: persistent state + compact out buffers stay resident for
+    # the whole dispatch; the per-chunk one-hot factors (oh_hiT [NSB*K/128,
+    # CH] + oh_lo [CH, 128], bf16) are the dominant transient
     nf = len(value_fields)
     state_bytes = S * K * 4 * (1 + nf) + R * K * 4 * (1 + nf)
-    return state_bytes <= 6 * 1024 * 1024
+    onehot_bytes = ((NSB * K // LANE) * chunk + chunk * LANE) * 2
+    return state_bytes + onehot_bytes <= 12 * 1024 * 1024
 
 
 @functools.lru_cache(maxsize=None)

@@ -80,6 +80,102 @@ class DeferredEmissions:
         ]
 
 
+class _PlanCursor:
+    """The fire/purge planning state machine for one dispatch.
+
+    Both stage_superbatch (data-driven) and plan_superbatch (bounds-driven)
+    drive this cursor; the plans they produce must be bit-identical for
+    identical streams, so the per-step logic lives only here.
+    """
+
+    def __init__(self, pipe: "FusedWindowPipeline"):
+        self.p = pipe
+        self.wm = pipe.watermark
+        self.fire_cursor = pipe.fire_cursor
+        self.purged_to = pipe.purged_to
+        self.min_used = pipe.min_used_slice
+        self.max_seen = pipe.max_seen_slice
+
+    def observe(self, smin: int, smax: int) -> None:
+        """Account for a step whose live records occupy slices [smin, smax]."""
+        p = self.p
+        if smax - smin >= p.NSB:
+            raise ValueError(
+                f"batch spans {smax - smin + 1} slices > nsb={p.NSB}; "
+                "raise nsb or shrink batches"
+            )
+        if self.purged_to is not None and smin < self.purged_to:
+            raise AssertionError("late-drop check should bound smin")
+        if self.max_seen is not None and self.max_seen - smin >= p.S:
+            # Pre-watermark inverted skew: this batch's slices lie >= S
+            # slices BELOW data already resident. Hold-back (StepNormalizer)
+            # only bounds the future direction — past-direction space never
+            # reopens (the purge frontier moves forward), so this is a
+            # configuration limit, not a transient: the resident span must
+            # fit the ring.
+            raise ValueError(
+                f"slice ring too small for this skew: batch slice "
+                f"{smin} is {self.max_seen - smin} slices below the "
+                f"newest resident slice {self.max_seen}, but the ring "
+                f"holds only num_slices={p.S}. Raise "
+                f"'execution.window.num-slices' above the expected "
+                f"pre-watermark timestamp skew (in slices), or "
+                f"advance the watermark sooner so old slices purge."
+            )
+        self.min_used = smin if self.min_used is None else min(self.min_used, smin)
+        self.max_seen = smax if self.max_seen is None else max(self.max_seen, smax)
+        cand = p._j_oldest(smin)
+        if self.wm > MIN_WATERMARK:
+            cand = max(cand, p._j_fired_upto(self.wm) + 1)
+        self.fire_cursor = cand if self.fire_cursor is None else min(self.fire_cursor, cand)
+
+    def advance(self, t: int, new_wm: int, fire_pos, fire_valid, fire_row,
+                purge_mask, fires: list) -> None:
+        """Watermark advance after step t: plan fires (window order) + purge."""
+        p = self.p
+        if new_wm <= self.wm:
+            return
+        if self.fire_cursor is not None and self.max_seen is not None:
+            hi = min(p._j_fired_upto(new_wm), p._j_newest(self.max_seen))
+            slot = 0
+            for j in range(self.fire_cursor, hi + 1):
+                if slot >= p.F:
+                    raise ValueError(
+                        f"{hi + 1 - self.fire_cursor} windows fire in one step "
+                        f"> fires_per_step={p.F}"
+                    )
+                if len(fires) >= p.R:
+                    raise ValueError(f"more than out_rows={p.R} fires per dispatch")
+                row = len(fires)
+                fires.append(_PlannedFire(row, j, t))
+                fire_pos[t, slot] = (j * p.sl) % p.S
+                fire_valid[t, slot] = 1
+                fire_row[t, slot] = row
+                slot += 1
+            if p._j_fired_upto(new_wm) >= self.fire_cursor:
+                self.fire_cursor = p._j_fired_upto(new_wm) + 1
+        # purge columns whose slices expired
+        new_min_live = p._min_live_slice(new_wm)
+        if self.min_used is not None:
+            lo = self.min_used if self.purged_to is None else max(self.purged_to, self.min_used)
+            hi_p = min(new_min_live, self.max_seen + 1)
+            if hi_p - lo >= p.S:
+                purge_mask[t, :] = 0
+            elif hi_p > lo:
+                dead = (np.arange(lo, hi_p) % p.S).astype(np.int64)
+                purge_mask[t, dead] = 0
+        self.purged_to = new_min_live if self.purged_to is None else max(self.purged_to, new_min_live)
+        self.wm = new_wm
+
+    def commit(self) -> None:
+        p = self.p
+        p.watermark = self.wm
+        p.fire_cursor = self.fire_cursor
+        p.purged_to = self.purged_to
+        p.min_used_slice = self.min_used
+        p.max_seen_slice = self.max_seen
+
+
 class FusedWindowPipeline:
     """One shard's keyed window aggregation, executed T steps per dispatch."""
 
@@ -170,9 +266,8 @@ class FusedWindowPipeline:
             if self.backend == "xla":
                 self._pallas = False
             else:
-                ok = (
-                    pallas_superscan.supports(self.agg, self.K, self.R, self.S)
-                    and self.chunk % pallas_superscan.MIN_CHUNK == 0
+                ok = pallas_superscan.supports(
+                    self.agg, self.K, self.R, self.S, self.NSB, self.chunk
                 )
                 if self.backend == "pallas":
                     if not ok:
@@ -383,46 +478,18 @@ class FusedWindowPipeline:
         purge_mask = np.ones((T, self.S), dtype=np.int32)
         fires: List[_PlannedFire] = []
 
-        wm = self.watermark
-        fire_cursor = self.fire_cursor
-        purged_to = self.purged_to
-        min_used = self.min_used_slice
-        max_seen = self.max_seen_slice
-
+        cur = _PlanCursor(self)
         for t, (kid, vals, ts) in enumerate(batches):
             n = len(ts)
             s_abs = self._slice_of(np.asarray(ts, dtype=np.int64))
             keep = np.ones(n, dtype=bool)
-            if wm > MIN_WATERMARK:
-                keep = s_abs >= self._min_live_slice(wm)
+            if cur.wm > MIN_WATERMARK:
+                keep = s_abs >= self._min_live_slice(cur.wm)
                 self.num_late_records_dropped += int(n - keep.sum())
             if keep.any():
                 live = s_abs[keep]
                 smin = int(live.min())
-                smax = int(live.max())
-                if smax - smin >= self.NSB:
-                    raise ValueError(
-                        f"batch spans {smax - smin + 1} slices > nsb={self.NSB}; "
-                        "raise nsb or shrink batches"
-                    )
-                if purged_to is not None and smin < purged_to:
-                    raise AssertionError("late-drop check should bound smin")
-                if max_seen is not None and max_seen - smin >= self.S:
-                    # Pre-watermark inverted skew: this batch's slices lie
-                    # >= S slices BELOW data already resident. Hold-back
-                    # (StepNormalizer) only bounds the future direction —
-                    # past-direction space never reopens (the purge frontier
-                    # moves forward), so this is a configuration limit, not
-                    # a transient: the resident span must fit the ring.
-                    raise ValueError(
-                        f"slice ring too small for this skew: batch slice "
-                        f"{smin} is {max_seen - smin} slices below the "
-                        f"newest resident slice {max_seen}, but the ring "
-                        f"holds only num_slices={self.S}. Raise "
-                        f"'execution.window.num-slices' above the expected "
-                        f"pre-watermark timestamp skew (in slices), or "
-                        f"advance the watermark sooner so old slices purge."
-                    )
+                cur.observe(smin, int(live.max()))
                 srel = (s_abs - smin).astype(np.int32)
                 idx_h[t, :n] = np.where(
                     keep, np.asarray(kid, dtype=np.int64) * self.NSB + srel, -1
@@ -430,53 +497,9 @@ class FusedWindowPipeline:
                 if vals is not None and self._needs_vals:
                     vals_h[t, :n] = np.where(keep, vals, 0.0)
                 smin_pos[t] = smin % self.S
-                min_used = smin if min_used is None else min(min_used, smin)
-                max_seen = smax if max_seen is None else max(max_seen, smax)
-                cand = self._j_oldest(smin)
-                if wm > MIN_WATERMARK:
-                    cand = max(cand, self._j_fired_upto(wm) + 1)
-                fire_cursor = cand if fire_cursor is None else min(fire_cursor, cand)
-
-            new_wm = watermarks[t]
-            if new_wm > wm:
-                # fires eligible at new_wm, in window order
-                if fire_cursor is not None and max_seen is not None:
-                    hi = min(self._j_fired_upto(new_wm), self._j_newest(max_seen))
-                    slot = 0
-                    for j in range(fire_cursor, hi + 1):
-                        if slot >= self.F:
-                            raise ValueError(
-                                f"{hi + 1 - fire_cursor} windows fire in one step "
-                                f"> fires_per_step={self.F}"
-                            )
-                        if len(fires) >= self.R:
-                            raise ValueError(f"more than out_rows={self.R} fires per dispatch")
-                        row = len(fires)
-                        fires.append(_PlannedFire(row, j, t))
-                        fire_pos[t, slot] = (j * self.sl) % self.S
-                        fire_valid[t, slot] = 1
-                        fire_row[t, slot] = row
-                        slot += 1
-                    if self._j_fired_upto(new_wm) >= fire_cursor:
-                        fire_cursor = self._j_fired_upto(new_wm) + 1
-                # purge columns whose slices expired
-                new_min_live = self._min_live_slice(new_wm)
-                if min_used is not None:
-                    lo = min_used if purged_to is None else max(purged_to, min_used)
-                    hi_p = min(new_min_live, max_seen + 1)
-                    if hi_p - lo >= self.S:
-                        purge_mask[t, :] = 0
-                    elif hi_p > lo:
-                        dead = (np.arange(lo, hi_p) % self.S).astype(np.int64)
-                        purge_mask[t, dead] = 0
-                purged_to = new_min_live if purged_to is None else max(purged_to, new_min_live)
-                wm = new_wm
-
-        self.watermark = wm
-        self.fire_cursor = fire_cursor
-        self.purged_to = purged_to
-        self.min_used_slice = min_used
-        self.max_seen_slice = max_seen
+            cur.advance(t, watermarks[t], fire_pos, fire_valid, fire_row,
+                        purge_mask, fires)
+        cur.commit()
 
         if self._use_pallas():
             # the fused kernel consumes flat [T*B] chunk streams; flatten on
@@ -525,77 +548,20 @@ class FusedWindowPipeline:
         purge_mask = np.ones((T, self.S), dtype=np.int32)
         fires: List[_PlannedFire] = []
 
-        wm = self.watermark
-        fire_cursor = self.fire_cursor
-        purged_to = self.purged_to
-        min_used = self.min_used_slice
-        max_seen = self.max_seen_slice
-
+        cur = _PlanCursor(self)
         for t, (smin, smax) in enumerate(slice_bounds):
-            if smax - smin >= self.NSB:
-                raise ValueError(
-                    f"step spans {smax - smin + 1} slices > nsb={self.NSB}"
-                )
-            if wm > MIN_WATERMARK and smin < self._min_live_slice(wm):
+            if cur.wm > MIN_WATERMARK and smin < self._min_live_slice(cur.wm):
                 raise ValueError(
                     "plan_superbatch requires a late-free schedule: step "
                     f"{t} smin={smin} is below the live frontier "
-                    f"{self._min_live_slice(wm)}"
+                    f"{self._min_live_slice(cur.wm)}"
                 )
-            if max_seen is not None and max_seen - smin >= self.S:
-                raise ValueError(
-                    f"slice ring too small for this skew: {max_seen - smin} "
-                    f">= num_slices={self.S}"
-                )
+            cur.observe(smin, smax)
             smin_pos[t] = smin % self.S
             smin_abs[t] = smin
-            min_used = smin if min_used is None else min(min_used, smin)
-            max_seen = smax if max_seen is None else max(max_seen, smax)
-            cand = self._j_oldest(smin)
-            if wm > MIN_WATERMARK:
-                cand = max(cand, self._j_fired_upto(wm) + 1)
-            fire_cursor = cand if fire_cursor is None else min(fire_cursor, cand)
-
-            new_wm = watermarks[t]
-            if new_wm > wm:
-                if fire_cursor is not None and max_seen is not None:
-                    hi = min(self._j_fired_upto(new_wm), self._j_newest(max_seen))
-                    slot = 0
-                    for j in range(fire_cursor, hi + 1):
-                        if slot >= self.F:
-                            raise ValueError(
-                                f"{hi + 1 - fire_cursor} windows fire in one "
-                                f"step > fires_per_step={self.F}"
-                            )
-                        if len(fires) >= self.R:
-                            raise ValueError(
-                                f"more than out_rows={self.R} fires per dispatch"
-                            )
-                        row = len(fires)
-                        fires.append(_PlannedFire(row, j, t))
-                        fire_pos[t, slot] = (j * self.sl) % self.S
-                        fire_valid[t, slot] = 1
-                        fire_row[t, slot] = row
-                        slot += 1
-                    if self._j_fired_upto(new_wm) >= fire_cursor:
-                        fire_cursor = self._j_fired_upto(new_wm) + 1
-                new_min_live = self._min_live_slice(new_wm)
-                if min_used is not None:
-                    lo = min_used if purged_to is None else max(purged_to, min_used)
-                    hi_p = min(new_min_live, max_seen + 1)
-                    if hi_p - lo >= self.S:
-                        purge_mask[t, :] = 0
-                    elif hi_p > lo:
-                        dead = (np.arange(lo, hi_p) % self.S).astype(np.int64)
-                        purge_mask[t, dead] = 0
-                purged_to = new_min_live if purged_to is None else max(purged_to, new_min_live)
-                wm = new_wm
-
-        self.watermark = wm
-        self.fire_cursor = fire_cursor
-        self.purged_to = purged_to
-        self.min_used_slice = min_used
-        self.max_seen_slice = max_seen
+            cur.advance(t, watermarks[t], fire_pos, fire_valid, fire_row,
+                        purge_mask, fires)
+        cur.commit()
 
         plan = (
             jax.device_put(smin_pos),
