@@ -84,9 +84,7 @@ class StreamExecutionEnvironment:
 
         if not self._sinks:
             raise RuntimeError("No sinks defined; nothing to execute")
-        if len(self._sinks) > 1:
-            raise NotImplementedError("multiple sinks per job arrive with multi-topology support")
-        graph = plan(self._sinks[0])
+        graph = plan(self._sinks)
         executor = LocalPipelineExecutor(self.config)
         return executor.execute(graph, job_name or self.config.get(PipelineOptions.NAME))
 
@@ -171,6 +169,33 @@ class DataStream:
             },
         )
 
+    # -- multi-input topologies (DataStream.java:111) ----------------------
+    def union(self, *others: "DataStream") -> "DataStream":
+        """Merge streams of the same type; watermarks min-combine across the
+        inputs (DataStream.union / UnionTransformation)."""
+        if not others:
+            return self
+        t = Transformation(
+            "union", "union",
+            [self.transform] + [o.transform for o in others], {},
+        )
+        return DataStream(self.env, t)
+
+    def connect(self, other: "DataStream") -> "ConnectedStreams":
+        """Pair two streams for co-processing with shared state
+        (DataStream.connect / ConnectedStreams)."""
+        return ConnectedStreams(self.env, self, other)
+
+    def join(self, other: "DataStream") -> "JoinBuilder":
+        """Keyed windowed join (JoinedStreams.java:101):
+        a.join(b).where(ks_a).equal_to(ks_b).window(assigner).apply(fn)."""
+        return JoinBuilder(self.env, self, other, cogroup=False)
+
+    def co_group(self, other: "DataStream") -> "JoinBuilder":
+        """Keyed windowed coGroup (CoGroupedStreams.java): apply(fn) receives
+        (left_elements, right_elements) once per key x window."""
+        return JoinBuilder(self.env, self, other, cogroup=True)
+
     # -- partitioning ------------------------------------------------------
     def key_by(self, key_selector: Callable, name: str = "key_by",
                vectorized: bool = False) -> "KeyedStream":
@@ -210,6 +235,94 @@ class DataStreamSink:
     def uid(self, uid: str) -> "DataStreamSink":
         self.transform.uid = uid
         return self
+
+
+class ConnectedStreams:
+    """Two paired streams (ConnectedStreams.java): co-transforms see both
+    inputs; keyed variants share per-key state across the two inputs."""
+
+    def __init__(self, env: StreamExecutionEnvironment,
+                 first: DataStream, second: DataStream):
+        self.env = env
+        self.first = first
+        self.second = second
+
+    def map(self, fn1: Callable, fn2: Callable, name: str = "co_map") -> DataStream:
+        t = Transformation(
+            "co_map", name, [self.first.transform, self.second.transform],
+            {"fn1": fn1, "fn2": fn2},
+        )
+        return DataStream(self.env, t)
+
+    def flat_map(self, fn1: Callable, fn2: Callable,
+                 name: str = "co_flat_map") -> DataStream:
+        t = Transformation(
+            "co_flat_map", name, [self.first.transform, self.second.transform],
+            {"fn1": fn1, "fn2": fn2},
+        )
+        return DataStream(self.env, t)
+
+    def key_by(self, key_selector1: Callable, key_selector2: Callable) -> "ConnectedStreams":
+        """Key both inputs; a subsequent process() shares keyed state/timers
+        across the two inputs (the point of connect over union)."""
+        cs = ConnectedStreams(self.env, self.first, self.second)
+        cs._ks = (as_key_selector(key_selector1), as_key_selector(key_selector2))
+        return cs
+
+    def process(self, co_process_fn, name: str = "co_process") -> DataStream:
+        """KeyedCoProcessFunction: process_element1/process_element2 (+
+        optional on_timer) with shared per-key state."""
+        ks = getattr(self, "_ks", None)
+        if ks is None:
+            raise ValueError("connect(...).process requires key_by(ks1, ks2)")
+        t = Transformation(
+            "co_process", name, [self.first.transform, self.second.transform],
+            {"process_fn": co_process_fn,
+             "key_selector1": ks[0], "key_selector2": ks[1]},
+        )
+        return DataStream(self.env, t)
+
+
+class JoinBuilder:
+    """where/equalTo/window/apply builder for joins and coGroups
+    (JoinedStreams.java:101, CoGroupedStreams.java)."""
+
+    def __init__(self, env, first: DataStream, second: DataStream, cogroup: bool):
+        self.env = env
+        self.first = first
+        self.second = second
+        self.cogroup = cogroup
+        self._ks1: Optional[Callable] = None
+        self._ks2: Optional[Callable] = None
+        self._assigner: Optional[WindowAssigner] = None
+
+    def where(self, key_selector: Callable) -> "JoinBuilder":
+        self._ks1 = as_key_selector(key_selector)
+        return self
+
+    def equal_to(self, key_selector: Callable) -> "JoinBuilder":
+        self._ks2 = as_key_selector(key_selector)
+        return self
+
+    def window(self, assigner: WindowAssigner) -> "JoinBuilder":
+        self._assigner = assigner
+        return self
+
+    def apply(self, fn: Callable, name: Optional[str] = None) -> DataStream:
+        """Join: fn(left, right) per matching pair. CoGroup: fn(lefts,
+        rights) once per key x window."""
+        if self._ks1 is None or self._ks2 is None:
+            raise ValueError("join requires where(...) and equal_to(...)")
+        if self._assigner is None:
+            raise ValueError("join requires a window(...) assigner")
+        kind = "co_group" if self.cogroup else "window_join"
+        t = Transformation(
+            kind, name or kind,
+            [self.first.transform, self.second.transform],
+            {"key_selector1": self._ks1, "key_selector2": self._ks2,
+             "assigner": self._assigner, "join_fn": fn},
+        )
+        return DataStream(self.env, t)
 
 
 class KeyedStream(DataStream):

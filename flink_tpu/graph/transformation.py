@@ -52,6 +52,18 @@ class Transformation:
 REDISTRIBUTING = {"key_by", "rebalance", "broadcast", "rescale", "global"}
 
 
+# record-local kinds fusable into one chain step
+CHAINABLE = {"map", "map_ts", "map_batch", "flat_map", "filter", "process"}
+
+# single-input stateful/boundary terminals
+TERMINALS = {
+    "window_aggregate", "reduce", "sink", "process_keyed", "async_map", "cep",
+}
+
+# multi-input terminals (DataStream.java:111 union/connect/join surface)
+MULTI_TERMINALS = {"union", "co_map", "co_flat_map", "co_process", "window_join", "co_group"}
+
+
 @dataclasses.dataclass
 class Step:
     """A fused pipeline stage (the reference's operator chain /
@@ -59,14 +71,19 @@ class Step:
 
     `chain` is the list of record-local transformations (map/flatMap/filter)
     fused into one program; `terminal` is the stage's stateful/boundary op
-    (window aggregate, sink) if any; `partitioning` describes how records
-    enter this step ('forward' or 'key_group')."""
+    (window aggregate, co-process, join, sink ...) if any; `partitioning`
+    describes how records enter this step ('forward' or 'key_group');
+    `inputs` lists (producer, ordinal) pairs, where a producer is either an
+    upstream Step or a source Transformation and the ordinal selects the
+    input gate at a multi-input operator (valves min-combine watermarks per
+    gate, StatusWatermarkValve.java analogue)."""
 
     chain: List[Transformation]
     terminal: Optional[Transformation]
     partitioning: str
     key_selector: Optional[Callable] = None
     upstream: Optional["Step"] = None
+    inputs: List = dataclasses.field(default_factory=list)
 
     @property
     def name(self) -> str:
@@ -84,39 +101,76 @@ class Step:
 
 @dataclasses.dataclass
 class StepGraph:
-    """Physical plan: linear pipeline of steps (fan-in/fan-out beyond union
-    is represented as multiple sources feeding one step)."""
+    """Physical plan: a DAG of steps. `sources` are the source
+    transformations feeding entry steps; `steps` is in topological order."""
 
-    source: Transformation
+    sources: List[Transformation]
     steps: List[Step]
 
+    @property
+    def source(self) -> Transformation:
+        """Single-source view (legacy callers of linear pipelines)."""
+        return self.sources[0]
+
     def describe(self) -> str:
-        lines = [f"source: {self.source.name}"]
+        lines = [f"source: {s.name}" for s in self.sources]
         for i, s in enumerate(self.steps):
-            lines.append(f"step[{i}] ({s.partitioning}): {s.name}")
+            ins = ",".join(
+                (f"src:{e.name}" if isinstance(e, Transformation) else f"step:{e.name}")
+                + f"@{o}"
+                for e, o in s.inputs
+            )
+            lines.append(f"step[{i}] ({s.partitioning}) [{ins}]: {s.name}")
         return "\n".join(lines)
 
 
-def plan(sink_transform: Transformation) -> StepGraph:
-    """Translate the transformation DAG rooted at `sink_transform` into a
-    StepGraph: walk source→sink, fusing chainable ops, cutting at keyBy.
+def plan(sink_transforms) -> StepGraph:
+    """Translate the transformation DAG rooted at the sink(s) into a
+    StepGraph: topological walk fusing chainable runs, cutting at keyBy and
+    at every multi-input or multi-consumer boundary.
 
     Mirrors StreamGraphGenerator.generate:253 + createJobGraph chaining in
-    one pass (chains = fused steps; shuffles = key_group exchanges).
-    """
-    # linearize (v0 supports linear topologies + union at source side)
+    one pass (chains = fused steps; shuffles = key_group exchanges)."""
+    sinks = ([sink_transforms] if isinstance(sink_transforms, Transformation)
+             else list(sink_transforms))
+
+    # collect nodes + per-edge consumer counts
+    consumers: Dict[int, int] = {}
+    nodes: Dict[int, Transformation] = {}
+    stack = list(sinks)
+    while stack:
+        n = stack.pop()
+        if n.id in nodes:
+            continue
+        nodes[n.id] = n
+        for i in n.inputs:
+            consumers[i.id] = consumers.get(i.id, 0) + 1
+            stack.append(i)
+
+    # topological order (sources first), deterministic by node id; explicit
+    # stack so thousand-op chains don't hit the recursion limit
     order: List[Transformation] = []
-    node = sink_transform
-    while True:
-        order.append(node)
-        if not node.inputs:
-            break
-        if len(node.inputs) > 1:
-            raise NotImplementedError("multi-input topologies arrive with connect/join support")
-        node = node.inputs[0]
-    order.reverse()
-    if order[0].kind != "source":
-        raise ValueError("pipeline must start at a source")
+    state: Dict[int, int] = {}
+    for s in sorted(sinks, key=lambda t: t.id):
+        work = [(s, False)]
+        while work:
+            n, expanded = work.pop()
+            if expanded:
+                state[n.id] = 2
+                order.append(n)
+                continue
+            if state.get(n.id) == 2:
+                continue
+            if state.get(n.id) == 1:
+                raise ValueError("transformation graph has a cycle")
+            state[n.id] = 1
+            work.append((n, True))
+            # reversed: LIFO pop then visits inputs in declaration order,
+            # matching the recursive traversal (source order is user-visible
+            # through the run loop's round-robin)
+            for i in reversed(n.inputs):
+                if state.get(i.id) != 2:
+                    work.append((i, False))
 
     # stabilize auto-generated uids by topological position so state restores
     # across identically-built pipelines (users set .uid() for evolving jobs,
@@ -125,47 +179,78 @@ def plan(sink_transform: Transformation) -> StepGraph:
         if t.uid == f"{t.kind}-{t.id}":
             t.uid = f"{t.kind}@{pos}"
 
-    source = order[0]
+    sources: List[Transformation] = []
     steps: List[Step] = []
-    chain: List[Transformation] = []
-    partitioning = "forward"
-    key_selector = None
+    # producer[node.id] = source Transformation | Step whose output carries
+    # the node's records; keyed[node.id] = key_by config for keyed views
+    producer: Dict[int, Any] = {}
+    keyed: Dict[int, Dict[str, Any]] = {}
 
-    def cut(terminal: Optional[Transformation]):
-        nonlocal chain, partitioning, key_selector
-        steps.append(
-            Step(
-                chain=chain,
-                terminal=terminal,
-                partitioning=partitioning,
-                key_selector=key_selector,
-                upstream=steps[-1] if steps else None,
-            )
-        )
-        chain = []
-        partitioning = "forward"
-        key_selector = None
+    def new_step(**kw) -> Step:
+        s = Step(**kw)
+        steps.append(s)
+        return s
 
-    for t in order[1:]:
-        if t.kind in ("map", "map_ts", "map_batch", "flat_map", "filter", "process"):
-            chain.append(t)
+    def input_of(t: Transformation, inp: Transformation, ordinal: int):
+        """(producer, ordinal, partitioning, key_selector) for one edge."""
+        ent = producer[inp.id]
+        if inp.id in keyed:
+            k = keyed[inp.id]
+            return ent, ordinal, "key_group", k["key_selector"]
+        return ent, ordinal, "forward", None
+
+    for t in order:
+        if t.kind == "source":
+            sources.append(t)
+            producer[t.id] = t
         elif t.kind == "key_by":
-            # repartition point: close current chain as a stateless step if
-            # nonempty, then start the keyed step
-            if chain:
-                cut(None)
-            partitioning = "key_group"
-            key_selector = t.config["key_selector"]
-        elif t.kind in (
-            "window_aggregate", "reduce", "sink", "process_keyed", "async_map", "cep",
-        ):
-            cut(t)
+            producer[t.id] = producer[t.inputs[0].id]
+            keyed[t.id] = t.config  # re-keying: the newest selector wins
+        elif t.kind in CHAINABLE:
+            inp = t.inputs[0]
+            ent = producer[inp.id]
+            if (
+                isinstance(ent, Step)
+                and ent.terminal is None
+                and consumers.get(inp.id, 0) == 1
+                and inp.id not in keyed
+                and ent.chain
+                and ent.chain[-1].id == inp.id
+            ):
+                ent.chain.append(t)          # fuse into the open chain
+                producer[t.id] = ent
+            else:
+                ent2, _o, part, ks = input_of(t, inp, 0)
+                producer[t.id] = new_step(
+                    chain=[t], terminal=None, partitioning=part,
+                    key_selector=ks, inputs=[(ent2, 0)],
+                )
+        elif t.kind in TERMINALS:
+            inp = t.inputs[0]
+            ent, _o, part, ks = input_of(t, inp, 0)
+            producer[t.id] = new_step(
+                chain=[], terminal=t, partitioning=part,
+                key_selector=ks, inputs=[(ent, 0)],
+            )
+        elif t.kind in MULTI_TERMINALS:
+            ins = []
+            part = "forward"
+            ks = None
+            for o, inp in enumerate(t.inputs):
+                ent, _o, p, k = input_of(t, inp, o)
+                ins.append((ent, o))
+                if p == "key_group":
+                    part, ks = p, (ks or k)
+            producer[t.id] = new_step(
+                chain=[], terminal=t, partitioning=part,
+                key_selector=ks, inputs=ins,
+            )
         elif t.kind in REDISTRIBUTING:
-            if chain:
-                cut(None)
-            partitioning = "rebalance"
+            # explicit repartition hints; locally a pass-through view
+            producer[t.id] = producer[t.inputs[0].id]
         else:
             raise NotImplementedError(f"transformation kind {t.kind}")
-    if chain:
-        cut(None)
-    return StepGraph(source=source, steps=steps)
+
+    if not sources:
+        raise ValueError("pipeline must start at a source")
+    return StepGraph(sources=sources, steps=steps)

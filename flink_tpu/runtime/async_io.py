@@ -153,9 +153,23 @@ class AsyncExecutor:
 
 
 class AsyncMapRunner:
-    """Step runner for DataStream.async_map (built by the executor)."""
+    """Step runner for DataStream.async_map (built by the executor).
+
+    Duck-typed to executor.StepRunner (imported lazily there to avoid a
+    module cycle); the input-gate shims below keep it wireable in the
+    runner DAG."""
 
     downstream = None
+    num_inputs = 1
+
+    def on_batch_n(self, ordinal, values, timestamps):
+        self.on_batch(values, timestamps)
+
+    def on_watermark_n(self, ordinal, watermark):
+        self.on_watermark(watermark)
+
+    def on_end_n(self, ordinal):
+        self.on_end()
 
     def __init__(self, transform, _config):
         cfg = transform.config

@@ -51,13 +51,71 @@ class JobExecutionResult:
 # step runners (push-based; each pushes into `downstream`)
 # ---------------------------------------------------------------------------
 
+class _FanOut:
+    """Downstream edge set of one runner. Runners emit through
+    `self.downstream` exactly as in a linear pipeline; the fan-out routes to
+    every consumer's input gate (ordinal), which is how one runner feeds
+    multiple sinks and how two-input operators distinguish their sides."""
+
+    __slots__ = ("edges",)
+
+    def __init__(self):
+        self.edges: List = []   # (runner, input_ordinal)
+
+    def __bool__(self) -> bool:
+        return bool(self.edges)
+
+    def add(self, runner: "StepRunner", ordinal: int) -> None:
+        self.edges.append((runner, ordinal))
+
+    def on_batch(self, values: np.ndarray, timestamps: np.ndarray) -> None:
+        for r, o in self.edges:
+            r.on_batch_n(o, values, timestamps)
+
+    def on_watermark(self, watermark: int) -> None:
+        for r, o in self.edges:
+            r.on_watermark_n(o, watermark)
+
+    def on_end(self) -> None:
+        for r, o in self.edges:
+            r.on_end_n(o)
+
+
 class StepRunner:
-    downstream: Optional["StepRunner"] = None
+    downstream: Optional[_FanOut] = None
+    num_inputs: int = 1
 
     def register_metrics(self, group) -> None:
         # operator-scope IO metrics (TaskIOMetricGroup.java:48 analogue)
         self.records_in_counter = group.counter("numRecordsIn")
 
+    # -- input-gate protocol (multi-input valve) --------------------------
+    def on_batch_n(self, ordinal: int, values: np.ndarray,
+                   timestamps: np.ndarray) -> None:
+        self.on_batch(values, timestamps)
+
+    def on_watermark_n(self, ordinal: int, watermark: int) -> None:
+        """Per-gate watermark: min-combine across gates before processing
+        (StatusWatermarkValve.java semantics)."""
+        if self.num_inputs <= 1:
+            self.on_watermark(watermark)
+            return
+        wms = self.__dict__.setdefault("_gate_wms", {})
+        wms[ordinal] = max(wms.get(ordinal, MIN_WATERMARK), watermark)
+        if len(wms) < self.num_inputs:
+            return
+        combined = min(wms.values())
+        if combined > self.__dict__.get("_combined_wm", MIN_WATERMARK):
+            self.__dict__["_combined_wm"] = combined
+            self.on_watermark(combined)
+
+    def on_end_n(self, ordinal: int) -> None:
+        ended = self.__dict__.setdefault("_ended_gates", set())
+        ended.add(ordinal)
+        if len(ended) >= self.num_inputs:
+            self.on_end()
+
+    # -- processing -------------------------------------------------------
     def on_batch(self, values: np.ndarray, timestamps: np.ndarray) -> None:
         raise NotImplementedError
 
@@ -382,9 +440,13 @@ class KeyedProcessRunner(StepRunner):
     def __init__(self, step: Step, config: Configuration):
         t = step.terminal
         self.key_selector = t.config["key_selector"]
+        self._init_keyed(t, config)
+
+    def _init_keyed(self, t: Transformation, config: Configuration) -> None:
         self.fn: ProcessFunction = t.config["process_fn"]
         max_par = config.get(PipelineOptions.MAX_PARALLELISM)
-        self.state = HeapKeyedStateBackend(KeyGroupRange(0, max_par - 1), max_par)
+        self.state = HeapKeyedStateBackend(
+            KeyGroupRange(0, max_par - 1), max_par, auto_register=True)
         self.timers = InternalTimerService(self._on_event_timer, lambda *a: None)
         self._out: List = []
         self._out_ts: List[int] = []
@@ -410,7 +472,10 @@ class KeyedProcessRunner(StepRunner):
 
     def _on_event_timer(self, time, key, _ns) -> None:
         self.state.set_current_key(key)
-        for out in self.fn.on_timer(time, self._ctx(key, time)):
+        on_timer = getattr(self.fn, "on_timer", None)
+        if on_timer is None:
+            return
+        for out in on_timer(time, self._ctx(key, time)):
             self._out.append(out)
             self._out_ts.append(time)
 
@@ -474,6 +539,163 @@ class CepRunner(StepRunner):
         self.op.restore(snap["operator"])
 
 
+class UnionRunner(StepRunner):
+    """N-way stream union: batches pass through; the base-class valve
+    min-combines the input watermarks (DataStream.union, UnionTransformation
+    — the reference wires union as extra input edges; here an explicit
+    pass-through gate keeps the valve bookkeeping in one place)."""
+
+    def __init__(self, step: Step):
+        self.num_inputs = len(step.inputs)
+        self.uid = step.terminal.uid
+
+    def on_batch(self, values: np.ndarray, timestamps: np.ndarray) -> None:
+        if self.downstream:
+            self.downstream.on_batch(values, timestamps)
+
+
+class CoMapRunner(StepRunner):
+    """Non-keyed connected-stream transform: fn1 on input 0, fn2 on input 1
+    (ConnectedStreams.map/flatMap, CoStreamMap/CoStreamFlatMap analogue)."""
+
+    num_inputs = 2
+
+    def __init__(self, step: Step):
+        t = step.terminal
+        self.fns = (t.config["fn1"], t.config["fn2"])
+        self.flat = t.kind == "co_flat_map"
+        self.uid = t.uid
+
+    def on_batch_n(self, ordinal: int, values, timestamps) -> None:
+        fn = self.fns[ordinal]
+        ts = np.asarray(timestamps, dtype=np.int64)
+        if self.flat:
+            out, out_ts = [], []
+            for v, tt in zip(values, ts):
+                for o in fn(v):
+                    out.append(o)
+                    out_ts.append(int(tt))
+            if out and self.downstream:
+                self.downstream.on_batch(
+                    obj_array(out), np.asarray(out_ts, dtype=np.int64))
+        else:
+            if len(ts) and self.downstream:
+                self.downstream.on_batch(obj_array([fn(v) for v in values]), ts)
+
+    def on_batch(self, values, timestamps) -> None:  # pragma: no cover
+        raise AssertionError("CoMapRunner consumes via input gates")
+
+
+class KeyedCoProcessRunner(KeyedProcessRunner):
+    """Keyed two-input process function with shared per-key state and
+    event-time timers (KeyedCoProcessFunction / CoProcessOperator analogue:
+    both inputs key into the SAME state backend, which is the whole point of
+    connect() vs union()). Inherits context/timer/flush/snapshot machinery
+    from KeyedProcessRunner; only the two-gate dispatch differs."""
+
+    num_inputs = 2
+
+    def __init__(self, step: Step, config: Configuration):
+        t = step.terminal
+        self.key_selectors = (t.config["key_selector1"], t.config["key_selector2"])
+        self._init_keyed(t, config)
+
+    def on_batch_n(self, ordinal: int, values, timestamps) -> None:
+        ks = self.key_selectors[ordinal]
+        process = (self.fn.process_element1 if ordinal == 0
+                   else self.fn.process_element2)
+        for v, ts in zip(values, np.asarray(timestamps, dtype=np.int64)):
+            key = ks(v)
+            self.state.set_current_key(key)
+            for out in process(v, self._ctx(key, int(ts))):
+                self._out.append(out)
+                self._out_ts.append(int(ts))
+        self._flush()
+
+    def on_batch(self, values, timestamps) -> None:  # pragma: no cover
+        raise AssertionError("KeyedCoProcessRunner consumes via input gates")
+
+
+class WindowJoinRunner(StepRunner):
+    """Keyed event-time window join / coGroup.
+
+    The reference implements join as coGroup over tagged inputs flowing into
+    one WindowOperator (JoinedStreams.java:101 'Join is implemented on top
+    of CoGroup', CoGroupedStreams.java WithWindow.apply): elements of both
+    sides buffer per (key, window); when the watermark passes the window
+    end, join emits one result per left x right pair, coGroup emits one
+    result per window from both element lists. Late elements (window already
+    fired) are dropped, matching WindowOperator.isWindowLate."""
+
+    num_inputs = 2
+
+    def __init__(self, step: Step, config: Configuration):
+        t = step.terminal
+        self.key_selectors = (t.config["key_selector1"], t.config["key_selector2"])
+        self.assigner = t.config["assigner"]
+        if not self.assigner.is_event_time:
+            raise ValueError("window joins support event-time assigners")
+        self.join_fn = t.config.get("join_fn")
+        self.cogroup = t.kind == "co_group"
+        # (key, window_start, window_end) -> ([left...], [right...])
+        self._buf: Dict[tuple, tuple] = {}
+        self._wm = MIN_WATERMARK
+        self.num_late_dropped = 0
+        self.uid = t.uid
+
+    def on_batch_n(self, ordinal: int, values, timestamps) -> None:
+        ks = self.key_selectors[ordinal]
+        for v, ts in zip(values, np.asarray(timestamps, dtype=np.int64)):
+            key = ks(v)
+            for w in self.assigner.assign_windows(v, int(ts)):
+                if w.end - 1 <= self._wm:
+                    self.num_late_dropped += 1
+                    continue
+                sides = self._buf.get((key, w.start, w.end))
+                if sides is None:
+                    sides = ([], [])
+                    self._buf[(key, w.start, w.end)] = sides
+                sides[ordinal].append(v)
+
+    def on_batch(self, values, timestamps) -> None:  # pragma: no cover
+        raise AssertionError("WindowJoinRunner consumes via input gates")
+
+    def on_watermark(self, watermark: int) -> None:
+        self._wm = max(self._wm, watermark)
+        out, out_ts = [], []
+        # fire in (window end, key-insertion) order, mirroring the oracle's
+        # timer ordering
+        ripe = [k for k in self._buf if k[2] - 1 <= self._wm]
+        ripe.sort(key=lambda k: k[2])
+        for k in ripe:
+            left, right = self._buf.pop(k)
+            max_ts = k[2] - 1
+            if self.cogroup:
+                out.append(self.join_fn(left, right))
+                out_ts.append(max_ts)
+            else:
+                for lv in left:
+                    for rv in right:
+                        out.append(self.join_fn(lv, rv))
+                        out_ts.append(max_ts)
+        if out and self.downstream:
+            self.downstream.on_batch(
+                obj_array(out), np.asarray(out_ts, dtype=np.int64))
+        super().on_watermark(watermark)
+
+    def snapshot(self) -> dict:
+        return {
+            "buf": {k: (list(l), list(r)) for k, (l, r) in self._buf.items()},
+            "wm": self._wm,
+            "late": self.num_late_dropped,
+        }
+
+    def restore(self, snap: dict) -> None:
+        self._buf = {k: (list(l), list(r)) for k, (l, r) in snap["buf"].items()}
+        self._wm = snap["wm"]
+        self.num_late_dropped = snap["late"]
+
+
 class SinkRunner(StepRunner):
     def __init__(self, step: Step):
         sink = step.terminal.config["sink"]
@@ -493,34 +715,63 @@ class SinkRunner(StepRunner):
         self.writer.close()
 
 
-def build_runners(graph: StepGraph, config: Configuration) -> List[StepRunner]:
+def _make_runner(step: Step, config: Configuration) -> StepRunner:
+    if step.terminal is None:
+        return ChainRunner(step.chain)
+    kind = step.terminal.kind
+    if kind == "window_aggregate":
+        return WindowStepRunner(step, config)
+    if kind == "reduce":
+        return KeyedReduceRunner(step, config)
+    if kind == "process_keyed":
+        return KeyedProcessRunner(step, config)
+    if kind == "async_map":
+        from flink_tpu.runtime.async_io import AsyncMapRunner
+
+        return AsyncMapRunner(step.terminal, config)
+    if kind == "cep":
+        return CepRunner(step, config)
+    if kind == "sink":
+        return SinkRunner(step)
+    if kind == "union":
+        return UnionRunner(step)
+    if kind in ("co_map", "co_flat_map"):
+        return CoMapRunner(step)
+    if kind == "co_process":
+        return KeyedCoProcessRunner(step, config)
+    if kind in ("window_join", "co_group"):
+        return WindowJoinRunner(step, config)
+    raise NotImplementedError(kind)
+
+
+def build_runners(graph: StepGraph, config: Configuration):
+    """Build the runner DAG: one runner per step, fan-out edges wired by
+    input ordinal. Returns (runners in topo order, source feed map
+    {source_transformation_id: [(entry_runner, ordinal)]})."""
+    runner_of: Dict[int, StepRunner] = {}
     runners: List[StepRunner] = []
     for step in graph.steps:
-        if step.terminal is None:
-            runners.append(ChainRunner(step.chain))
-            continue
-        kind = step.terminal.kind
-        if step.chain:
-            runners.append(ChainRunner(step.chain))
-        if kind == "window_aggregate":
-            runners.append(WindowStepRunner(step, config))
-        elif kind == "reduce":
-            runners.append(KeyedReduceRunner(step, config))
-        elif kind == "process_keyed":
-            runners.append(KeyedProcessRunner(step, config))
-        elif kind == "async_map":
-            from flink_tpu.runtime.async_io import AsyncMapRunner
+        r = _make_runner(step, config)
+        if len(step.inputs) > 1:
+            r.num_inputs = len(step.inputs)
+        runner_of[id(step)] = r
+        runners.append(r)
 
-            runners.append(AsyncMapRunner(step.terminal, config))
-        elif kind == "cep":
-            runners.append(CepRunner(step, config))
-        elif kind == "sink":
-            runners.append(SinkRunner(step))
-        else:
-            raise NotImplementedError(kind)
-    for up, down in zip(runners, runners[1:]):
-        up.downstream = down
-    return runners
+    feeds: Dict[int, List] = {}
+    for step in graph.steps:
+        r = runner_of[id(step)]
+        for entity, ordinal in step.inputs:
+            if isinstance(entity, Transformation):       # a source feeds this
+                feeds.setdefault(entity.id, []).append((r, ordinal))
+            else:
+                up = runner_of[id(entity)]
+                if up.downstream is None:
+                    up.downstream = _FanOut()
+                up.downstream.add(r, ordinal)
+    for r in runners:
+        if r.downstream is None:
+            r.downstream = _FanOut()
+    return runners, feeds
 
 
 def register_runner_metrics(runners: List[StepRunner], registry: MetricRegistry) -> None:
@@ -539,22 +790,71 @@ class JobRuntime:
     checkpoint-capture/restore surface (task-side checkpointing, §3.4
     analogue — here capture happens between steps so alignment is free)."""
 
+    class _SourceDriver:
+        """One source's read state: enumerator/reader/watermark generator
+        plus the entry gates it feeds (SourceOperator analogue)."""
+
+        def __init__(self, transform: Transformation, feeds: List):
+            cfg = transform.config
+            self.uid = transform.uid
+            self.source = cfg["source"]
+            strategy: Optional[WatermarkStrategy] = cfg.get("watermark_strategy")
+            self.generator = strategy.create_generator() if strategy else None
+            self.assigner = strategy.timestamp_assigner if strategy else None
+            self.enumerator = self.source.create_enumerator()
+            self.reader = self.source.create_reader()
+            self.current_split = None
+            self.done = False
+            self.finished_signalled = False
+            self.feeds = feeds              # [(runner, ordinal)]
+
+        def emit_batch(self, values, ts) -> None:
+            for r, o in self.feeds:
+                r.on_batch_n(o, values, ts)
+
+        def emit_watermark(self, wm: int) -> None:
+            for r, o in self.feeds:
+                r.on_watermark_n(o, wm)
+
+        def finish(self) -> None:
+            """End of this source: flush its contribution to every valve and
+            close its gates (idempotent)."""
+            if self.finished_signalled:
+                return
+            self.finished_signalled = True
+            self.emit_watermark(MAX_WATERMARK - 1)
+            for r, o in self.feeds:
+                r.on_end_n(o)
+
+        def snapshot(self) -> dict:
+            return {
+                "pending_splits": self.enumerator.snapshot(),
+                "current_split": self.current_split,
+                "reader_position": self.reader.snapshot_position(),
+                "done": self.done,
+                "generator": self.generator.snapshot() if self.generator else None,
+            }
+
+        def restore(self, snap: dict) -> None:
+            self.enumerator.restore(snap["pending_splits"])
+            self.current_split = snap["current_split"]
+            self.done = snap["done"]
+            if self.current_split is not None:
+                self.reader.add_split(self.current_split)
+                self.reader.restore_position(snap["reader_position"])
+            if self.generator is not None and snap.get("generator") is not None:
+                self.generator.restore(snap["generator"])
+
     def __init__(self, graph: StepGraph, config: Configuration,
                  registry: Optional[MetricRegistry] = None):
         self.graph = graph
         self.config = config
-        source_cfg = graph.source.config
-        self.source = source_cfg["source"]
-        strategy: Optional[WatermarkStrategy] = source_cfg.get("watermark_strategy")
-        self.generator = strategy.create_generator() if strategy else None
-        self.assigner = strategy.timestamp_assigner if strategy else None
-        self.runners = build_runners(graph, config)
-        self.head = self.runners[0]
-        self.enumerator = self.source.create_enumerator()
-        self.reader = self.source.create_reader()
-        self.current_split = None
+        self.runners, feeds = build_runners(graph, config)
+        self.sources = [
+            JobRuntime._SourceDriver(t, feeds.get(t.id, []))
+            for t in graph.sources
+        ]
         self.records_in = 0
-        self.source_done = False
         # observability (O1/O3): job-scope throughput, busy-ratio, step latency
         self.registry = registry or MetricRegistry()
         register_runner_metrics(self.runners, self.registry)
@@ -574,27 +874,21 @@ class JobRuntime:
             if snap:
                 runner_snaps[getattr(r, "uid", f"runner-{id(r)}")] = snap
         return {
-            "source": {
-                "pending_splits": self.enumerator.snapshot(),
-                "current_split": self.current_split,
-                "reader_position": self.reader.snapshot_position(),
-                "done": self.source_done,
-            },
-            "generator": self.generator.snapshot() if self.generator else None,
+            "sources": {d.uid: d.snapshot() for d in self.sources},
             "runners": runner_snaps,
             "records_in": self.records_in,
         }
 
     def restore(self, snap: dict) -> None:
-        src = snap["source"]
-        self.enumerator.restore(src["pending_splits"])
-        self.current_split = src["current_split"]
-        self.source_done = src["done"]
-        if self.current_split is not None:
-            self.reader.add_split(self.current_split)
-            self.reader.restore_position(src["reader_position"])
-        if self.generator is not None and snap["generator"] is not None:
-            self.generator.restore(snap["generator"])
+        if "sources" in snap:
+            for d in self.sources:
+                if d.uid in snap["sources"]:
+                    d.restore(snap["sources"][d.uid])
+        else:
+            # single-source snapshot from the pre-DAG layout
+            legacy = dict(snap["source"])
+            legacy["generator"] = snap.get("generator")
+            self.sources[0].restore(legacy)
         for r in self.runners:
             uid = getattr(r, "uid", None)
             if uid is not None and uid in snap["runners"]:
@@ -616,63 +910,81 @@ class JobRuntime:
         batch_size = self.config.get(ExecutionOptions.BATCH_SIZE)
         if coordinator is not None:
             coordinator.register_on_complete(self.commit_sinks)
-        if self.current_split is None and not self.source_done:
-            self.current_split = self.enumerator.next_split()
-            if self.current_split is not None:
-                self.reader.add_split(self.current_split)
-            else:
-                self.source_done = True
+        for d in self.sources:
+            if d.current_split is None and not d.done:
+                d.current_split = d.enumerator.next_split()
+                if d.current_split is not None:
+                    d.reader.add_split(d.current_split)
+                else:
+                    d.done = True
+            if d.done:
+                # zero-split or restored-as-done sources must still flush
+                # their watermark/end contribution, or every multi-input
+                # valve downstream stalls for the whole run
+                d.finish()
 
-        while not self.source_done:
-            loop_t0 = time.perf_counter()
-            if cancel_check is not None and cancel_check():
-                raise JobCancelledException()
-            batch = self.reader.poll_batch(batch_size)
-            if batch is None:
-                self.current_split = self.enumerator.next_split()
-                if self.current_split is None:
-                    self.source_done = True
-                    break
-                self.reader.add_split(self.current_split)
+        # round-robin over sources, one batch per turn; checkpoints align at
+        # batch boundaries regardless of which source produced the batch
+        while any(not d.done for d in self.sources):
+            for d in self.sources:
+                if d.done:
+                    continue
+                loop_t0 = time.perf_counter()
+                if cancel_check is not None and cancel_check():
+                    raise JobCancelledException()
+                batch = d.reader.poll_batch(batch_size)
+                if batch is None:
+                    d.current_split = d.enumerator.next_split()
+                    if d.current_split is None:
+                        d.done = True
+                        # a finished source must not hold back the combined
+                        # watermark of still-running inputs
+                        busy_t0 = time.perf_counter()
+                        d.finish()
+                        self._busy_time += time.perf_counter() - busy_t0
+                    else:
+                        d.reader.add_split(d.current_split)
+                    self._loop_time += time.perf_counter() - loop_t0
+                    continue
+                values = batch.values
+                ts = batch.timestamps
+                if d.assigner is not None:
+                    ts = np.asarray(
+                        [d.assigner(v, int(t)) for v, t in zip(values, ts)],
+                        dtype=np.int64,
+                    )
+                self.records_in += len(batch)
+                self.records_meter.mark(len(batch))
+                busy_t0 = time.perf_counter()
+                d.emit_batch(values, ts)
+                if d.generator is not None:
+                    wm = (
+                        d.generator.on_batch_np(ts)
+                        if hasattr(d.generator, "on_batch_np")
+                        else None
+                    )
+                    if wm is None:
+                        for v, t in zip(values, ts):
+                            d.generator.on_event(v, int(t))
+                        wm = d.generator.on_periodic_emit()
+                    if wm is not None and wm > MIN_WATERMARK:
+                        d.emit_watermark(wm)
+                step_dt = time.perf_counter() - busy_t0
+                self._busy_time += step_dt
+                self.step_latency.update(step_dt * 1000)
+                # step boundary: checkpoints/savepoints align here for free
+                if coordinator is not None:
+                    coordinator.maybe_trigger(self.capture)
+                if savepoint_request is not None:
+                    path = savepoint_request()
+                    if path is not None:
+                        self._write_savepoint(path)
                 self._loop_time += time.perf_counter() - loop_t0
-                continue
-            values = batch.values
-            ts = batch.timestamps
-            if self.assigner is not None:
-                ts = np.asarray(
-                    [self.assigner(v, int(t)) for v, t in zip(values, ts)], dtype=np.int64
-                )
-            self.records_in += len(batch)
-            self.records_meter.mark(len(batch))
-            busy_t0 = time.perf_counter()
-            self.head.on_batch(values, ts)
-            if self.generator is not None:
-                wm = (
-                    self.generator.on_batch_np(ts)
-                    if hasattr(self.generator, "on_batch_np")
-                    else None
-                )
-                if wm is None:
-                    for v, t in zip(values, ts):
-                        self.generator.on_event(v, int(t))
-                    wm = self.generator.on_periodic_emit()
-                if wm is not None and wm > MIN_WATERMARK:
-                    self.head.on_watermark(wm)
-            step_dt = time.perf_counter() - busy_t0
-            self._busy_time += step_dt
-            self.step_latency.update(step_dt * 1000)
-            # step boundary: checkpoints/savepoints align here for free
-            if coordinator is not None:
-                coordinator.maybe_trigger(self.capture)
-            if savepoint_request is not None:
-                path = savepoint_request()
-                if path is not None:
-                    self._write_savepoint(path)
-            self._loop_time += time.perf_counter() - loop_t0
 
-        # end of input: watermark jumps to +inf, firing all remaining windows
-        self.head.on_watermark(MAX_WATERMARK - 1)
-        self.head.on_end()
+        # end of input: every source's final watermark + end signal has been
+        # (or is now) delivered, firing all remaining windows downstream
+        for d in self.sources:
+            d.finish()
 
     def _write_savepoint(self, path: str) -> None:
         from flink_tpu.checkpoint.storage import FsCheckpointStorage

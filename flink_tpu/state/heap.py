@@ -60,9 +60,11 @@ class HeapKeyedStateBackend:
     InternalKvState.setCurrentNamespace.
     """
 
-    def __init__(self, key_group_range: KeyGroupRange, max_parallelism: int):
+    def __init__(self, key_group_range: KeyGroupRange, max_parallelism: int,
+                 auto_register: bool = False):
         self.key_group_range = key_group_range
         self.max_parallelism = max_parallelism
+        self.auto_register = auto_register
         self._tables: Dict[str, Dict[int, Dict[Tuple, Any]]] = {}
         self._descriptors: Dict[str, StateDescriptor] = {}
         self._current_key: Any = None
@@ -83,12 +85,23 @@ class HeapKeyedStateBackend:
 
     # -- access (key from context, namespace explicit) --------------------
     def _slot(self, name: str) -> Dict[Tuple, Any]:
-        table = self._tables[name]
+        table = self._tables.get(name)
+        if table is None:
+            if not self.auto_register:
+                raise KeyError(
+                    f"state {name!r} not registered (register a descriptor "
+                    "first, or construct the backend with auto_register=True)"
+                )
+            # dynamic registration: ProcessFunctions may declare state at
+            # first use (getState(descriptor) mid-stream in the reference)
+            self.register(value_state(name))
+            table = self._tables[name]
         return table.setdefault(self._current_key_group, {})
 
     def get(self, name: str, namespace=None):
+        slot = self._slot(name)  # may dynamically register (auto_register)
         desc = self._descriptors[name]
-        val = self._slot(name).get((self._current_key, namespace), _MISSING)
+        val = slot.get((self._current_key, namespace), _MISSING)
         if val is _MISSING:
             return copy.copy(desc.default) if desc.kind == "value" else None
         return val
@@ -98,6 +111,9 @@ class HeapKeyedStateBackend:
 
     def add(self, name: str, value, namespace=None) -> None:
         """Reducing/Aggregating/List add (HeapAggregatingState.add:94)."""
+        if name not in self._descriptors and self.auto_register:
+            # dynamic first-use via add() implies append semantics
+            self.register(list_state(name))
         desc = self._descriptors[name]
         slot = self._slot(name)
         k = (self._current_key, namespace)

@@ -37,7 +37,20 @@ class SavepointReader:
         return self.data.get("records_in", 0)
 
     def source_state(self) -> dict:
+        """State of the (single) source; for multi-source jobs returns the
+        first source's state — use source_states() for all of them."""
+        if "sources" in self.data:
+            srcs = self.data["sources"]
+            return next(iter(srcs.values())) if srcs else {}
         return self.data.get("source", {})
+
+    def source_states(self) -> Dict[str, dict]:
+        """Per-source-uid state ({uid: state}); single-source snapshots from
+        the pre-DAG layout appear under uid 'source'."""
+        if "sources" in self.data:
+            return dict(self.data["sources"])
+        legacy = self.data.get("source")
+        return {"source": legacy} if legacy else {}
 
     def _runner(self, uid: str) -> dict:
         runners = self.data.get("runners", {})
