@@ -227,6 +227,16 @@ class ExecutionOptions:
         "superbatch) for eligible event-time window aggregates; fall back to the "
         "per-step device operator when off or ineligible."
     )
+    DEVICE_SESSIONS = (
+        ConfigOptions.key("execution.window.device-sessions").bool_type().default_value(True)
+    ).with_description(
+        "Select the device session-window operator (per-slice fragments + "
+        "vectorized gap-merge) for eligible event-time session aggregates. "
+        "Its late contract drops records whose standalone session is already "
+        "expired, which matches the merging oracle only while watermark "
+        "out-of-orderness stays below the session gap — set to false to force "
+        "the per-record oracle for streams with larger disorder."
+    )
     SUPERBATCH_STEPS = (
         ConfigOptions.key("execution.window.superbatch-steps").int_type().default_value(32)
     ).with_description(

@@ -236,7 +236,7 @@ class WindowStepRunner(StepRunner):
         self._needs_value = device_agg is None or any(
             f.source != ONE for f in device_agg.fields
         )
-        from flink_tpu.api.windowing.assigners import GlobalWindows
+        from flink_tpu.api.windowing.assigners import EventTimeSessionWindows, GlobalWindows
         from flink_tpu.runtime.tpu_global_window_operator import (
             TpuGlobalWindowOperator,
             supported_trigger,
@@ -263,6 +263,30 @@ class WindowStepRunner(StepRunner):
                 count_n=n,
                 purging=purging,
                 key_capacity=config.get(ExecutionOptions.KEY_CAPACITY),
+            )
+            self.device = True
+        elif (
+            isinstance(assigner, EventTimeSessionWindows)
+            and device_agg is not None
+            and assigner.is_event_time
+            and config.get(ExecutionOptions.DEVICE_SESSIONS)
+            and cfg.get("trigger") is None
+            and cfg.get("evictor") is None
+            and self.window_fn is None
+            and cfg["allowed_lateness"] == 0
+            and not cfg["side_output_late"]
+        ):
+            # device-path sessions: per-slice fragments + vectorized
+            # gap-merge (the MergingWindowSet re-design; see
+            # runtime/tpu_session_operator.py)
+            from flink_tpu.runtime.tpu_session_operator import (
+                TpuSessionWindowOperator,
+            )
+
+            self.op = TpuSessionWindowOperator(
+                assigner,
+                device_agg,
+                key_capacity=min(1 << 10, config.get(ExecutionOptions.KEY_CAPACITY)),
             )
             self.device = True
         elif use_fused:

@@ -1,0 +1,408 @@
+"""Device-path session windows: per-slice fragments + vectorized gap-merge.
+
+The reference merges session windows per record through MergingWindowSet
+(WindowOperator.java:303-403, EventTimeSessionWindows.java): each element's
+[ts, ts+gap) window is merged with intersecting in-flight windows, state
+namespaces are merged, and the merged window's trigger fires when the
+watermark passes its end.
+
+The TPU-native re-design exploits one invariant: with slice width == gap,
+ALL events that land in the same slice belong to the same session (any two
+timestamps in a slice differ by < gap). Ingest therefore needs no merge
+logic at all — it is the same columnar scatter as the sliced aggregates,
+accumulating per-(key, slice) *fragments*:
+
+    count[k, s], min_rel[k, s], max_rel[k, s], field[k, s]...
+
+(min/max are stored slice-relative so int32 device arithmetic never
+overflows millisecond timestamps). Merging collapses to a LINEAR SCAN over
+the slice axis: fragment s+i joins the current session iff
+``min_ts(frag) - max_ts(session) < gap``; the scan is vectorized over the
+whole key dimension at once (numpy [K]-wide ops per slice column, ~S tiny
+ops per watermark instead of per-record hash-map surgery). A session is
+emitted when a later fragment proves a gap, or when the watermark passes
+``max_ts + gap - 1``; emitted cells purge, open sessions stay resident.
+
+Late contract: a record whose standalone session is already expired
+(ts + gap - 1 <= watermark) is dropped and counted, matching the oracle
+whenever the stream's out-of-orderness is below the session gap (the
+merging analogue of isWindowLate, WindowOperator.java:609). Streams with
+out-of-orderness >= gap should use the oracle operator, which implements
+the order-dependent late-merge semantics exactly.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from flink_tpu.api.windowing.assigners import EventTimeSessionWindows
+from flink_tpu.core.time import MIN_WATERMARK, TimeWindow
+from flink_tpu.ops.aggregators import DeviceAggregator, VALUE, resolve
+from flink_tpu.state.columnar import KeyDictionary
+
+_NP_COMBINE = {"add": np.add, "min": np.minimum, "max": np.maximum}
+
+
+@functools.lru_cache(maxsize=None)
+def _build_ingest(K: int, S: int, B: int, vfields: tuple):
+    import jax
+    import jax.numpy as jnp
+
+    def run(cnt, mn, mx, fields, kid, spos, rel, vals):
+        flat = jnp.where(kid >= 0, kid * S + spos, K * S)
+        cnt = cnt.reshape(-1).at[flat].add(1, mode="drop").reshape(K, S)
+        mn = mn.reshape(-1).at[flat].min(rel, mode="drop").reshape(K, S)
+        mx = mx.reshape(-1).at[flat].max(rel, mode="drop").reshape(K, S)
+        new_fields = []
+        for (name, dt, scatter), f in zip(vfields, fields):
+            upd = getattr(f.reshape(-1).at[flat], scatter)
+            new_fields.append(
+                upd(vals.astype(dt), mode="drop").reshape(K, S)
+            )
+        return cnt, mn, mx, tuple(new_fields)
+
+    return jax.jit(run)
+
+
+@functools.lru_cache(maxsize=None)
+def _build_purge(K: int, S: int, nf: int, idents: tuple, dts: tuple, g: int):
+    import jax
+    import jax.numpy as jnp
+
+    def run(cnt, mn, mx, fields, keep_mask):
+        cnt = jnp.where(keep_mask, cnt, 0)
+        mn = jnp.where(keep_mask, mn, g)
+        mx = jnp.where(keep_mask, mx, -1)
+        new_fields = tuple(
+            jnp.where(keep_mask, f, jnp.asarray(ident, dt))
+            for f, ident, dt in zip(fields, idents, dts)
+        )
+        return cnt, mn, mx, new_fields
+
+    return jax.jit(run)
+
+
+class TpuSessionWindowOperator:
+    """One shard's keyed session-window aggregation on one device."""
+
+    def __init__(
+        self,
+        assigner: EventTimeSessionWindows,
+        aggregate,
+        *,
+        key_capacity: int = 1 << 12,
+        num_slices: int = 64,
+        batch_pad: int = 256,
+    ):
+        agg = resolve(aggregate)
+        if agg is None:
+            raise ValueError(f"aggregate {aggregate!r} has no device form")
+        for f in agg.fields:
+            if f.source == VALUE and f.scatter not in ("add", "min", "max"):
+                raise ValueError(f"unsupported scatter {f.scatter!r}")
+        if not assigner.is_event_time:
+            raise ValueError("TpuSessionWindowOperator is event-time only")
+        self.agg: DeviceAggregator = agg
+        self.g = assigner.gap
+        self.S = num_slices
+        self.batch_pad = batch_pad
+        self.keydict = KeyDictionary()
+        self.K = key_capacity
+
+        self._vfields = tuple(
+            (f.name, np.dtype(f.dtype).name, f.scatter)
+            for f in agg.fields
+            if f.source == VALUE
+        )
+        self._idents = tuple(
+            f.identity for f in agg.fields if f.source == VALUE
+        )
+        self._init_state()
+
+        self.current_watermark = MIN_WATERMARK
+        self.ring_lo: Optional[int] = None     # lowest resident slice
+        self.max_used: Optional[int] = None
+        self._future: List[Tuple[Any, float, int]] = []
+        self.output: List[Tuple[Any, Any, Any, int]] = []
+        self.num_late_records_dropped = 0
+
+    # ------------------------------------------------------------------
+    def _init_state(self) -> None:
+        import jax.numpy as jnp
+
+        K, S = self.K, self.S
+        self._cnt = jnp.zeros((K, S), jnp.int32)
+        self._mn = jnp.full((K, S), self.g, jnp.int32)    # identity: > any rel
+        self._mx = jnp.full((K, S), -1, jnp.int32)
+        self._fields = tuple(
+            jnp.full((K, S), ident, jnp.dtype(dt))
+            for (_n, dt, _s), ident in zip(self._vfields, self._idents)
+        )
+
+    def ensure_key_capacity(self, required: int) -> None:
+        if required <= self.K:
+            return
+        import jax.numpy as jnp
+
+        new_k = 1 << (required - 1).bit_length()
+        pad = new_k - self.K
+
+        def grow(arr, fill, dt):
+            return jnp.concatenate(
+                [arr, jnp.full((pad, self.S), fill, dt)]
+            )
+
+        self._cnt = grow(self._cnt, 0, jnp.int32)
+        self._mn = grow(self._mn, self.g, jnp.int32)
+        self._mx = grow(self._mx, -1, jnp.int32)
+        self._fields = tuple(
+            grow(f, ident, f.dtype)
+            for f, ident in zip(self._fields, self._idents)
+        )
+        self.K = new_k
+
+    # ------------------------------------------------------------------
+    def process_record(self, key, value, timestamp: int) -> None:
+        self.process_batch(
+            np.asarray([key]), np.asarray([value], dtype=np.float32),
+            np.asarray([timestamp], dtype=np.int64),
+        )
+
+    def process_batch(self, keys: np.ndarray, vals: np.ndarray,
+                      ts: np.ndarray) -> None:
+        ts = np.asarray(ts, dtype=np.int64)
+        if len(ts) == 0:
+            return
+        vals = np.asarray(vals, dtype=np.float32)
+        wm = self.current_watermark
+
+        # standalone-expired records are late (see module docstring)
+        late = ts + self.g - 1 <= wm
+        if late.any():
+            self.num_late_records_dropped += int(late.sum())
+            keep = ~late
+            keys, vals, ts = keys[keep], vals[keep], ts[keep]
+            if len(ts) == 0:
+                return
+
+        s_abs = ts // self.g
+        # the ring floor this batch will actually occupy: its own lowest
+        # slice can move ring_lo DOWN, so the overflow check must use the
+        # post-batch floor or aliased positions corrupt state
+        lo = int(s_abs.min())
+        if self.ring_lo is not None:
+            lo = min(self.ring_lo, lo)
+        if self.max_used is not None and self.max_used - lo >= self.S:
+            # a record this far BELOW resident fragments cannot be ingested
+            # (existing cells cannot be held back retroactively) — the
+            # resident span must fit the ring, same contract as the fused
+            # pipeline's inverted-skew check
+            raise ValueError(
+                f"session slice ring too small for this skew: batch slice "
+                f"{lo} is {self.max_used - lo} gap-slices below resident "
+                f"slice {self.max_used}, ring holds num_slices={self.S}. "
+                f"Raise num_slices above the expected out-of-orderness "
+                f"(in units of the session gap)."
+            )
+        # ring overflow: far-future records wait on host until purge opens
+        # space (same hold-back contract as TpuWindowOperator._future)
+        over = s_abs >= lo + self.S
+        if over.any():
+            for i in np.flatnonzero(over):
+                self._future.append((keys[i], float(vals[i]), int(ts[i])))
+            keep = ~over
+            keys, vals, ts, s_abs = keys[keep], vals[keep], ts[keep], s_abs[keep]
+            if len(ts) == 0:
+                return
+
+        ids, required = self.keydict.lookup_or_insert(keys)
+        self.ensure_key_capacity(required)
+
+        n = len(ts)
+        padded = self.batch_pad
+        while padded < n:
+            padded *= 2
+        kid = np.full(padded, -1, dtype=np.int32)
+        kid[:n] = ids.astype(np.int32)
+        spos = np.zeros(padded, dtype=np.int32)
+        spos[:n] = (s_abs % self.S).astype(np.int32)
+        rel = np.zeros(padded, dtype=np.int32)
+        rel[:n] = (ts - s_abs * self.g).astype(np.int32)
+        v = np.zeros(padded, dtype=np.float32)
+        v[:n] = vals
+
+        run = _build_ingest(self.K, self.S, padded, self._vfields)
+        self._cnt, self._mn, self._mx, self._fields = run(
+            self._cnt, self._mn, self._mx, self._fields, kid, spos, rel, v,
+        )
+
+        smin, smax = int(s_abs.min()), int(s_abs.max())
+        self.ring_lo = smin if self.ring_lo is None else min(self.ring_lo, smin)
+        self.max_used = smax if self.max_used is None else max(self.max_used, smax)
+
+    # ------------------------------------------------------------------
+    def process_watermark(self, watermark: int) -> None:
+        if watermark <= self.current_watermark:
+            return
+        self.current_watermark = watermark
+        if self.ring_lo is None:
+            self._drain_future()
+            return
+
+        g, S = self.g, self.S
+        lo, hi = self.ring_lo, self.max_used
+        cnt = np.asarray(self._cnt)
+        mn = np.asarray(self._mn).astype(np.int64)
+        mx = np.asarray(self._mx).astype(np.int64)
+        fields = [np.asarray(f) for f in self._fields]
+        K = self.K
+
+        # vectorized gap-merge scan over the resident slice span
+        cur_open = np.zeros(K, dtype=bool)
+        cur_min = np.zeros(K, dtype=np.int64)
+        cur_max = np.zeros(K, dtype=np.int64)
+        cur_cnt = np.zeros(K, dtype=np.int64)
+        cur_fld = [np.full(K, ident) for ident in self._idents]
+        cells = np.zeros((K, S), dtype=bool)      # current session's cells
+        purge = np.zeros((K, S), dtype=bool)      # cells of emitted sessions
+        emitted: List[Tuple[int, int, int, int, list]] = []  # per emit row
+
+        def emit(mask: np.ndarray) -> None:
+            for k in np.flatnonzero(mask):
+                emitted.append((
+                    int(cur_min[k]), int(cur_max[k]), k, int(cur_cnt[k]),
+                    [f[k] for f in cur_fld],
+                ))
+            purge[mask] |= cells[mask]
+            cells[mask] = False
+            cur_open[mask] = False
+
+        for s in range(lo, hi + 1):
+            pos = s % S
+            frag = cnt[:, pos] > 0
+            if not frag.any():
+                continue
+            fmn = s * g + mn[:, pos]
+            fmx = s * g + mx[:, pos]
+            # touching windows merge: [a, b) and [b, b+g) intersect per the
+            # reference's TimeWindow.intersects ("just after or before"),
+            # so the merge condition is gap <= g, strict only beyond it
+            joins = cur_open & frag & (fmn - cur_max <= g)
+            breaks = cur_open & frag & ~joins
+            # a later fragment with gap >= g proves the session closed
+            emit(breaks)
+            starts = frag & ~joins
+            cur_min[starts] = fmn[starts]
+            cur_cnt[starts] = 0
+            for cf, ident in zip(cur_fld, self._idents):
+                cf[starts] = ident
+            cur_open |= frag
+            cur_max[frag] = fmx[frag]
+            cur_cnt[frag] += cnt[:, pos][frag]
+            for cf, f, (_n, _dt, scatter) in zip(cur_fld, fields, self._vfields):
+                cf[frag] = _NP_COMBINE[scatter](cf[frag], f[:, pos][frag])
+            cells[frag, pos] = True
+
+        # sessions whose gap the watermark itself proves
+        emit(cur_open & (cur_max + g - 1 <= watermark))
+
+        if emitted:
+            # fire order: by merged-window end then key id (deterministic,
+            # matching the oracle's timer ordering)
+            emitted.sort(key=lambda e: (e[1] + g, e[2]))
+            names = [n for n, _dt, _s in self._vfields]
+            one_names = [
+                f.name for f in self.agg.fields if f.source != VALUE
+            ]
+            for mn_ts, mx_ts, k, c, fvals in emitted:
+                window = TimeWindow(mn_ts, mx_ts + g)
+                fdict = dict(zip(names, fvals))
+                for n in one_names:  # ONE-source fields carry the count
+                    fdict[n] = c
+                result = self.agg.extract(fdict)
+                self.output.append(
+                    (self.keydict.key_at(k), window,
+                     np.asarray(result).item(), window.max_timestamp())
+                )
+            run = _build_purge(
+                self.K, S, len(self._vfields), self._idents,
+                tuple(dt for _n, dt, _s in self._vfields), g,
+            )
+            self._cnt, self._mn, self._mx, self._fields = run(
+                self._cnt, self._mn, self._mx, self._fields, ~purge,
+            )
+            cnt = np.asarray(self._cnt)
+
+        # advance the resident span to the surviving fragments
+        live_cols = cnt.any(axis=0)
+        alive_abs = [s for s in range(lo, hi + 1) if live_cols[s % S]]
+        if alive_abs:
+            self.ring_lo = min(alive_abs)
+            self.max_used = max(alive_abs)
+        else:
+            self.ring_lo = None
+            self.max_used = None
+        self._drain_future()
+
+    def _drain_future(self) -> None:
+        if not self._future:
+            return
+        lo = self.ring_lo
+        pending, self._future = self._future, []
+        ready_k, ready_v, ready_t = [], [], []
+        for k, v, t in pending:
+            s = t // self.g
+            if lo is None or s < lo + self.S:
+                ready_k.append(k)
+                ready_v.append(v)
+                ready_t.append(t)
+                if lo is None:
+                    lo = s
+            else:
+                self._future.append((k, v, t))
+        if ready_k:
+            self.process_batch(
+                np.asarray(ready_k), np.asarray(ready_v, dtype=np.float32),
+                np.asarray(ready_t, dtype=np.int64),
+            )
+
+    def advance_processing_time(self, time: int) -> None:  # pragma: no cover
+        raise NotImplementedError("event-time only")
+
+    def drain_output(self) -> List[Tuple[Any, Any, Any, int]]:
+        out = self.output
+        self.output = []
+        return out
+
+    # ------------------------------------------------------------------
+    def snapshot(self) -> dict:
+        return {
+            "cnt": np.asarray(self._cnt),
+            "mn": np.asarray(self._mn),
+            "mx": np.asarray(self._mx),
+            "fields": [np.asarray(f) for f in self._fields],
+            "keys": self.keydict.snapshot(),
+            "watermark": self.current_watermark,
+            "ring_lo": self.ring_lo,
+            "max_used": self.max_used,
+            "future": [(k, float(v), int(t)) for k, v, t in self._future],
+            "num_late_dropped": self.num_late_records_dropped,
+        }
+
+    def restore(self, snap: dict) -> None:
+        import jax.numpy as jnp
+
+        self._cnt = jnp.asarray(snap["cnt"])
+        self._mn = jnp.asarray(snap["mn"])
+        self._mx = jnp.asarray(snap["mx"])
+        self._fields = tuple(jnp.asarray(f) for f in snap["fields"])
+        self.K = int(self._cnt.shape[0])
+        self.keydict = KeyDictionary.restore(snap["keys"])
+        self.current_watermark = snap["watermark"]
+        self.ring_lo = snap["ring_lo"]
+        self.max_used = snap["max_used"]
+        self._future = list(snap["future"])
+        self.num_late_records_dropped = snap["num_late_dropped"]

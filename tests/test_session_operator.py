@@ -1,0 +1,233 @@
+"""TpuSessionWindowOperator parity vs the oracle's MergingWindowSet path.
+
+Randomized clickstream-style workloads with bounded out-of-orderness below
+the session gap (the device operator's documented contract); the oracle
+implements WindowOperator.java:303-403 merging semantics per record.
+"""
+
+import numpy as np
+import pytest
+
+from flink_tpu.api.windowing.assigners import EventTimeSessionWindows
+from flink_tpu.ops.aggregators import count_agg, max_agg, sum_agg
+from flink_tpu.runtime.oracle_window_operator import OracleWindowOperator
+from flink_tpu.runtime.tpu_session_operator import TpuSessionWindowOperator
+
+
+def _run_oracle(agg, gap, batches, wms):
+    op = OracleWindowOperator(
+        EventTimeSessionWindows.with_gap(gap), agg.python_equivalent()
+    )
+    out = []
+    for (keys, vals, ts), wm in zip(batches, wms):
+        for k, v, t in zip(keys, vals, ts):
+            op.process_record(k, float(v), int(t))
+        op.process_watermark(wm)
+        out.extend(op.drain_output())
+    op.process_watermark(1 << 60)
+    out.extend(op.drain_output())
+    return out
+
+
+def _run_device(agg, gap, batches, wms, *, snapshot_at=None, num_slices=64):
+    op = TpuSessionWindowOperator(
+        EventTimeSessionWindows.with_gap(gap), agg,
+        key_capacity=64, num_slices=num_slices,
+    )
+    out = []
+    for i, ((keys, vals, ts), wm) in enumerate(zip(batches, wms)):
+        if snapshot_at is not None and i == snapshot_at:
+            snap = op.snapshot()
+            op = TpuSessionWindowOperator(
+                EventTimeSessionWindows.with_gap(gap), agg,
+                key_capacity=64, num_slices=num_slices,
+            )
+            op.restore(snap)
+        op.process_batch(
+            np.asarray(keys), np.asarray(vals, dtype=np.float32),
+            np.asarray(ts, dtype=np.int64),
+        )
+        op.process_watermark(wm)
+        out.extend(op.drain_output())
+    op.process_watermark(1 << 60)
+    out.extend(op.drain_output())
+    return out
+
+
+def _norm(out):
+    return sorted(
+        (k, w.start, w.end, round(float(r), 4)) for (k, w, r, _t) in out
+    )
+
+
+def _mk_stream(seed, *, n_batches=12, batch=60, num_keys=7, gap=1000,
+               ooo=300, density_ms=260):
+    """Bursty keyed stream: keys go quiet at random, creating real sessions."""
+    rng = np.random.default_rng(seed)
+    t_cursor = 0
+    batches, wms = [], []
+    for _ in range(n_batches):
+        keys = rng.integers(0, num_keys, size=batch)
+        # bursts: each key's events cluster, with occasional long silences
+        base = t_cursor + rng.integers(0, density_ms * 4, size=batch)
+        jitter = rng.integers(0, ooo + 1, size=batch)
+        ts = np.maximum(base - jitter, 0)
+        vals = rng.integers(1, 10, size=batch).astype(np.float32)
+        batches.append((keys, vals, np.sort(ts)))
+        t_cursor += density_ms * 4 + int(rng.integers(0, 3)) * gap * 2
+        wms.append(int(ts.max()) - ooo)
+    return batches, wms
+
+
+@pytest.mark.parametrize("seed", [1, 2, 3, 4, 5])
+@pytest.mark.parametrize("aggname,agg", [
+    ("count", count_agg()), ("sum", sum_agg()), ("max", max_agg()),
+])
+def test_session_parity_randomized(seed, aggname, agg):
+    gap = 1000
+    batches, wms = _mk_stream(seed, gap=gap)
+    ref = _norm(_run_oracle(agg, gap, batches, wms))
+    got = _norm(_run_device(agg, gap, batches, wms))
+    assert len(ref) > 0
+    assert got == ref
+
+
+def test_session_merge_across_batches_and_gap_boundary():
+    """Touching windows merge (TimeWindow.intersects covers 'just after or
+    before'): events exactly gap apart still form one session; one past the
+    gap splits."""
+    gap = 100
+    agg = count_agg()
+    batches = [
+        (["a", "a", "b"], [1, 1, 1], [0, 99, 0]),   # a: merge (99 < gap)
+        (["b", "c", "c"], [1, 1, 1], [100, 0, 101]),  # b: ==gap merges; c: >gap splits
+    ]
+    wms = [50, 1 << 40]
+    ref = _norm(_run_oracle(agg, gap, batches, wms))
+    got = _norm(_run_device(agg, gap, batches, wms))
+    assert got == ref
+    assert ("a", 0, 199, 2.0) in got
+    assert ("b", 0, 200, 2.0) in got
+    assert ("c", 0, 100, 1.0) in got
+    assert ("c", 101, 201, 1.0) in got
+
+
+def test_session_snapshot_restore_mid_stream():
+    gap = 1000
+    agg = sum_agg()
+    batches, wms = _mk_stream(11, gap=gap)
+    ref = _norm(_run_device(agg, gap, batches, wms))
+    got = _norm(_run_device(agg, gap, batches, wms, snapshot_at=6))
+    assert got == ref and len(got) > 0
+
+
+def test_session_late_records_counted():
+    gap = 100
+    op = TpuSessionWindowOperator(
+        EventTimeSessionWindows.with_gap(gap), count_agg(), key_capacity=8,
+    )
+    op.process_batch(np.asarray(["k"]), np.zeros(1, np.float32),
+                     np.asarray([0], dtype=np.int64))
+    op.process_watermark(500)
+    assert len(op.drain_output()) == 1
+    # standalone session [10,110) expired at wm=500 -> dropped late
+    op.process_batch(np.asarray(["k"]), np.zeros(1, np.float32),
+                     np.asarray([10], dtype=np.int64))
+    assert op.num_late_records_dropped == 1
+    op.process_watermark(1 << 40)
+    assert op.drain_output() == []
+
+
+def test_session_ring_overflow_holds_future_records():
+    gap = 10
+    op = TpuSessionWindowOperator(
+        EventTimeSessionWindows.with_gap(gap), count_agg(),
+        key_capacity=8, num_slices=8,
+    )
+    # slice span: ts 0 -> slice 0; ts 1000 -> slice 100 >= 0+8 -> held back
+    op.process_batch(np.asarray(["k", "k"]), np.zeros(2, np.float32),
+                     np.asarray([0, 1000], dtype=np.int64))
+    assert len(op._future) == 1
+    op.process_watermark(500)   # closes [0,10), purges, reopens the ring
+    out = op.drain_output()
+    assert [(w.start, w.end) for (_k, w, _r, _t) in out] == [(0, 10)]
+    op.process_watermark(1 << 40)
+    out = op.drain_output()
+    assert [(w.start, w.end) for (_k, w, _r, _t) in out] == [(1000, 1010)]
+
+
+def test_session_through_datastream_api_uses_device_operator():
+    from flink_tpu.api.datastream import StreamExecutionEnvironment
+    from flink_tpu.config import Configuration, ExecutionOptions
+    from flink_tpu.core.watermarks import WatermarkStrategy
+    from flink_tpu.runtime.executor import WindowStepRunner, build_runners
+    from flink_tpu.graph.transformation import plan
+    from flink_tpu.runtime.tpu_session_operator import TpuSessionWindowOperator
+
+    conf = Configuration()
+    conf.set(ExecutionOptions.BATCH_SIZE, 8)
+    env = StreamExecutionEnvironment.get_execution_environment(conf)
+    data = [("u1", 0), ("u1", 300), ("u2", 100), ("u1", 2000), ("u2", 2500)]
+    sink = (
+        env.from_collection(
+            data,
+            timestamp_fn=lambda x: x[1],
+            watermark_strategy=WatermarkStrategy.for_monotonous_timestamps(),
+        )
+        .key_by(lambda x: x[0])
+        .window(EventTimeSessionWindows.with_gap(1000))
+        .count()
+        .collect()
+    )
+    graph = plan(env._sinks)
+    runners, _ = build_runners(graph, env.config)
+    wr = [r for r in runners if isinstance(r, WindowStepRunner)]
+    assert len(wr) == 1 and isinstance(wr[0].op, TpuSessionWindowOperator)
+
+    env.execute()
+    # u1: sessions {0,300} and {2000}; u2: {100} and {2500}
+    assert sorted(sink.results) == [("u1", 1), ("u1", 2), ("u2", 1), ("u2", 1)]
+
+
+def test_device_sessions_config_gate_forces_oracle():
+    from flink_tpu.api.datastream import StreamExecutionEnvironment
+    from flink_tpu.config import Configuration, ExecutionOptions
+    from flink_tpu.core.watermarks import WatermarkStrategy
+    from flink_tpu.runtime.executor import WindowStepRunner, build_runners
+    from flink_tpu.runtime.oracle_window_operator import OracleWindowOperator
+    from flink_tpu.graph.transformation import plan
+
+    conf = Configuration()
+    conf.set(ExecutionOptions.DEVICE_SESSIONS, False)
+    env = StreamExecutionEnvironment.get_execution_environment(conf)
+    (
+        env.from_collection(
+            [("u", 0)], timestamp_fn=lambda x: x[1],
+            watermark_strategy=WatermarkStrategy.for_monotonous_timestamps(),
+        )
+        .key_by(lambda x: x[0])
+        .window(EventTimeSessionWindows.with_gap(1000))
+        .count()
+        .collect()
+    )
+    runners, _ = build_runners(plan(env._sinks), conf)
+    wr = [r for r in runners if isinstance(r, WindowStepRunner)]
+    assert isinstance(wr[0].op, OracleWindowOperator)
+
+
+def test_session_inverted_skew_raises_config_error():
+    """A record far BELOW resident fragments cannot be ingested (the ring
+    cannot hold the span, and resident cells cannot be held back) — the
+    operator raises the same actionable configuration error as the fused
+    pipeline's inverted-skew check instead of silently aliasing ring
+    positions (regression: stale ring_lo conflated two absolute slices)."""
+    gap = 10
+    op = TpuSessionWindowOperator(
+        EventTimeSessionWindows.with_gap(gap), count_agg(),
+        key_capacity=8, num_slices=8,
+    )
+    op.process_batch(np.asarray(["k"]), np.zeros(1, np.float32),
+                     np.asarray([605], dtype=np.int64))
+    with pytest.raises(ValueError, match="ring too small"):
+        op.process_batch(np.asarray(["k", "k"]), np.zeros(2, np.float32),
+                         np.asarray([5, 645], dtype=np.int64))
