@@ -43,6 +43,7 @@ import subprocess
 import sys
 import threading
 import time
+from typing import Optional
 
 import numpy as np
 
@@ -82,10 +83,10 @@ def _emit(obj):
 #   watermark after step t: (t+1)*STEP_MS - WM_DELAY_MS
 # ---------------------------------------------------------------------------
 
-def step_bounds(t: int, B: int):
+def step_bounds(t: int, B: int, slide_ms: int = SLIDE_MS):
     """Inclusive (smin, smax) slice bounds of step t's records."""
-    smin = max((t * STEP_MS + STEP_MS // B - OOO_MS) // SLIDE_MS, 0)
-    smax = ((t + 1) * STEP_MS) // SLIDE_MS
+    smin = max((t * STEP_MS + STEP_MS // B - OOO_MS) // slide_ms, 0)
+    smax = ((t + 1) * STEP_MS) // slide_ms
     return smin, smax
 
 
@@ -117,8 +118,10 @@ def make_bits_fn(B: int):
     return bits_fn
 
 
-def make_device_gen(T: int, B: int):
-    """Jitted on-device generator: span of T steps -> flat idx [T*B] int32."""
+def make_device_gen(T: int, B: int, slide_ms: int = SLIDE_MS,
+                    with_vals: bool = False, flat: bool = True):
+    """Jitted on-device generator: span of T steps -> idx [T*B] (or [T,B])
+    int32, optionally with a value column derived from the same bits."""
     import jax
     import jax.numpy as jnp
 
@@ -133,12 +136,24 @@ def make_device_gen(T: int, B: int):
             kid = (bits & jnp.uint32(NUM_KEYS - 1)).astype(jnp.int32)
             jit_ = ((bits >> jnp.uint32(13)) % jnp.uint32(OOO_MS + 1)).astype(jnp.int32)
             ts = jnp.maximum(t * STEP_MS + (bb * STEP_MS) // B - jit_, 0)
-            srel = ts // SLIDE_MS - smin_abs[tr]
-            return kid * NSB + srel
+            srel = ts // slide_ms - smin_abs[tr]
+            idx = kid * NSB + srel
+            if with_vals:
+                val = ((bits >> jnp.uint32(23)) & jnp.uint32(0xFF)).astype(jnp.float32)
+                return idx, val
+            return idx
 
-        return jax.vmap(one)(jnp.arange(T, dtype=jnp.int32)).reshape(-1)
+        out = jax.vmap(one)(jnp.arange(T, dtype=jnp.int32))
+        if with_vals:
+            idx, vals = out
+            return (idx.reshape(-1), vals.reshape(-1)) if flat else (idx, vals)
+        return out.reshape(-1) if flat else out
 
     return gen
+
+
+def host_vals(bits: np.ndarray) -> np.ndarray:
+    return ((bits >> 23) & 0xFF).astype(np.float32)
 
 
 # ---------------------------------------------------------------------------
@@ -150,28 +165,41 @@ class NumpyWindower:
 
     S = 64
 
-    def __init__(self):
-        self.counts = np.zeros((NUM_KEYS, self.S), dtype=np.int64)
+    def __init__(self, window_ms: int = WINDOW_MS, slide_ms: int = SLIDE_MS,
+                 agg: str = "count"):
+        self.window_ms = window_ms
+        self.slide_ms = slide_ms
+        self.agg = agg
+        fill = 0 if agg in ("count", "sum") else -np.inf
+        self.counts = np.full((NUM_KEYS, self.S), fill, dtype=np.float64)
         self.fired_upto = None
         self.fired = {}
         self.alg_seconds = 0.0
         self.events = 0
 
-    def step(self, keys, ts, wm):
-        S, spw = self.S, WINDOW_MS // SLIDE_MS
+    def step(self, keys, ts, wm, vals=None):
+        S, spw = self.S, self.window_ms // self.slide_ms
         t0 = time.perf_counter()
-        s_abs = ts // SLIDE_MS
+        s_abs = ts // self.slide_ms
         flat = keys * S + (s_abs % S)
-        self.counts += np.bincount(flat, minlength=NUM_KEYS * S).reshape(NUM_KEYS, S)
+        if self.agg == "count":
+            self.counts += np.bincount(flat, minlength=NUM_KEYS * S).reshape(
+                NUM_KEYS, S)
+        elif self.agg == "sum":
+            np.add.at(self.counts.reshape(-1), flat, vals)
+        else:  # max
+            np.maximum.at(self.counts.reshape(-1), flat, vals)
         self.events += len(keys)
-        j_hi = (wm + 1 - WINDOW_MS) // SLIDE_MS
+        j_hi = (wm + 1 - self.window_ms) // self.slide_ms
         j_lo = self.fired_upto + 1 if self.fired_upto is not None else j_hi
+        combine = np.max if self.agg == "max" else np.sum
+        fill = 0 if self.agg in ("count", "sum") else -np.inf
         for j in range(j_lo, j_hi + 1):
             # windows with negative start exist for early records, matching
             # the reference's getWindowStartWithOffset arithmetic
             pos = np.arange(j, j + spw) % S
-            self.fired[j] = self.counts[:, pos].sum(axis=1)
-            self.counts[:, j % S] = 0
+            self.fired[j] = combine(self.counts[:, pos], axis=1)
+            self.counts[:, j % S] = fill
         if self.fired_upto is None or j_hi > self.fired_upto:
             self.fired_upto = j_hi
         self.alg_seconds += time.perf_counter() - t0
@@ -202,13 +230,14 @@ def _parity(cpu_fired, dev_fired, require_all: bool = True):
 # TPU child
 # ---------------------------------------------------------------------------
 
-def _new_pipe(chunk: int, backend: str = "auto"):
+def _new_pipe(chunk: int, backend: str = "auto", window_ms: int = WINDOW_MS,
+              slide_ms: int = SLIDE_MS, agg: str = "count"):
     from flink_tpu.api.windowing.assigners import SlidingEventTimeWindows
     from flink_tpu.runtime.fused_window_pipeline import FusedWindowPipeline
 
     return FusedWindowPipeline(
-        SlidingEventTimeWindows.of(WINDOW_MS, SLIDE_MS),
-        "count",
+        SlidingEventTimeWindows.of(window_ms, slide_ms),
+        agg,
         key_capacity=NUM_KEYS,
         num_slices=32,
         nsb=NSB,
@@ -220,37 +249,53 @@ def _new_pipe(chunk: int, backend: str = "auto"):
 
 
 def run_tpu_stream(T: int, B: int, spans: int, depth: int, t0_step: int = 0,
-                   warmup: bool = True):
-    """Pipelined on-device-generated stream; yields progress per resolve."""
+                   warmup: bool = True, window_ms: int = WINDOW_MS,
+                   slide_ms: int = SLIDE_MS, agg: str = "count",
+                   backend: str = "auto", resolve_field: Optional[str] = None,
+                   postproc=None):
+    """Pipelined on-device-generated stream; yields progress per resolve.
+
+    agg 'count' streams only key/slice ids; 'sum'/'max' also stream a value
+    column derived from the same threefry bits. `postproc(count_row,
+    field_row)` maps a fired window's device rows before banking (e.g. the
+    Q5 top-k cut); default keeps the count row (count agg) or field row.
+    """
     import jax
     import jax.numpy as jnp
 
-    pipe = _new_pipe(chunk=8192)
-    gen = make_device_gen(T, B)
+    with_vals = agg != "count"
+    pallas = backend != "xla"
+
+    def mk():
+        return _new_pipe(chunk=8192 if pallas else 4096, backend=backend,
+                         window_ms=window_ms, slide_ms=slide_ms, agg=agg)
+
+    pipe = mk()
+    gen = make_device_gen(T, B, slide_ms=slide_ms, with_vals=with_vals,
+                          flat=pallas)
+
+    def stage(p, lo):
+        bounds = [step_bounds(lo + r, B, slide_ms) for r in range(T)]
+        wms = [(lo + r + 1) * STEP_MS - WM_DELAY_MS for r in range(T)]
+        plan, smin_abs = p.plan_superbatch(bounds, wms)
+        out = gen(jnp.int32(lo), jnp.asarray(smin_abs))
+        if with_vals:
+            idx, vals = out
+        else:
+            idx, vals = out, jnp.zeros((T, 1), jnp.float32)
+        return (idx, vals, plan)
 
     if warmup:
         # compile gen + superscan + staging shapes on a throwaway pipe (the
         # compiled executables are shared via module-level caches), so the
         # timed region below measures steady-state streaming only
-        wpipe = _new_pipe(chunk=8192)
-        bounds = [step_bounds(r, B) for r in range(T)]
-        wms = [(r + 1) * STEP_MS - WM_DELAY_MS for r in range(T)]
-        plan, smin_abs = wpipe.plan_superbatch(bounds, wms)
-        widx = gen(jnp.int32(0), jnp.asarray(smin_abs))
-        wpipe.process_superbatch(
-            None, None, staged=(widx, jnp.zeros((T, 1), jnp.float32), plan),
-        )
-        del wpipe, widx
+        wpipe = mk()
+        wpipe.process_superbatch(None, None, staged=stage(wpipe, t0_step))
+        del wpipe
 
     def enqueue(i):
-        lo = t0_step + i * T
-        bounds = [step_bounds(lo + r, B) for r in range(T)]
-        wms = [(lo + r + 1) * STEP_MS - WM_DELAY_MS for r in range(T)]
-        plan, smin_abs = pipe.plan_superbatch(bounds, wms)
-        idx = gen(jnp.int32(lo), jnp.asarray(smin_abs))
         d = pipe.process_superbatch(
-            None, None,
-            staged=(idx, jnp.zeros((T, 1), jnp.float32), plan), defer=True,
+            None, None, staged=stage(pipe, t0_step + i * T), defer=True,
         )
         return d, time.perf_counter()
 
@@ -264,8 +309,11 @@ def run_tpu_stream(T: int, B: int, spans: int, depth: int, t0_step: int = 0,
     resolved = 0
     while inflight:
         d, t_enq = inflight.pop(0)
-        for window, counts, _f in d.resolve():
-            fired[window.start // SLIDE_MS] = counts
+        for window, counts, fields in d.resolve():
+            row = fields[resolve_field] if resolve_field else counts
+            if postproc is not None:
+                row = postproc(counts, row)
+            fired[window.start // slide_ms] = row
         span_lat.append((time.perf_counter() - t_enq) * 1000.0)
         resolved += 1
         if next_i < spans:
@@ -381,6 +429,257 @@ def child_tpu(T: int, B: int, spans: int) -> None:
          "late_dropped": 0},
     )
     _emit({"event": "result", "result": res})
+
+    # secondary BASELINE configs ride the same artifact; the banked headline
+    # above survives any failure here
+    if os.environ.get("BENCH_SECONDARY", "1") == "1":
+        res["secondary"] = run_secondary_configs(headline_ref=ref)
+    _emit({"event": "result_final", "result": res})
+
+
+# ---------------------------------------------------------------------------
+# secondary BASELINE configs (1: WordCount tumbling, 3: session reduce,
+# 4: Nexmark Q5 top-k, 5: Nexmark Q7 global max) — each guarded so the
+# headline result survives any secondary failure
+# ---------------------------------------------------------------------------
+
+def _replay(window_ms, slide_ms, agg, T, B, bits_fn):
+    ref = NumpyWindower(window_ms, slide_ms, agg)
+    for t in range(T):
+        bits = bits_fn(t)
+        keys = (bits & (NUM_KEYS - 1)).astype(np.int64)
+        jitter = ((bits >> 13) % (OOO_MS + 1)).astype(np.int64)
+        base = t * STEP_MS + ((np.arange(1, B + 1, dtype=np.int64) * STEP_MS) // B)
+        ts = np.maximum(base - jitter, 0)
+        ref.step(keys, ts, (t + 1) * STEP_MS - WM_DELAY_MS,
+                 vals=host_vals(bits))
+    return ref
+
+
+def secondary_wordcount(bits_fn) -> dict:
+    """Config 1: WordCount keyBy().sum() over 1s tumbling windows (the
+    count of 1s == sum of ones; pallas superscan, tumbling geometry)."""
+    T, B, spans = 24, 1 << 20, 2
+    last = None
+    for prog in run_tpu_stream(T, B, spans, depth=2, t0_step=0,
+                               window_ms=1000, slide_ms=1000):
+        last = prog
+    ref = _replay(1000, 1000, "count", T * spans, B, bits_fn)
+    ok, checked = _parity(ref.fired, last["fired"], require_all=True)
+    tps = last["events"] / last["elapsed"]
+    return {
+        "metric": "wordcount_tumbling_count_tuples_per_sec",
+        "value": round(tps, 1),
+        "vs_baseline": round(tps / (ref.events / max(ref.alg_seconds, 1e-9)), 3),
+        "parity": bool(ok),
+        "windows_checked": checked,
+        "events": last["events"],
+    }
+
+
+def secondary_q5_topk(headline_ref) -> dict:
+    """Config 4: Nexmark Q5 hot items — sliding count + top-10 per window.
+    The top-k cut runs per fired window; parity compares the sorted top-10
+    multiset (tie-insensitive). Reuses the headline replay (same stream
+    prefix) instead of re-running minutes of single-core numpy."""
+    N = 10
+    T, B, spans = SPAN_STEPS, 1 << LOG2_BATCH, 2
+
+    def topk(counts, _row):
+        part = np.partition(counts, len(counts) - N)[-N:]
+        return np.sort(part)[::-1]
+
+    last = None
+    for prog in run_tpu_stream(T, B, spans, depth=2, postproc=topk):
+        last = prog
+    ref = headline_ref
+    mismatch = 0
+    for j, row in last["fired"].items():
+        expect = np.sort(np.partition(ref.fired[j], NUM_KEYS - N)[-N:])[::-1]
+        if not np.array_equal(np.asarray(row, dtype=np.int64),
+                              expect.astype(np.int64)):
+            mismatch += 1
+    tps = last["events"] / last["elapsed"]
+    return {
+        "metric": "nexmark_q5_topk_tuples_per_sec",
+        "value": round(tps, 1),
+        "vs_baseline": round(tps / (ref.events / max(ref.alg_seconds, 1e-9)), 3),
+        "parity": mismatch == 0 and len(last["fired"]) > 0,
+        "windows_checked": len(last["fired"]),
+        "top_n": N,
+        "events": last["events"],
+    }
+
+
+def secondary_q7_global_max(bits_fn_small) -> dict:
+    """Config 5: Nexmark Q7 — global per-window max with keyed
+    pre-aggregation (max scatter on the XLA superscan; the global merge is
+    the final max over key rows, the single-chip analogue of the psum/pmax
+    cross-shard merge exercised in the multichip dryrun)."""
+    T, B, spans = 24, 1 << 18, 2
+
+    def gmax(_counts, row):
+        return float(np.max(row))
+
+    last = None
+    for prog in run_tpu_stream(T, B, spans, depth=2, window_ms=10_000,
+                               slide_ms=10_000, agg="max", backend="xla",
+                               resolve_field="max", postproc=gmax):
+        last = prog
+    ref = _replay(10_000, 10_000, "max", T * spans, B, bits_fn_small)
+    mismatch = 0
+    for j, got in last["fired"].items():
+        if abs(float(np.max(ref.fired[j])) - got) > 1e-3:
+            mismatch += 1
+    tps = last["events"] / last["elapsed"]
+    return {
+        "metric": "nexmark_q7_global_max_tuples_per_sec",
+        "value": round(tps, 1),
+        "vs_baseline": round(tps / (ref.events / max(ref.alg_seconds, 1e-9)), 3),
+        "parity": mismatch == 0 and len(last["fired"]) > 0,
+        "windows_checked": len(last["fired"]),
+        "events": last["events"],
+    }
+
+
+def _numpy_sessionize(keys, ts, vals, gap):
+    """Single-core batch sessionizer: sort by (key, ts), split where the key
+    changes or the gap exceeds `gap`, segment-sum the values."""
+    order = np.lexsort((ts, keys))
+    k, t, v = keys[order], ts[order], vals[order]
+    brk = np.empty(len(k), dtype=bool)
+    brk[0] = True
+    brk[1:] = (k[1:] != k[:-1]) | (t[1:] - t[:-1] > gap)
+    starts = np.flatnonzero(brk)
+    sums = np.add.reduceat(v, starts)
+    ends = np.r_[starts[1:], len(k)] - 1
+    return {
+        (int(k[s]), int(t[s]), int(t[e]) + gap): float(sv)
+        for s, e, sv in zip(starts, ends, sums)
+    }
+
+
+def secondary_sessions() -> dict:
+    """Config 3: clickstream sessionization (session windows + sum reduce)
+    on the device session operator. The stream rotates its active key set so
+    sessions actually close; records are synthesized ON DEVICE (dense-key
+    staged ingest) with the host replaying identical bits for the
+    single-core baseline + parity, like the headline config."""
+    from flink_tpu.api.windowing.assigners import EventTimeSessionWindows
+    from flink_tpu.runtime.tpu_session_operator import TpuSessionWindowOperator
+
+    import jax
+    import jax.numpy as jnp
+
+    gap = 2000
+    B, nb = 1 << 20, 16
+    S = 64
+    base_key = jax.random.PRNGKey(SEED + 7)
+    cpu = jax.devices("cpu")[0]
+    bb_i32 = jnp.arange(1, B + 1, dtype=jnp.int32)
+
+    @jax.jit
+    def gen(t):
+        bits = jax.random.bits(jax.random.fold_in(base_key, t), (B,), "uint32")
+        active = (t >> 2) & 3
+        kid = ((bits & jnp.uint32(4095)) | (active.astype(jnp.uint32) << 12)
+               ).astype(jnp.int32)
+        jit_ = ((bits >> jnp.uint32(13)) % jnp.uint32(OOO_MS + 1)).astype(jnp.int32)
+        ts = jnp.maximum(t * STEP_MS + (bb_i32 * STEP_MS) // B - jit_, 0)
+        s_abs = ts // gap
+        return kid, (s_abs % S).astype(jnp.int32), (ts - s_abs * gap), \
+            ((bits >> jnp.uint32(23)) & jnp.uint32(0xFF)).astype(jnp.float32)
+
+    def host_batch(t):
+        with jax.default_device(cpu):
+            bits = np.asarray(jax.random.bits(
+                jax.random.fold_in(base_key, jnp.int32(t)), (B,), "uint32"))
+        active = (t >> 2) & 3
+        keys = ((bits & 4095) | (active << 12)).astype(np.int64)
+        jitter = ((bits >> 13) % (OOO_MS + 1)).astype(np.int64)
+        bb = np.arange(1, B + 1, dtype=np.int64)
+        ts = np.maximum(t * STEP_MS + (bb * STEP_MS) // B - jitter, 0)
+        return keys, host_vals(bits), ts
+
+    def bounds(t):
+        smin = max((t * STEP_MS + STEP_MS // B - OOO_MS) // gap, 0)
+        smax = ((t + 1) * STEP_MS) // gap
+        return smin, smax
+
+    def mk():
+        return TpuSessionWindowOperator(
+            EventTimeSessionWindows.with_gap(gap), "sum",
+            key_capacity=1 << 14, num_slices=S,
+        )
+
+    # warmup compile on a throwaway operator
+    warm = mk()
+    warm.process_batch_staged(*gen(jnp.int32(0)), *bounds(0))
+    warm.process_watermark(STEP_MS)
+
+    op = mk()
+    out = []
+    t0 = time.perf_counter()
+    for t in range(nb):
+        op.process_batch_staged(*gen(jnp.int32(t)), *bounds(t))
+        op.process_watermark((t + 1) * STEP_MS - WM_DELAY_MS)
+        out.extend(op.drain_output())
+    op.process_watermark(1 << 60)
+    out.extend(op.drain_output())
+    elapsed = time.perf_counter() - t0
+    events = nb * B
+
+    data = [host_batch(t) for t in range(nb)]
+    all_k = np.concatenate([d[0] for d in data])
+    all_v = np.concatenate([d[1] for d in data])
+    all_t = np.concatenate([d[2] for d in data])
+    t0 = time.perf_counter()
+    expect = _numpy_sessionize(all_k, all_t, all_v, gap)
+    base_s = time.perf_counter() - t0
+    got = {
+        (int(k), w.start, w.end): float(r) for (k, w, r, _t) in out
+    }
+    parity = (
+        len(got) > 0
+        and got.keys() == expect.keys()
+        and all(abs(got[k] - expect[k]) <= 1e-3 * max(1.0, abs(expect[k]))
+                for k in got)
+    )
+    tps = events / elapsed
+    return {
+        "metric": "session_sum_tuples_per_sec",
+        "value": round(tps, 1),
+        "vs_baseline": round(tps / (events / max(base_s, 1e-9)), 3),
+        "parity": bool(parity),
+        "sessions_emitted": len(got),
+        "gap_ms": gap,
+        "events": events,
+        "data_source": "on_device_threefry_generator",
+    }
+
+
+def run_secondary_configs(headline_ref=None) -> dict:
+    sec = {}
+    bits_big = make_bits_fn(1 << 20)
+    bits_small = make_bits_fn(1 << 18)
+    if headline_ref is None:
+        headline_ref = _replay(WINDOW_MS, SLIDE_MS, "count",
+                               SPAN_STEPS * 2, 1 << LOG2_BATCH,
+                               make_bits_fn(1 << LOG2_BATCH))
+    for name, fn in (
+        ("wordcount_tumbling_count", lambda: secondary_wordcount(bits_big)),
+        ("nexmark_q5_topk", lambda: secondary_q5_topk(headline_ref)),
+        ("nexmark_q7_global_max", lambda: secondary_q7_global_max(bits_small)),
+        ("session_sum", secondary_sessions),
+    ):
+        t0 = time.perf_counter()
+        try:
+            sec[name] = fn()
+            sec[name]["wall_s"] = round(time.perf_counter() - t0, 1)
+        except Exception as e:  # noqa: BLE001 — headline must survive
+            sec[name] = {"error": repr(e)[:300]}
+        _emit({"event": "secondary_done", "config": name, "result": sec[name]})
+    return sec
 
 
 # ---------------------------------------------------------------------------
@@ -589,7 +888,18 @@ def parent_main() -> None:
         if not tpu_child.alive():
             tpu_child.join_output()  # drain a just-printed final result line
         if tpu_child.result is not None:
-            consider(tpu_child.result, rank=3)
+            # the headline is banked; give the secondary-config pass a
+            # bounded window to enrich it, then take whichever is newest
+            enrich_by = min(deadline - 20, time.monotonic() + 300)
+            while (tpu_child.alive()
+                   and "result_final" not in tpu_child.events
+                   and time.monotonic() < enrich_by):
+                time.sleep(1.0)
+            if not tpu_child.alive():
+                tpu_child.join_output()
+            final = tpu_child.events.get("result_final")
+            consider(final["result"] if final else tpu_child.result, rank=3)
+            tpu_child.kill()
             break
         consider(tpu_child.best_partial, rank=2)
         tpu_child.kill()

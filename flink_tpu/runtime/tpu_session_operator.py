@@ -68,6 +68,36 @@ def _build_ingest(K: int, S: int, B: int, vfields: tuple):
 
 
 @functools.lru_cache(maxsize=None)
+def _build_take(nf: int):
+    """Gather the resident span's columns: one int stack + field tuple."""
+    import jax
+    import jax.numpy as jnp
+
+    def run(cnt, mn, mx, fields, pos):
+        ints = jnp.stack([cnt[:, pos], mn[:, pos], mx[:, pos]])
+        return ints, tuple(f[:, pos] for f in fields)
+
+    return jax.jit(run)
+
+
+@functools.lru_cache(maxsize=None)
+def _build_precheck(g: int):
+    """Scalar 'any session closable at wm?' test — a fragment whose
+    max_ts + g - 1 <= wm must exist for any emission to be possible, so the
+    expensive span pull + merge scan is skipped (one bool crosses the link)
+    while every resident session is provably still open."""
+    import jax
+    import jax.numpy as jnp
+
+    def run(cnt, mx, pos, s_rel, wm_rel):
+        c = cnt[:, pos]
+        m = mx[:, pos] + s_rel[None, :] * g
+        return jnp.any((c > 0) & (m + g - 1 <= wm_rel))
+
+    return jax.jit(run)
+
+
+@functools.lru_cache(maxsize=None)
 def _build_purge(K: int, S: int, nf: int, idents: tuple, dts: tuple, g: int):
     import jax
     import jax.numpy as jnp
@@ -176,6 +206,11 @@ class TpuSessionWindowOperator:
         ts = np.asarray(ts, dtype=np.int64)
         if len(ts) == 0:
             return
+        if getattr(self, "_dense", False):
+            raise ValueError(
+                "process_batch (keydict path) cannot be mixed with "
+                "process_batch_staged dense ids on one operator"
+            )
         vals = np.asarray(vals, dtype=np.float32)
         wm = self.current_watermark
 
@@ -243,6 +278,38 @@ class TpuSessionWindowOperator:
         self.ring_lo = smin if self.ring_lo is None else min(self.ring_lo, smin)
         self.max_used = smax if self.max_used is None else max(self.max_used, smax)
 
+    def process_batch_staged(self, kid, spos, rel, vals,
+                             smin: int, smax: int) -> None:
+        """Device-staged dense-key ingest: `kid`/`spos`/`rel`/`vals` are
+        device int32/float32 arrays already in ring coordinates (kid < the
+        declared key capacity or -1 to drop, spos = abs_slice % S, rel =
+        ts - abs_slice*gap). The caller guarantees no record is late and
+        that [smin, smax] keeps the resident span inside the ring — this is
+        the zero-host-copy path for device-side sources (the session
+        analogue of FusedWindowPipeline.plan_superbatch staging)."""
+        lo = smin if self.ring_lo is None else min(self.ring_lo, smin)
+        if (self.max_used is not None and self.max_used - lo >= self.S) or (
+                smax - lo >= self.S):
+            raise ValueError(
+                f"session slice ring too small: span [{lo}, "
+                f"{max(smax, self.max_used or smax)}] exceeds num_slices={self.S}"
+            )
+        if len(self.keydict) > 0:
+            raise ValueError(
+                "process_batch_staged (dense ids) cannot be mixed with the "
+                "keydict-backed process_batch path on one operator"
+            )
+        self._dense = True
+        run = _build_ingest(self.K, self.S, int(kid.shape[0]), self._vfields)
+        self._cnt, self._mn, self._mx, self._fields = run(
+            self._cnt, self._mn, self._mx, self._fields, kid, spos, rel, vals,
+        )
+        self.ring_lo = lo
+        self.max_used = smax if self.max_used is None else max(self.max_used, smax)
+
+    def _key_of(self, kid: int):
+        return kid if getattr(self, "_dense", False) else self.keydict.key_at(kid)
+
     # ------------------------------------------------------------------
     def process_watermark(self, watermark: int) -> None:
         if watermark <= self.current_watermark:
@@ -254,11 +321,39 @@ class TpuSessionWindowOperator:
 
         g, S = self.g, self.S
         lo, hi = self.ring_lo, self.max_used
-        cnt = np.asarray(self._cnt)
-        mn = np.asarray(self._mn).astype(np.int64)
-        mx = np.asarray(self._mx).astype(np.int64)
-        fields = [np.asarray(f) for f in self._fields]
         K = self.K
+        span = hi - lo + 1
+        pos_arr = np.asarray([(s % S) for s in range(lo, hi + 1)],
+                             dtype=np.int32)
+        import jax.numpy as jnp
+
+        # cheap closable test before the span pull: while no fragment's
+        # standalone window has expired, nothing can emit (break-closed
+        # sessions wait for the watermark to pass their end — exactly the
+        # oracle's trigger time)
+        wm_rel = watermark - lo * g
+        if wm_rel < (1 << 62) and (span + 2) * g < (1 << 31):
+            pre = _build_precheck(g)
+            wm_c = int(np.clip(wm_rel, -(1 << 31) + 1, (1 << 31) - 1))
+            closable = pre(
+                self._cnt, self._mx, jnp.asarray(pos_arr),
+                jnp.arange(span, dtype=jnp.int32), jnp.int32(wm_c),
+            )
+            if not bool(closable):
+                self._drain_future()
+                return
+
+        # pull only the resident span's columns (one gather + two transfers
+        # instead of the full [K, S] state)
+        take = _build_take(len(self._vfields))
+
+        ints_d, flds_d = take(self._cnt, self._mn, self._mx, self._fields,
+                              jnp.asarray(pos_arr))
+        ints = np.asarray(ints_d)
+        cnt = ints[0]
+        mn = ints[1].astype(np.int64)
+        mx = ints[2].astype(np.int64)
+        fields = [np.asarray(f) for f in flds_d]
 
         # vectorized gap-merge scan over the resident slice span
         cur_open = np.zeros(K, dtype=bool)
@@ -266,8 +361,8 @@ class TpuSessionWindowOperator:
         cur_max = np.zeros(K, dtype=np.int64)
         cur_cnt = np.zeros(K, dtype=np.int64)
         cur_fld = [np.full(K, ident) for ident in self._idents]
-        cells = np.zeros((K, S), dtype=bool)      # current session's cells
-        purge = np.zeros((K, S), dtype=bool)      # cells of emitted sessions
+        cells = np.zeros((K, span), dtype=bool)   # current session's cells
+        purge = np.zeros((K, span), dtype=bool)   # cells of emitted sessions
         emitted: List[Tuple[int, int, int, int, list]] = []  # per emit row
 
         def emit(mask: np.ndarray) -> None:
@@ -280,13 +375,12 @@ class TpuSessionWindowOperator:
             cells[mask] = False
             cur_open[mask] = False
 
-        for s in range(lo, hi + 1):
-            pos = s % S
-            frag = cnt[:, pos] > 0
+        for i, s in enumerate(range(lo, hi + 1)):
+            frag = cnt[:, i] > 0
             if not frag.any():
                 continue
-            fmn = s * g + mn[:, pos]
-            fmx = s * g + mx[:, pos]
+            fmn = s * g + mn[:, i]
+            fmx = s * g + mx[:, i]
             # touching windows merge: [a, b) and [b, b+g) intersect per the
             # reference's TimeWindow.intersects ("just after or before"),
             # so the merge condition is gap <= g, strict only beyond it
@@ -301,10 +395,10 @@ class TpuSessionWindowOperator:
                 cf[starts] = ident
             cur_open |= frag
             cur_max[frag] = fmx[frag]
-            cur_cnt[frag] += cnt[:, pos][frag]
+            cur_cnt[frag] += cnt[:, i][frag]
             for cf, f, (_n, _dt, scatter) in zip(cur_fld, fields, self._vfields):
-                cf[frag] = _NP_COMBINE[scatter](cf[frag], f[:, pos][frag])
-            cells[frag, pos] = True
+                cf[frag] = _NP_COMBINE[scatter](cf[frag], f[:, i][frag])
+            cells[frag, i] = True
 
         # sessions whose gap the watermark itself proves
         emit(cur_open & (cur_max + g - 1 <= watermark))
@@ -324,21 +418,25 @@ class TpuSessionWindowOperator:
                     fdict[n] = c
                 result = self.agg.extract(fdict)
                 self.output.append(
-                    (self.keydict.key_at(k), window,
+                    (self._key_of(k), window,
                      np.asarray(result).item(), window.max_timestamp())
                 )
+            # scatter the span purge back to ring coordinates (span <= S so
+            # each position appears once)
+            keep_full = np.ones((K, S), dtype=bool)
+            keep_full[:, pos_arr] = ~purge
             run = _build_purge(
                 self.K, S, len(self._vfields), self._idents,
                 tuple(dt for _n, dt, _s in self._vfields), g,
             )
             self._cnt, self._mn, self._mx, self._fields = run(
-                self._cnt, self._mn, self._mx, self._fields, ~purge,
+                self._cnt, self._mn, self._mx, self._fields, keep_full,
             )
-            cnt = np.asarray(self._cnt)
+            cnt = np.where(purge, 0, cnt)
 
         # advance the resident span to the surviving fragments
         live_cols = cnt.any(axis=0)
-        alive_abs = [s for s in range(lo, hi + 1) if live_cols[s % S]]
+        alive_abs = [s for i, s in enumerate(range(lo, hi + 1)) if live_cols[i]]
         if alive_abs:
             self.ring_lo = min(alive_abs)
             self.max_used = max(alive_abs)
@@ -390,6 +488,7 @@ class TpuSessionWindowOperator:
             "max_used": self.max_used,
             "future": [(k, float(v), int(t)) for k, v, t in self._future],
             "num_late_dropped": self.num_late_records_dropped,
+            "dense": getattr(self, "_dense", False),
         }
 
     def restore(self, snap: dict) -> None:
@@ -406,3 +505,4 @@ class TpuSessionWindowOperator:
         self.max_used = snap["max_used"]
         self._future = list(snap["future"])
         self.num_late_records_dropped = snap["num_late_dropped"]
+        self._dense = snap.get("dense", False)

@@ -231,3 +231,55 @@ def test_session_inverted_skew_raises_config_error():
     with pytest.raises(ValueError, match="ring too small"):
         op.process_batch(np.asarray(["k", "k"]), np.zeros(2, np.float32),
                          np.asarray([5, 645], dtype=np.int64))
+
+
+def test_session_staged_ingest_matches_host_path():
+    """process_batch_staged (device-staged dense-key ingest) produces the
+    same emissions as the host process_batch path on an identical stream."""
+    import jax.numpy as jnp
+
+    gap, S = 500, 16
+    rng = np.random.default_rng(21)
+    host_op = TpuSessionWindowOperator(
+        EventTimeSessionWindows.with_gap(gap), "sum",
+        key_capacity=32, num_slices=S,
+    )
+    dev_op = TpuSessionWindowOperator(
+        EventTimeSessionWindows.with_gap(gap), "sum",
+        key_capacity=32, num_slices=S,
+    )
+    out_h, out_d = [], []
+    t_cursor = 0
+    for t in range(6):
+        keys = rng.integers(0, 32, size=200).astype(np.int64)
+        ts = np.sort(t_cursor + rng.integers(0, 400, size=200)).astype(np.int64)
+        vals = rng.integers(1, 5, size=200).astype(np.float32)
+        host_op.process_batch(keys, vals, ts)
+        s_abs = ts // gap
+        dev_op.process_batch_staged(
+            jnp.asarray(keys.astype(np.int32)),
+            jnp.asarray((s_abs % S).astype(np.int32)),
+            jnp.asarray((ts - s_abs * gap).astype(np.int32)),
+            jnp.asarray(vals),
+            int(s_abs.min()), int(s_abs.max()),
+        )
+        wm = t_cursor + 400 - 100
+        host_op.process_watermark(wm)
+        dev_op.process_watermark(wm)
+        out_h.extend(host_op.drain_output())
+        out_d.extend(dev_op.drain_output())
+        t_cursor += 400 + (gap * 3 if t % 2 else 0)
+    host_op.process_watermark(1 << 40)
+    dev_op.process_watermark(1 << 40)
+    out_h.extend(host_op.drain_output())
+    out_d.extend(dev_op.drain_output())
+    # host path emits dictionary keys; staged path emits the dense ids —
+    # the host keydict maps them 1:1 here (int keys inserted in order seen)
+    norm_h = sorted((int(k), w.start, w.end, float(r)) for k, w, r, _ in out_h)
+    norm_d = sorted((int(k), w.start, w.end, float(r)) for k, w, r, _ in out_d)
+    assert len(norm_h) > 0
+    # compare window/value multisets and per-window totals (id spaces align
+    # only if insertion order matched; compare on (start, end, value) plus
+    # totals per key count)
+    assert sorted(x[1:] for x in norm_h) == sorted(x[1:] for x in norm_d)
+    assert len({x[0] for x in norm_h}) == len({x[0] for x in norm_d})
