@@ -617,10 +617,10 @@ def _slice_rows(buf, n: int):
     return _row_slicer(n)(buf)
 
 
-@functools.lru_cache(maxsize=None)
-def _build_superscan(agg, K, S, NSB, F, R, SPW, chunk, exact, T, B):
-    """Compiled T-step superscan; module-level cache so every pipeline with
-    identical geometry (incl. warmup instances) shares one executable."""
+def make_superscan_step(agg, K, S, NSB, F, R, SPW, chunk, exact):
+    """The per-step ingest/fire/purge body, shared by the single-chip
+    superscan and the shard_map sharded superscan (each shard runs this on
+    its local key range)."""
     import jax
     import jax.numpy as jnp
 
@@ -703,6 +703,17 @@ def _build_superscan(agg, K, S, NSB, F, R, SPW, chunk, exact, T, B):
                 for name, dt, _scatter, ident in vfields
             }
         return (state, count, outs, count_out), None
+
+    return step
+
+
+@functools.lru_cache(maxsize=None)
+def _build_superscan(agg, K, S, NSB, F, R, SPW, chunk, exact, T, B):
+    """Compiled T-step superscan; module-level cache so every pipeline with
+    identical geometry (incl. warmup instances) shares one executable."""
+    import jax
+
+    step = make_superscan_step(agg, K, S, NSB, F, R, SPW, chunk, exact)
 
     @jax.jit
     def run(state, count, outs, count_out, idx, vals, smin_pos, fire_pos, fire_valid, fire_row, purge_mask):

@@ -50,3 +50,29 @@ class KeyedWindowOperatorHarness:
 
     def restore(self, snap: dict) -> None:
         self.op.restore(snap)
+
+
+def keyed_window_stream(seed: int, steps: int, batch: int, num_keys: int,
+                        with_vals: bool = False, ms_per_batch: float = 400.0,
+                        jitter_ms: int = 120, wm_lag_ms: int = 150):
+    """Deterministic keyed test stream shared by the sharded-superscan tests
+    and the driver dryrun: sorted random timestamps per batch with backward
+    jitter strictly below the watermark lag, so late-drop behavior is
+    deterministic across operators. Returns (batches, watermarks) where
+    batches[t] = (keys, vals|None, ts)."""
+    import numpy as np
+
+    rng = np.random.default_rng(seed)
+    batches, wms = [], []
+    t_cursor = 0.0
+    for _ in range(steps):
+        keys = rng.integers(0, num_keys, size=batch).astype(np.int32)
+        base = t_cursor + np.sort(rng.random(batch)) * ms_per_batch
+        ts = np.maximum(
+            base.astype(np.int64) - rng.integers(0, jitter_ms, batch), 0)
+        vals = (rng.integers(0, 9, size=batch).astype(np.float32)
+                if with_vals else None)
+        batches.append((keys, vals, ts))
+        wms.append(int(base[-1]) - wm_lag_ms)
+        t_cursor += ms_per_batch
+    return batches, wms
