@@ -169,6 +169,19 @@ class DataStream:
             },
         )
 
+    def get_side_output(self, tag) -> "DataStream":
+        """The stream of this operator's side output for `tag`
+        (SingleOutputStreamOperator.getSideOutput / OutputTag). Works on the
+        result of process()-style operators that call ctx.output(tag, v) and
+        on windowed streams with side_output_late_data()."""
+        from flink_tpu.api.functions import OutputTag
+
+        if not isinstance(tag, OutputTag):
+            tag = OutputTag(str(tag))
+        t = Transformation("side_output", f"side:{tag.tag_id}",
+                           [self.transform], {"tag": tag})
+        return DataStream(self.env, t)
+
     # -- multi-input topologies (DataStream.java:111) ----------------------
     def union(self, *others: "DataStream") -> "DataStream":
         """Merge streams of the same type; watermarks min-combine across the

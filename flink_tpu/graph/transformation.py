@@ -117,8 +117,10 @@ class StepGraph:
         for i, s in enumerate(self.steps):
             ins = ",".join(
                 (f"src:{e.name}" if isinstance(e, Transformation) else f"step:{e.name}")
+                + (f"[{edge[2]}]" if len(edge) > 2 and edge[2] else "")
                 + f"@{o}"
-                for e, o in s.inputs
+                for edge in s.inputs
+                for e, o in [edge[:2]]
             )
             lines.append(f"step[{i}] ({s.partitioning}) [{ins}]: {s.name}")
         return "\n".join(lines)
@@ -182,9 +184,11 @@ def plan(sink_transforms) -> StepGraph:
     sources: List[Transformation] = []
     steps: List[Step] = []
     # producer[node.id] = source Transformation | Step whose output carries
-    # the node's records; keyed[node.id] = key_by config for keyed views
+    # the node's records; keyed[node.id] = key_by config for keyed views;
+    # side_tag[node.id] = the producing step's side-output channel
     producer: Dict[int, Any] = {}
     keyed: Dict[int, Dict[str, Any]] = {}
+    side_tag: Dict[int, str] = {}
 
     def new_step(**kw) -> Step:
         s = Step(**kw)
@@ -192,12 +196,13 @@ def plan(sink_transforms) -> StepGraph:
         return s
 
     def input_of(t: Transformation, inp: Transformation, ordinal: int):
-        """(producer, ordinal, partitioning, key_selector) for one edge."""
+        """(producer, ordinal, tag, partitioning, key_selector) per edge."""
         ent = producer[inp.id]
+        tag = side_tag.get(inp.id)
         if inp.id in keyed:
             k = keyed[inp.id]
-            return ent, ordinal, "key_group", k["key_selector"]
-        return ent, ordinal, "forward", None
+            return ent, ordinal, tag, "key_group", k["key_selector"]
+        return ent, ordinal, tag, "forward", None
 
     for t in order:
         if t.kind == "source":
@@ -205,7 +210,14 @@ def plan(sink_transforms) -> StepGraph:
             producer[t.id] = t
         elif t.kind == "key_by":
             producer[t.id] = producer[t.inputs[0].id]
+            if t.inputs[0].id in side_tag:
+                side_tag[t.id] = side_tag[t.inputs[0].id]
             keyed[t.id] = t.config  # re-keying: the newest selector wins
+        elif t.kind == "side_output":
+            # a tagged view of the producing step's side channel
+            # (OutputTag / SingleOutputStreamOperator.getSideOutput)
+            producer[t.id] = producer[t.inputs[0].id]
+            side_tag[t.id] = t.config["tag"].tag_id
         elif t.kind in CHAINABLE:
             inp = t.inputs[0]
             ent = producer[inp.id]
@@ -214,31 +226,32 @@ def plan(sink_transforms) -> StepGraph:
                 and ent.terminal is None
                 and consumers.get(inp.id, 0) == 1
                 and inp.id not in keyed
+                and inp.id not in side_tag
                 and ent.chain
                 and ent.chain[-1].id == inp.id
             ):
                 ent.chain.append(t)          # fuse into the open chain
                 producer[t.id] = ent
             else:
-                ent2, _o, part, ks = input_of(t, inp, 0)
+                ent2, _o, tag, part, ks = input_of(t, inp, 0)
                 producer[t.id] = new_step(
                     chain=[t], terminal=None, partitioning=part,
-                    key_selector=ks, inputs=[(ent2, 0)],
+                    key_selector=ks, inputs=[(ent2, 0, tag)],
                 )
         elif t.kind in TERMINALS:
             inp = t.inputs[0]
-            ent, _o, part, ks = input_of(t, inp, 0)
+            ent, _o, tag, part, ks = input_of(t, inp, 0)
             producer[t.id] = new_step(
                 chain=[], terminal=t, partitioning=part,
-                key_selector=ks, inputs=[(ent, 0)],
+                key_selector=ks, inputs=[(ent, 0, tag)],
             )
         elif t.kind in MULTI_TERMINALS:
             ins = []
             part = "forward"
             ks = None
             for o, inp in enumerate(t.inputs):
-                ent, _o, p, k = input_of(t, inp, o)
-                ins.append((ent, o))
+                ent, _o, tag, p, k = input_of(t, inp, o)
+                ins.append((ent, o, tag))
                 if p == "key_group":
                     part, ks = p, (ks or k)
             producer[t.id] = new_step(

@@ -85,7 +85,7 @@ class ShardedFusedPipeline:
             assigner, aggregate,
             key_capacity=key_capacity, num_slices=num_slices, nsb=nsb,
             fires_per_step=fires_per_step, out_rows=out_rows, chunk=chunk,
-            exact_sums=exact_sums, backend="xla",
+            exact_sums=exact_sums, backend="xla", plan_only=True,
         )
         self.agg = self._planner.agg
         self.K = key_capacity

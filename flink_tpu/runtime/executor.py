@@ -83,7 +83,19 @@ class _FanOut:
 
 class StepRunner:
     downstream: Optional[_FanOut] = None
+    sides: Optional[Dict[str, _FanOut]] = None   # side-output channels by tag
     num_inputs: int = 1
+
+    def side_channel(self, tag_id: str) -> _FanOut:
+        if self.sides is None:
+            self.sides = {}
+        if tag_id not in self.sides:
+            self.sides[tag_id] = _FanOut()
+        return self.sides[tag_id]
+
+    def emit_side(self, tag_id: str, values, timestamps) -> None:
+        if self.sides and tag_id in self.sides:
+            self.sides[tag_id].on_batch(values, timestamps)
 
     def register_metrics(self, group) -> None:
         # operator-scope IO metrics (TaskIOMetricGroup.java:48 analogue)
@@ -122,10 +134,16 @@ class StepRunner:
     def on_watermark(self, watermark: int) -> None:
         if self.downstream:
             self.downstream.on_watermark(watermark)
+        if self.sides:
+            for f in self.sides.values():
+                f.on_watermark(watermark)
 
     def on_end(self) -> None:
         if self.downstream:
             self.downstream.on_end()
+        if self.sides:
+            for f in self.sides.values():
+                f.on_end()
 
     def snapshot(self) -> dict:
         return {}
@@ -388,14 +406,27 @@ class WindowStepRunner(StepRunner):
         safe = getattr(self.op, "emitted_watermark", None)
         if safe is not None:
             watermark = min(watermark, safe)
-        if watermark > MIN_WATERMARK and self.downstream:
-            self.downstream.on_watermark(watermark)
+        if watermark > MIN_WATERMARK:
+            if self.downstream:
+                self.downstream.on_watermark(watermark)
+            if self.sides:
+                for f in self.sides.values():
+                    f.on_watermark(watermark)
 
     def on_end(self) -> None:
         self._drain()
         super().on_end()
 
     def _drain(self) -> None:
+        op_sides = getattr(self.op, "side_output", None)
+        if op_sides:
+            for tag_id, rows in list(op_sides.items()):
+                if rows and self.sides and tag_id in self.sides:
+                    vals = obj_array([(k, v) for (k, v, _t) in rows])
+                    tss = np.asarray([t for (_k, _v, t) in rows], dtype=np.int64)
+                    self.emit_side(tag_id, vals, tss)
+                # rows without a consumer are dropped, not accumulated
+                op_sides[tag_id] = []
         out = self.op.drain_output()
         if out and self.downstream:
             vals = obj_array(
@@ -474,6 +505,7 @@ class KeyedProcessRunner(StepRunner):
         self.timers = InternalTimerService(self._on_event_timer, lambda *a: None)
         self._out: List = []
         self._out_ts: List[int] = []
+        self._side_buf: Dict[str, tuple] = {}
         self.uid = t.uid
 
     class _TimerService:
@@ -491,7 +523,12 @@ class KeyedProcessRunner(StepRunner):
             return self._r.state
 
     def _ctx(self, key, timestamp):
-        side = lambda tag, value: None  # side outputs arrive with OutputTag wiring
+        def side(tag, value):
+            tag_id = getattr(tag, "tag_id", tag)
+            buf = self._side_buf.setdefault(tag_id, ([], []))
+            buf[0].append(value)
+            buf[1].append(timestamp)
+
         return ProcessFunction.Context(timestamp, self._TimerService(self, key), side)
 
     def _on_event_timer(self, time, key, _ns) -> None:
@@ -518,11 +555,21 @@ class KeyedProcessRunner(StepRunner):
         super().on_watermark(watermark)
 
     def _flush(self):
-        if self._out and self.downstream:
-            self.downstream.on_batch(
-                obj_array(self._out), np.asarray(self._out_ts, dtype=np.int64)
-            )
+        if self._out:
+            if self.downstream:
+                self.downstream.on_batch(
+                    obj_array(self._out),
+                    np.asarray(self._out_ts, dtype=np.int64))
+            # clear even without a consumer (a step may be reachable only
+            # through its side output) — unconsumed output must not pile up
             self._out, self._out_ts = [], []
+        if self._side_buf:
+            for tag_id, (vals, tss) in self._side_buf.items():
+                if vals:
+                    self.emit_side(
+                        tag_id, obj_array(vals),
+                        np.asarray(tss, dtype=np.int64))
+            self._side_buf = {}
 
     def snapshot(self) -> dict:
         return {"state": self.state.snapshot(), "timers": self.timers.snapshot()}
@@ -784,9 +831,15 @@ def build_runners(graph: StepGraph, config: Configuration):
     feeds: Dict[int, List] = {}
     for step in graph.steps:
         r = runner_of[id(step)]
-        for entity, ordinal in step.inputs:
+        for edge in step.inputs:
+            entity, ordinal = edge[0], edge[1]
+            tag = edge[2] if len(edge) > 2 else None
             if isinstance(entity, Transformation):       # a source feeds this
+                if tag is not None:
+                    raise ValueError("sources have no side-output channels")
                 feeds.setdefault(entity.id, []).append((r, ordinal))
+            elif tag is not None:
+                runner_of[id(entity)].side_channel(tag).add(r, ordinal)
             else:
                 up = runner_of[id(entity)]
                 if up.downstream is None:

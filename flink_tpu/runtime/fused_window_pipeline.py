@@ -193,6 +193,7 @@ class FusedWindowPipeline:
         exact_sums: bool = True,
         backend: str = "auto",        # 'auto' | 'xla' | 'pallas'
         pallas_interpret: bool = False,
+        plan_only: bool = False,      # host planner/cursors only, no device state
     ):
         agg = resolve(aggregate)
         if agg is None:
@@ -230,14 +231,21 @@ class FusedWindowPipeline:
         self._value_fields = [f for f in agg.fields if f.source == VALUE]
         self._needs_vals = bool(self._value_fields)
 
-        import jax.numpy as jnp
+        self.plan_only = plan_only
+        if plan_only:
+            # pure host planner (e.g. the sharded pipeline's control plane):
+            # never allocate the [K, S] device arrays
+            self._state = {}
+            self._count = None
+        else:
+            import jax.numpy as jnp
 
-        self._state: Dict[str, Any] = {
-            f.name: jnp.full((self.K, self.S), f.identity, jnp.dtype(f.dtype))
-            for f in agg.fields
-            if f.source == VALUE
-        }
-        self._count = jnp.zeros((self.K, self.S), jnp.int32)
+            self._state = {
+                f.name: jnp.full((self.K, self.S), f.identity, jnp.dtype(f.dtype))
+                for f in agg.fields
+                if f.source == VALUE
+            }
+            self._count = jnp.zeros((self.K, self.S), jnp.int32)
 
         # host-side stream position
         self.watermark = MIN_WATERMARK

@@ -17,10 +17,23 @@ from __future__ import annotations
 
 import copy
 import dataclasses
+import time
 from typing import Any, Callable, Dict, Iterable, List, Optional, Tuple
 
 from flink_tpu.api.functions import AggregateFunction, ReduceFunction, as_reduce_function
 from flink_tpu.core.keygroups import KeyGroupRange, assign_to_key_group
+
+
+@dataclasses.dataclass(frozen=True)
+class StateTtlConfig:
+    """State time-to-live (TtlStateFactory.java:54 analogue): processing-time
+    TTL with NeverReturnExpired visibility. `update_on_read=True` matches
+    UpdateType.OnReadAndWrite; default is OnCreateAndWrite. Expired entries
+    are invisible immediately, dropped on access, and filtered from
+    snapshots (the 'cleanup in full snapshot' strategy)."""
+
+    ttl_ms: int
+    update_on_read: bool = False
 
 
 @dataclasses.dataclass(frozen=True)
@@ -30,26 +43,28 @@ class StateDescriptor:
     default: Any = None
     reduce_fn: Optional[ReduceFunction] = None
     agg_fn: Optional[AggregateFunction] = None
+    ttl: Optional[StateTtlConfig] = None
 
 
-def value_state(name: str, default=None) -> StateDescriptor:
-    return StateDescriptor(name, "value", default)
+def value_state(name: str, default=None, ttl: Optional[StateTtlConfig] = None) -> StateDescriptor:
+    return StateDescriptor(name, "value", default, ttl=ttl)
 
 
-def list_state(name: str) -> StateDescriptor:
-    return StateDescriptor(name, "list")
+def list_state(name: str, ttl: Optional[StateTtlConfig] = None) -> StateDescriptor:
+    return StateDescriptor(name, "list", ttl=ttl)
 
 
-def map_state(name: str) -> StateDescriptor:
-    return StateDescriptor(name, "map")
+def map_state(name: str, ttl: Optional[StateTtlConfig] = None) -> StateDescriptor:
+    return StateDescriptor(name, "map", ttl=ttl)
 
 
-def reducing_state(name: str, reduce_fn) -> StateDescriptor:
-    return StateDescriptor(name, "reducing", reduce_fn=as_reduce_function(reduce_fn))
+def reducing_state(name: str, reduce_fn, ttl: Optional[StateTtlConfig] = None) -> StateDescriptor:
+    return StateDescriptor(name, "reducing", reduce_fn=as_reduce_function(reduce_fn), ttl=ttl)
 
 
-def aggregating_state(name: str, agg_fn: AggregateFunction) -> StateDescriptor:
-    return StateDescriptor(name, "aggregating", agg_fn=agg_fn)
+def aggregating_state(name: str, agg_fn: AggregateFunction,
+                      ttl: Optional[StateTtlConfig] = None) -> StateDescriptor:
+    return StateDescriptor(name, "aggregating", agg_fn=agg_fn, ttl=ttl)
 
 
 class HeapKeyedStateBackend:
@@ -61,11 +76,15 @@ class HeapKeyedStateBackend:
     """
 
     def __init__(self, key_group_range: KeyGroupRange, max_parallelism: int,
-                 auto_register: bool = False):
+                 auto_register: bool = False,
+                 clock: Optional[Callable[[], int]] = None):
         self.key_group_range = key_group_range
         self.max_parallelism = max_parallelism
         self.auto_register = auto_register
+        # processing-time source for state TTL (injectable for tests)
+        self.clock = clock or (lambda: int(time.time() * 1000))
         self._tables: Dict[str, Dict[int, Dict[Tuple, Any]]] = {}
+        self._ttl_ts: Dict[str, Dict[int, Dict[Tuple, int]]] = {}
         self._descriptors: Dict[str, StateDescriptor] = {}
         self._current_key: Any = None
         self._current_key_group: int = -1
@@ -82,6 +101,15 @@ class HeapKeyedStateBackend:
     def register(self, descriptor: StateDescriptor) -> None:
         self._descriptors.setdefault(descriptor.name, descriptor)
         self._tables.setdefault(descriptor.name, {})
+        if descriptor.ttl is not None:
+            # a TTL descriptor registered over already-restored entries (the
+            # auto_register/late-registration path) must stamp them now, or
+            # they would never expire
+            now = self.clock()
+            for kg, entries in self._tables[descriptor.name].items():
+                stamps = self._ttl_ts.setdefault(descriptor.name, {}).setdefault(kg, {})
+                for k in entries:
+                    stamps.setdefault(k, now)
 
     # -- access (key from context, namespace explicit) --------------------
     def _slot(self, name: str) -> Dict[Tuple, Any]:
@@ -98,16 +126,38 @@ class HeapKeyedStateBackend:
             table = self._tables[name]
         return table.setdefault(self._current_key_group, {})
 
+    def _ttl_slot(self, name: str) -> Dict[Tuple, int]:
+        return self._ttl_ts.setdefault(name, {}).setdefault(
+            self._current_key_group, {})
+
+    def _ttl_live(self, name: str, desc: StateDescriptor, k: Tuple) -> bool:
+        """NeverReturnExpired: drop + report dead when past the TTL."""
+        ts = self._ttl_slot(name).get(k)
+        if ts is not None and self.clock() - ts > desc.ttl.ttl_ms:
+            self._slot(name).pop(k, None)
+            self._ttl_slot(name).pop(k, None)
+            return False
+        return True
+
     def get(self, name: str, namespace=None):
         slot = self._slot(name)  # may dynamically register (auto_register)
         desc = self._descriptors[name]
-        val = slot.get((self._current_key, namespace), _MISSING)
+        k = (self._current_key, namespace)
+        val = slot.get(k, _MISSING)
+        if val is not _MISSING and desc.ttl is not None:
+            if not self._ttl_live(name, desc, k):
+                val = _MISSING
+            elif desc.ttl.update_on_read:
+                self._ttl_slot(name)[k] = self.clock()
         if val is _MISSING:
             return copy.copy(desc.default) if desc.kind == "value" else None
         return val
 
     def put(self, name: str, value, namespace=None) -> None:
-        self._slot(name)[(self._current_key, namespace)] = value
+        k = (self._current_key, namespace)
+        self._slot(name)[k] = value
+        if self._descriptors[name].ttl is not None:
+            self._ttl_slot(name)[k] = self.clock()
 
     def add(self, name: str, value, namespace=None) -> None:
         """Reducing/Aggregating/List add (HeapAggregatingState.add:94)."""
@@ -117,7 +167,11 @@ class HeapKeyedStateBackend:
         desc = self._descriptors[name]
         slot = self._slot(name)
         k = (self._current_key, namespace)
+        if desc.ttl is not None and not self._ttl_live(name, desc, k):
+            pass  # expired accumulator restarts from scratch
         cur = slot.get(k, _MISSING)
+        if desc.ttl is not None:
+            self._ttl_slot(name)[k] = self.clock()
         if desc.kind == "list":
             if cur is _MISSING:
                 slot[k] = [value]
@@ -133,15 +187,21 @@ class HeapKeyedStateBackend:
 
     def clear(self, name: str, namespace=None) -> None:
         self._slot(name).pop((self._current_key, namespace), None)
+        if self._descriptors.get(name) is not None and \
+                self._descriptors[name].ttl is not None:
+            self._ttl_slot(name).pop((self._current_key, namespace), None)
 
     def merge_namespaces(self, name: str, target, sources: Iterable) -> None:
         """Merge state of `sources` namespaces into `target` for the current
         key (used by session-window merge; InternalMergingState)."""
         desc = self._descriptors[name]
         slot = self._slot(name)
+        ttl_slot = self._ttl_slot(name) if desc.ttl is not None else None
         merged = slot.pop((self._current_key, target), _MISSING)
         for ns in sources:
             v = slot.pop((self._current_key, ns), _MISSING)
+            if ttl_slot is not None:
+                ttl_slot.pop((self._current_key, ns), None)
             if v is _MISSING:
                 continue
             if merged is _MISSING:
@@ -156,6 +216,8 @@ class HeapKeyedStateBackend:
                 raise TypeError(f"merge not supported for kind {desc.kind}")
         if merged is not _MISSING:
             slot[(self._current_key, target)] = merged
+            if ttl_slot is not None:
+                ttl_slot[(self._current_key, target)] = self.clock()
 
     # -- introspection / snapshot ----------------------------------------
     def namespaces_for_key(self, name: str, key) -> List:
@@ -173,8 +235,27 @@ class HeapKeyedStateBackend:
         return all(not kg for t in self._tables.values() for kg in t.values())
 
     def snapshot(self) -> Dict:
-        """Per-key-group snapshot: {state_name: {kg: {(key, ns): value}}}."""
-        return copy.deepcopy(self._tables)
+        """Per-key-group snapshot: {state_name: {kg: {(key, ns): value}}}.
+        Expired TTL entries are filtered out (the reference's
+        'cleanup in full snapshot' strategy); surviving entries restore with
+        a fresh TTL stamp."""
+        now = self.clock()
+        out = {}
+        for name, table in self._tables.items():
+            desc = self._descriptors.get(name)
+            if desc is None or desc.ttl is None:
+                out[name] = copy.deepcopy(table)
+                continue
+            ttl = desc.ttl.ttl_ms
+            out[name] = {
+                kg: {
+                    k: copy.deepcopy(v)
+                    for k, v in entries.items()
+                    if now - self._ttl_ts.get(name, {}).get(kg, {}).get(k, now) <= ttl
+                }
+                for kg, entries in table.items()
+            }
+        return out
 
     def restore(self, snap: Dict, descriptors: Optional[Dict[str, StateDescriptor]] = None) -> None:
         if descriptors:
@@ -191,6 +272,16 @@ class HeapKeyedStateBackend:
         }
         for name in self._descriptors:
             self._tables.setdefault(name, {})
+        # restored TTL entries restart their clock at restore time
+        now = self.clock()
+        self._ttl_ts = {}
+        for name, desc in self._descriptors.items():
+            if desc.ttl is None:
+                continue
+            for kg, entries in self._tables.get(name, {}).items():
+                self._ttl_ts.setdefault(name, {})[kg] = {
+                    k: now for k in entries
+                }
 
     @property
     def descriptors(self) -> Dict[str, StateDescriptor]:

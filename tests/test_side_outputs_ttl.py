@@ -1,0 +1,250 @@
+"""General OutputTag side outputs + state TTL through the public API.
+
+Reference surface: OutputTag usage across streaming/api/datastream
+(SingleOutputStreamOperator.getSideOutput, ProcessFunction.Context.output)
+and TtlStateFactory.java:54.
+"""
+
+import numpy as np
+
+from flink_tpu.api.datastream import StreamExecutionEnvironment
+from flink_tpu.api.functions import LATE_DATA_TAG, OutputTag
+from flink_tpu.api.windowing.assigners import TumblingEventTimeWindows
+from flink_tpu.config import Configuration, ExecutionOptions
+from flink_tpu.core.keygroups import KeyGroupRange
+from flink_tpu.core.watermarks import WatermarkStrategy
+from flink_tpu.state.heap import (
+    HeapKeyedStateBackend,
+    StateTtlConfig,
+    list_state,
+    value_state,
+)
+
+
+def _env(batch=8):
+    conf = Configuration()
+    conf.set(ExecutionOptions.BATCH_SIZE, batch)
+    return StreamExecutionEnvironment.get_execution_environment(conf)
+
+
+def _stream(env, pairs):
+    values = [p[0] for p in pairs]
+    ts_map = {i: p[1] for i, p in enumerate(pairs)}
+    s = env.from_collection(
+        list(enumerate(values)),
+        timestamp_fn=lambda iv: ts_map[iv[0]],
+        watermark_strategy=WatermarkStrategy.for_monotonous_timestamps(),
+    )
+    return s.map(lambda iv: iv[1], name="unwrap")
+
+
+# ---------------------------------------------------------------------------
+# side outputs
+# ---------------------------------------------------------------------------
+
+def test_process_function_side_output_routes_by_tag():
+    REJECTED = OutputTag("rejected")
+
+    class Validate:
+        def process_element(self, v, ctx):
+            if v[1] < 0:
+                ctx.output(REJECTED, v)
+                return []
+            return [v]
+
+    env = _env()
+    s = _stream(env, [(("k", 5), 10), (("k", -3), 20), (("k", 7), 30),
+                      (("k", -1), 40)])
+    main = s.key_by(lambda v: v[0]).process(Validate())
+    good = main.collect()
+    bad = main.get_side_output(REJECTED).collect()
+    env.execute()
+    assert sorted(good.results) == [("k", 5), ("k", 7)]
+    assert sorted(bad.results) == [("k", -3), ("k", -1)]
+
+
+def test_side_output_feeds_downstream_operators():
+    """A side stream is a full DataStream: transforms and windows compose."""
+    ALERTS = OutputTag("alerts")
+
+    class Monitor:
+        def process_element(self, v, ctx):
+            if v[1] > 100:
+                ctx.output(ALERTS, (v[0], v[1]))
+            return [v]
+
+    env = _env()
+    s = _stream(env, [(("a", 50), 100), (("a", 150), 200), (("b", 500), 300),
+                      (("a", 120), 2500)])
+    main = s.key_by(lambda v: v[0]).process(Monitor())
+    main.collect()
+    alert_counts = (
+        main.get_side_output(ALERTS)
+        .key_by(lambda v: v[0])
+        .window(TumblingEventTimeWindows.of(1000))
+        .count()
+        .collect()
+    )
+    env.execute()
+    # window [0,1000): a:1 (150), b:1 (500); window [2000,3000): a:1 (120)
+    assert sorted(alert_counts.results) == [("a", 1), ("a", 1), ("b", 1)]
+
+
+def test_window_late_data_side_output_via_api():
+    env = _env(batch=2)
+    # monotonic watermarks: the ts=50 record arrives after wm passed 5000
+    s = _stream(env, [(("k", 1), 100), (("k", 1), 5000), (("k", 1), 50)])
+    windowed = (
+        s.key_by(lambda v: v[0])
+        .window(TumblingEventTimeWindows.of(1000))
+        .side_output_late_data()
+        .count()
+    )
+    main = windowed.collect()
+    late = windowed.get_side_output(LATE_DATA_TAG).collect()
+    env.execute()
+    assert ("k", 1) in main.results          # window [0,1000) counted one
+    assert len(late.results) == 1            # the ts=50 record went late
+    key, _val = late.results[0]
+    assert key == "k"
+
+
+# ---------------------------------------------------------------------------
+# state TTL
+# ---------------------------------------------------------------------------
+
+class _FakeClock:
+    def __init__(self):
+        self.now = 0
+
+    def __call__(self):
+        return self.now
+
+
+def test_value_state_ttl_expires_and_refreshes_on_write():
+    clock = _FakeClock()
+    b = HeapKeyedStateBackend(KeyGroupRange(0, 127), 128, clock=clock)
+    b.register(value_state("v", ttl=StateTtlConfig(ttl_ms=100)))
+    b.set_current_key("k")
+    b.put("v", 42)
+    clock.now = 90
+    assert b.get("v") == 42
+    b.put("v", 43)               # OnCreateAndWrite refresh
+    clock.now = 180
+    assert b.get("v") == 43      # 90ms since last write
+    clock.now = 300
+    assert b.get("v") is None    # expired, NeverReturnExpired
+
+
+def test_ttl_update_on_read_extends_lifetime():
+    clock = _FakeClock()
+    b = HeapKeyedStateBackend(KeyGroupRange(0, 127), 128, clock=clock)
+    b.register(value_state(
+        "v", ttl=StateTtlConfig(ttl_ms=100, update_on_read=True)))
+    b.set_current_key("k")
+    b.put("v", 1)
+    for t in (80, 160, 240):     # each read extends
+        clock.now = t
+        assert b.get("v") == 1
+    clock.now = 400              # 160ms after the last read
+    assert b.get("v") is None
+
+
+def test_ttl_list_state_expired_accumulator_restarts():
+    clock = _FakeClock()
+    b = HeapKeyedStateBackend(KeyGroupRange(0, 127), 128, clock=clock)
+    b.register(list_state("l", ttl=StateTtlConfig(ttl_ms=100)))
+    b.set_current_key("k")
+    b.add("l", "a")
+    b.add("l", "b")
+    assert b.get("l") == ["a", "b"]
+    clock.now = 250
+    b.add("l", "c")              # prior list expired -> restart
+    assert b.get("l") == ["c"]
+
+
+def test_ttl_snapshot_filters_expired_entries():
+    clock = _FakeClock()
+    b = HeapKeyedStateBackend(KeyGroupRange(0, 127), 128, clock=clock)
+    b.register(value_state("v", ttl=StateTtlConfig(ttl_ms=100)))
+    b.set_current_key("old")
+    b.put("v", 1)
+    clock.now = 200
+    b.set_current_key("fresh")
+    b.put("v", 2)
+    snap = b.snapshot()
+    kept = {k for kg in snap["v"].values() for (k, _ns) in kg.keys()}
+    assert kept == {"fresh"}     # 'old' filtered (cleanup in full snapshot)
+
+    b2 = HeapKeyedStateBackend(KeyGroupRange(0, 127), 128, clock=clock)
+    b2.register(value_state("v", ttl=StateTtlConfig(ttl_ms=100)))
+    b2.restore(snap)
+    b2.set_current_key("fresh")
+    assert b2.get("v") == 2      # restored entries restart their clock
+    clock.now = 350
+    assert b2.get("v") is None
+
+
+def test_ttl_through_keyed_process_function():
+    """TTL state used from a real pipeline: a dedupe operator whose 'seen'
+    flag expires, letting the key through again later."""
+    from flink_tpu.graph.transformation import plan
+    from flink_tpu.runtime.executor import KeyedProcessRunner, build_runners
+
+    class Dedupe:
+        def process_element(self, v, ctx):
+            st = ctx.timer_service.state()
+            if st._descriptors.get("seen") is None:
+                st.register(value_state(
+                    "seen", ttl=StateTtlConfig(ttl_ms=1000)))
+            if st.get("seen"):
+                return []
+            st.put("seen", True)
+            return [v]
+
+    env = _env(batch=1)
+    s = _stream(env, [("a", 0), ("a", 1), ("b", 2), ("a", 3)])
+    sink = s.key_by(lambda v: v).process(Dedupe()).collect()
+
+    graph = plan(env._sinks)
+    from flink_tpu.runtime.executor import JobRuntime
+
+    rt = JobRuntime(graph, env.config)
+    clock = _FakeClock()
+    kpr = [r for r in rt.runners if isinstance(r, KeyedProcessRunner)][0]
+    kpr.state.clock = clock
+    rt.run()
+    assert sorted(sink.results) == ["a", "b"]
+
+    # a second stream after the TTL would re-admit 'a' — emulate by direct
+    # state inspection: the 'seen' entry dies past the TTL
+    kpr.state.set_current_key("a")
+    assert kpr.state.get("seen") is True
+    clock.now = 2000
+    assert kpr.state.get("seen") is None
+
+
+def test_window_side_output_carries_watermarks_downstream():
+    """Regression: a window operator's side channel must forward watermarks,
+    or an event-time operator consuming the late-data stream never fires."""
+    env = _env(batch=2)
+    s = _stream(env, [(("k", 1), 100), (("k", 1), 5000), (("k", 1), 50),
+                      (("k", 1), 60), (("k", 1), 9000)])
+    windowed = (
+        s.key_by(lambda v: v[0])
+        .window(TumblingEventTimeWindows.of(1000))
+        .side_output_late_data()
+        .count()
+    )
+    windowed.collect()
+    late_counts = (
+        windowed.get_side_output(LATE_DATA_TAG)
+        .key_by(lambda kv: kv[0])
+        .window(TumblingEventTimeWindows.of(10_000))
+        .count()
+        .collect()
+    )
+    env.execute()
+    # the two late records (ts 50, 60) must come out of the downstream
+    # event-time window — which only happens if watermarks flowed
+    assert late_counts.results == [("k", 2)]
