@@ -315,6 +315,13 @@ class FusedWindowPipeline:
         }
         self._kernel_layout = False
 
+    def _require_state(self) -> None:
+        if getattr(self, "plan_only", False):
+            raise RuntimeError(
+                "this FusedWindowPipeline is plan_only (host planner); it "
+                "has no device state to snapshot/restore/grow"
+            )
+
     def ensure_key_capacity(self, required: int) -> None:
         """Grow the key dimension (next pow2) when the dictionary outgrows K;
         existing rows keep their accumulators, new rows start at identity.
@@ -323,6 +330,7 @@ class FusedWindowPipeline:
         ensure_key_capacity."""
         if required <= self.K:
             return
+        self._require_state()
         self._to_canonical()
         import jax.numpy as jnp
 
@@ -583,6 +591,7 @@ class FusedWindowPipeline:
 
     # ------------------------------------------------------------------
     def snapshot(self) -> dict:
+        self._require_state()
         self._to_canonical()  # snapshots use the [K, S] layout across backends
         return {
             "state": {k: np.asarray(v) for k, v in self._state.items()},
@@ -596,6 +605,7 @@ class FusedWindowPipeline:
         }
 
     def restore(self, snap: dict) -> None:
+        self._require_state()
         import jax.numpy as jnp
 
         self._state = {k: jnp.asarray(v) for k, v in snap["state"].items()}

@@ -199,11 +199,15 @@ class MiniCluster:
         config: Configuration,
         savepoint_restore_path: Optional[str],
     ) -> None:
+        from flink_tpu.metrics.otel import OtlpJsonTraceReporter
         from flink_tpu.metrics.registry import MetricRegistry
         from flink_tpu.metrics.traces import TraceRegistry
 
         client.metrics = MetricRegistry()
         client.traces = TraceRegistry()
+        # OTel-shape export: buffered OTLP/JSON, served at /jobs/<id>/traces
+        client.otel = OtlpJsonTraceReporter(service_name="flink-tpu")
+        client.traces.add_reporter(client.otel)
         interval = config.get(CheckpointingOptions.INTERVAL_MS)
         chk_dir = config.get(CheckpointingOptions.DIRECTORY)
         storage = FsCheckpointStorage(chk_dir) if chk_dir else MemoryCheckpointStorage()

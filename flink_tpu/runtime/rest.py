@@ -149,6 +149,11 @@ class _Handler(BaseHTTPRequestHandler):
                         "error": repr(client.error) if client.error else None,
                     },
                 )
+            if parts[2] == "traces":
+                # OTLP/JSON resourceSpans (OpenTelemetryTraceReporter SPI)
+                if not hasattr(client, "otel"):
+                    return self._json(200, {"resourceSpans": []})
+                return self._json(200, client.otel.payload())
             if parts[2] == "metrics":
                 if not hasattr(client, "metrics"):
                     return self._json(200, {})

@@ -419,6 +419,7 @@ class TpuWindowOperator:
             "fire_cursor": self.fire_cursor,
             "future": [(k, float(v), int(t)) for k, v, t in self._future],
             "num_late_dropped": self.num_late_records_dropped,
+            "side_output": {k: list(v) for k, v in self.side_output.items()},
             "cold": self.cold_tier.snapshot() if self.cold_tier is not None else None,
         }
 
@@ -428,6 +429,9 @@ class TpuWindowOperator:
         self.fire_cursor = snap["fire_cursor"]
         self._future = list(snap["future"])
         self.num_late_records_dropped = snap["num_late_dropped"]
+        self.side_output = {
+            k: list(v) for k, v in snap.get("side_output", {}).items()
+        }
         if snap.get("cold") is not None and self.cold_tier is not None:
             self.cold_tier.restore(snap["cold"])
         self._pending = []

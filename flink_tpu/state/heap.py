@@ -99,7 +99,16 @@ class HeapKeyedStateBackend:
         return self._current_key
 
     def register(self, descriptor: StateDescriptor) -> None:
-        self._descriptors.setdefault(descriptor.name, descriptor)
+        existing = self._descriptors.get(descriptor.name)
+        if existing is not None and descriptor.name in getattr(
+                self, "_auto_names", set()):
+            # an explicit descriptor supersedes an auto-registered
+            # placeholder (get()-before-register with auto_register=True),
+            # so late TTL/kind declarations are honored, not discarded
+            self._descriptors[descriptor.name] = descriptor
+            self._auto_names.discard(descriptor.name)
+        else:
+            self._descriptors.setdefault(descriptor.name, descriptor)
         self._tables.setdefault(descriptor.name, {})
         if descriptor.ttl is not None:
             # a TTL descriptor registered over already-restored entries (the
@@ -121,8 +130,11 @@ class HeapKeyedStateBackend:
                     "first, or construct the backend with auto_register=True)"
                 )
             # dynamic registration: ProcessFunctions may declare state at
-            # first use (getState(descriptor) mid-stream in the reference)
+            # first use (getState(descriptor) mid-stream in the reference);
+            # mark it auto so an explicit register() can supersede it
             self.register(value_state(name))
+            self._auto_names = getattr(self, "_auto_names", set())
+            self._auto_names.add(name)
             table = self._tables[name]
         return table.setdefault(self._current_key_group, {})
 
@@ -164,11 +176,13 @@ class HeapKeyedStateBackend:
         if name not in self._descriptors and self.auto_register:
             # dynamic first-use via add() implies append semantics
             self.register(list_state(name))
+            self._auto_names = getattr(self, "_auto_names", set())
+            self._auto_names.add(name)
         desc = self._descriptors[name]
         slot = self._slot(name)
         k = (self._current_key, namespace)
-        if desc.ttl is not None and not self._ttl_live(name, desc, k):
-            pass  # expired accumulator restarts from scratch
+        if desc.ttl is not None:
+            self._ttl_live(name, desc, k)  # evicts an expired accumulator
         cur = slot.get(k, _MISSING)
         if desc.ttl is not None:
             self._ttl_slot(name)[k] = self.clock()

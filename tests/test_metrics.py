@@ -133,3 +133,54 @@ def test_flamegraph_sampling_and_tree():
         assert "busy_loop" in flat
     finally:
         stop.set()
+
+
+# ---------------------------------------------------------------------------
+# OTel-shape trace export (OTLP/JSON)
+# ---------------------------------------------------------------------------
+
+def test_otlp_span_encoding():
+    from flink_tpu.metrics.otel import span_to_otlp, spans_to_otlp
+    from flink_tpu.metrics.traces import Span
+
+    s = Span("checkpointing", "Checkpoint", 1000.0, 1250.5,
+             {"checkpointId": 7, "status": "COMPLETED", "full": True,
+              "ratio": 0.5})
+    enc = span_to_otlp(s)
+    assert len(enc["traceId"]) == 32 and len(enc["spanId"]) == 16
+    assert enc["name"] == "checkpointing.Checkpoint"
+    assert enc["startTimeUnixNano"] == str(int(1000.0 * 1e6))
+    assert enc["endTimeUnixNano"] == str(int(1250.5 * 1e6))
+    attrs = {a["key"]: a["value"] for a in enc["attributes"]}
+    assert attrs["checkpointId"] == {"intValue": "7"}
+    assert attrs["status"] == {"stringValue": "COMPLETED"}
+    assert attrs["full"] == {"boolValue": True}
+    assert attrs["ratio"] == {"doubleValue": 0.5}
+
+    doc = spans_to_otlp([enc], "svc")
+    rs = doc["resourceSpans"][0]
+    assert rs["resource"]["attributes"][0]["value"]["stringValue"] == "svc"
+    assert rs["scopeSpans"][0]["spans"] == [enc]
+
+
+def test_otlp_reporter_buffers_and_flushes_to_file(tmp_path):
+    import json
+
+    from flink_tpu.metrics.otel import OtlpJsonTraceReporter
+    from flink_tpu.metrics.traces import TraceRegistry
+
+    path = str(tmp_path / "traces.otlp.jsonl")
+    reg = TraceRegistry()
+    rep = OtlpJsonTraceReporter(service_name="svc", path=path)
+    reg.add_reporter(rep)
+    for i in range(3):
+        reg.report(reg.span("restart", "JobRestart")
+                   .set_attribute("attempt", i).end())
+    payload = rep.payload()
+    spans = payload["resourceSpans"][0]["scopeSpans"][0]["spans"]
+    assert len(spans) == 3
+    lines = open(path).read().strip().splitlines()
+    assert len(lines) == 3
+    first = json.loads(lines[0])
+    assert first["resourceSpans"][0]["scopeSpans"][0]["spans"][0][
+        "name"] == "restart.JobRestart"

@@ -33,14 +33,14 @@ def _post(url, body=None):
         return r.status, json.loads(r.read())
 
 
-def _app_script(tmp_path, count=500, sleep=0.0):
+def _app_script(tmp_path, count=500, sleep=0.0, checkpoint_ms=0):
     script = tmp_path / "app.py"
     script.write_text(textwrap.dedent(f"""
         import time
         import numpy as np
         from flink_tpu.api.datastream import StreamExecutionEnvironment
         from flink_tpu.api.windowing.assigners import TumblingEventTimeWindows
-        from flink_tpu.config import Configuration, ExecutionOptions
+        from flink_tpu.config import CheckpointingOptions, Configuration, ExecutionOptions
         from flink_tpu.connectors.sink import CollectSink
         from flink_tpu.connectors.source import Batch, DataGeneratorSource
         from flink_tpu.core.watermarks import WatermarkStrategy
@@ -54,6 +54,8 @@ def _app_script(tmp_path, count=500, sleep=0.0):
         def main():
             config = Configuration()
             config.set(ExecutionOptions.BATCH_SIZE, 50)
+            if {checkpoint_ms}:
+                config.set(CheckpointingOptions.INTERVAL_MS, {checkpoint_ms})
             env = StreamExecutionEnvironment(config)
             stream = env.from_source(
                 DataGeneratorSource(gen, count={count}),
@@ -155,3 +157,28 @@ def test_cli_against_rest(cluster_server, tmp_path, capsys):
     rc = main(["info", job_id, "--address", server.url])
     assert rc == 0
     assert '"status"' in capsys.readouterr().out
+
+
+def test_rest_traces_otlp(cluster_server, tmp_path):
+    """Checkpoint lifecycle spans surface as OTLP/JSON at /jobs/<id>/traces
+    (OpenTelemetryTraceReporter SPI analogue)."""
+    cluster, server = cluster_server
+    status, out = _post(
+        f"{server.url}/jars/run",
+        {"module": _app_script(tmp_path, count=400, sleep=0.02,
+                               checkpoint_ms=50)},
+    )
+    assert status == 200
+    job_id = out["jobid"]
+    assert cluster.jobs[job_id].wait(60) == JobStatus.FINISHED
+
+    status, body = _get(f"{server.url}/jobs/{job_id}/traces")
+    assert status == 200
+    doc = json.loads(body)
+    spans = doc["resourceSpans"][0]["scopeSpans"][0]["spans"]
+    assert spans, "expected checkpoint spans"
+    s0 = spans[0]
+    assert s0["name"] == "checkpointing.Checkpoint"
+    assert len(s0["traceId"]) == 32
+    attrs = {a["key"]: a["value"] for a in s0["attributes"]}
+    assert "checkpointId" in attrs

@@ -248,3 +248,17 @@ def test_window_side_output_carries_watermarks_downstream():
     # the two late records (ts 50, 60) must come out of the downstream
     # event-time window — which only happens if watermarks flowed
     assert late_counts.results == [("k", 2)]
+
+
+def test_explicit_register_supersedes_auto_registered_placeholder():
+    """Regression: get() before register() auto-registers a no-TTL value
+    placeholder; the later explicit TTL descriptor must win."""
+    clock = _FakeClock()
+    b = HeapKeyedStateBackend(KeyGroupRange(0, 127), 128,
+                              auto_register=True, clock=clock)
+    b.set_current_key("k")
+    assert b.get("seen") is None                 # auto-registers placeholder
+    b.register(value_state("seen", ttl=StateTtlConfig(ttl_ms=100)))
+    b.put("seen", True)
+    clock.now = 10_000
+    assert b.get("seen") is None                 # TTL actually applies
