@@ -12,7 +12,6 @@ file; the REST server serves the same payload at /jobs/<id>/traces.
 from __future__ import annotations
 
 import json
-import os
 import secrets
 import threading
 from typing import Any, Dict, List, Optional

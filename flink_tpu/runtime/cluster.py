@@ -139,6 +139,7 @@ class _JobState:
     pending: Dict[int, dict] = field(default_factory=dict)     # cp_id -> {shard: handle}
     pending_target: Dict[int, int] = field(default_factory=dict)
     completed: List[Tuple[int, dict, int]] = field(default_factory=list)  # (cp_id, handles, step)
+    cp_origins: Dict[int, Dict[int, str]] = field(default_factory=dict)    # cp_id -> {shard: tm_id}
     steps: Dict[int, int] = field(default_factory=dict)        # shard -> last reported step
 
 
@@ -261,6 +262,7 @@ class JobManagerEndpoint(RpcEndpoint):
         job = self._jobs[job_id]
         self._cancel_tasks(job)
         job.status = "CANCELED"
+        self._release_job_local_state(job)
 
     # ---- scheduling (M4-lite: deploy when slots cover parallelism) -------
     def _try_schedule_all(self) -> None:
@@ -305,9 +307,11 @@ class JobManagerEndpoint(RpcEndpoint):
             job.parallelism = min(len(slots), job.requested_parallelism)
         restore = None
         restore_step = 0
+        local_cp = None        # checkpoint id eligible for task-local restore
         if job.completed:
             cp_id, handles, step = job.completed[-1]
             restore, restore_step = handles, step
+            local_cp = cp_id
             if set(handles) != set(range(job.parallelism)):
                 # parallelism changed since the checkpoint: re-shard
                 try:
@@ -325,6 +329,7 @@ class JobManagerEndpoint(RpcEndpoint):
                                 else {**merged, "results": []})
                         for shard in range(job.parallelism)
                     }
+                    local_cp = None  # re-sharded state has no local copy
         job.attempt += 1
         job.assignment = {shard: slots[shard] for shard in range(job.parallelism)}
         peers = {
@@ -334,12 +339,19 @@ class JobManagerEndpoint(RpcEndpoint):
         job.steps = {}
         job.pending.clear()
         job.pending_target.clear()
+        origins = job.cp_origins.get(local_cp, {}) if local_cp is not None else {}
         for shard, tm_id in job.assignment.items():
+            # local recovery: a shard redeployed onto the TM that produced
+            # its snapshot restores from the TM-local copy — the snapshot is
+            # not re-shipped over the wire
+            use_local = local_cp is not None and origins.get(shard) == tm_id
             try:
                 self._tms[tm_id]["gateway"].deploy_task(
                     job.job_id, job.attempt, shard, job.parallelism, job.blob_key,
                     self.rpc.address, peers,
-                    restore[shard] if restore else None, restore_step,
+                    None if use_local else (restore[shard] if restore else None),
+                    restore_step,
+                    local_cp if use_local else None,
                 )
             except Exception:
                 # undetected-dead worker: evict it, cancel the partial
@@ -365,6 +377,7 @@ class JobManagerEndpoint(RpcEndpoint):
         self._cancel_tasks(job)
         if job.restarts >= self.restart_attempts:
             job.status = "FAILED"
+            self._release_job_local_state(job)
             return
         job.restarts += 1
         job.status = "RESTARTING"
@@ -376,6 +389,21 @@ class JobManagerEndpoint(RpcEndpoint):
         threading.Thread(target=delayed, daemon=True).start()
 
     # ---- task callbacks ---------------------------------------------------
+    def _release_job_local_state(self, job: _JobState) -> None:
+        """Best-effort: tell every TM to drop its task-local snapshot copies
+        for a terminally finished job (the copies exist only for recovery)."""
+        def _release(gateways=[tm["gateway"] for tm in self._tms.values()],
+                     job_id=job.job_id):
+            for gw in gateways:
+                try:
+                    gw.release_job_state(job_id)
+                except Exception:
+                    pass
+
+        # off the JM main thread: the TM handler is one-directional, but a
+        # dead TM's connect timeout must not stall scheduling
+        threading.Thread(target=_release, daemon=True).start()
+
     def task_finished(self, job_id: str, attempt: int, shard: int, results: list) -> None:
         job = self._jobs.get(job_id)
         if job is None or attempt != job.attempt:
@@ -383,6 +411,7 @@ class JobManagerEndpoint(RpcEndpoint):
         job.finished[shard] = results
         if len(job.finished) == job.parallelism:
             job.status = "FINISHED"
+            self._release_job_local_state(job)
 
     def task_failed(self, job_id: str, attempt: int, shard: int, error: str) -> None:
         job = self._jobs.get(job_id)
@@ -426,12 +455,29 @@ class JobManagerEndpoint(RpcEndpoint):
                     checkpoint_id, {"job": job_id, "shards": handles, "step": step}
                 )
             job.completed.append((checkpoint_id, handles, step))
+            # local recovery (S11): remember which TM produced each shard's
+            # snapshot, so a redeploy to the same TM can restore from its
+            # task-local copy (TaskLocalStateStoreImpl analogue)
+            job.cp_origins[checkpoint_id] = dict(job.assignment)
             # retain a bounded history in JM memory (durable copies live in
             # checkpoint storage); discard superseded ones
             while len(job.completed) > 3:
                 old_id, _, _ = job.completed.pop(0)
+                job.cp_origins.pop(old_id, None)
                 if self._storage is not None:
                     self._storage.discard(old_id)
+
+    def fetch_shard_restore(self, job_id: str, checkpoint_id: int, shard: int) -> dict:
+        """Local-recovery fallback: a TM whose task-local copy is missing
+        pulls the shard snapshot from the JM's retained checkpoints."""
+        job = self._jobs.get(job_id)
+        if job is not None:
+            for cp_id, handles, _step in job.completed:
+                if cp_id == checkpoint_id and shard in handles:
+                    return handles[shard]
+        raise KeyError(
+            f"no retained snapshot for job {job_id} cp {checkpoint_id} shard {shard}"
+        )
 
     def decline_checkpoint(self, job_id: str, attempt: int, shard: int,
                            checkpoint_id: int, reason: str) -> None:
@@ -461,7 +507,7 @@ class _ShardTask:
     def __init__(self, te: "TaskExecutorEndpoint", job_id: str, attempt: int,
                  shard: int, parallelism: int, spec: DistributedJobSpec,
                  jm_gateway, peers: Dict[int, str], restore: Optional[dict],
-                 restore_step: int):
+                 restore_step: int, restore_local_cp: Optional[int] = None):
         self.te = te
         self.job_id = job_id
         self.attempt = attempt
@@ -472,6 +518,7 @@ class _ShardTask:
         self.peers = peers
         self.restore = restore
         self.restore_step = restore_step
+        self.restore_local_cp = restore_local_cp
         self.cancelled = threading.Event()
         self.done = threading.Event()
         self.current_step = restore_step
@@ -566,6 +613,22 @@ class _ShardTask:
         batches = self.spec.source_factory(self.shard, P)
         op = self._make_operator()
         results: list = []
+        if self.restore is None and self.restore_local_cp is not None:
+            # local recovery (S11): restore from the TM-local copy of the
+            # snapshot this shard acked — nothing re-ships over the wire.
+            # Runs on the task thread, NOT deploy_task (which executes on
+            # the TM main thread while the JM main thread awaits the deploy
+            # reply — a synchronous JM fetch there would be a circular RPC).
+            local = self.te._local_state.get((self.job_id, self.shard))
+            if local is not None and local[0] == self.restore_local_cp:
+                self.restore = local[1]
+                self.te.num_local_restores += 1
+            else:
+                # local copy lost (e.g. the TM process restarted): pull the
+                # shard snapshot from the JM's retained checkpoints
+                self.restore = self.jm.fetch_shard_restore(
+                    self.job_id, self.restore_local_cp, self.shard
+                )
         if self.restore is not None:
             op_snap = self.restore["operator"]
             if self.restore.get("merged"):
@@ -613,6 +676,10 @@ class _ShardTask:
                     if target == step:
                         snap = {"operator": op.snapshot(), "step": step,
                                 "results": list(results)}
+                        # task-local state store (S11): keep the latest
+                        # snapshot on this TM for cheap local recovery
+                        self.te._local_state[(self.job_id, self.shard)] = (
+                            cp_id, snap)
                         self.jm.ack_checkpoint(
                             self.job_id, self.attempt, self.shard, cp_id, snap
                         )
@@ -694,6 +761,9 @@ class TaskExecutorEndpoint(RpcEndpoint):
         self.slots = slots
         self.exchange = ExchangeServer()
         self._tasks: Dict[Tuple[str, int, int], _ShardTask] = {}
+        # task-local state store (S11): latest acked snapshot per (job, shard)
+        self._local_state: Dict[Tuple[str, int], Tuple[int, dict]] = {}
+        self.num_local_restores = 0
         self._jm_gateway = None
         self._blob: Optional[BlobCache] = None
         rpc.register(self)
@@ -728,11 +798,13 @@ class TaskExecutorEndpoint(RpcEndpoint):
 
     def deploy_task(self, job_id: str, attempt: int, shard: int, parallelism: int,
                     blob_key: str, jm_address: str, peers: Dict[int, str],
-                    restore: Optional[dict], restore_step: int) -> bool:
+                    restore: Optional[dict], restore_step: int,
+                    restore_local_cp: Optional[int] = None) -> bool:
         spec = DistributedJobSpec.from_bytes(self._blob.get(blob_key))
         jm = self.rpc.gateway(jm_address, "jobmanager")
         task = _ShardTask(self, job_id, attempt, shard, parallelism, spec, jm,
-                          peers, restore, restore_step)
+                          peers, restore, restore_step,
+                          restore_local_cp=restore_local_cp)
         # superseded attempts can never be checkpointed or resumed: cancel
         # and drop them so restarts don't grow the task table without bound
         # (a still-running old-attempt thread would otherwise be unreachable
@@ -753,6 +825,14 @@ class TaskExecutorEndpoint(RpcEndpoint):
         for (jid, att, _shard), task in self._tasks.items():
             if jid == job_id and att == attempt and not task.cancelled.is_set():
                 task.request_checkpoint(cp_id, target_step)
+        return True
+
+    def release_job_state(self, job_id: str) -> bool:
+        """Drop task-local snapshot copies for a TERMINALLY finished job
+        (sent by the JM on FINISHED/FAILED/CANCELED — failover cancels must
+        NOT release, that is exactly when local recovery needs the copies)."""
+        for key in [k for k in self._local_state if k[0] == job_id]:
+            self._local_state.pop(key, None)
         return True
 
     def cancel_task(self, job_id: str) -> bool:

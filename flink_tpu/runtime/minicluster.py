@@ -57,6 +57,7 @@ class JobClient:
         self.error: Optional[BaseException] = None
         self.records_in = 0
         self.num_restarts = 0
+        self.num_checkpoints = 0
 
     # -- status -----------------------------------------------------------
     def status(self) -> JobStatus:
@@ -221,6 +222,10 @@ class MiniCluster:
             if interval > 0
             else None
         )
+        if coordinator is not None:
+            coordinator.register_on_complete(
+                lambda _cp, c=client, co=coordinator:
+                    setattr(c, "num_checkpoints", co.num_completed))
         strategy = restart_strategy_from_config(config)
         attempt = 0
 

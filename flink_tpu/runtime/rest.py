@@ -33,32 +33,7 @@ from flink_tpu.metrics.registry import prometheus_text
 from flink_tpu.runtime.minicluster import JobStatus, MiniCluster
 
 
-_DASHBOARD_HTML = """<!DOCTYPE html>
-<html><head><title>flink-tpu dashboard</title>
-<meta http-equiv="refresh" content="2">
-<style>
- body { font-family: monospace; margin: 2em; background:#101418; color:#d8dee9; }
- table { border-collapse: collapse; margin-top: 1em; }
- td, th { border: 1px solid #3b4252; padding: 6px 12px; text-align: left; }
- th { background: #2e3440; }
- .RUNNING { color: #a3be8c; } .FINISHED { color: #81a1c1; }
- .FAILED { color: #bf616a; } .CANCELED, .RESTARTING { color: #ebcb8b; }
- h1 { font-size: 1.3em; }
-</style></head>
-<body>
-<h1>flink-tpu — streaming on TPU</h1>
-<div id="overview">{overview}</div>
-<table><tr><th>job id</th><th>name</th><th>status</th><th>records in</th>
-<th>restarts</th></tr>{rows}</table>
-</body></html>"""
-
-
-def _job_row(client) -> str:
-    return (
-        f"<tr><td>{client.job_id}</td><td>{client.job_name}</td>"
-        f"<td class='{client.status().value}'>{client.status().value}</td>"
-        f"<td>{client.records_in}</td><td>{client.num_restarts}</td></tr>"
-    )
+from flink_tpu.runtime.web_dashboard import DASHBOARD_HTML
 
 
 class _Handler(BaseHTTPRequestHandler):
@@ -91,10 +66,8 @@ class _Handler(BaseHTTPRequestHandler):
     def do_GET(self):
         parts = [p for p in self.path.split("?")[0].split("/") if p]
         if not parts:
-            rows = "".join(_job_row(c) for c in self.cluster.jobs.values())
-            overview = f"{len(self.cluster.jobs)} jobs"
-            html = _DASHBOARD_HTML.replace("{rows}", rows).replace("{overview}", overview)
-            return self._send(200, html.encode(), "text/html")
+            # the live dashboard (web_dashboard.py) polls the JSON routes
+            return self._send(200, DASHBOARD_HTML.encode(), "text/html")
         if parts == ["overview"]:
             by_status = {}
             for c in self.cluster.jobs.values():
@@ -146,6 +119,7 @@ class _Handler(BaseHTTPRequestHandler):
                         "status": client.status().value,
                         "records_in": client.records_in,
                         "num_restarts": client.num_restarts,
+                        "num_checkpoints": getattr(client, "num_checkpoints", 0),
                         "error": repr(client.error) if client.error else None,
                     },
                 )

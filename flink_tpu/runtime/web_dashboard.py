@@ -1,0 +1,134 @@
+"""Single-file web dashboard served by the REST server at '/'.
+
+A deliberately dependency-free stand-in for the reference's Angular SPA
+(flink-runtime-web/web-dashboard): one HTML document with inline JS that
+polls the same public REST endpoints a human or script would use
+(/overview, /jobs, /jobs/<id>, /jobs/<id>/metrics, /jobs/<id>/traces) and
+renders job state, throughput, busy ratio, checkpoints, restarts, and the
+checkpoint span feed. Deep links: Prometheus text at /metrics, flame graphs
+at /flamegraph.
+"""
+
+DASHBOARD_HTML = """<!DOCTYPE html>
+<html><head><title>flink-tpu dashboard</title>
+<meta charset="utf-8">
+<style>
+ :root { --bg:#101418; --panel:#161b22; --line:#2e3440; --fg:#d8dee9;
+         --dim:#7a8494; --ok:#a3be8c; --info:#81a1c1; --bad:#bf616a;
+         --warn:#ebcb8b; }
+ body { font-family: ui-monospace, Menlo, monospace; margin: 0;
+        background: var(--bg); color: var(--fg); }
+ header { padding: 14px 22px; border-bottom: 1px solid var(--line);
+          display: flex; gap: 18px; align-items: baseline; }
+ h1 { font-size: 1.05rem; margin: 0; }
+ #overview { color: var(--dim); }
+ header a { color: var(--info); text-decoration: none; margin-left: 10px; }
+ main { padding: 18px 22px; }
+ table { border-collapse: collapse; width: 100%; }
+ td, th { border-bottom: 1px solid var(--line); padding: 7px 12px;
+          text-align: left; font-size: 0.86rem; }
+ th { color: var(--dim); font-weight: normal; }
+ tr.job { cursor: pointer; }
+ tr.job:hover { background: var(--panel); }
+ .RUNNING { color: var(--ok); } .FINISHED { color: var(--info); }
+ .FAILED { color: var(--bad); }
+ .CANCELED, .RESTARTING, .CREATED { color: var(--warn); }
+ .detail { background: var(--panel); }
+ .detail td { padding: 12px 16px; }
+ .kv { display: grid; grid-template-columns: repeat(auto-fill, minmax(210px, 1fr));
+       gap: 6px 18px; margin-bottom: 8px; }
+ .kv div span { color: var(--dim); margin-right: 6px; }
+ .spans { margin-top: 8px; color: var(--dim); font-size: 0.8rem;
+          max-height: 140px; overflow-y: auto; }
+ .empty { color: var(--dim); padding: 30px 0; }
+</style></head>
+<body>
+<header>
+  <h1>flink-tpu &mdash; streaming on TPU</h1>
+  <div id="overview">loading&hellip;</div>
+  <nav>
+    <a href="/metrics">prometheus</a>
+    <a href="/flamegraph">flamegraph</a>
+  </nav>
+</header>
+<main>
+  <table id="jobs"><thead>
+    <tr><th>job id</th><th>name</th><th>status</th><th>records in</th>
+        <th>rec/s</th><th>busy</th><th>restarts</th><th>checkpoints</th></tr>
+  </thead><tbody id="rows"></tbody></table>
+  <div id="none" class="empty" hidden>no jobs submitted yet</div>
+</main>
+<script>
+const open = new Set();
+const esc = (v) => String(v).replace(/[&<>"']/g,
+  c => ({"&":"&amp;","<":"&lt;",">":"&gt;",'"':"&quot;","'":"&#39;"}[c]));
+const fmt = (v, d=1) => v == null ? "-" :
+  (typeof v === "number" ? (v >= 1e6 ? (v/1e6).toFixed(d)+"M"
+   : v >= 1e3 ? (v/1e3).toFixed(d)+"k" : (Number.isInteger(v) ? v : v.toFixed(d))) : v);
+async function j(url) { const r = await fetch(url); return r.json(); }
+
+function kv(obj) {
+  return '<div class="kv">' + Object.entries(obj).map(
+    ([k, v]) => `<div><span>${k}</span>${v}</div>`).join("") + "</div>";
+}
+
+async function detailRow(id) {
+  const [info, metrics, traces] = await Promise.all([
+    j(`/jobs/${id}`), j(`/jobs/${id}/metrics`),
+    j(`/jobs/${id}/traces`).catch(() => ({resourceSpans: []})),
+  ]);
+  const spans = (traces.resourceSpans[0]?.scopeSpans[0]?.spans ?? []);
+  const spanRows = spans.slice(-12).reverse().map(s => {
+    const ms = (Number(s.endTimeUnixNano) - Number(s.startTimeUnixNano)) / 1e6;
+    const at = Object.fromEntries(
+      s.attributes.map(a => [a.key, Object.values(a.value)[0]]));
+    return esc(`${s.name} #${at.checkpointId ?? ""} ${ms.toFixed(1)}ms ` +
+               `${at.status ?? ""} ${fmt(Number(at.stateSizeBytes))}B`);
+  }).join("<br>");
+  const latency = metrics["job.stepLatencyMs"] || {};
+  return kv({
+    "records/s": fmt(metrics["job.numRecordsInPerSecond"]),
+    "busy ratio": fmt(metrics["job.busyTimeRatio"], 2),
+    "step p50 ms": fmt(latency.p50), "step p99 ms": fmt(latency.p99),
+    "late dropped": fmt(Object.entries(metrics).find(
+        ([k]) => k.endsWith("numLateRecordsDropped"))?.[1]),
+    "error": esc(info.error ?? "none"),
+  }) + (spanRows ? `<div class="spans">${spanRows}</div>` : "");
+}
+
+async function refresh() {
+  const [ov, jobs] = await Promise.all([j("/overview"), j("/jobs")]);
+  document.getElementById("overview").textContent =
+    `${ov.jobs} jobs ` + Object.entries(ov.by_status ?? {})
+      .map(([s, n]) => `${s.toLowerCase()}:${n}`).join(" ");
+  const tbody = document.getElementById("rows");
+  document.getElementById("none").hidden = jobs.jobs.length > 0;
+  const rows = [];
+  for (const job of jobs.jobs) {
+    const [d, m] = await Promise.all([
+      j(`/jobs/${job.id}`),
+      j(`/jobs/${job.id}/metrics`).catch(() => ({})),
+    ]);
+    rows.push(`<tr class="job" onclick="toggle('${esc(job.id)}')">
+      <td>${esc(job.id)}</td><td>${esc(job.name)}</td>
+      <td class="${esc(job.status)}">${esc(job.status)}</td>
+      <td>${fmt(d.records_in)}</td>
+      <td>${fmt(m["job.numRecordsInPerSecond"])}</td>
+      <td>${fmt(m["job.busyTimeRatio"], 2)}</td>
+      <td>${d.num_restarts ?? 0}</td>
+      <td>${d.num_checkpoints ?? 0}</td></tr>`);
+    if (open.has(job.id)) {
+      rows.push(`<tr class="detail"><td colspan="8">` +
+                await detailRow(job.id) + `</td></tr>`);
+    }
+  }
+  tbody.innerHTML = rows.join("");
+}
+function toggle(id) { open.has(id) ? open.delete(id) : open.add(id); refresh(); }
+// self-rescheduling: a slow refresh never overlaps the next one
+async function tick() {
+  try { await refresh(); } finally { setTimeout(tick, 2000); }
+}
+tick();
+</script>
+</body></html>"""

@@ -90,7 +90,8 @@ class ColdKeyTier:
     def __init__(self, agg: DeviceAggregator, ring_slices: int,
                  directory: Optional[str] = None,
                  flush_threshold: int = 1 << 18,
-                 purge_granularity: Optional[int] = None):
+                 purge_granularity: Optional[int] = None,
+                 gc_retained: int = 4):
         self.agg = agg
         self.S = ring_slices
         self.fields = list(agg.fields)
@@ -106,6 +107,12 @@ class ColdKeyTier:
             self.native = False
         self.num_cold_rows_written = 0
         self.num_cold_rows_purged = 0
+        # Disk GC window: keep run files reachable from this many recent
+        # manifests INCLUDING the in-flight one. Must be >= the checkpoint
+        # coordinator's max_retained + 1, or a failed in-flight checkpoint
+        # could orphan the oldest retained checkpoint's files.
+        self.gc_retained = gc_retained
+        self._retained_manifests: list = []
         # memtable spills to a sorted run past this size (bounds host RSS)
         self.flush_threshold = flush_threshold
         # retention cuts batch up: purge once the slice frontier has moved
@@ -187,11 +194,27 @@ class ColdKeyTier:
 
     # ------------------------------------------------------------------
     def snapshot(self) -> dict:
-        return {"manifest": self.store.checkpoint(), "dir": self.dir,
+        manifest = self.store.checkpoint()
+        if self.native:
+            # disk GC: files outside the retained-manifest window and the
+            # live run list are unreachable (every run a future restore can
+            # name lives in one of these manifests)
+            self._retained_manifests.append(manifest)
+            if len(self._retained_manifests) > self.gc_retained:
+                self._retained_manifests = \
+                    self._retained_manifests[-self.gc_retained:]
+            try:
+                self.store.gc(self._retained_manifests)
+            except OSError:
+                pass  # GC is best-effort; state correctness is unaffected
+        return {"manifest": manifest, "dir": self.dir,
                 "native": self.native, "purged_to_slice": self._purged_to_slice}
 
     def restore(self, snap: dict) -> None:
         self.store.restore(snap["manifest"])
+        # a fresh instance must not GC away files the restored (and earlier
+        # still-retained) checkpoints reference: seed the window
+        self._retained_manifests = [snap["manifest"]]
         self._purged_to_slice = snap.get("purged_to_slice")
 
     def compact(self) -> None:

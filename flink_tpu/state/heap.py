@@ -84,6 +84,7 @@ class HeapKeyedStateBackend:
         # processing-time source for state TTL (injectable for tests)
         self.clock = clock or (lambda: int(time.time() * 1000))
         self._tables: Dict[str, Dict[int, Dict[Tuple, Any]]] = {}
+        self._auto_names: set = set()   # auto-registered placeholder states
         self._ttl_ts: Dict[str, Dict[int, Dict[Tuple, int]]] = {}
         self._descriptors: Dict[str, StateDescriptor] = {}
         self._current_key: Any = None
@@ -100,8 +101,7 @@ class HeapKeyedStateBackend:
 
     def register(self, descriptor: StateDescriptor) -> None:
         existing = self._descriptors.get(descriptor.name)
-        if existing is not None and descriptor.name in getattr(
-                self, "_auto_names", set()):
+        if existing is not None and descriptor.name in self._auto_names:
             # an explicit descriptor supersedes an auto-registered
             # placeholder (get()-before-register with auto_register=True),
             # so late TTL/kind declarations are honored, not discarded
@@ -133,7 +133,6 @@ class HeapKeyedStateBackend:
             # first use (getState(descriptor) mid-stream in the reference);
             # mark it auto so an explicit register() can supersede it
             self.register(value_state(name))
-            self._auto_names = getattr(self, "_auto_names", set())
             self._auto_names.add(name)
             table = self._tables[name]
         return table.setdefault(self._current_key_group, {})
@@ -176,7 +175,6 @@ class HeapKeyedStateBackend:
         if name not in self._descriptors and self.auto_register:
             # dynamic first-use via add() implies append semantics
             self.register(list_state(name))
-            self._auto_names = getattr(self, "_auto_names", set())
             self._auto_names.add(name)
         desc = self._descriptors[name]
         slot = self._slot(name)

@@ -116,6 +116,8 @@ def _declare(lib: ctypes.CDLL) -> None:
     lib.ss_compact.argtypes = [c.c_void_p]
     lib.ss_manifest.restype = c.c_int64
     lib.ss_manifest.argtypes = [c.c_void_p, c.c_void_p, c.c_int64]
+    lib.ss_gc.restype = c.c_int64
+    lib.ss_gc.argtypes = [c.c_void_p, c.c_char_p, c.c_int64]
     lib.ss_restore.restype = c.c_int64
     lib.ss_restore.argtypes = [c.c_void_p, c.c_char_p, c.c_int64]
     lib.ss_clear.argtypes = [c.c_void_p]
@@ -321,6 +323,17 @@ class NativeSpillStore:
         n = self._lib.ss_purge_below(self._handle, ctypes.c_uint64(threshold))
         if n < 0:
             raise OSError(f"spill purge failed in {self.dir}")
+        return int(n)
+
+    def gc(self, retained_manifests) -> int:
+        """Unlink run files referenced by neither the live run list nor any
+        retained checkpoint manifest (the shared-state registry's
+        unregisterUnusedState analogue). Pass the manifests the checkpoint
+        retention window still holds."""
+        blob = "\n".join(m for m in retained_manifests if m).encode()
+        n = self._lib.ss_gc(self._handle, blob, len(blob))
+        if n < 0:
+            raise OSError(f"spill gc failed in {self.dir}")
         return int(n)
 
     def restore(self, manifest: str) -> None:

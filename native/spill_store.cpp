@@ -23,8 +23,12 @@
 #include <cstdint>
 #include <cstdio>
 #include <cstring>
+#include <set>
 #include <string>
 #include <vector>
+
+#include <dirent.h>
+#include <unistd.h>
 
 extern "C" {
 
@@ -412,6 +416,55 @@ int64_t ss_restore(SpillStore* st, const char* manifest, int64_t len) {
     st->runs.push_back(r);
   }
   return (int64_t)st->runs.size();
+}
+
+// Garbage-collect superseded run files. Compaction/purge rewrite runs but
+// must leave old files on disk while earlier checkpoint manifests still
+// reference them; once the checkpoint coordinator's retention window moves
+// past those manifests the files are unreachable garbage (the analogue of
+// RocksDB's shared-state registry discarding unreferenced SSTs,
+// SharedStateRegistryImpl.unregisterUnusedState). `retained` is the
+// \n-joined union of run ids referenced by every RETAINED manifest; the
+// live run list is always kept. Returns files unlinked, -1 on error.
+int64_t ss_gc(SpillStore* st, const char* retained, int64_t len) {
+  std::set<std::string> keep;
+  std::string cur;
+  for (int64_t i = 0; i < len; i++) {
+    char c = retained[i];
+    if (c == '\n') {
+      if (!cur.empty()) keep.insert(cur);
+      cur.clear();
+    } else {
+      cur.push_back(c);
+    }
+  }
+  if (!cur.empty()) keep.insert(cur);
+  for (auto* r : st->runs) {
+    // live runs: manifests store file names, so keep the basename
+    size_t slash = r->path.rfind('/');
+    keep.insert(slash == std::string::npos ? r->path
+                                           : r->path.substr(slash + 1));
+  }
+  DIR* d = opendir(st->dir.c_str());
+  if (!d) return -1;
+  int64_t deleted = 0;
+  bool err = false;
+  struct dirent* e;
+  while ((e = readdir(d)) != nullptr) {
+    std::string name(e->d_name);
+    if (name.size() < 10 || name.compare(0, 4, "run-") != 0) continue;
+    size_t dot = name.rfind(".spill");
+    if (dot == std::string::npos || dot + 6 != name.size()) continue;
+    if (keep.count(name)) continue;
+    std::string full = st->dir + "/" + name;
+    if (::unlink(full.c_str()) == 0) {
+      deleted++;
+    } else {
+      err = true;
+    }
+  }
+  closedir(d);
+  return err ? -1 : deleted;
 }
 
 }  // extern "C"

@@ -397,3 +397,94 @@ def test_cluster_rescales_down_after_tm_loss(tmp_path):
     jm.heartbeats.stop()
     svc_jm.stop()
     svc1.stop()
+
+
+def test_local_recovery_restores_from_tm_local_copy(tmp_path):
+    """S11 local recovery: a task that fails after a checkpoint and is
+    redeployed onto the SAME TaskExecutor restores from the TM-local copy of
+    its snapshot (no snapshot re-ships), and results stay exact."""
+    flag = tmp_path / "failed-once"
+
+    def source_factory(shard, num_shards, _flag=str(flag)):
+        import os as _os
+
+        rng = np.random.default_rng(500)
+        batches = []
+        for s in range(14):
+            keys = np.asarray([f"k{v}" for v in rng.integers(0, 5, 40)],
+                              dtype=object)
+            vals = np.ones(40, dtype=np.float64)
+            ts = (s * 1000 + rng.integers(0, 1000, 40)).astype(np.int64)
+            batches.append((keys, vals, ts, s * 1000 + 500))
+
+        class _FailOnce(list):
+            def __getitem__(self, i):
+                time.sleep(0.15)
+                if i >= 10 and not _os.path.exists(_flag):
+                    open(_flag, "w").write("x")
+                    raise RuntimeError("injected task failure")
+                return list.__getitem__(self, i)
+
+        return _FailOnce(batches)
+
+    spec = DistributedJobSpec(
+        name="local-recovery",
+        source_factory=source_factory,
+        assigner=TumblingEventTimeWindows.of(2000),
+        aggregate="sum",
+        max_parallelism=16,
+    )
+
+    svc_jm = RpcService()
+    jm = JobManagerEndpoint(
+        svc_jm, checkpoint_dir=str(tmp_path / "chk"),
+        restart_attempts=3, restart_delay=0.2,
+        heartbeat_interval=0.2, heartbeat_timeout=5.0,
+    )
+    svc1 = RpcService()
+    te1 = TaskExecutorEndpoint(svc1, slots=1)
+    te1.connect(svc_jm.address)
+    client = svc_jm.gateway(svc_jm.address, "jobmanager")
+    job_id = client.submit_job(spec.to_bytes(), 1)
+
+    # land a checkpoint before the injected failure at step >= 6
+    deadline = time.time() + 20
+    while time.time() < deadline:
+        if client.trigger_checkpoint(job_id) and \
+                client.job_status(job_id)["checkpoints"]:
+            break
+        time.sleep(0.1)
+    assert client.job_status(job_id)["checkpoints"]
+
+    deadline = time.time() + 60
+    while time.time() < deadline:
+        st = client.job_status(job_id)
+        if st["status"] in ("FINISHED", "FAILED"):
+            break
+        time.sleep(0.2)
+    assert st["status"] == "FINISHED", st
+    assert st["restarts"] >= 1
+    assert te1.num_local_restores >= 1, "expected a TM-local restore"
+
+    def clean_factory(shard, num_shards):
+        rng = np.random.default_rng(500)
+        batches = []
+        for s in range(14):
+            keys = np.asarray([f"k{v}" for v in rng.integers(0, 5, 40)],
+                              dtype=object)
+            vals = np.ones(40, dtype=np.float64)
+            ts = (s * 1000 + rng.integers(0, 1000, 40)).astype(np.int64)
+            batches.append((keys, vals, ts, s * 1000 + 500))
+        return batches
+
+    ref_spec = DistributedJobSpec(
+        name="ref", source_factory=clean_factory,
+        assigner=TumblingEventTimeWindows.of(2000), aggregate="sum",
+        max_parallelism=16,
+    )
+    assert _collect(client.job_result(job_id)) == _expected(ref_spec, 1)
+
+    te1.stop()
+    jm.heartbeats.stop()
+    svc_jm.stop()
+    svc1.stop()

@@ -116,3 +116,45 @@ def test_segment_ring_backpressure():
         out.append(item)
     assert out == [b"seg-1", b"seg-2", b"seg-3", b"seg-4"]
     assert not ring.offer(b"x" * 100)  # larger than a segment
+
+
+def test_spill_store_gc_unlinks_superseded_runs(tmp_path):
+    """Compaction/purge rewrite runs; files outside the retained-manifest
+    window must be unlinked (disk growth was unbounded before ss_gc)."""
+    import os
+
+    from flink_tpu.utils.native_bridge import NativeSpillStore, get_lib
+
+    if get_lib() is None:
+        import pytest
+
+        pytest.skip("native lib unavailable")
+
+    d = str(tmp_path)
+    st = NativeSpillStore(16, d)
+    manifests = []
+    for round_i in range(5):
+        keys = np.arange(round_i * 100, round_i * 100 + 100, dtype=np.uint64)
+        vals = np.zeros((100, 16), dtype=np.uint8)
+        st.put_batch(keys, vals)
+        manifests.append(st.checkpoint())   # flush -> one run per round
+        st.compact()                        # supersedes all prior files
+
+    files = lambda: sorted(f for f in os.listdir(d) if f.endswith(".spill"))
+    assert len(files()) >= 6               # 5 flushed + compacted rewrites
+
+    # retain the last 2 manifests: everything else is garbage
+    deleted = st.gc(manifests[-2:])
+    assert deleted > 0
+    kept = files()
+    referenced = set()
+    for m in manifests[-2:]:
+        referenced.update(x for x in m.splitlines() if x)
+    live = {x for x in st.checkpoint().splitlines() if x}
+    assert set(kept) <= (referenced | live)
+
+    # restoring the oldest RETAINED manifest still works after GC
+    st2 = NativeSpillStore(16, d)
+    st2.restore(manifests[-2])
+    out, mask = st2.get_batch(np.arange(0, 400, dtype=np.uint64))
+    assert mask.sum() > 0
