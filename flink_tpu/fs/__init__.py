@@ -1,0 +1,1 @@
+"""Cloud filesystem drivers for the scheme-routed FileSystem SPI (C4)."""

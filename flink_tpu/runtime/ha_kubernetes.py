@@ -238,7 +238,11 @@ class KubernetesLeaderElection:
 
     def stop(self, release: bool = True) -> None:
         self._running = False
-        self._thread.join(timeout=5)
+        # join longer than the transport timeout (10s): an in-flight renew
+        # completing AFTER the release below would resurrect the lease
+        self._thread.join(timeout=12)
+        if self._thread.is_alive():
+            release = False  # cannot release safely under a wedged renew
         if release and self.is_leader:
             try:
                 lease = self.api.get_lease(self.namespace, self.lease_name)

@@ -104,11 +104,12 @@ async function refresh() {
   const tbody = document.getElementById("rows");
   document.getElementById("none").hidden = jobs.jobs.length > 0;
   const rows = [];
-  for (const job of jobs.jobs) {
-    const [d, m] = await Promise.all([
-      j(`/jobs/${job.id}`),
-      j(`/jobs/${job.id}/metrics`).catch(() => ({})),
-    ]);
+  const fetched = await Promise.all(jobs.jobs.map(job => Promise.all([
+    j(`/jobs/${job.id}`),
+    j(`/jobs/${job.id}/metrics`).catch(() => ({})),
+  ])));
+  for (const [i, job] of jobs.jobs.entries()) {
+    const [d, m] = fetched[i];
     rows.push(`<tr class="job" onclick="toggle('${esc(job.id)}')">
       <td>${esc(job.id)}</td><td>${esc(job.name)}</td>
       <td class="${esc(job.status)}">${esc(job.status)}</td>

@@ -113,6 +113,7 @@ class ColdKeyTier:
         # could orphan the oldest retained checkpoint's files.
         self.gc_retained = gc_retained
         self._retained_manifests: list = []
+        self._gc_holdoff = 0
         # memtable spills to a sorted run past this size (bounds host RSS)
         self.flush_threshold = flush_threshold
         # retention cuts batch up: purge once the slice frontier has moved
@@ -203,18 +204,26 @@ class ColdKeyTier:
             if len(self._retained_manifests) > self.gc_retained:
                 self._retained_manifests = \
                     self._retained_manifests[-self.gc_retained:]
-            try:
-                self.store.gc(self._retained_manifests)
-            except OSError:
-                pass  # GC is best-effort; state correctness is unaffected
+            if self._gc_holdoff > 0:
+                self._gc_holdoff -= 1
+            else:
+                try:
+                    self.store.gc(self._retained_manifests)
+                except OSError:
+                    pass  # GC is best-effort; correctness is unaffected
         return {"manifest": manifest, "dir": self.dir,
                 "native": self.native, "purged_to_slice": self._purged_to_slice}
 
     def restore(self, snap: dict) -> None:
         self.store.restore(snap["manifest"])
-        # a fresh instance must not GC away files the restored (and earlier
-        # still-retained) checkpoints reference: seed the window
+        # A fresh instance must not GC away files that checkpoints from
+        # BEFORE the restore still reference (the coordinator may retain
+        # several older than the one restored, and their manifests are not
+        # knowable here): hold GC off until gc_retained NEW checkpoints have
+        # completed, by which time the retention window has provably rolled
+        # past every pre-restore checkpoint.
         self._retained_manifests = [snap["manifest"]]
+        self._gc_holdoff = self.gc_retained
         self._purged_to_slice = snap.get("purged_to_slice")
 
     def compact(self) -> None:
