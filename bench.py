@@ -265,9 +265,12 @@ def run_tpu_stream(T: int, B: int, spans: int, depth: int, t0_step: int = 0,
 
     with_vals = agg != "count"
     pallas = backend != "xla"
+    # count-only pallas dispatches fit CH=32768 int8 one-hots in VMEM
+    # (measured ~1.7x the 8192-chunk rate); weighted stays at 8192 bf16
+    chunk = (32768 if not with_vals else 8192) if pallas else 4096
 
     def mk():
-        return _new_pipe(chunk=8192 if pallas else 4096, backend=backend,
+        return _new_pipe(chunk=chunk, backend=backend,
                          window_ms=window_ms, slide_ms=slide_ms, agg=agg)
 
     pipe = mk()

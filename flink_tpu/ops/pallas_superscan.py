@@ -68,8 +68,11 @@ def supports(agg, K: int, R: int, S: int, NSB: int, chunk: int) -> bool:
     # CH] + oh_lo [CH, 128], bf16) are the dominant transient
     nf = len(value_fields)
     state_bytes = S * K * 4 * (1 + nf) + R * K * 4 * (1 + nf)
-    onehot_bytes = ((NSB * K // LANE) * chunk + chunk * LANE) * 2
-    return state_bytes + onehot_bytes <= 12 * 1024 * 1024
+    # count-only dispatches build int8 one-hot factors (1 byte), weighted
+    # ones bf16 (2 bytes, needed for the split-float value terms)
+    bytes_per = 1 if nf == 0 else 2
+    onehot_bytes = ((NSB * K // LANE) * chunk + chunk * LANE) * bytes_per
+    return state_bytes + onehot_bytes <= 15 * 1024 * 1024
 
 
 @functools.lru_cache(maxsize=None)
@@ -129,6 +132,11 @@ def build_superscan(
                 o[:] = jnp.zeros_like(o)
 
         # ---- ingest one chunk: one-hot factors in VMEM, MXU contraction ----
+        # count-only dispatches use int8 factors with an int32 MXU
+        # accumulator (exact, half the VMEM, measured ~1.7x the bf16 form);
+        # weighted dispatches need bf16 for the split-float value terms
+        oh_dt = jnp.int8 if nf == 0 else jnp.bfloat16
+        acc_dt = jnp.int32 if nf == 0 else jnp.float32
         ii = idx_ref[:]                                   # [CH] i32
         kid = ii // NSB
         srel = ii % NSB
@@ -136,12 +144,12 @@ def build_superscan(
         hi = seg // LANE
         lo = seg % LANE
         oh_hiT = (hi[None, :] == jax.lax.broadcasted_iota(
-            jnp.int32, (HI, CH), 0)).astype(jnp.bfloat16)
+            jnp.int32, (HI, CH), 0)).astype(oh_dt)
         oh_lo = (lo[:, None] == jax.lax.broadcasted_iota(
-            jnp.int32, (CH, LANE), 1)).astype(jnp.bfloat16)
+            jnp.int32, (CH, LANE), 1)).astype(oh_dt)
         part = jax.lax.dot_general(
             oh_hiT, oh_lo, (((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32).astype(jnp.int32)
+            preferred_element_type=acc_dt).astype(jnp.int32)
 
         smin = smin_ref[t]
         for sr in range(NSB):
