@@ -67,7 +67,9 @@ class SelectItem:
             return self.alias
         if self.kind == "agg":
             return f"{self.func.lower()}_{self.name if self.name != '*' else 'all'}"
-        return self.name if self.kind == "column" else self.kind
+        if self.kind == "column":
+            return self.name.split(".")[-1] if "." in self.name else self.name
+        return self.kind
 
 
 @dataclasses.dataclass
@@ -79,6 +81,19 @@ class WindowSpec:
 
 
 @dataclasses.dataclass
+class JoinSpec:
+    """Windowed equi-join (the reference implements stream joins as coGroup
+    over a shared window; JoinedStreams.java:101)."""
+
+    table2: str
+    alias1: str
+    alias2: str
+    left_col: str           # qualified 'alias.col'
+    right_col: str
+    window: WindowSpec
+
+
+@dataclasses.dataclass
 class Query:
     select: List[SelectItem]
     table: str
@@ -86,6 +101,7 @@ class Query:
     where_text: Optional[str]
     group_by: List[str]
     window: Optional[WindowSpec]
+    join: Optional[JoinSpec] = None
 
 
 class _Parser:
@@ -121,6 +137,36 @@ class _Parser:
             select.append(self.select_item())
         self.expect("FROM")
         table = self.next()
+        join = None
+        alias1 = table
+        if self.peek_upper() == "AS":
+            self.next()
+            alias1 = self.next()
+        if self.peek_upper() == "JOIN":
+            self.next()
+            table2 = self.next()
+            alias2 = table2
+            if self.peek_upper() == "AS":
+                self.next()
+                alias2 = self.next()
+            if alias2 == alias1:
+                raise ValueError(
+                    f"join sides must have distinct aliases, both are "
+                    f"{alias1!r} (use FROM t AS a JOIN t AS b ...)"
+                )
+            self.expect("ON")
+            left = self.next()
+            self.expect("=")
+            right = self.next()
+            # normalize side order to (alias1 col, alias2 col)
+            if right.split(".")[0] == alias1 and left.split(".")[0] == alias2:
+                left, right = right, left
+            if left.split(".")[0] != alias1 or right.split(".")[0] != alias2:
+                raise ValueError(
+                    f"join condition must equate {alias1}.<col> with "
+                    f"{alias2}.<col>, got {left} = {right}"
+                )
+            join = (table2, alias1, alias2, left, right)
         where = where_text = None
         if self.peek_upper() == "WHERE":
             self.next()
@@ -139,6 +185,21 @@ class _Parser:
                     self.next()
                     continue
                 break
+        if join is None and alias1 != table:
+            raise ValueError(
+                "table aliases are only meaningful on join queries; "
+                f"drop 'AS {alias1}' or add a JOIN"
+            )
+        if join is not None:
+            # joins take a trailing WINDOW <spec> clause (the bound that
+            # makes a streaming equi-join finite)
+            self.expect("WINDOW")
+            jwindow = self.window_spec(time_col_optional=True)
+            if self.peek() is not None:
+                raise ValueError(f"trailing tokens: {self.tokens[self.i:]}")
+            return Query(select, table, where, where_text, group_by, None,
+                         JoinSpec(join[0], join[1], join[2], join[3],
+                                  join[4], jwindow))
         if self.peek() is not None:
             raise ValueError(f"trailing tokens: {self.tokens[self.i:]}")
         return Query(select, table, where, where_text, group_by, window)
@@ -172,11 +233,13 @@ class _Parser:
             item.alias = self.next()
         return item
 
-    def window_spec(self) -> WindowSpec:
+    def window_spec(self, time_col_optional: bool = False) -> WindowSpec:
         kind = self.next().upper()
         self.expect("(")
-        time_col = self.next()
-        self.expect(",")
+        time_col = ""
+        if not (time_col_optional and self.peek_upper() == "INTERVAL"):
+            time_col = self.next()
+            self.expect(",")
         first = self.interval()
         if kind == "HOP":
             self.expect(",")

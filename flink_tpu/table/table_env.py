@@ -130,6 +130,9 @@ class TableEnvironment:
         table = self._tables[q.table]
         stream = table.stream
 
+        if q.join is not None:
+            return self._join_query(q)
+
         if q.where is not None:
             pred = q.where
             stream = stream.filter(pred, name=f"where[{q.where_text}]")
@@ -245,6 +248,64 @@ class TableEnvironment:
 
         return result.map_with_timestamp(to_row, name="sql_output")
 
+    def _join_query(self, q: Query) -> DataStream:
+        """Windowed equi-join: translated onto DataStream.join (which the
+        runtime implements as coGroup over a shared window, the reference's
+        JoinedStreams design). Joined rows carry both alias-qualified and
+        (side-unique) plain column names; the SELECT projects them."""
+        j = q.join
+        if j.table2 not in self._tables:
+            raise KeyError(
+                f"unknown table {j.table2!r}; registered: {list(self._tables)}")
+        if q.group_by:
+            raise ValueError("join queries aggregate via a follow-up query; "
+                             "GROUP BY on a join is not supported yet")
+        if any(i.kind == "agg" for i in q.select):
+            raise ValueError("aggregates over a join are not supported yet")
+        if any(i.kind == "ml_predict" for i in q.select):
+            raise ValueError("ML_PREDICT over a join is not supported yet")
+        if j.window.kind == "session":
+            raise ValueError("session windows are not supported for joins")
+
+        s1 = self._tables[q.table].stream
+        s2 = self._tables[j.table2].stream
+        lcol = j.left_col.split(".", 1)[1]
+        rcol = j.right_col.split(".", 1)[1]
+        cols1 = set(self._tables[q.table].schema.fields)
+        cols2 = set(self._tables[j.table2].schema.fields)
+        a1, a2 = j.alias1, j.alias2
+
+        def merge(l, r):
+            row = {f"{a1}.{k}": v for k, v in l.items()}
+            row.update({f"{a2}.{k}": v for k, v in r.items()})
+            for k, v in l.items():        # side-unique plain names
+                if k not in cols2:
+                    row[k] = v
+            for k, v in r.items():
+                if k not in cols1:
+                    row[k] = v
+            return row
+
+        assigner = self._assigner_for(j.window)
+        joined = (
+            s1.join(s2)
+            .where(lambda row, c=lcol: row[c])
+            .equal_to(lambda row, c=rcol: row[c])
+            .window(assigner)
+            .apply(merge, name=f"sql_join[{j.left_col}={j.right_col}]")
+        )
+        if q.where is not None:
+            joined = joined.filter(q.where, name=f"where[{q.where_text}]")
+        cols = [i for i in q.select if i.kind == "column"]
+        if any(i.kind in ("window_start", "window_end") for i in q.select):
+            raise ValueError("WINDOW_START/WINDOW_END are not supported on "
+                             "join projections yet")
+
+        def project(row, _cols=cols):
+            return {i.output_name: row[i.name] for i in _cols}
+
+        return joined.map(project, name="sql_join_output")
+
     def execute_sql_to_list(self, sql: str) -> List[dict]:
         """Convenience: run the query to completion, return rows."""
         sink = self.sql_query(sql).collect()
@@ -252,7 +313,9 @@ class TableEnvironment:
         return sink.results
 
     def _assigner(self, q: Query):
-        w = q.window
+        return self._assigner_for(q.window)
+
+    def _assigner_for(self, w):
         if w.kind == "tumble":
             return TumblingEventTimeWindows.of(w.size_ms)
         if w.kind == "hop":

@@ -131,3 +131,69 @@ def test_sql_projection_only():
     out = tenv.execute_sql_to_list("SELECT campaign FROM clicks WHERE price = 6")
     assert all(set(r) == {"campaign"} for r in out)
     assert len(out) == len([i for i in range(100) if i % 7 == 6])
+
+
+def test_sql_windowed_join():
+    """Windowed equi-join through SQL: translated onto DataStream.join
+    (coGroup over a shared window, JoinedStreams.java:101 design)."""
+    tenv = TableEnvironment()
+    orders = [
+        {"user": f"u{i % 3}", "amount": float(i), "rowtime": i * 100}
+        for i in range(10)
+    ]
+    users = [
+        {"user": f"u{i}", "city": f"city{i}", "ts": i * 100}
+        for i in range(3)
+    ]
+    tenv.from_rows("orders", orders,
+                   TableSchema(["user", "amount", "rowtime"], rowtime="rowtime"))
+    tenv.from_rows("users", users,
+                   TableSchema(["user", "city", "ts"], rowtime="ts"))
+    rows = tenv.execute_sql_to_list(
+        "SELECT a.user, b.city, a.amount FROM orders AS a "
+        "JOIN users AS b ON a.user = b.user "
+        "WHERE a.amount > 1 "
+        "WINDOW TUMBLE(INTERVAL '10' SECOND)"
+    )
+    # users u0/u1/u2 each match their orders with amount>1 in window [0,10s)
+    assert all(set(r) == {"user", "city", "amount"} for r in rows)
+    assert {(r["user"], r["city"]) for r in rows} == {
+        ("u0", "city0"), ("u1", "city1"), ("u2", "city2")
+    }
+    amounts = sorted(r["amount"] for r in rows)
+    assert amounts == [2.0, 3.0, 4.0, 5.0, 6.0, 7.0, 8.0, 9.0]
+
+
+def test_sql_join_unaliased_plain_columns():
+    tenv = TableEnvironment()
+    tenv.from_rows("l", [{"k": 1, "x": 10.0, "t": 0}],
+                   TableSchema(["k", "x", "t"], rowtime="t"))
+    tenv.from_rows("r", [{"k": 1, "y": 20.0, "t": 5}],
+                   TableSchema(["k", "y", "t"], rowtime="t"))
+    rows = tenv.execute_sql_to_list(
+        "SELECT x, y FROM l AS a JOIN r AS b ON a.k = b.k "
+        "WINDOW TUMBLE(INTERVAL '1' SECOND)"
+    )
+    assert rows == [{"x": 10.0, "y": 20.0}]
+
+
+def test_sql_join_rejects_unsupported_shapes():
+    tenv = TableEnvironment()
+    tenv.from_rows("l", [{"k": 1, "t": 0}], TableSchema(["k", "t"], rowtime="t"))
+    tenv.from_rows("r", [{"k": 1, "t": 0}], TableSchema(["k", "t"], rowtime="t"))
+    with pytest.raises(ValueError, match="aggregates over a join"):
+        tenv.sql_query(
+            "SELECT COUNT(*) FROM l AS a JOIN r AS b ON a.k = b.k "
+            "WINDOW TUMBLE(INTERVAL '1' SECOND)")
+    with pytest.raises(ValueError, match="session"):
+        tenv.sql_query(
+            "SELECT a.k FROM l AS a JOIN r AS b ON a.k = b.k "
+            "WINDOW SESSION(INTERVAL '1' SECOND)")
+
+
+def test_sql_join_alias_validation():
+    with pytest.raises(ValueError, match="distinct aliases"):
+        parse_query("SELECT t.x FROM t JOIN t ON t.k = t.k "
+                    "WINDOW TUMBLE(INTERVAL '1' SECOND)")
+    with pytest.raises(ValueError, match="aliases are only meaningful"):
+        parse_query("SELECT a.x FROM t AS a WHERE a.x > 1")
