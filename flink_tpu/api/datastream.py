@@ -210,6 +210,41 @@ class DataStream:
         return JoinBuilder(self.env, self, other, cogroup=True)
 
     # -- partitioning ------------------------------------------------------
+    def _partition_hint(self, kind: str) -> "DataStream":
+        """Explicit repartitioning (DataStream.rebalance/broadcast/...).
+
+        Locally these are pass-through views (one parallel instance); the
+        distributed scheduler reads the hint to choose the exchange pattern,
+        and key_by remains the only data-moving partitioner on the stepped
+        executor (records route by key group)."""
+        return DataStream(
+            self.env, Transformation(kind, kind, [self.transform], {})
+        )
+
+    def rebalance(self) -> "DataStream":
+        """Round-robin redistribution (RebalancePartitioner)."""
+        return self._partition_hint("rebalance")
+
+    def rescale(self) -> "DataStream":
+        """Local-group round-robin (RescalePartitioner)."""
+        return self._partition_hint("rescale")
+
+    def shuffle(self) -> "DataStream":
+        """Uniform-random redistribution (ShufflePartitioner)."""
+        return self._partition_hint("shuffle")
+
+    def broadcast(self) -> "DataStream":
+        """Every downstream instance sees every record (BroadcastPartitioner)."""
+        return self._partition_hint("broadcast")
+
+    def forward(self) -> "DataStream":
+        """Pin to the local downstream instance (ForwardPartitioner)."""
+        return self._partition_hint("forward")
+
+    def global_(self) -> "DataStream":
+        """Route everything to instance 0 (GlobalPartitioner)."""
+        return self._partition_hint("global")
+
     def key_by(self, key_selector: Callable, name: str = "key_by",
                vectorized: bool = False) -> "KeyedStream":
         """Partition by key. Vectorized form: key_selector(values_column)

@@ -49,7 +49,7 @@ class Transformation:
 
 
 # chain-breaking kinds: a keyBy repartition or any stateful keyed op boundary
-REDISTRIBUTING = {"key_by", "rebalance", "broadcast", "rescale", "global"}
+REDISTRIBUTING = {"key_by", "rebalance", "broadcast", "rescale", "global", "shuffle", "forward"}
 
 
 # record-local kinds fusable into one chain step
@@ -189,6 +189,7 @@ def plan(sink_transforms) -> StepGraph:
     producer: Dict[int, Any] = {}
     keyed: Dict[int, Dict[str, Any]] = {}
     side_tag: Dict[int, str] = {}
+    alias_of: Dict[int, int] = {}   # pass-through views -> effective node
 
     def new_step(**kw) -> Step:
         s = Step(**kw)
@@ -221,6 +222,7 @@ def plan(sink_transforms) -> StepGraph:
         elif t.kind in CHAINABLE:
             inp = t.inputs[0]
             ent = producer[inp.id]
+            eff_id = alias_of.get(inp.id, inp.id)
             if (
                 isinstance(ent, Step)
                 and ent.terminal is None
@@ -228,7 +230,7 @@ def plan(sink_transforms) -> StepGraph:
                 and inp.id not in keyed
                 and inp.id not in side_tag
                 and ent.chain
-                and ent.chain[-1].id == inp.id
+                and ent.chain[-1].id == eff_id
             ):
                 ent.chain.append(t)          # fuse into the open chain
                 producer[t.id] = ent
@@ -259,8 +261,18 @@ def plan(sink_transforms) -> StepGraph:
                 key_selector=ks, inputs=ins,
             )
         elif t.kind in REDISTRIBUTING:
-            # explicit repartition hints; locally a pass-through view
-            producer[t.id] = producer[t.inputs[0].id]
+            # explicit repartition hints; locally a pass-through view that
+            # must keep the upstream's channel (side tag) and, for forward —
+            # the one partitioner that PRESERVES chaining — its keyed view
+            inp = t.inputs[0]
+            producer[t.id] = producer[inp.id]
+            if inp.id in side_tag:
+                side_tag[t.id] = side_tag[inp.id]
+            if t.kind == "forward":
+                if inp.id in keyed:
+                    keyed[t.id] = keyed[inp.id]
+                # forward is chain-transparent: fusion sees through it
+                alias_of[t.id] = alias_of.get(inp.id, inp.id)
         else:
             raise NotImplementedError(f"transformation kind {t.kind}")
 

@@ -183,3 +183,30 @@ def test_late_data_dropped_end_to_end():
     # watermark only advances after the full batch -> the "late" record is
     # NOT late here; end-to-end lateness is covered in operator tests.
     assert sorted(sink.results) == [("a", 1.0), ("a", 2.0)]
+
+
+def test_partitioning_hints_compose_through_pipelines():
+    """rebalance/broadcast/forward/shuffle/rescale/global_ are explicit
+    repartitioning points (DataStream.java partitioners); locally they are
+    correctness-neutral views and pipelines through them stay exact."""
+    env = StreamExecutionEnvironment.get_execution_environment()
+    data = [(f"k{i % 3}", 1.0, i * 100) for i in range(60)]
+    s = env.from_collection(
+        data, timestamp_fn=lambda x: x[2],
+        watermark_strategy=WatermarkStrategy.for_monotonous_timestamps(),
+    )
+    sink = (
+        s.rebalance()
+        .map(lambda x: x)
+        .shuffle()
+        .broadcast()
+        .forward()
+        .rescale()
+        .global_()
+        .key_by(lambda x: x[0])
+        .window(TumblingEventTimeWindows.of(2000))
+        .count()
+        .collect()
+    )
+    env.execute()
+    assert sum(n for _, n in sink.results) == 60

@@ -298,3 +298,34 @@ def test_plan_handles_deep_chains():
     graph = plan(env._sinks)
     # the whole run of maps fuses into a handful of chain steps
     assert len(graph.steps) < 10
+
+
+def test_partition_hint_preserves_side_channel_and_forward_chains():
+    """Regression: a partition hint after get_side_output must keep the side
+    channel, and forward() must not break operator chaining."""
+    from flink_tpu.api.functions import OutputTag
+
+    REJ = OutputTag("rej")
+
+    class Split:
+        def process_element(self, v, ctx):
+            if v < 0:
+                ctx.output(REJ, v)
+                return []
+            return [v]
+
+    env = _env()
+    s = _stream(env, [(1, 10), (-2, 20), (3, 30), (-4, 40)])
+    main = s.key_by(lambda v: v).process(Split())
+    main.collect()
+    side = main.get_side_output(REJ).rebalance().map(lambda v: -v).collect()
+    env.execute()
+    assert sorted(side.results) == [2, 4]   # side records, not main ones
+
+    # forward() keeps two maps in ONE fused chain step
+    env2 = _env()
+    s2 = _stream(env2, [(1, 10)])
+    s2.map(lambda v: v + 1).forward().map(lambda v: v * 2).collect()
+    graph = plan(env2._sinks)
+    chains = [st for st in graph.steps if st.terminal is None]
+    assert len(chains) == 1 and len(chains[0].chain) >= 3  # unwrap+both maps
