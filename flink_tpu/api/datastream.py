@@ -318,17 +318,33 @@ class ConnectedStreams:
         return cs
 
     def process(self, co_process_fn, name: str = "co_process") -> DataStream:
-        """KeyedCoProcessFunction: process_element1/process_element2 (+
-        optional on_timer) with shared per-key state."""
+        """Keyed: KeyedCoProcessFunction (process_element1/process_element2 +
+        optional on_timer) with shared per-key state — requires
+        key_by(ks1, ks2). Broadcast: when the second stream is
+        .broadcast(), a BroadcastProcessFunction
+        (process_element(value, state_view) / process_broadcast_element
+        (value, state)) with operator-wide broadcast state — the reference's
+        broadcast state pattern (BroadcastConnectedStream.process)."""
         ks = getattr(self, "_ks", None)
-        if ks is None:
-            raise ValueError("connect(...).process requires key_by(ks1, ks2)")
-        t = Transformation(
-            "co_process", name, [self.first.transform, self.second.transform],
-            {"process_fn": co_process_fn,
-             "key_selector1": ks[0], "key_selector2": ks[1]},
+        if ks is not None:
+            t = Transformation(
+                "co_process", name,
+                [self.first.transform, self.second.transform],
+                {"process_fn": co_process_fn,
+                 "key_selector1": ks[0], "key_selector2": ks[1]},
+            )
+            return DataStream(self.env, t)
+        if self.second.transform.kind == "broadcast":
+            t = Transformation(
+                "broadcast_process", name,
+                [self.first.transform, self.second.transform],
+                {"process_fn": co_process_fn},
+            )
+            return DataStream(self.env, t)
+        raise ValueError(
+            "connect(...).process requires key_by(ks1, ks2), or a "
+            ".broadcast() second stream for the broadcast state pattern"
         )
-        return DataStream(self.env, t)
 
 
 class JoinBuilder:

@@ -61,7 +61,7 @@ TERMINALS = {
 }
 
 # multi-input terminals (DataStream.java:111 union/connect/join surface)
-MULTI_TERMINALS = {"union", "co_map", "co_flat_map", "co_process", "window_join", "co_group"}
+MULTI_TERMINALS = {"union", "co_map", "co_flat_map", "co_process", "window_join", "co_group", "broadcast_process"}
 
 
 @dataclasses.dataclass
@@ -227,6 +227,9 @@ def plan(sink_transforms) -> StepGraph:
                 isinstance(ent, Step)
                 and ent.terminal is None
                 and consumers.get(inp.id, 0) == 1
+                # seeing through a forward alias must not hide the effective
+                # node's OTHER consumers (fusing would corrupt their data)
+                and (eff_id == inp.id or consumers.get(eff_id, 0) == 1)
                 and inp.id not in keyed
                 and inp.id not in side_tag
                 and ent.chain

@@ -171,6 +171,10 @@ class AsyncMapRunner:
     def on_end_n(self, ordinal):
         self.on_end()
 
+    def on_marker(self, wall_ms):
+        if self.downstream:
+            self.downstream.on_marker(wall_ms)
+
     def __init__(self, transform, _config):
         cfg = transform.config
         self.executor = AsyncExecutor(

@@ -76,6 +76,10 @@ class _FanOut:
         for r, o in self.edges:
             r.on_watermark_n(o, watermark)
 
+    def on_marker(self, wall_ms: float) -> None:
+        for r, _o in self.edges:
+            r.on_marker(wall_ms)
+
     def on_end(self) -> None:
         for r, o in self.edges:
             r.on_end_n(o)
@@ -137,6 +141,17 @@ class StepRunner:
         if self.sides:
             for f in self.sides.values():
                 f.on_watermark(watermark)
+
+    def on_marker(self, wall_ms: float) -> None:
+        """Latency marker (LatencyMarker analogue): a wall-clock stamp from
+        the source that flows straight through every operator — windows and
+        buffers forward it immediately, so a sink's (now - stamp) measures
+        true pipeline transit latency rather than event-time residence."""
+        if self.downstream:
+            self.downstream.on_marker(wall_ms)
+        if self.sides:
+            for f in self.sides.values():
+                f.on_marker(wall_ms)
 
     def on_end(self) -> None:
         if self.downstream:
@@ -687,6 +702,57 @@ class KeyedCoProcessRunner(KeyedProcessRunner):
         raise AssertionError("KeyedCoProcessRunner consumes via input gates")
 
 
+class BroadcastProcessRunner(StepRunner):
+    """Broadcast state pattern (BroadcastConnectedStream.process /
+    CoBroadcastWithNonKeyedOperator): input gate 1 carries the broadcast
+    stream, whose elements update operator-wide broadcast state; gate 0
+    elements read it through an immutable view — the reference's read-only
+    non-broadcast side contract, enforced here with a mapping proxy."""
+
+    num_inputs = 2
+
+    def __init__(self, step: Step, config: Configuration):
+        import types
+
+        t = step.terminal
+        self.fn = t.config["process_fn"]
+        self.state: Dict[Any, Any] = {}
+        self._view = types.MappingProxyType(self.state)  # live read-only view
+        self._out: List = []
+        self._out_ts: List[int] = []
+        self.uid = t.uid
+
+    def on_batch_n(self, ordinal: int, values, timestamps) -> None:
+        ts = np.asarray(timestamps, dtype=np.int64)
+        if ordinal == 1:
+            for v in values:
+                self.fn.process_broadcast_element(v, self.state)
+            return
+        view = self._view
+        for v, tt in zip(values, ts):
+            for out in self.fn.process_element(v, view):
+                self._out.append(out)
+                self._out_ts.append(int(tt))
+        if self._out:
+            if self.downstream:
+                self.downstream.on_batch(
+                    obj_array(self._out),
+                    np.asarray(self._out_ts, dtype=np.int64))
+            self._out, self._out_ts = [], []
+
+    def on_batch(self, values, timestamps) -> None:  # pragma: no cover
+        raise AssertionError("BroadcastProcessRunner consumes via input gates")
+
+    def snapshot(self) -> dict:
+        return {"broadcast": dict(self.state)}
+
+    def restore(self, snap: dict) -> None:
+        import types
+
+        self.state = dict(snap["broadcast"])
+        self._view = types.MappingProxyType(self.state)
+
+
 class WindowJoinRunner(StepRunner):
     """Keyed event-time window join / coGroup.
 
@@ -773,6 +839,18 @@ class SinkRunner(StepRunner):
         self.writer = sink.create_writer()
         self.committer = sink.create_committer()
         self.uid = step.terminal.uid
+        self._latency_hist = None
+
+    def register_metrics(self, group) -> None:
+        super().register_metrics(group)
+        # O3: per-marker pipeline latency at the sink (source wall clock ->
+        # sink arrival; the reference's LatencyMarker histogram)
+        self._latency_hist = group.histogram("pipelineLatencyMs")
+
+    def on_marker(self, wall_ms: float) -> None:
+        if self._latency_hist is not None:
+            self._latency_hist.update(time.time() * 1000.0 - wall_ms)
+        super().on_marker(wall_ms)
 
     def on_batch(self, values: np.ndarray, timestamps: np.ndarray) -> None:
         self.writer.write_batch(values, timestamps)
@@ -810,6 +888,8 @@ def _make_runner(step: Step, config: Configuration) -> StepRunner:
         return CoMapRunner(step)
     if kind == "co_process":
         return KeyedCoProcessRunner(step, config)
+    if kind == "broadcast_process":
+        return BroadcastProcessRunner(step, config)
     if kind in ("window_join", "co_group"):
         return WindowJoinRunner(step, config)
     raise NotImplementedError(kind)
@@ -892,6 +972,10 @@ class JobRuntime:
         def emit_watermark(self, wm: int) -> None:
             for r, o in self.feeds:
                 r.on_watermark_n(o, wm)
+
+        def emit_marker(self, wall_ms: float) -> None:
+            for r, _o in self.feeds:
+                r.on_marker(wall_ms)
 
         def finish(self) -> None:
             """End of this source: flush its contribution to every valve and
@@ -1033,7 +1117,11 @@ class JobRuntime:
                 self.records_in += len(batch)
                 self.records_meter.mark(len(batch))
                 busy_t0 = time.perf_counter()
+                # latency marker stamped BEFORE the synchronous push so the
+                # sink's (now - stamp) measures this batch's real transit
+                t_mark = time.time() * 1000.0
                 d.emit_batch(values, ts)
+                d.emit_marker(t_mark)
                 if d.generator is not None:
                     wm = (
                         d.generator.on_batch_np(ts)

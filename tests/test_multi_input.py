@@ -6,6 +6,7 @@ ConnectedStreams/JoinedStreams/CoGroupedStreams, StatusWatermarkValve
 """
 
 import numpy as np
+import pytest
 
 from flink_tpu.api.datastream import StreamExecutionEnvironment
 from flink_tpu.api.windowing.assigners import (
@@ -329,3 +330,50 @@ def test_partition_hint_preserves_side_channel_and_forward_chains():
     graph = plan(env2._sinks)
     chains = [st for st in graph.steps if st.terminal is None]
     assert len(chains) == 1 and len(chains[0].chain) >= 3  # unwrap+both maps
+
+
+def test_broadcast_state_pattern():
+    """Broadcast state (BroadcastConnectedStream.process): rule updates on
+    the broadcast side are visible to every main-side element; the main side
+    sees a read-only view."""
+    class RuleFilter:
+        def process_broadcast_element(self, rule, state):
+            state[rule[0]] = rule[1]          # ('min_amount', 5)
+
+        def process_element(self, v, state):
+            import pytest as _p
+
+            with _p.raises(TypeError):
+                state["x"] = 1                # read-only on the main side
+            thr = state.get("min_amount")
+            return [v] if thr is not None and v[1] >= thr else []
+
+    # batch=1 so the round-robin source order is: event a (no rule yet,
+    # dropped — the reference's broadcast side has the same race), rule,
+    # then b and c which must both see it
+    env = _env(batch=1)
+    rules = _stream(env, [(("min_amount", 5), 0)])
+    events = _stream(env, [(("a", 3), 100), (("b", 7), 200), (("c", 9), 300)])
+    sink = events.connect(rules.broadcast()).process(RuleFilter()).collect()
+    env.execute()
+    assert sorted(v for v in sink.results) == [("b", 7), ("c", 9)]
+
+
+def test_connect_without_keys_or_broadcast_rejected():
+    env = _env()
+    a = _stream(env, [(1, 0)])
+    b = _stream(env, [(2, 0)])
+    with pytest.raises(ValueError, match="broadcast"):
+        a.connect(b).process(object())
+
+
+def test_forward_alias_does_not_fuse_across_fan_out():
+    """Regression: forward()'s chain transparency must not fuse a map into
+    a chain another consumer also reads (their data would be corrupted)."""
+    env = _env()
+    m = _stream(env, [(1, 10), (2, 20)]).map(lambda v: v)
+    via_forward = m.forward().map(lambda v: v + 100).collect()
+    plain = m.collect()
+    env.execute()
+    assert sorted(via_forward.results) == [101, 102]
+    assert sorted(plain.results) == [1, 2]
