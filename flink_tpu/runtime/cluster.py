@@ -58,8 +58,26 @@ from flink_tpu.runtime.rpc import RpcEndpoint, RpcService
 # job specification (shipped through the blob server)
 # ---------------------------------------------------------------------------
 
+class _PickledSpec:
+    """Serialization shared by job specs: cloudpickle (when present) ships
+    closures/lambdas the way the reference ships user JARs; plain picklable
+    specs need only stdlib."""
+
+    def to_bytes(self) -> bytes:
+        try:
+            import cloudpickle
+
+            return cloudpickle.dumps(self)
+        except ImportError:
+            return pickle.dumps(self, protocol=pickle.HIGHEST_PROTOCOL)
+
+    @staticmethod
+    def from_bytes(b: bytes):
+        return pickle.loads(b)
+
+
 @dataclass
-class DistributedJobSpec:
+class DistributedJobSpec(_PickledSpec):
     """A keyed windowed-aggregation pipeline, the distributed hot path.
 
     source_factory(shard, num_shards) -> list of (keys, vals, ts, wm) step
@@ -73,19 +91,21 @@ class DistributedJobSpec:
     max_parallelism: int = 128
     operator: str = "oracle"          # 'oracle' | 'device'
 
-    def to_bytes(self) -> bytes:
-        # cloudpickle (when present) ships closures/lambdas the way the
-        # reference ships user JARs; plain picklable specs need only stdlib
-        try:
-            import cloudpickle
 
-            return cloudpickle.dumps(self)
-        except ImportError:
-            return pickle.dumps(self, protocol=pickle.HIGHEST_PROTOCOL)
+@dataclass
+class GraphJobSpec(_PickledSpec):
+    """A general StepGraph job for the distributed runtime.
 
-    @staticmethod
-    def from_bytes(b: bytes) -> "DistributedJobSpec":
-        return pickle.loads(b)
+    The keyed-window hot path runs sharded through DistributedJobSpec; any
+    OTHER planned pipeline (multi-input DAGs, joins, side outputs, CEP,
+    process functions...) ships as its full StepGraph and executes as one
+    JobRuntime task on a TaskExecutor — the cluster analogue of submitting
+    an arbitrary JobGraph: full operator coverage with cluster supervision
+    (checkpoints, failover, local recovery) at task granularity."""
+
+    name: str
+    graph: Any          # graph.transformation.StepGraph
+    config: Any         # flink_tpu.config.Configuration
 
 
 def merge_shard_snapshots(handles: Dict[int, dict]) -> dict:
@@ -233,6 +253,12 @@ class JobManagerEndpoint(RpcEndpoint):
     def submit_job(self, spec_bytes: bytes, parallelism: int) -> str:
         blob_key = self.blob.put(spec_bytes)
         spec = DistributedJobSpec.from_bytes(spec_bytes)
+        if isinstance(spec, GraphJobSpec) and parallelism != 1:
+            raise ValueError(
+                "GraphJobSpec jobs run as one supervised task "
+                "(parallelism=1); keyed sharded execution uses "
+                "DistributedJobSpec"
+            )
         job_id = uuid.uuid4().hex[:16]
         self._jobs[job_id] = _JobState(
             job_id, blob_key, parallelism, spec.name,
@@ -555,6 +581,83 @@ class _ShardTask:
 
         threading.Thread(target=_decline, daemon=True).start()
 
+    def _resolve_local_restore(self) -> None:
+        """Local recovery (S11): restore from the TM-local copy of the
+        snapshot this shard acked — nothing re-ships over the wire. Runs on
+        the task thread, NOT deploy_task (which executes on the TM main
+        thread while the JM main thread awaits the deploy reply — a
+        synchronous JM fetch there would be a circular RPC)."""
+        if self.restore is not None or self.restore_local_cp is None:
+            return
+        local = self.te._local_state.get((self.job_id, self.shard))
+        if local is not None and local[0] == self.restore_local_cp:
+            self.restore = local[1]
+            self.te.num_local_restores += 1
+        else:
+            # local copy lost (e.g. the TM process restarted): pull the
+            # shard snapshot from the JM's retained checkpoints
+            self.restore = self.jm.fetch_shard_restore(
+                self.job_id, self.restore_local_cp, self.shard
+            )
+
+    def _run_graph(self) -> None:
+        """One-task execution of a general StepGraph under cluster
+        supervision: step-aligned checkpoint requests snapshot the whole
+        JobRuntime (sources + every runner), failover restores it."""
+        from flink_tpu.runtime.executor import (
+            JobCancelledException,
+            JobRuntime,
+            SinkRunner,
+        )
+
+        rt = JobRuntime(self.spec.graph, self.spec.config)
+        self._resolve_local_restore()
+        if self.restore is not None:
+            rt.restore(self.restore["runtime"])
+            self.current_step = self.restore["step"]
+
+        task = self
+
+        class _Coord:
+            def __init__(self):
+                self.on_complete = []
+
+            def register_on_complete(self, fn):
+                self.on_complete.append(fn)
+
+            def maybe_trigger(self, capture):
+                task.current_step += 1
+                with task._cp_lock:
+                    due = [r for r in task._cp_requests
+                           if r[1] <= task.current_step]
+                    task._cp_requests = [
+                        r for r in task._cp_requests if r[1] > task.current_step
+                    ]
+                for cp_id, _target in due:
+                    snap = {"runtime": capture(), "step": task.current_step}
+                    task.te._local_state[(task.job_id, task.shard)] = (
+                        cp_id, snap)
+                    task.jm.ack_checkpoint(
+                        task.job_id, task.attempt, task.shard, cp_id, snap)
+                    # single-shard job: the ack completes the checkpoint
+                    # inside the JM before returning, so completion
+                    # callbacks (2PC sink epoch commits) fire now
+                    for fn in self.on_complete:
+                        fn(cp_id)
+
+        try:
+            rt.run(coordinator=_Coord(),
+                   cancel_check=lambda: self.cancelled.is_set())
+        except JobCancelledException:
+            return
+        if self.cancelled.is_set():
+            return
+        results: list = []
+        for r in rt.runners:
+            if isinstance(r, SinkRunner) and hasattr(r.writer, "store"):
+                results.extend(r.writer.store)
+        self.jm.task_finished(self.job_id, self.attempt, self.shard, results)
+
     def _channel_id(self, src: int) -> str:
         return f"{self.job_id}/a{self.attempt}/{src}->{self.shard}"
 
@@ -609,26 +712,13 @@ class _ShardTask:
         )
 
     def _run(self) -> None:
+        if isinstance(self.spec, GraphJobSpec):
+            return self._run_graph()
         P = self.parallelism
         batches = self.spec.source_factory(self.shard, P)
         op = self._make_operator()
         results: list = []
-        if self.restore is None and self.restore_local_cp is not None:
-            # local recovery (S11): restore from the TM-local copy of the
-            # snapshot this shard acked — nothing re-ships over the wire.
-            # Runs on the task thread, NOT deploy_task (which executes on
-            # the TM main thread while the JM main thread awaits the deploy
-            # reply — a synchronous JM fetch there would be a circular RPC).
-            local = self.te._local_state.get((self.job_id, self.shard))
-            if local is not None and local[0] == self.restore_local_cp:
-                self.restore = local[1]
-                self.te.num_local_restores += 1
-            else:
-                # local copy lost (e.g. the TM process restarted): pull the
-                # shard snapshot from the JM's retained checkpoints
-                self.restore = self.jm.fetch_shard_restore(
-                    self.job_id, self.restore_local_cp, self.shard
-                )
+        self._resolve_local_restore()
         if self.restore is not None:
             op_snap = self.restore["operator"]
             if self.restore.get("merged"):

@@ -863,6 +863,18 @@ class SinkRunner(StepRunner):
         self.commit_epoch("final")
         self.writer.close()
 
+    def snapshot(self) -> dict:
+        # collect-style sinks are stateful: emissions before the cut belong
+        # to the checkpoint (post-cut emissions of a failed attempt are
+        # discarded and re-fired on replay — the shard-task contract)
+        store = getattr(self.writer, "store", None)
+        return {"collected": list(store)} if store is not None else {}
+
+    def restore(self, snap: dict) -> None:
+        store = getattr(self.writer, "store", None)
+        if store is not None and "collected" in snap:
+            store[:] = snap["collected"]
+
 
 def _make_runner(step: Step, config: Configuration) -> StepRunner:
     if step.terminal is None:
