@@ -122,6 +122,13 @@ class TableEnvironment:
                                           watermark_strategy=strategy)
         self.register_table(name, stream, schema)
 
+    def table(self, name: str):
+        """Fluent Table API handle (the reference's Table surface;
+        flink_tpu/table/api.py)."""
+        from flink_tpu.table.api import Table
+
+        return Table(self, name)
+
     # -- queries ----------------------------------------------------------
     def sql_query(self, sql: str) -> DataStream:
         q = parse_query(sql)
@@ -196,7 +203,13 @@ class TableEnvironment:
             raise NotImplementedError(
                 "aggregate queries require GROUP BY with a TUMBLE/HOP/SESSION window"
             )
+        return self._grouped_window_query(q, stream)
 
+    def _grouped_window_query(self, q: Query, stream: DataStream) -> DataStream:
+        """Windowed GROUP BY translation shared by SQL and the fluent Table
+        API (both lower onto the same DataStream window machinery, like the
+        reference's two APIs lowering onto one planner)."""
+        aggs = [i for i in q.select if i.kind == "agg"]
         group_cols = list(q.group_by)
         key_fn = (
             (lambda row, c=group_cols[0]: row[c])
