@@ -175,6 +175,9 @@ class AsyncMapRunner:
         if self.downstream:
             self.downstream.on_marker(wall_ms)
 
+    def on_processing_time(self, now_ms):
+        pass
+
     def __init__(self, transform, _config):
         cfg = transform.config
         self.executor = AsyncExecutor(

@@ -153,6 +153,10 @@ class StepRunner:
             for f in self.sides.values():
                 f.on_marker(wall_ms)
 
+    def on_processing_time(self, now_ms: int) -> None:
+        """Wall-clock tick driven by the run loop (ProcessingTimeService
+        analogue); runners with processing-time timers fire them here."""
+
     def on_end(self) -> None:
         if self.downstream:
             self.downstream.on_end()
@@ -432,6 +436,13 @@ class WindowStepRunner(StepRunner):
         self._drain()
         super().on_end()
 
+    def on_processing_time(self, now_ms: int) -> None:
+        # PT windows fire from the shared ProcessingTimeService tick, not
+        # only when their own source produces a batch
+        if self.processing_time:
+            self.op.advance_processing_time(now_ms)
+            self._drain()
+
     def _drain(self) -> None:
         op_sides = getattr(self.op, "side_output", None)
         if op_sides:
@@ -517,7 +528,8 @@ class KeyedProcessRunner(StepRunner):
         max_par = config.get(PipelineOptions.MAX_PARALLELISM)
         self.state = HeapKeyedStateBackend(
             KeyGroupRange(0, max_par - 1), max_par, auto_register=True)
-        self.timers = InternalTimerService(self._on_event_timer, lambda *a: None)
+        self.timers = InternalTimerService(
+            self._on_event_timer, self._on_proc_timer)  # both bind dynamically
         self._out: List = []
         self._out_ts: List[int] = []
         self._side_buf: Dict[str, tuple] = {}
@@ -530,6 +542,9 @@ class KeyedProcessRunner(StepRunner):
 
         def register_event_time_timer(self, time: int) -> None:
             self._r.timers.register_event_time_timer(self._key, None, time)
+
+        def register_processing_time_timer(self, time: int) -> None:
+            self._r.timers.register_processing_time_timer(self._key, None, time)
 
         def current_watermark(self) -> int:
             return self._r.timers.current_watermark
@@ -554,6 +569,23 @@ class KeyedProcessRunner(StepRunner):
         for out in on_timer(time, self._ctx(key, time)):
             self._out.append(out)
             self._out_ts.append(time)
+
+    def _on_proc_timer(self, time, key, _ns) -> None:
+        """Same user callback (onTimer), but outputs carry NO event
+        timestamp (MIN_TIMESTAMP sentinel) — the reference erases
+        timestamps on processing-time timer output rather than leaking
+        wall-clock epochs into the event-time domain."""
+        self.state.set_current_key(key)
+        on_timer = getattr(self.fn, "on_timer", None)
+        if on_timer is None:
+            return
+        for out in on_timer(time, self._ctx(key, time)):
+            self._out.append(out)
+            self._out_ts.append(MIN_TIMESTAMP)
+
+    def on_processing_time(self, now_ms: int) -> None:
+        self.timers.advance_processing_time(now_ms)
+        self._flush()
 
     def on_batch(self, values: np.ndarray, timestamps: np.ndarray) -> None:
         for v, ts in zip(values, timestamps):
@@ -1036,6 +1068,7 @@ class JobRuntime:
         self.step_latency = job_group.histogram("stepLatencyMs")
         self._busy_time = 0.0
         self._loop_time = 1e-9
+        self._last_pt_tick = 0.0
         job_group.gauge("busyTimeRatio", lambda: self._busy_time / self._loop_time)
         job_group.gauge("numRecordsIn", lambda: self.records_in)
 
@@ -1156,6 +1189,12 @@ class JobRuntime:
                     path = savepoint_request()
                     if path is not None:
                         self._write_savepoint(path)
+                now_ms = time.time() * 1000.0
+                if now_ms - self._last_pt_tick >= 50.0:
+                    # ProcessingTimeService tick: drive wall-clock timers
+                    self._last_pt_tick = now_ms
+                    for r in self.runners:
+                        r.on_processing_time(int(now_ms))
                 self._loop_time += time.perf_counter() - loop_t0
 
         # end of input: every source's final watermark + end signal has been

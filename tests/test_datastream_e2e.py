@@ -210,3 +210,47 @@ def test_partitioning_hints_compose_through_pipelines():
     )
     env.execute()
     assert sum(n for _, n in sink.results) == 60
+
+
+def test_keyed_process_processing_time_timers():
+    """ProcessingTimeService tick: wall-clock timers registered by a
+    KeyedProcessFunction fire from the run loop (the reference's
+    registerProcessingTimeTimer path)."""
+    import time as _time
+
+    class Deferred:
+        def process_element(self, v, ctx):
+            ctx.timer_service.register_processing_time_timer(
+                int(_time.time() * 1000) + 80)
+            return []
+
+        def on_timer(self, ts, ctx):
+            return [("fired", ts)]
+
+    # a slow source keeps the loop alive past the timer deadline
+    import numpy as np
+    from flink_tpu.connectors.source import Batch, DataGeneratorSource
+
+    def gen(idx):
+        _time.sleep(0.05)
+        return Batch(
+            np.asarray([f"k{int(i) % 2}" for i in idx], dtype=object),
+            (idx * 10).astype(np.int64),
+        )
+
+    from flink_tpu.config import Configuration, ExecutionOptions
+
+    conf = Configuration()
+    conf.set(ExecutionOptions.BATCH_SIZE, 2)
+    env2 = StreamExecutionEnvironment(conf)
+    sink = (
+        env2.from_source(
+            DataGeneratorSource(gen, count=20),
+            watermark_strategy=WatermarkStrategy.for_monotonous_timestamps(),
+        )
+        .key_by(lambda v: v)
+        .process(Deferred())
+        .collect()
+    )
+    env2.execute()
+    assert any(tag == "fired" for tag, _ in sink.results)
