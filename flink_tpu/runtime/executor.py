@@ -1059,6 +1059,30 @@ class JobRuntime:
             JobRuntime._SourceDriver(t, feeds.get(t.id, []))
             for t in graph.sources
         ]
+        # OperatorCoordinator SPI (D15): operator functions declaring
+        # create_coordinator() get a job-scope coordinator + event bus.
+        # Candidates: terminal runners' fn, chain transforms' fns, and both
+        # sides of co-transforms; keys are deterministic across rebuilds so
+        # coordinator state survives restore.
+        from flink_tpu.runtime.coordination import wire as _wire_coordinator
+
+        self.coordinators = {}
+        for idx, r in enumerate(self.runners):
+            candidates = []
+            if getattr(r, "fn", None) is not None:
+                candidates.append((getattr(r, "uid", f"coordinator@{idx}"),
+                                   r.fn))
+            for j, f in enumerate(getattr(r, "fns", ()) or ()):
+                candidates.append(
+                    (f"{getattr(r, 'uid', f'coordinator@{idx}')}#{j}", f))
+            for t in getattr(r, "transforms", ()) or ():
+                f = t.config.get("fn")
+                if f is not None:
+                    candidates.append((t.uid, f))
+            for uid, f in candidates:
+                coord = _wire_coordinator(f)
+                if coord is not None:
+                    self.coordinators[uid] = coord
         self.records_in = 0
         # observability (O1/O3): job-scope throughput, busy-ratio, step latency
         self.registry = registry or MetricRegistry()
@@ -1082,6 +1106,9 @@ class JobRuntime:
         return {
             "sources": {d.uid: d.snapshot() for d in self.sources},
             "runners": runner_snaps,
+            "coordinators": {
+                uid: c.checkpoint() for uid, c in self.coordinators.items()
+            },
             "records_in": self.records_in,
         }
 
@@ -1099,6 +1126,9 @@ class JobRuntime:
             uid = getattr(r, "uid", None)
             if uid is not None and uid in snap["runners"]:
                 r.restore(snap["runners"][uid])
+        for uid, c in self.coordinators.items():
+            if uid in snap.get("coordinators", {}):
+                c.restore(snap["coordinators"][uid])
         self.records_in = snap["records_in"]
 
     def commit_sinks(self, checkpoint_id: int) -> None:

@@ -63,7 +63,22 @@ class _Handler(BaseHTTPRequestHandler):
         return self.cluster.jobs.get(job_id)
 
     # -- GET --------------------------------------------------------------
+    auth_token: Optional[str] = None
+
+    def _authorized(self) -> bool:
+        if self.auth_token is None:
+            return True
+        import hmac as _hmac
+
+        got = self.headers.get("Authorization", "")
+        if _hmac.compare_digest(got, f"Bearer {self.auth_token}"):
+            return True
+        self._json(401, {"error": "missing or invalid bearer token"})
+        return False
+
     def do_GET(self):
+        if not self._authorized():
+            return
         parts = [p for p in self.path.split("?")[0].split("/") if p]
         if not parts:
             # the live dashboard (web_dashboard.py) polls the JSON routes
@@ -156,6 +171,8 @@ class _Handler(BaseHTTPRequestHandler):
 
     # -- POST/PATCH -------------------------------------------------------
     def do_POST(self):
+        if not self._authorized():
+            return
         parts = [p for p in self.path.split("?")[0].split("/") if p]
         if parts == ["jars", "run"]:
             body = self._read_body()
@@ -225,9 +242,15 @@ def _run_application(cluster: MiniCluster, module_path: str, entry: str):
 class RestServer:
     """Threaded REST server bound to a MiniCluster (WebMonitorEndpoint)."""
 
-    def __init__(self, cluster: Optional[MiniCluster] = None, port: int = 0):
+    def __init__(self, cluster: Optional[MiniCluster] = None, port: int = 0,
+                 auth_token: Optional[str] = None):
+        """auth_token: when set, every request must carry
+        `Authorization: Bearer <token>` (D16-minimal; the reference's SSL/
+        Kerberos plumbing is deployment-level — TLS terminates at the
+        ingress in the K8s deployment, this guards the API itself)."""
         self.cluster = cluster or MiniCluster.get_shared()
-        handler = type("BoundHandler", (_Handler,), {"cluster": self.cluster})
+        handler = type("BoundHandler", (_Handler,),
+                       {"cluster": self.cluster, "auth_token": auth_token})
         self._httpd = ThreadingHTTPServer(("127.0.0.1", port), handler)
         self.port = self._httpd.server_port
         self._thread: Optional[threading.Thread] = None

@@ -254,3 +254,70 @@ def test_keyed_process_processing_time_timers():
     )
     env2.execute()
     assert any(tag == "fired" for tag, _ in sink.results)
+
+
+def test_operator_coordinator_event_bus():
+    """OperatorCoordinator SPI (D15): the operator sends events up, the
+    coordinator reacts and pushes configuration back down; coordinator state
+    rides checkpoints."""
+    from flink_tpu.config import Configuration, ExecutionOptions
+    from flink_tpu.graph.transformation import plan
+    from flink_tpu.runtime.coordination import OperatorCoordinator
+    from flink_tpu.runtime.executor import JobRuntime
+
+    class ThresholdCoordinator(OperatorCoordinator):
+        def __init__(self):
+            self.seen = 0
+
+        def start(self, context):
+            self.ctx = context
+
+        def handle_event(self, event):
+            self.seen += event
+            if self.seen >= 10:
+                self.ctx.send_to_operator(("raise_threshold", 100))
+
+        def checkpoint(self):
+            return {"seen": self.seen}
+
+        def restore(self, snap):
+            self.seen = snap["seen"]
+
+    class Gated:
+        def __init__(self):
+            self.threshold = 0
+
+        def create_coordinator(self):
+            return ThresholdCoordinator()
+
+        def handle_coordinator_event(self, event):
+            kind, value = event
+            if kind == "raise_threshold":
+                self.threshold = value
+
+        def process_element(self, v, ctx):
+            self.coordinator_gateway.send_event(1)
+            return [v] if v >= self.threshold else []
+
+    conf = Configuration()
+    conf.set(ExecutionOptions.BATCH_SIZE, 5)
+    env = StreamExecutionEnvironment(conf)
+    sink = (
+        env.from_collection(list(range(30)))
+        .key_by(lambda v: v % 2)
+        .process(Gated())
+        .collect()
+    )
+    rt = JobRuntime(plan(env._sinks), conf)
+    assert len(rt.coordinators) == 1
+    rt.run()
+    # elements 0..8 pass (threshold 0); the 10th event raises the threshold
+    # to 100 while element 9 is in flight, so 9 and everything after gate
+    assert sink.results == list(range(9))
+    snap = rt.capture()
+    uid = next(iter(rt.coordinators))
+    assert snap["coordinators"][uid]["seen"] == 30   # every event counted
+
+    rt2 = JobRuntime(plan(env._sinks), conf)
+    rt2.restore(snap)
+    assert next(iter(rt2.coordinators.values())).seen == 30

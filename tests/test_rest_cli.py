@@ -183,3 +183,23 @@ def test_rest_traces_otlp(cluster_server, tmp_path):
     assert len(s0["traceId"]) == 32
     attrs = {a["key"]: a["value"] for a in s0["attributes"]}
     assert "checkpointId" in attrs
+
+
+def test_rest_bearer_auth():
+    """Minimal API auth (D16): with auth_token set, unauthenticated
+    requests get 401; the bearer token unlocks every route."""
+    import urllib.error
+
+    cluster = MiniCluster()
+    server = RestServer(cluster, auth_token="s3cret").start()
+    try:
+        with pytest.raises(urllib.error.HTTPError) as e:
+            _get(f"{server.url}/jobs")
+        assert e.value.code == 401
+
+        req = urllib.request.Request(f"{server.url}/jobs")
+        req.add_header("Authorization", "Bearer s3cret")
+        with urllib.request.urlopen(req, timeout=10) as r:
+            assert r.status == 200
+    finally:
+        server.stop()
