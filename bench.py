@@ -519,7 +519,7 @@ def secondary_q7_global_max(bits_fn_small) -> dict:
     pre-aggregation (max scatter on the XLA superscan; the global merge is
     the final max over key rows, the single-chip analogue of the psum/pmax
     cross-shard merge exercised in the multichip dryrun)."""
-    T, B, spans = 24, 1 << 18, 2
+    T, B, spans = 48, 1 << 18, 3
 
     def gmax(_counts, row):
         return float(np.max(row))
