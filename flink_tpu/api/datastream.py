@@ -34,6 +34,9 @@ class StreamExecutionEnvironment:
     def __init__(self, config: Optional[Configuration] = None):
         self.config = config or Configuration()
         self._sinks: List[Transformation] = []
+        # non-sink plan roots (iteration tails): reachable only through
+        # close_with, so they must be planned explicitly
+        self._roots: List[Transformation] = []
 
     @staticmethod
     def get_execution_environment(config: Optional[Configuration] = None) -> "StreamExecutionEnvironment":
@@ -84,7 +87,7 @@ class StreamExecutionEnvironment:
 
         if not self._sinks:
             raise RuntimeError("No sinks defined; nothing to execute")
-        graph = plan(self._sinks)
+        graph = plan(self._sinks + self._roots)
         executor = LocalPipelineExecutor(self.config)
         return executor.execute(graph, job_name or self.config.get(PipelineOptions.NAME))
 
@@ -94,7 +97,7 @@ class StreamExecutionEnvironment:
 
         if len(self._sinks) != 1:
             raise RuntimeError("exactly one sink required")
-        graph = plan(self._sinks[0])
+        graph = plan([self._sinks[0]] + self._roots)
         return MiniCluster.get_shared().submit(graph, self.config, job_name)
 
 
@@ -245,6 +248,25 @@ class DataStream:
         """Route everything to instance 0 (GlobalPartitioner)."""
         return self._partition_hint("global")
 
+    def iterate(self, max_rounds: int = 10000) -> "IterativeStream":
+        """Open an iteration (DataStream.iterate / IterativeStream.java):
+        the returned stream carries this stream's records plus every record
+        later fed back via close_with(). Watermarks do not cross the
+        feedback edge (reference semantics); with bounded inputs the job
+        finishes when the loop body stops emitting feedback records, and
+        `max_rounds` bounds non-converging loop bodies.
+
+            it = stream.iterate()
+            body = it.map(step_fn)
+            it.close_with(body.filter(still_going))   # feedback edge
+            body.filter(done).sink_to(...)            # loop exit
+        """
+        t = Transformation(
+            "iteration_head", "iterate", [self.transform],
+            {"max_rounds": max_rounds},
+        )
+        return IterativeStream(self.env, t)
+
     def key_by(self, key_selector: Callable, name: str = "key_by",
                vectorized: bool = False) -> "KeyedStream":
         """Partition by key. Vectorized form: key_selector(values_column)
@@ -273,6 +295,21 @@ class DataStream:
         sink = CollectSink()
         self.sink_to(sink, name="collect")
         return sink
+
+
+class IterativeStream(DataStream):
+    """The head of an iteration (IterativeStream.java analogue); close_with
+    wires the feedback edge back to this head."""
+
+    def close_with(self, feedback: DataStream) -> DataStream:
+        """Feed `feedback`'s records back into the iteration head
+        (IterativeStream.closeWith). Returns the feedback stream."""
+        tail = Transformation(
+            "iteration_tail", "iteration_tail", [feedback.transform],
+            {"head": self.transform},
+        )
+        self.env._roots.append(tail)
+        return feedback
 
 
 class DataStreamSink:

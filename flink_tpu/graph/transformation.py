@@ -58,6 +58,10 @@ CHAINABLE = {"map", "map_ts", "map_batch", "flat_map", "filter", "process"}
 # single-input stateful/boundary terminals
 TERMINALS = {
     "window_aggregate", "reduce", "sink", "process_keyed", "async_map", "cep",
+    # iteration feedback edges (StreamIterationHead/Tail analogue): the tail
+    # references its head out-of-band via config["head"], so the
+    # transformation DAG stays acyclic and the cycle exists only at runtime
+    "iteration_head", "iteration_tail",
 }
 
 # multi-input terminals (DataStream.java:111 union/connect/join surface)

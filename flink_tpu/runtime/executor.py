@@ -20,6 +20,7 @@ from __future__ import annotations
 
 import dataclasses
 import time
+from collections import deque
 from typing import Any, Callable, Dict, List, Optional
 
 import numpy as np
@@ -908,6 +909,107 @@ class SinkRunner(StepRunner):
             store[:] = snap["collected"]
 
 
+class IterationHeadRunner(StepRunner):
+    """Iteration head (StreamIterationHead.java analogue on the stepped
+    executor): forwards the initial stream and re-injects feedback batches
+    that its tail enqueues. Watermarks cross only the initial edge — as in
+    the reference, feedback edges carry no watermarks — and the end-of-input
+    signal is HELD until the run loop drains feedback to quiescence (the
+    stepped analogue of the reference's iteration await-timeout
+    termination: here bounded inputs terminate exactly when the loop body
+    stops feeding records back)."""
+
+    def __init__(self, step: Step):
+        t = step.terminal
+        self.uid = t.uid
+        self.max_rounds = int(t.config.get("max_rounds", 10000))
+        self._feedback: deque = deque()     # (values, timestamps) batches
+        self._end_held = False
+        self._held_wm: Optional[int] = None
+        self._closed = False
+
+    def on_batch(self, values: np.ndarray, timestamps: np.ndarray) -> None:
+        if self.downstream:
+            self.downstream.on_batch(values, timestamps)
+
+    def on_watermark(self, watermark: int) -> None:
+        if watermark >= MAX_WATERMARK - 1 and not self._closed:
+            # the sources' final flush must not fire downstream windows while
+            # feedback can still inject records for them
+            self._held_wm = max(self._held_wm or MIN_WATERMARK, watermark)
+            return
+        super().on_watermark(watermark)
+
+    def on_end(self) -> None:
+        self._end_held = True   # released by finish_iteration()
+
+    # -- feedback edge (called by the tail / the run loop) -----------------
+    def enqueue_feedback(self, values, timestamps) -> None:
+        if len(timestamps):
+            self._feedback.append(
+                (values, np.asarray(timestamps, dtype=np.int64))
+            )
+
+    def has_feedback(self) -> bool:
+        return bool(self._feedback)
+
+    def drain_round(self) -> int:
+        """Re-inject the batches queued at round start; batches their
+        processing enqueues belong to the next round. Returns records sent."""
+        n_batches = len(self._feedback)
+        sent = 0
+        for _ in range(n_batches):
+            values, ts = self._feedback.popleft()
+            sent += len(ts)
+            if self.downstream:
+                self.downstream.on_batch(values, ts)
+        return sent
+
+    def finish_iteration(self) -> None:
+        """Quiescence reached: release the held final watermark/end."""
+        self._closed = True
+        if self._held_wm is not None:
+            StepRunner.on_watermark(self, self._held_wm)
+            self._held_wm = None
+        if self._end_held:
+            StepRunner.on_end(self)
+
+    def snapshot(self) -> dict:
+        if not self._feedback:
+            return {}
+        return {
+            "feedback": [(obj_array(list(v)), ts.copy())
+                         for v, ts in self._feedback]
+        }
+
+    def restore(self, snap: dict) -> None:
+        self._feedback = deque(
+            (v, np.asarray(ts, dtype=np.int64))
+            for v, ts in snap.get("feedback", ())
+        )
+
+
+class IterationTailRunner(StepRunner):
+    """Iteration tail (StreamIterationTail.java analogue): every batch it
+    receives is queued on its head's feedback edge. Watermarks and end
+    signals stop here — they never cross a feedback edge."""
+
+    def __init__(self, step: Step):
+        t = step.terminal
+        self.uid = t.uid
+        self.head_transform_id = t.config["head"].id
+        self.head: Optional[IterationHeadRunner] = None  # wired in build_runners
+
+    def on_batch(self, values: np.ndarray, timestamps: np.ndarray) -> None:
+        self.head.enqueue_feedback(values, timestamps)
+
+    def on_watermark(self, watermark: int) -> None:
+        pass
+
+    def on_end(self) -> None:
+        pass
+
+
 def _make_runner(step: Step, config: Configuration) -> StepRunner:
     if step.terminal is None:
         return ChainRunner(step.chain)
@@ -936,6 +1038,10 @@ def _make_runner(step: Step, config: Configuration) -> StepRunner:
         return BroadcastProcessRunner(step, config)
     if kind in ("window_join", "co_group"):
         return WindowJoinRunner(step, config)
+    if kind == "iteration_head":
+        return IterationHeadRunner(step)
+    if kind == "iteration_tail":
+        return IterationTailRunner(step)
     raise NotImplementedError(kind)
 
 
@@ -972,6 +1078,21 @@ def build_runners(graph: StepGraph, config: Configuration):
     for r in runners:
         if r.downstream is None:
             r.downstream = _FanOut()
+    # feedback edges: tail -> head, matched by the head transformation the
+    # tail's closeWith recorded (the runtime-only cycle)
+    heads = {
+        step.terminal.id: runner_of[id(step)]
+        for step in graph.steps
+        if step.terminal is not None and step.terminal.kind == "iteration_head"
+    }
+    for r in runners:
+        if isinstance(r, IterationTailRunner):
+            if r.head_transform_id not in heads:
+                raise ValueError(
+                    "iteration tail closed with a head that is not part of "
+                    "this pipeline"
+                )
+            r.head = heads[r.head_transform_id]
     return runners, feeds
 
 
@@ -1083,6 +1204,9 @@ class JobRuntime:
                 coord = _wire_coordinator(f)
                 if coord is not None:
                     self.coordinators[uid] = coord
+        self.iteration_heads = [
+            r for r in self.runners if isinstance(r, IterationHeadRunner)
+        ]
         self.records_in = 0
         # observability (O1/O3): job-scope throughput, busy-ratio, step latency
         self.registry = registry or MetricRegistry()
@@ -1209,6 +1333,10 @@ class JobRuntime:
                         wm = d.generator.on_periodic_emit()
                     if wm is not None and wm > MIN_WATERMARK:
                         d.emit_watermark(wm)
+                if self.iteration_heads:
+                    # run feedback to quiescence at the step boundary so
+                    # checkpoints capture (almost) no in-flight feedback
+                    self._drain_iterations()
                 step_dt = time.perf_counter() - busy_t0
                 self._busy_time += step_dt
                 self.step_latency.update(step_dt * 1000)
@@ -1231,6 +1359,33 @@ class JobRuntime:
         # (or is now) delivered, firing all remaining windows downstream
         for d in self.sources:
             d.finish()
+        if self.iteration_heads:
+            # iteration heads held the final watermark/end; drain remaining
+            # feedback to quiescence, then release them
+            self._drain_iterations()
+            for h in self.iteration_heads:
+                h.finish_iteration()
+
+    def _drain_iterations(self) -> None:
+        """Round-robin feedback rounds across iteration heads until every
+        feedback queue is empty (termination = the loop body stopped feeding
+        records back). Each head's own max_rounds bounds the rounds in which
+        IT still had feedback, so one non-converging loop trips its own
+        (possibly tight) bound regardless of other loops in the job."""
+        rounds = {id(h): 0 for h in self.iteration_heads}
+        while any(h.has_feedback() for h in self.iteration_heads):
+            for h in self.iteration_heads:
+                if not h.has_feedback():
+                    continue
+                rounds[id(h)] += 1
+                if rounds[id(h)] > h.max_rounds:
+                    raise RuntimeError(
+                        f"iteration '{h.uid}' did not reach quiescence "
+                        f"within max_rounds={h.max_rounds}; the loop body "
+                        "must eventually stop emitting feedback records "
+                        "(or raise iterate(max_rounds=...))"
+                    )
+                h.drain_round()
 
     def _write_savepoint(self, path: str) -> None:
         from flink_tpu.checkpoint.storage import FsCheckpointStorage

@@ -235,7 +235,9 @@ def _run_application(cluster: MiniCluster, module_path: str, entry: str):
     if isinstance(result, StreamExecutionEnvironment):
         if len(result._sinks) != 1:
             raise RuntimeError("application must define exactly one sink")
-        return cluster.submit(plan(result._sinks[0]), result.config)
+        # iteration tails live in env._roots (reachable only via close_with)
+        roots = result._sinks[:1] + getattr(result, "_roots", [])
+        return cluster.submit(plan(roots), result.config)
     raise TypeError(f"{entry}() must return JobClient or StreamExecutionEnvironment")
 
 

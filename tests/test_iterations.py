@@ -1,0 +1,156 @@
+"""Stream iterations: feedback edges on the stepped executor.
+
+Reference semantics under parity test: DataStream.iterate/closeWith
+(flink-runtime .../streaming/api/datastream/IterativeStream.java,
+runtime StreamIterationHead/Tail in .../streaming/runtime/tasks/):
+records fed back re-enter the loop body; watermarks never cross the
+feedback edge; bounded jobs terminate when feedback quiesces.
+"""
+
+import numpy as np
+import pytest
+
+from flink_tpu.api.datastream import StreamExecutionEnvironment
+from flink_tpu.core.watermarks import WatermarkStrategy
+
+
+def test_collatz_iteration_reaches_one():
+    """Classic loop: every value iterates x -> x/2 | 3x+1 until 1; the exit
+    stream must see exactly one 1 per input element."""
+    env = StreamExecutionEnvironment.get_execution_environment()
+    src = env.from_collection([7, 12, 27, 1, 6])
+
+    it = src.iterate()
+    body = it.map(lambda x: x // 2 if x % 2 == 0 else 3 * x + 1)
+    it.close_with(body.filter(lambda x: x != 1))
+    done = body.filter(lambda x: x == 1).collect()
+
+    env.execute("collatz")
+    assert done.results == [1] * 5
+
+
+def test_iteration_decrement_counts_rounds():
+    """x enters at n and is fed back n times -> the exit sees one 0 per
+    input, and the head re-injected sum(values) feedback records total."""
+    env = StreamExecutionEnvironment.get_execution_environment()
+    src = env.from_collection([3, 1, 4])
+
+    it = src.iterate()
+    body = it.map(lambda x: x - 1)
+    it.close_with(body.filter(lambda x: x > 0))
+    out = body.filter(lambda x: x <= 0).collect()
+
+    env.execute("decrement")
+    assert sorted(out.results) == [0, 0, 0]
+
+
+def test_iteration_head_passthrough_also_reaches_sink():
+    """The head stream itself (initial + feedback records) is observable:
+    sum of everything passing the head = initial values + all feedback."""
+    env = StreamExecutionEnvironment.get_execution_environment()
+    src = env.from_collection([2])
+
+    it = src.iterate()
+    seen = it.collect()                     # taps the head's output
+    body = it.map(lambda x: x - 1)
+    it.close_with(body.filter(lambda x: x > 0))
+
+    env.execute("tap")
+    # head passes 2, then feedback 1 -> [2, 1]
+    assert sorted(seen.results) == [1, 2]
+
+
+def test_iteration_max_rounds_guard():
+    env = StreamExecutionEnvironment.get_execution_environment()
+    src = env.from_collection([1])
+
+    it = src.iterate(max_rounds=50)
+    body = it.map(lambda x: x)              # never converges
+    it.close_with(body)
+    body.collect()
+
+    with pytest.raises(RuntimeError, match="max_rounds"):
+        env.execute("diverges")
+
+
+def _run_iter_window(watermark_strategy):
+    """Loop whose exits feed a tumbling window; returns the fired counts."""
+    from flink_tpu.api.windowing.assigners import TumblingEventTimeWindows
+
+    env = StreamExecutionEnvironment.get_execution_environment()
+    src = env.from_collection(
+        [(1, 500), (2, 1500), (3, 2500)],
+        timestamp_fn=lambda v: v[1],
+        watermark_strategy=watermark_strategy,
+    )
+    it = src.iterate()
+    body = it.map(lambda v: (v[0] - 1, v[1]))
+    it.close_with(body.filter(lambda v: v[0] > 0))
+    out = (
+        body.filter(lambda v: v[0] <= 0)
+        .key_by(lambda v: 0)
+        .window(TumblingEventTimeWindows.of(1000))
+        .count()
+        .collect()
+    )
+    env.execute("iter-window")
+    return sorted((k, int(c)) for (k, c) in out.results)
+
+
+def test_iteration_window_fires_at_end_without_watermarks():
+    """Without a source watermark strategy every exit record is on time and
+    the end-of-input flush (held by the head until quiescence) fires one
+    window per input's original 1s bucket."""
+    assert _run_iter_window(None) == [(0, 1), (0, 1), (0, 1)]
+
+
+def test_iteration_watermarks_do_not_cross_feedback():
+    """With monotonic source watermarks the feedback edge still emits NO
+    watermarks (reference contract), so a record that re-enters after the
+    source watermark passed its bucket is dropped as late on arrival —
+    exactly the reference's iteration/lateness interaction: (2,1500) exits
+    on round 2 when the watermark is already 2499 (its [1000,2000) bucket
+    closed), while (1,500) exits in-batch and (3,2500)'s bucket is still
+    open at the final flush."""
+    assert _run_iter_window(
+        WatermarkStrategy.for_monotonous_timestamps()
+    ) == [(0, 1), (0, 1)]
+
+
+def test_iteration_close_with_foreign_head_rejected():
+    env = StreamExecutionEnvironment.get_execution_environment()
+    a = env.from_collection([1]).iterate()
+    b = env.from_collection([2])
+    a.close_with(b.map(lambda x: x))
+    b.collect()          # job that plans the tail but NOT a's head
+    env._sinks = [env._sinks[-1]]
+    with pytest.raises(ValueError, match="iteration tail"):
+        env.execute("foreign")
+
+
+def test_iteration_feedback_rides_checkpoints():
+    """Pending feedback is operator state: snapshot mid-flight, restore into
+    a fresh runtime, finish the run — nothing lost, nothing duplicated."""
+    from flink_tpu.config import Configuration
+    from flink_tpu.graph.transformation import plan
+    from flink_tpu.runtime.executor import JobRuntime, IterationHeadRunner
+
+    env = StreamExecutionEnvironment.get_execution_environment()
+    src = env.from_collection([5])
+    it = src.iterate()
+    body = it.map(lambda x: x - 1)
+    it.close_with(body.filter(lambda x: x > 0))
+    out = body.filter(lambda x: x <= 0).collect()
+
+    graph = plan(env._sinks + env._roots)
+    rt = JobRuntime(graph, Configuration())
+    head = rt.iteration_heads[0]
+    head.enqueue_feedback(np.array([9], dtype=object), np.array([0]))
+    snap = rt.capture()
+
+    rt2 = JobRuntime(graph, Configuration())
+    rt2.restore(snap)
+    h2 = rt2.iteration_heads[0]
+    assert h2.has_feedback()
+    (v, ts) = h2._feedback[0]
+    assert list(v) == [9]
