@@ -248,6 +248,18 @@ class DataStream:
         """Route everything to instance 0 (GlobalPartitioner)."""
         return self._partition_hint("global")
 
+    def slot_sharing_group(self, name: str) -> "DataStream":
+        """Put the operator that produced this stream into slot-sharing
+        group `name` (DataStream.slotSharingGroup). Downstream operators
+        inherit the group unless they declare their own. On the distributed
+        cluster, each named group deploys as its own pipeline stage in its
+        own slot, connected by credit-controlled exchanges — isolating
+        heavyweight operators AND running the stages concurrently; locally
+        (one process) groups are a no-op, like the reference's local
+        environments."""
+        self.transform.config["slot_sharing_group"] = name
+        return self
+
     def iterate(self, max_rounds: int = 10000) -> "IterativeStream":
         """Open an iteration (DataStream.iterate / IterativeStream.java):
         the returned stream carries this stream's records plus every record

@@ -88,6 +88,11 @@ class Step:
     key_selector: Optional[Callable] = None
     upstream: Optional["Step"] = None
     inputs: List = dataclasses.field(default_factory=list)
+    # slot-sharing group (SlotSharingGroup analogue): steps of different
+    # groups deploy as separate pipeline stages in their own slots
+    # (runtime/stages.py); the default group keeps the whole slice in one
+    # slot, the reference's default sharing behavior
+    slot_group: str = "default"
 
     @property
     def name(self) -> str:
@@ -194,6 +199,10 @@ def plan(sink_transforms) -> StepGraph:
     keyed: Dict[int, Dict[str, Any]] = {}
     side_tag: Dict[int, str] = {}
     alias_of: Dict[int, int] = {}   # pass-through views -> effective node
+    # slot-sharing group per node: explicit declaration wins, else inherited
+    # from the first input (DataStream.slotSharingGroup semantics: operators
+    # join their input's group unless told otherwise)
+    group_of: Dict[int, str] = {}
 
     def new_step(**kw) -> Step:
         s = Step(**kw)
@@ -210,6 +219,10 @@ def plan(sink_transforms) -> StepGraph:
         return ent, ordinal, tag, "forward", None
 
     for t in order:
+        g = t.config.get("slot_sharing_group")
+        if g is None and t.inputs:
+            g = group_of[t.inputs[0].id]
+        group_of[t.id] = g or "default"
         if t.kind == "source":
             sources.append(t)
             producer[t.id] = t
@@ -238,6 +251,9 @@ def plan(sink_transforms) -> StepGraph:
                 and inp.id not in side_tag
                 and ent.chain
                 and ent.chain[-1].id == eff_id
+                # a different slot-sharing group breaks the chain (the
+                # reference's isChainable group check)
+                and group_of[t.id] == ent.slot_group
             ):
                 ent.chain.append(t)          # fuse into the open chain
                 producer[t.id] = ent
@@ -246,6 +262,7 @@ def plan(sink_transforms) -> StepGraph:
                 producer[t.id] = new_step(
                     chain=[t], terminal=None, partitioning=part,
                     key_selector=ks, inputs=[(ent2, 0, tag)],
+                    slot_group=group_of[t.id],
                 )
         elif t.kind in TERMINALS:
             inp = t.inputs[0]
@@ -253,6 +270,7 @@ def plan(sink_transforms) -> StepGraph:
             producer[t.id] = new_step(
                 chain=[], terminal=t, partitioning=part,
                 key_selector=ks, inputs=[(ent, 0, tag)],
+                slot_group=group_of[t.id],
             )
         elif t.kind in MULTI_TERMINALS:
             ins = []
@@ -266,6 +284,7 @@ def plan(sink_transforms) -> StepGraph:
             producer[t.id] = new_step(
                 chain=[], terminal=t, partitioning=part,
                 key_selector=ks, inputs=ins,
+                slot_group=group_of[t.id],
             )
         elif t.kind in REDISTRIBUTING:
             # explicit repartition hints; locally a pass-through view that
@@ -285,4 +304,15 @@ def plan(sink_transforms) -> StepGraph:
 
     if not sources:
         raise ValueError("pipeline must start at a source")
+    # co-location (CoLocationGroup analogue): an iteration tail always joins
+    # its head's slot-sharing group — the runtime feedback cycle is local
+    head_group = {
+        s.terminal.id: s.slot_group for s in steps
+        if s.terminal is not None and s.terminal.kind == "iteration_head"
+    }
+    for s in steps:
+        if s.terminal is not None and s.terminal.kind == "iteration_tail":
+            hid = s.terminal.config["head"].id
+            if hid in head_group:
+                s.slot_group = head_group[hid]
     return StepGraph(sources=sources, steps=steps)

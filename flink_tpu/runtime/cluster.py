@@ -161,6 +161,8 @@ class _JobState:
     completed: List[Tuple[int, dict, int]] = field(default_factory=list)  # (cp_id, handles, step)
     cp_origins: Dict[int, Dict[int, str]] = field(default_factory=dict)    # cp_id -> {shard: tm_id}
     steps: Dict[int, int] = field(default_factory=dict)        # shard -> last reported step
+    stages: int = 1      # >1: GraphJobSpec split into pipeline stages (slot
+    #                      sharing groups); shard index = stage index
 
 
 class JobManagerEndpoint(RpcEndpoint):
@@ -253,16 +255,23 @@ class JobManagerEndpoint(RpcEndpoint):
     def submit_job(self, spec_bytes: bytes, parallelism: int) -> str:
         blob_key = self.blob.put(spec_bytes)
         spec = DistributedJobSpec.from_bytes(spec_bytes)
-        if isinstance(spec, GraphJobSpec) and parallelism != 1:
-            raise ValueError(
-                "GraphJobSpec jobs run as one supervised task "
-                "(parallelism=1); keyed sharded execution uses "
-                "DistributedJobSpec"
-            )
+        stages = 1
+        if isinstance(spec, GraphJobSpec):
+            from flink_tpu.runtime.stages import num_stages, validate_stages
+
+            validate_stages(spec.graph)
+            stages = num_stages(spec.graph)
+            if parallelism not in (1, stages):
+                raise ValueError(
+                    "GraphJobSpec jobs deploy one task per slot-sharing "
+                    f"group ({stages} stage(s)); keyed sharded execution "
+                    "uses DistributedJobSpec"
+                )
+            parallelism = stages
         job_id = uuid.uuid4().hex[:16]
         self._jobs[job_id] = _JobState(
             job_id, blob_key, parallelism, spec.name,
-            requested_parallelism=parallelism,
+            requested_parallelism=parallelism, stages=stages,
         )
         self._try_schedule(self._jobs[job_id])
         return job_id
@@ -271,6 +280,8 @@ class JobManagerEndpoint(RpcEndpoint):
         job = self._jobs[job_id]
         return {
             "status": job.status, "attempt": job.attempt, "name": job.spec_name,
+            "parallelism": job.parallelism, "stages": job.stages,
+            "tasks": len(job.assignment),
             "failure": job.failure, "restarts": job.restarts,
             "checkpoints": [c[0] for c in job.completed],
         }
@@ -450,6 +461,11 @@ class JobManagerEndpoint(RpcEndpoint):
         job = self._jobs.get(job_id)
         if job is None or job.status != "RUNNING" or self._storage is None:
             return None
+        if job.stages > 1:
+            # pipeline stages progress at independent step counts, so the
+            # step-aligned cut is not consistent across them; multi-stage
+            # jobs fail over by full restart (full-graph failover strategy)
+            return None
         if len(job.steps) < job.parallelism:
             return None
         cp_id = job.next_checkpoint_id
@@ -600,6 +616,67 @@ class _ShardTask:
                 self.job_id, self.restore_local_cp, self.shard
             )
 
+    def _run_graph_stage(self) -> None:
+        """One stage of a slot-sharing-group-split StepGraph (this task's
+        shard index = stage index). The stage's sub-graph runs as a normal
+        JobRuntime; cross-stage edges are exchange channels (stages.py), so
+        the stages of the job execute CONCURRENTLY as a pipeline with
+        credit backpressure — the PIPELINED-result-partition analogue.
+        Failover is full-restart (no cross-stage checkpoint cut)."""
+        from flink_tpu.runtime.dataplane import OutputChannel
+        from flink_tpu.runtime.executor import (
+            JobCancelledException,
+            JobRuntime,
+            SinkRunner,
+        )
+        from flink_tpu.runtime.stages import build_stage_graph, cross_edges
+
+        stage_idx = self.shard
+        edges = cross_edges(self.spec.graph)
+        ins: Dict[str, object] = {}
+        outs: Dict[str, OutputChannel] = {}
+        for e in edges:
+            cid = f"{self.job_id}/a{self.attempt}/{e.edge_id}"
+            if e.dst_stage == stage_idx:
+                ins[e.edge_id] = self.te.exchange.channel(cid)
+            if e.src_stage == stage_idx:
+                outs[e.edge_id] = OutputChannel(self.peers[e.dst_stage], cid)
+        graph = build_stage_graph(
+            self.spec.graph, stage_idx, ins, outs, self.cancelled
+        )
+        rt = JobRuntime(graph, self.spec.config)
+
+        task = self
+
+        class _StepCounter:
+            """Progress for heartbeats; no checkpoints across stages."""
+
+            def register_on_complete(self, fn):
+                pass
+
+            def maybe_trigger(self, capture):
+                task.current_step += 1
+
+        try:
+            rt.run(coordinator=_StepCounter(),
+                   cancel_check=lambda: self.cancelled.is_set())
+        except JobCancelledException:
+            return
+        finally:
+            for ch in outs.values():
+                try:
+                    ch.end()     # duplicate eos is harmless; frees receivers
+                    ch.close()
+                except Exception:
+                    pass
+        if self.cancelled.is_set():
+            return
+        results: list = []
+        for r in rt.runners:
+            if isinstance(r, SinkRunner) and hasattr(r.writer, "store"):
+                results.extend(r.writer.store)
+        self.jm.task_finished(self.job_id, self.attempt, self.shard, results)
+
     def _run_graph(self) -> None:
         """One-task execution of a general StepGraph under cluster
         supervision: step-aligned checkpoint requests snapshot the whole
@@ -713,6 +790,10 @@ class _ShardTask:
 
     def _run(self) -> None:
         if isinstance(self.spec, GraphJobSpec):
+            from flink_tpu.runtime.stages import num_stages
+
+            if num_stages(self.spec.graph) > 1:
+                return self._run_graph_stage()
             return self._run_graph()
         P = self.parallelism
         batches = self.spec.source_factory(self.shard, P)

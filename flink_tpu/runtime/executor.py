@@ -1042,6 +1042,10 @@ def _make_runner(step: Step, config: Configuration) -> StepRunner:
         return IterationHeadRunner(step)
     if kind == "iteration_tail":
         return IterationTailRunner(step)
+    if kind == "stage_output":
+        from flink_tpu.runtime.stages import StageOutputRunner
+
+        return StageOutputRunner(step)
     raise NotImplementedError(kind)
 
 

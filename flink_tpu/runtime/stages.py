@@ -1,0 +1,422 @@
+"""Slot-sharing groups → pipeline stages.
+
+Reference capability: `SlotSharingGroup` / `CoLocationGroup`
+(flink-runtime .../runtime/jobmanager/scheduler/SlotSharingGroup.java,
+`DataStream.slotSharingGroup`). In the reference, subtasks of all vertices
+share one slot by default, and naming a group ISOLATES heavyweight
+operators into their own slots — which also makes the cut stages run
+concurrently as a pipeline (PIPELINED result partitions).
+
+The stepped-executor analogue: a planned StepGraph is split at
+slot-sharing-group boundaries into *stages*. Each stage is deployed as its
+own task in its own slot (one process/thread running a JobRuntime over the
+stage's sub-graph) and the cross-stage edges become credit-controlled
+dataplane exchanges (runtime/dataplane.py — the PIPELINED partition
+analogue, backpressure via credits). The default (everything in one group)
+keeps today's behavior: the whole pipeline slice in one slot, which is
+exactly the reference's default slot sharing.
+
+Co-location: an iteration's feedback cycle (head → body → tail) must stay
+within one stage — the CoLocationGroup constraint the reference applies to
+iteration head/tail pairs — validated here.
+
+Protocol on a cross-stage channel (FIFO, credit-controlled):
+  ("b", values, timestamps)  — a record batch
+  ("w", watermark_ms)        — a watermark advance
+  end-of-stream via the channel's eos frame (OutputChannel.end()).
+Latency markers do not cross stages (sampled per stage instead).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from flink_tpu.connectors.source import Batch, Source, SourceSplit, SplitEnumerator, SourceReader
+from flink_tpu.core.time import MIN_WATERMARK
+from flink_tpu.graph.transformation import Step, StepGraph, Transformation
+from flink_tpu.utils.arrays import obj_array
+
+
+# ---------------------------------------------------------------------------
+# stage assignment / validation
+# ---------------------------------------------------------------------------
+
+def stage_names(graph: StepGraph) -> List[str]:
+    """Distinct slot-sharing groups in first-appearance (topological)
+    order; the deployment order of the stages."""
+    names: List[str] = []
+    for s in graph.steps:
+        if s.slot_group not in names:
+            names.append(s.slot_group)
+    return names
+
+
+def num_stages(graph: StepGraph) -> int:
+    return len(stage_names(graph))
+
+
+def _stage_index(graph: StepGraph) -> Dict[int, int]:
+    names = stage_names(graph)
+    return {id(s): names.index(s.slot_group) for s in graph.steps}
+
+
+def validate_stages(graph: StepGraph) -> None:
+    """Slot-sharing groups must cut the graph into a forward pipeline:
+
+    - every cross-group edge flows from an earlier stage to a later one
+      (groups may not interleave along a path);
+    - all steps fed directly by one source belong to one stage (a physical
+      reader cannot be split across processes);
+    - an iteration's feedback cycle stays within one stage (CoLocationGroup
+      analogue — the runtime cycle is process-local)."""
+    idx = _stage_index(graph)
+    # co-location first: a split iteration loop is the clearer diagnosis
+    # (its backward feedback edge would otherwise read as "interleaved")
+    tails = [s for s in graph.steps
+             if s.terminal is not None and s.terminal.kind == "iteration_tail"]
+    heads = {s.terminal.id: s for s in graph.steps
+             if s.terminal is not None and s.terminal.kind == "iteration_head"}
+    for tail in tails:
+        head = heads.get(tail.terminal.config["head"].id)
+        if head is None:
+            continue  # caught by build_runners
+        loop_steps = _between(graph, head, tail)
+        bad = [s for s in loop_steps if idx[id(s)] != idx[id(head)]]
+        if bad:
+            raise ValueError(
+                "iteration loop must stay within one slot sharing group "
+                f"(co-location): step '{bad[0].name}' is in group "
+                f"{bad[0].slot_group!r} but the iteration head is in "
+                f"{head.slot_group!r}"
+            )
+    for s in graph.steps:
+        for edge in s.inputs:
+            ent = edge[0]
+            if isinstance(ent, Step) and idx[id(ent)] > idx[id(s)]:
+                raise ValueError(
+                    f"slot sharing groups interleave: step '{s.name}' "
+                    f"(group {s.slot_group!r}) consumes step '{ent.name}' "
+                    f"(group {ent.slot_group!r}) which is scheduled later; "
+                    "groups must form a forward pipeline"
+                )
+    src_stage: Dict[int, int] = {}
+    for s in graph.steps:
+        for edge in s.inputs:
+            ent = edge[0]
+            if isinstance(ent, Transformation):
+                prev = src_stage.setdefault(ent.id, idx[id(s)])
+                if prev != idx[id(s)]:
+                    raise ValueError(
+                        f"source '{ent.name}' feeds steps in different slot "
+                        "sharing groups; keep its direct consumers in one "
+                        "group"
+                    )
+
+
+def _between(graph: StepGraph, head: Step, tail: Step) -> List[Step]:
+    """Steps on any path head → … → tail (inclusive), following step edges."""
+    consumers: Dict[int, List[Step]] = {}
+    for s in graph.steps:
+        for edge in s.inputs:
+            if isinstance(edge[0], Step):
+                consumers.setdefault(id(edge[0]), []).append(s)
+    reach_from_head = set()
+    work = [head]
+    while work:
+        s = work.pop()
+        if id(s) in reach_from_head:
+            continue
+        reach_from_head.add(id(s))
+        work.extend(consumers.get(id(s), ()))
+    reaches_tail = set()
+    work = [tail]
+    while work:
+        s = work.pop()
+        if id(s) in reaches_tail:
+            continue
+        reaches_tail.add(id(s))
+        for edge in s.inputs:
+            if isinstance(edge[0], Step):
+                work.append(edge[0])
+    both = reach_from_head & reaches_tail
+    return [s for s in graph.steps if id(s) in both]
+
+
+# ---------------------------------------------------------------------------
+# cross-stage edges
+# ---------------------------------------------------------------------------
+
+@dataclasses.dataclass
+class CrossEdge:
+    edge_id: str
+    producer_step: int        # index into graph.steps
+    consumer_step: int
+    ordinal: int              # input gate at the consumer
+    tag: Optional[str]        # producer side-output channel, if any
+    src_stage: int
+    dst_stage: int
+
+
+def cross_edges(graph: StepGraph) -> List[CrossEdge]:
+    """Deterministic enumeration of edges crossing stage boundaries —
+    identical on every task, so channel ids agree across processes."""
+    idx = _stage_index(graph)
+    pos = {id(s): i for i, s in enumerate(graph.steps)}
+    edges: List[CrossEdge] = []
+    for s in graph.steps:
+        for edge in s.inputs:
+            ent, ordinal = edge[0], edge[1]
+            tag = edge[2] if len(edge) > 2 else None
+            if isinstance(ent, Step) and idx[id(ent)] != idx[id(s)]:
+                edges.append(CrossEdge(
+                    edge_id=f"x{len(edges)}",
+                    producer_step=pos[id(ent)],
+                    consumer_step=pos[id(s)],
+                    ordinal=ordinal,
+                    tag=tag,
+                    src_stage=idx[id(ent)],
+                    dst_stage=idx[id(s)],
+                ))
+    return edges
+
+
+# ---------------------------------------------------------------------------
+# runtime pieces: channel-fed source, channel-writing sink
+# ---------------------------------------------------------------------------
+
+class _WmBox:
+    """Shared watermark cell between a stage-input reader (writer) and its
+    watermark 'generator' (reader)."""
+
+    __slots__ = ("wm",)
+
+    def __init__(self):
+        self.wm = MIN_WATERMARK
+
+
+class _ChannelWatermarkGenerator:
+    def __init__(self, box: _WmBox):
+        self._box = box
+        self._emitted = MIN_WATERMARK
+
+    def on_batch_np(self, ts) -> int:
+        # always an int (None would trigger the per-event fallback in the
+        # source driver); non-advancing values are dropped by the valves
+        if self._box.wm > self._emitted:
+            self._emitted = self._box.wm
+        return self._emitted
+
+    def snapshot(self):
+        return self._emitted
+
+    def restore(self, snap) -> None:
+        self._emitted = snap
+
+
+class _ChannelWatermarks:
+    """WatermarkStrategy duck-type forwarding upstream-stage watermarks."""
+
+    timestamp_assigner = None
+
+    def __init__(self, box: _WmBox):
+        self._box = box
+
+    def create_generator(self) -> _ChannelWatermarkGenerator:
+        return _ChannelWatermarkGenerator(self._box)
+
+
+class _StageReader(SourceReader):
+    """Reads ('b', values, ts) / ('w', wm) messages off one exchange
+    channel. Returns an EMPTY batch on poll timeout (keeps the round-robin
+    source loop live for the job's other inputs) and None only at
+    end-of-stream."""
+
+    def __init__(self, channel, cancelled: threading.Event, box: _WmBox):
+        self._chan = channel
+        self._cancelled = cancelled
+        self._box = box
+
+    def add_split(self, split: SourceSplit) -> None:
+        pass
+
+    def poll_batch(self, max_records: int) -> Optional[Batch]:
+        while not self._cancelled.is_set():
+            try:
+                msg = self._chan.poll(timeout=0.05)
+            except TimeoutError:
+                return _EMPTY_BATCH
+            if msg is None:
+                return None                       # upstream stage ended
+            if msg[0] == "w":
+                self._box.wm = max(self._box.wm, int(msg[1]))
+                return _EMPTY_BATCH               # watermark piggybacks next
+            return Batch(values=msg[1],
+                         timestamps=np.asarray(msg[2], dtype=np.int64))
+        return None
+
+
+_EMPTY_BATCH = Batch(values=obj_array([]),
+                     timestamps=np.asarray([], dtype=np.int64))
+
+
+class StageInputSource(Source):
+    """Source wrapping one cross-stage input channel."""
+
+    boundedness = "CONTINUOUS_UNBOUNDED"
+
+    def __init__(self, channel, cancelled: threading.Event, box: _WmBox):
+        self._channel = channel
+        self._cancelled = cancelled
+        self._box = box
+
+    def create_enumerator(self) -> SplitEnumerator:
+        return SplitEnumerator([SourceSplit("stage-input")])
+
+    def create_reader(self) -> _StageReader:
+        return _StageReader(self._channel, self._cancelled, self._box)
+
+
+class StageOutputRunner:
+    """Terminal step writing this stage's boundary output to the exchange
+    (instantiated via executor._make_runner on kind 'stage_output';
+    duck-typed StepRunner — import cycle keeps it out of executor.py).
+    Backpressure: send blocks on credits, surfacing the downstream stage's
+    backlog to this stage's run loop (reference: writer blocking on
+    LocalBufferPool)."""
+
+    downstream = None
+    sides = None
+    num_inputs = 1
+
+    def __init__(self, step: Step):
+        t = step.terminal
+        self.uid = t.uid
+        self.sender = t.config["sender"]
+        self.cancelled: threading.Event = t.config["cancelled"]
+        self._ended = False
+        self.records_out = None
+
+    def register_metrics(self, group) -> None:
+        self.records_out = group.counter("numRecordsOut")
+
+    def _send(self, msg) -> None:
+        while True:
+            try:
+                self.sender.send(msg, timeout=1.0)
+                return
+            except TimeoutError:
+                if self.cancelled.is_set():
+                    from flink_tpu.runtime.executor import JobCancelledException
+
+                    raise JobCancelledException()
+
+    # StepRunner protocol (single gate)
+    def on_batch_n(self, ordinal, values, timestamps) -> None:
+        self.on_batch(values, timestamps)
+
+    def on_watermark_n(self, ordinal, watermark) -> None:
+        self.on_watermark(watermark)
+
+    def on_end_n(self, ordinal) -> None:
+        self.on_end()
+
+    def on_batch(self, values, timestamps) -> None:
+        if len(timestamps):
+            if self.records_out is not None:
+                self.records_out.inc(len(timestamps))
+            self._send(("b", values, timestamps))
+
+    def on_watermark(self, watermark: int) -> None:
+        self._send(("w", int(watermark)))
+
+    def on_marker(self, wall_ms: float) -> None:
+        pass  # latency markers are per-stage
+
+    def on_processing_time(self, now_ms: int) -> None:
+        pass
+
+    def on_end(self) -> None:
+        if not self._ended:
+            self._ended = True
+            self.sender.end()
+
+    def snapshot(self) -> dict:
+        return {}
+
+    def restore(self, snap: dict) -> None:
+        pass
+
+
+# ---------------------------------------------------------------------------
+# per-stage sub-graph
+# ---------------------------------------------------------------------------
+
+def build_stage_graph(
+    graph: StepGraph,
+    stage_idx: int,
+    in_channels: Dict[str, Any],
+    out_senders: Dict[str, Any],
+    cancelled: threading.Event,
+) -> StepGraph:
+    """Carve stage `stage_idx` out of `graph` (the task's OWN unpickled
+    copy — mutated in place): cross-stage inputs become StageInputSource
+    transformations reading `in_channels[edge_id]`; boundary outputs grow a
+    'stage_output' terminal step writing `out_senders[edge_id]`."""
+    idx = _stage_index(graph)
+    edges = cross_edges(graph)
+    mine = [s for s in graph.steps if idx[id(s)] == stage_idx]
+
+    for e in edges:
+        if e.dst_stage == stage_idx:
+            consumer = graph.steps[e.consumer_step]
+            box = _WmBox()
+            src_t = Transformation(
+                "source", f"stage-in:{e.edge_id}", [],
+                {
+                    "source": StageInputSource(
+                        in_channels[e.edge_id], cancelled, box),
+                    "watermark_strategy": _ChannelWatermarks(box),
+                },
+            )
+            src_t.uid = f"stage-in-{e.edge_id}"
+            # string id: the unpickled graph carries CLIENT-counter ids, and
+            # this process's fresh counter would collide with them (feeds in
+            # build_runners key by id — a collision merges two sources'
+            # feed lists and misroutes records)
+            src_t.id = f"stage-in-{e.edge_id}"
+            for j, edge in enumerate(consumer.inputs):
+                ent, ordinal = edge[0], edge[1]
+                tag = edge[2] if len(edge) > 2 else None
+                if (isinstance(ent, Step)
+                        and graph.steps[e.producer_step] is ent
+                        and ordinal == e.ordinal and tag == e.tag):
+                    # tag consumed producer-side; this gate sees a plain feed
+                    consumer.inputs[j] = (src_t, ordinal, None)
+                    break
+        if e.src_stage == stage_idx:
+            producer = graph.steps[e.producer_step]
+            out_t = Transformation(
+                "stage_output", f"stage-out:{e.edge_id}", [],
+                {"sender": out_senders[e.edge_id], "cancelled": cancelled},
+            )
+            out_t.uid = f"stage-out-{e.edge_id}"
+            out_t.id = f"stage-out-{e.edge_id}"   # collision-proof (see above)
+            mine.append(Step(
+                chain=[], terminal=out_t, partitioning="forward",
+                inputs=[(producer, 0, e.tag)],
+            ))
+
+    sources: List[Transformation] = []
+    for s in mine:
+        for edge in s.inputs:
+            ent = edge[0]
+            if isinstance(ent, Transformation) and ent.kind == "source" \
+                    and ent not in sources:
+                sources.append(ent)
+    if not sources:
+        raise ValueError(f"stage {stage_idx} has no inputs")
+    return StepGraph(sources=sources, steps=mine)

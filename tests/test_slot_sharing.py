@@ -1,0 +1,236 @@
+"""Slot-sharing groups and pipeline stages.
+
+Reference capability under test: SlotSharingGroup / CoLocationGroup
+(flink-runtime .../runtime/jobmanager/scheduler/SlotSharingGroup.java,
+DataStream.slotSharingGroup) and pipelined cross-vertex execution
+(ResultPartitionType.PIPELINED): named groups isolate operators into their
+own slots and the resulting stages run concurrently, connected by
+credit-controlled exchanges.
+"""
+
+import time
+
+import pytest
+
+from flink_tpu.api.datastream import StreamExecutionEnvironment
+from flink_tpu.api.windowing.assigners import TumblingEventTimeWindows
+from flink_tpu.config import Configuration, ExecutionOptions
+from flink_tpu.core.watermarks import WatermarkStrategy
+from flink_tpu.graph.transformation import plan
+from flink_tpu.runtime.stages import (
+    cross_edges,
+    num_stages,
+    stage_names,
+    validate_stages,
+)
+
+
+def _pipeline(env, group_on_window=None):
+    src = env.from_collection(
+        [(f"k{i % 3}", i * 250) for i in range(40)],
+        timestamp_fn=lambda v: v[1],
+        watermark_strategy=WatermarkStrategy.for_monotonous_timestamps(),
+    )
+    mapped = src.map(lambda v: v[0])
+    windowed = (
+        mapped.key_by(lambda v: v)
+        .window(TumblingEventTimeWindows.of(2000))
+        .count()
+    )
+    if group_on_window:
+        windowed.slot_sharing_group(group_on_window)
+    return windowed.collect()
+
+
+# ---------------------------------------------------------------------------
+# planner: group assignment, inheritance, chain cuts
+# ---------------------------------------------------------------------------
+
+def test_default_everything_is_one_stage():
+    env = StreamExecutionEnvironment.get_execution_environment()
+    _pipeline(env)
+    g = plan(env._sinks)
+    assert stage_names(g) == ["default"]
+    assert num_stages(g) == 1
+    assert cross_edges(g) == []
+
+
+def test_named_group_splits_and_downstream_inherits():
+    env = StreamExecutionEnvironment.get_execution_environment()
+    _pipeline(env, group_on_window="agg")
+    g = plan(env._sinks)
+    assert stage_names(g) == ["default", "agg"]
+    window_step = next(s for s in g.steps
+                       if s.terminal is not None
+                       and s.terminal.kind == "window_aggregate")
+    sink_step = next(s for s in g.steps
+                     if s.terminal is not None and s.terminal.kind == "sink")
+    assert window_step.slot_group == "agg"
+    assert sink_step.slot_group == "agg"       # inherited from its input
+    edges = cross_edges(g)
+    assert len(edges) == 1
+    assert (edges[0].src_stage, edges[0].dst_stage) == (0, 1)
+    validate_stages(g)
+
+
+def test_group_change_breaks_chain():
+    """Two maps that would fuse stay separate steps when the second one
+    declares its own group (the reference's isChainable group check)."""
+    env = StreamExecutionEnvironment.get_execution_environment()
+    s = env.from_collection([1, 2, 3]).map(lambda x: x + 1)
+    s2 = s.map(lambda x: x * 2).slot_sharing_group("heavy")
+    s2.collect()
+    g = plan(env._sinks)
+    chains = [st for st in g.steps if st.terminal is None]
+    assert len(chains) == 2
+    assert {st.slot_group for st in chains} == {"default", "heavy"}
+
+
+def test_interleaved_groups_rejected():
+    """a(default) -> b(g2) -> c(default): the default group appears on both
+    sides of g2, which cannot form a forward pipeline of slots."""
+    env = StreamExecutionEnvironment.get_execution_environment()
+    s = env.from_collection([1]).map(lambda x: x, name="a")
+    b = s.map(lambda x: x, name="b").slot_sharing_group("g2")
+    c = b.map(lambda x: x, name="c").slot_sharing_group("default")
+    c.collect()
+    g = plan(env._sinks)
+    with pytest.raises(ValueError, match="interleave"):
+        validate_stages(g)
+
+
+def test_iteration_tail_colocated_with_head():
+    """CoLocationGroup analogue: the feedback tail always joins its head's
+    group, and a loop body split across groups is rejected."""
+    env = StreamExecutionEnvironment.get_execution_environment()
+    it = env.from_collection([3]).iterate()
+    body = it.map(lambda x: x - 1).slot_sharing_group("body")
+    it.close_with(body.filter(lambda x: x > 0))
+    body.filter(lambda x: x <= 0).collect()
+    g = plan(env._sinks + env._roots)
+    tail_step = next(s for s in g.steps
+                     if s.terminal is not None
+                     and s.terminal.kind == "iteration_tail")
+    head_step = next(s for s in g.steps
+                     if s.terminal is not None
+                     and s.terminal.kind == "iteration_head")
+    assert tail_step.slot_group == head_step.slot_group
+    with pytest.raises(ValueError, match="co-location"):
+        validate_stages(g)
+
+
+def test_groups_are_noop_locally():
+    """Local execution ignores groups (reference local environments):
+    results match the identical pipeline without groups."""
+    env = StreamExecutionEnvironment.get_execution_environment()
+    out = _pipeline(env, group_on_window="agg")
+    env.execute()
+    ref_env = StreamExecutionEnvironment.get_execution_environment()
+    ref = _pipeline(ref_env)
+    ref_env.execute()
+    assert sorted(out.results) == sorted(ref.results)
+    assert sum(c for _k, c in out.results) == 40
+
+
+# ---------------------------------------------------------------------------
+# distributed: each group deploys as its own pipelined stage task
+# ---------------------------------------------------------------------------
+
+def test_cluster_runs_two_stage_pipeline(tmp_path):
+    from flink_tpu.runtime.cluster import (
+        GraphJobSpec,
+        JobManagerEndpoint,
+        TaskExecutorEndpoint,
+    )
+    from flink_tpu.runtime.rpc import RpcService
+
+    conf = Configuration()
+    conf.set(ExecutionOptions.BATCH_SIZE, 8)
+    env = StreamExecutionEnvironment.get_execution_environment(conf)
+    expected_sink = _pipeline(env, group_on_window="agg")
+    # reference result from local execution of an identical pipeline
+    env_local = StreamExecutionEnvironment.get_execution_environment(
+        Configuration())
+    local_sink = _pipeline(env_local)
+    env_local.execute()
+
+    spec = GraphJobSpec("two-stage", plan(env._sinks), conf)
+
+    svc_jm = RpcService()
+    jm = JobManagerEndpoint(
+        svc_jm, checkpoint_dir=str(tmp_path / "chk"), checkpoint_interval=0.2,
+        restart_attempts=1, heartbeat_interval=0.2, heartbeat_timeout=10.0,
+    )
+    svc1 = RpcService()
+    te1 = TaskExecutorEndpoint(svc1, slots=2)
+    te1.connect(svc_jm.address)
+    client = svc_jm.gateway(svc_jm.address, "jobmanager")
+
+    job_id = client.submit_job(spec.to_bytes(), 1)
+    deadline = time.time() + 60
+    status = None
+    while time.time() < deadline:
+        status = client.job_status(job_id)
+        if status["status"] in ("FINISHED", "FAILED"):
+            break
+        time.sleep(0.1)
+    assert status["status"] == "FINISHED", status
+    result = client.job_result(job_id)
+    assert sorted(result) == sorted(local_sink.results)
+    # the job really deployed one task per stage
+    st = client.job_status(job_id)
+    assert st["stages"] == 2
+    assert st["parallelism"] == 2
+    assert st["tasks"] == 2
+
+    te1.stop()
+    jm.heartbeats.stop()
+    svc_jm.stop()
+    svc1.stop()
+
+
+def test_cluster_two_stage_waits_for_two_slots(tmp_path):
+    """A two-stage job needs two slots: with one slot it parks in CREATED
+    (WaitingForResources) and deploys once a second TM registers."""
+    from flink_tpu.runtime.cluster import (
+        GraphJobSpec,
+        JobManagerEndpoint,
+        TaskExecutorEndpoint,
+    )
+    from flink_tpu.runtime.rpc import RpcService
+
+    conf = Configuration()
+    conf.set(ExecutionOptions.BATCH_SIZE, 8)
+    env = StreamExecutionEnvironment.get_execution_environment(conf)
+    _pipeline(env, group_on_window="agg")
+    spec = GraphJobSpec("two-stage", plan(env._sinks), conf)
+
+    svc_jm = RpcService()
+    jm = JobManagerEndpoint(svc_jm, heartbeat_interval=0.2,
+                            heartbeat_timeout=10.0)
+    svc1 = RpcService()
+    te1 = TaskExecutorEndpoint(svc1, slots=1)
+    te1.connect(svc_jm.address)
+    client = svc_jm.gateway(svc_jm.address, "jobmanager")
+    job_id = client.submit_job(spec.to_bytes(), 1)
+    time.sleep(0.5)
+    assert client.job_status(job_id)["status"] == "CREATED"
+
+    svc2 = RpcService()
+    te2 = TaskExecutorEndpoint(svc2, slots=1)
+    te2.connect(svc_jm.address)
+    deadline = time.time() + 60
+    status = None
+    while time.time() < deadline:
+        status = client.job_status(job_id)
+        if status["status"] in ("FINISHED", "FAILED"):
+            break
+        time.sleep(0.1)
+    assert status["status"] == "FINISHED", status
+
+    te1.stop()
+    te2.stop()
+    jm.heartbeats.stop()
+    svc_jm.stop()
+    svc1.stop()
+    svc2.stop()
