@@ -119,7 +119,8 @@ def make_bits_fn(B: int):
 
 
 def make_device_gen(T: int, B: int, slide_ms: int = SLIDE_MS,
-                    with_vals: bool = False, flat: bool = True):
+                    with_vals: bool = False, flat: bool = True,
+                    nsb: int = NSB):
     """Jitted on-device generator: span of T steps -> idx [T*B] (or [T,B])
     int32, optionally with a value column derived from the same bits."""
     import jax
@@ -137,7 +138,7 @@ def make_device_gen(T: int, B: int, slide_ms: int = SLIDE_MS,
             jit_ = ((bits >> jnp.uint32(13)) % jnp.uint32(OOO_MS + 1)).astype(jnp.int32)
             ts = jnp.maximum(t * STEP_MS + (bb * STEP_MS) // B - jit_, 0)
             srel = ts // slide_ms - smin_abs[tr]
-            idx = kid * NSB + srel
+            idx = kid * nsb + srel
             if with_vals:
                 val = ((bits >> jnp.uint32(23)) & jnp.uint32(0xFF)).astype(jnp.float32)
                 return idx, val
@@ -231,18 +232,25 @@ def _parity(cpu_fired, dev_fired, require_all: bool = True):
 # ---------------------------------------------------------------------------
 
 def _new_pipe(chunk: int, backend: str = "auto", window_ms: int = WINDOW_MS,
-              slide_ms: int = SLIDE_MS, agg: str = "count"):
+              slide_ms: int = SLIDE_MS, agg: str = "count",
+              num_slices: int = 32, nsb: int = NSB, out_rows: int = 64):
     from flink_tpu.api.windowing.assigners import SlidingEventTimeWindows
     from flink_tpu.runtime.fused_window_pipeline import FusedWindowPipeline
 
+    if agg == "max8":
+        # bounded-domain max (values are 8-bit here): rides the pallas MXU
+        # nibble-histogram path, ~3x the scatter unit
+        from flink_tpu.ops.aggregators import max_agg
+
+        agg = max_agg(domain_bits=8)
     return FusedWindowPipeline(
         SlidingEventTimeWindows.of(window_ms, slide_ms),
         agg,
         key_capacity=NUM_KEYS,
-        num_slices=32,
-        nsb=NSB,
+        num_slices=num_slices,
+        nsb=nsb,
         fires_per_step=4,
-        out_rows=64,
+        out_rows=out_rows,
         chunk=chunk,
         backend=backend,
     )
@@ -252,7 +260,8 @@ def run_tpu_stream(T: int, B: int, spans: int, depth: int, t0_step: int = 0,
                    warmup: bool = True, window_ms: int = WINDOW_MS,
                    slide_ms: int = SLIDE_MS, agg: str = "count",
                    backend: str = "auto", resolve_field: Optional[str] = None,
-                   postproc=None):
+                   postproc=None, num_slices: int = 32, nsb: int = NSB,
+                   out_rows: int = 64):
     """Pipelined on-device-generated stream; yields progress per resolve.
 
     agg 'count' streams only key/slice ids; 'sum'/'max' also stream a value
@@ -266,16 +275,20 @@ def run_tpu_stream(T: int, B: int, spans: int, depth: int, t0_step: int = 0,
     with_vals = agg != "count"
     pallas = backend != "xla"
     # count-only pallas dispatches fit CH=32768 int8 one-hots in VMEM
-    # (measured ~1.7x the 8192-chunk rate); weighted stays at 8192 bf16
+    # (measured ~1.7x the 8192-chunk rate); weighted stays at 8192 bf16;
+    # max8's nibble-pass transients cap the chunk at 1024 with S=32/R=64
     chunk = (32768 if not with_vals else 8192) if pallas else 4096
+    if agg == "max8":
+        chunk = 1024
 
     def mk():
         return _new_pipe(chunk=chunk, backend=backend,
-                         window_ms=window_ms, slide_ms=slide_ms, agg=agg)
+                         window_ms=window_ms, slide_ms=slide_ms, agg=agg,
+                         num_slices=num_slices, nsb=nsb, out_rows=out_rows)
 
     pipe = mk()
     gen = make_device_gen(T, B, slide_ms=slide_ms, with_vals=with_vals,
-                          flat=pallas)
+                          flat=pallas, nsb=nsb)
 
     def stage(p, lo):
         bounds = [step_bounds(lo + r, B, slide_ms) for r in range(T)]
@@ -516,18 +529,24 @@ def secondary_q5_topk(headline_ref) -> dict:
 
 def secondary_q7_global_max(bits_fn_small) -> dict:
     """Config 5: Nexmark Q7 — global per-window max with keyed
-    pre-aggregation (max scatter on the XLA superscan; the global merge is
-    the final max over key rows, the single-chip analogue of the psum/pmax
-    cross-shard merge exercised in the multichip dryrun)."""
-    T, B, spans = 48, 1 << 18, 3
+    pre-aggregation. Values are 8-bit, so the keyed max rides the pallas
+    MXU nibble-histogram path (no scatter; ~3x the scatter unit). The
+    global merge is the final max over key rows, the single-chip analogue
+    of the psum/pmax cross-shard merge exercised in the multichip dryrun."""
+    T, B, spans = 96, 1 << 18, 5
 
     def gmax(_counts, row):
         return float(np.max(row))
 
     last = None
-    for prog in run_tpu_stream(T, B, spans, depth=2, window_ms=10_000,
-                               slide_ms=10_000, agg="max", backend="xla",
-                               resolve_field="max", postproc=gmax):
+    # right-sized ring for 10s tumbling windows (a step touches <=2
+    # slices), so the nibble-pass transients fit VMEM; backend='pallas'
+    # raises rather than silently falling back to the scatter path
+    for prog in run_tpu_stream(T, B, spans, depth=3, window_ms=10_000,
+                               slide_ms=10_000, agg="max8",
+                               resolve_field="max", postproc=gmax,
+                               num_slices=8, nsb=2, out_rows=16,
+                               backend="pallas"):
         last = prog
     ref = _replay(10_000, 10_000, "max", T * spans, B, bits_fn_small)
     mismatch = 0

@@ -40,6 +40,10 @@ class AccField:
     identity: float       # padding / empty-slice value
     scatter: str          # 'add' | 'min' | 'max'
     source: str = VALUE   # which input column feeds the scatter
+    # declared value domain: non-negative ints < 2**domain_bits. Unlocks the
+    # MXU fast path for order statistics (pallas nibble-histogram max, ~5x
+    # the scatter unit); None = unbounded, order statistics scatter-combine
+    domain_bits: Any = None
 
 
 @dataclasses.dataclass(frozen=True, eq=False)
@@ -138,7 +142,20 @@ def min_agg(dtype=np.float32) -> DeviceAggregator:
 
 
 @functools.lru_cache(maxsize=None)
-def max_agg(dtype=np.float32) -> DeviceAggregator:
+def max_agg(dtype=np.float32, domain_bits=None) -> DeviceAggregator:
+    """Windowed max. With `domain_bits` set, values are declared to be
+    non-negative ints < 2**domain_bits: the accumulator becomes int32 with
+    identity -1 ("absent") and the pallas superscan runs max on the MXU via
+    two conditional nibble histograms instead of the serial scatter unit."""
+    if domain_bits is not None:
+        if domain_bits > 8:
+            raise ValueError("bounded max supports domain_bits <= 8")
+        return DeviceAggregator(
+            "max8",
+            (AccField("max", np.int32, -1, "max", domain_bits=domain_bits),),
+            lambda f: f["max"],
+            result_dtype=np.int32,
+        )
     ident = _min_of(dtype)
     return DeviceAggregator(
         "max", (AccField("max", dtype, ident, "max"),), lambda f: f["max"], result_dtype=dtype

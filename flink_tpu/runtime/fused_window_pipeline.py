@@ -281,8 +281,9 @@ class FusedWindowPipeline:
                     if not ok:
                         raise ValueError(
                             "pallas superscan does not support this "
-                            "aggregate/geometry (need add-combining fields, "
-                            "K%128==0, VMEM-sized state)"
+                            "aggregate/geometry (need add-combining or "
+                            "bounded-domain max fields, K%128==0, "
+                            "VMEM-sized state)"
                         )
                     self._pallas = True
                 else:

@@ -11,7 +11,7 @@ import pytest
 
 from flink_tpu.api.windowing.assigners import SlidingEventTimeWindows
 from flink_tpu.ops import pallas_superscan as ps
-from flink_tpu.ops.aggregators import count_agg, sum_agg
+from flink_tpu.ops.aggregators import count_agg, max_agg, sum_agg
 from flink_tpu.runtime.fused_window_pipeline import FusedWindowPipeline
 
 K, S, NSB, F, SPW, R = 256, 8, 2, 2, 3, 8
@@ -19,11 +19,14 @@ T, B, CH = 4, 2048, 1024
 KB = K // 128
 
 
-def _numpy_model(idx, vals, smin, fpos, fvalid, frow, purge, with_sum):
+def _numpy_model(idx, vals, smin, fpos, fvalid, frow, purge, mode):
+    """mode: 'count' | 'sum' | 'max8' — field semantics of the kernel."""
     cnt = np.zeros((S, KB, 128), np.int64)
     sm = np.zeros((S, KB, 128), np.float64)
+    mx = np.full((S, KB, 128), -1, np.int64)
     out_c = np.zeros((R, KB, 128), np.int64)
     out_s = np.zeros((R, KB, 128), np.float64)
+    out_m = np.zeros((R, KB, 128), np.int64)
     for t in range(T):
         for b in range(B):
             ii = idx[t * B + b]
@@ -32,26 +35,33 @@ def _numpy_model(idx, vals, smin, fpos, fvalid, frow, purge, with_sum):
             kid, sr = ii // NSB, ii % NSB
             col = (smin[t] + sr) % S
             cnt[col, kid // 128, kid % 128] += 1
-            if with_sum:
+            if mode == "sum":
                 sm[col, kid // 128, kid % 128] += vals[t * B + b]
+            elif mode == "max8":
+                cell = (col, kid // 128, kid % 128)
+                mx[cell] = max(mx[cell], int(vals[t * B + b]))
         for f in range(F):
             if fvalid[t, f]:
                 acc_c = np.zeros((KB, 128), np.int64)
                 acc_s = np.zeros((KB, 128), np.float64)
+                acc_m = np.full((KB, 128), -1, np.int64)
                 for w in range(SPW):
                     acc_c += cnt[(fpos[t, f] + w) % S]
                     acc_s += sm[(fpos[t, f] + w) % S]
+                    acc_m = np.maximum(acc_m, mx[(fpos[t, f] + w) % S])
                 out_c[frow[t, f]] = acc_c
                 out_s[frow[t, f]] = acc_s
+                out_m[frow[t, f]] = acc_m
         for s in range(S):
             if purge[t, s] == 0:
                 cnt[s] = 0
                 sm[s] = 0
-    return cnt, sm, out_c, out_s
+                mx[s] = -1
+    return cnt, {"sum": sm, "max8": mx}, out_c, {"sum": out_s, "max8": out_m}
 
 
-@pytest.mark.parametrize("with_sum", [False, True])
-def test_kernel_parity_vs_numpy(with_sum):
+@pytest.mark.parametrize("mode", ["count", "sum", "max8"])
+def test_kernel_parity_vs_numpy(mode):
     import jax.numpy as jnp
 
     rng = np.random.default_rng(3)
@@ -63,19 +73,22 @@ def test_kernel_parity_vs_numpy(with_sum):
     frow = (np.arange(T * F, dtype=np.int32).reshape(T, F)) % R
     purge = (rng.random((T, S)) > 0.2).astype(np.int32)
 
-    agg = sum_agg() if with_sum else count_agg()
+    agg = {"count": count_agg, "sum": sum_agg,
+           "max8": lambda: max_agg(domain_bits=8)}[mode]()
     run = ps.build_superscan(
         agg, K, S, NSB, F, SPW, R, T, B, CH, True, True  # interpret=True
     )
-    nf = 1 if with_sum else 0
-    states = (jnp.zeros((S * KB, 128), jnp.float32),) if with_sum else ()
+    with_field = mode != "count"
+    field_dt = jnp.float32 if mode == "sum" else jnp.int32
+    ident = 0 if mode == "sum" else -1
+    states = (jnp.full((S * KB, 128), ident, field_dt),) if with_field else ()
     count_state, field_states, count_out, field_outs = run(
         smin, fpos, fvalid, frow, purge,
         jnp.zeros((S * KB, 128), jnp.int32), states,
-        jnp.asarray(idx), jnp.asarray(vals) if with_sum else None,
+        jnp.asarray(idx), jnp.asarray(vals) if with_field else None,
     )
     cnt, sm, out_c, out_s = _numpy_model(
-        idx, vals, smin, fpos, fvalid, frow, purge, with_sum
+        idx, vals, smin, fpos, fvalid, frow, purge, mode
     )
     assert np.array_equal(
         np.asarray(count_state).reshape(S, KB, 128).astype(np.int64), cnt
@@ -83,12 +96,14 @@ def test_kernel_parity_vs_numpy(with_sum):
     assert np.array_equal(
         np.asarray(count_out).reshape(R, KB, 128).astype(np.int64), out_c
     )
-    if with_sum:
+    if with_field:
         np.testing.assert_allclose(
-            np.asarray(field_states[0]).reshape(S, KB, 128), sm, rtol=1e-6
+            np.asarray(field_states[0]).reshape(S, KB, 128).astype(np.float64),
+            sm[mode], rtol=1e-6,
         )
         np.testing.assert_allclose(
-            np.asarray(field_outs[0]).reshape(R, KB, 128), out_s, rtol=1e-6
+            np.asarray(field_outs[0]).reshape(R, KB, 128).astype(np.float64),
+            out_s[mode], rtol=1e-6,
         )
 
 
@@ -108,14 +123,15 @@ def _ysb_stream(steps, batch, num_keys, seed=11):
     return batches, wms
 
 
-@pytest.mark.parametrize("aggregate", ["count", "sum"])
+@pytest.mark.parametrize("aggregate", ["count", "sum", "max8"])
 def test_pipeline_pallas_matches_xla(aggregate):
     steps, batch, num_keys = 6, 700, 128
     batches, wms = _ysb_stream(steps, batch, num_keys)
+    agg = max_agg(domain_bits=8) if aggregate == "max8" else aggregate
 
     def mk(backend):
         return FusedWindowPipeline(
-            SlidingEventTimeWindows.of(2000, 500), aggregate,
+            SlidingEventTimeWindows.of(2000, 500), agg,
             key_capacity=num_keys, num_slices=16, nsb=4, fires_per_step=4,
             out_rows=16, chunk=1024, backend=backend,
             pallas_interpret=(backend == "pallas"),
