@@ -108,7 +108,10 @@ class ExchangeServer:
                     return grant
 
                 while True:
-                    msg = _recv_msg(sock)
+                    try:
+                        msg = _recv_msg(sock)
+                    except OSError:
+                        return   # abrupt peer disconnect (task cancel/kill)
                     if msg is None:
                         return
                     kind, channel = msg[0], msg[1]
@@ -190,6 +193,12 @@ class OutputChannel:
                 with self._cv:
                     self._credits = -1  # poisoned: connection gone
                     self._cv.notify_all()
+                # the peer closed (or close() shut down our write side and
+                # the peer answered with FIN): now fully close the socket
+                try:
+                    self._sock.close()
+                except OSError:
+                    pass
                 return
             if msg[0] == "credit" and msg[1] == self.channel_id:
                 with self._cv:
@@ -219,10 +228,18 @@ class OutputChannel:
             _send_msg(self._sock, ("eos", self.channel_id))
 
     def close(self) -> None:
+        # graceful FIN, not a hard close: an immediate close() with unread
+        # credit messages in the receive buffer sends RST, which can discard
+        # the just-sent eos before the receiver processes it (observed as a
+        # downstream stage waiting forever). Shut down the write side only;
+        # _credit_loop closes the socket once the peer answers with FIN.
         try:
-            self._sock.close()
+            self._sock.shutdown(socket.SHUT_WR)
         except OSError:
-            pass
+            try:
+                self._sock.close()
+            except OSError:
+                pass
 
 
 class BatchDebloater:

@@ -85,14 +85,149 @@ def _build_precheck(g: int):
     """Scalar 'any session closable at wm?' test — a fragment whose
     max_ts + g - 1 <= wm must exist for any emission to be possible, so the
     expensive span pull + merge scan is skipped (one bool crosses the link)
-    while every resident session is provably still open."""
+    while every resident session is provably still open. `valid` masks the
+    bucket padding (positions are padded to pow2 lengths so each bucket
+    size compiles ONCE — an unpadded span length would retrace per call)."""
     import jax
     import jax.numpy as jnp
 
-    def run(cnt, mx, pos, s_rel, wm_rel):
+    def run(cnt, mx, pos, s_rel, wm_rel, valid):
         c = cnt[:, pos]
         m = mx[:, pos] + s_rel[None, :] * g
-        return jnp.any((c > 0) & (m + g - 1 <= wm_rel))
+        return jnp.any((c > 0) & (m + g - 1 <= wm_rel) & valid[None, :])
+
+    return jax.jit(run)
+
+
+@functools.lru_cache(maxsize=None)
+def _build_merge_scan(K: int, S: int, P: int, M: int, g: int, vfields: tuple,
+                      idents: tuple):
+    """The WHOLE watermark path as one device program: gather the resident
+    span, run the gap-merge scan [K]-wide over its P slices, write closed
+    sessions into M fixed emission slots per key, purge their cells, and
+    return the updated ring plus compact emission arrays — ONE dispatch and
+    one D2H instead of (precheck + span pull + host python scan + purge
+    scatter). The scan is a static python loop over P (P <= 64, bucketed
+    pow2), so XLA sees straight-line [K]-wide ops it can fuse.
+
+    Returns (cnt, mn, mx, fields, e_start, e_end, e_cnt, e_fields [K,M],
+    e_n [K], overflow, lo_rel, hi_rel): e_* rel-ms coordinates against the
+    span base; overflow=True means a key closed more than M sessions in one
+    scan — the caller falls back to the exact host path (state unmodified
+    because the returned arrays are simply discarded)."""
+    import jax
+    import jax.numpy as jnp
+
+    combine = {"add": jnp.add, "min": jnp.minimum, "max": jnp.maximum}
+
+    def run(cnt, mn, mx, fields, pos, valid, wm_rel):
+        i32 = jnp.int32
+        idx_p = jnp.arange(P, dtype=i32)
+        vmask = valid[None, :]
+        c = jnp.where(vmask, cnt[:, pos], 0)              # [K, P]
+        fmn = mn[:, pos] + idx_p[None, :] * g
+        fmx = mx[:, pos] + idx_p[None, :] * g
+        fl = [f[:, pos] for f in fields]
+
+        open_ = jnp.zeros((K,), bool)
+        cmin = jnp.zeros((K,), i32)
+        cmax = jnp.full((K,), -(1 << 30), i32)
+        ccnt = jnp.zeros((K,), i32)
+        cstart = jnp.zeros((K,), i32)
+        clast = jnp.zeros((K,), i32)
+        cflds = [jnp.full((K,), ident, f.dtype)
+                 for f, ident in zip(fl, idents)]
+        slots = jnp.zeros((K,), i32)                      # next emit slot
+        e_start = jnp.zeros((K, M), i32)
+        e_end = jnp.zeros((K, M), i32)
+        e_cnt = jnp.zeros((K, M), i32)
+        e_s0 = jnp.zeros((K, M), i32)                     # cell range for purge
+        e_s1 = jnp.full((K, M), -1, i32)
+        e_flds = [jnp.full((K, M), ident, f.dtype)
+                  for f, ident in zip(fl, idents)]
+        overflow = jnp.zeros((), bool)
+        mslots = jnp.arange(M, dtype=i32)[None, :]
+
+        def do_emit(mask, state):
+            (slots, e_start, e_end, e_cnt, e_s0, e_s1, e_flds, overflow) = state
+            can = mask & (slots < M)
+            oh = (mslots == slots[:, None]) & can[:, None]    # [K, M]
+            e_start = jnp.where(oh, cmin[:, None], e_start)
+            e_end = jnp.where(oh, cmax[:, None], e_end)
+            e_cnt = jnp.where(oh, ccnt[:, None], e_cnt)
+            e_s0 = jnp.where(oh, cstart[:, None], e_s0)
+            e_s1 = jnp.where(oh, clast[:, None], e_s1)
+            e_flds = [jnp.where(oh, cf[:, None], ef)
+                      for cf, ef in zip(cflds, e_flds)]
+            overflow = overflow | jnp.any(mask & (slots >= M))
+            slots = slots + can.astype(i32)
+            return (slots, e_start, e_end, e_cnt, e_s0, e_s1, e_flds, overflow)
+
+        est = (slots, e_start, e_end, e_cnt, e_s0, e_s1, e_flds, overflow)
+        for i in range(P):
+            ci = c[:, i]
+            frag = ci > 0
+            mni = fmn[:, i]
+            mxi = fmx[:, i]
+            joins = open_ & frag & (mni - cmax <= g)
+            breaks = open_ & frag & ~joins
+            est = do_emit(breaks, est)
+            starts = frag & ~joins
+            cmin = jnp.where(starts, mni, cmin)
+            ccnt = jnp.where(starts, 0, ccnt)
+            cstart = jnp.where(starts, i, cstart)
+            cflds = [jnp.where(starts, jnp.asarray(ident, cf.dtype), cf)
+                     for cf, ident in zip(cflds, idents)]
+            open_ = open_ | frag
+            cmax = jnp.where(frag, mxi, cmax)
+            ccnt = jnp.where(frag, ccnt + ci, ccnt)
+            clast = jnp.where(frag, i, clast)
+            cflds = [
+                jnp.where(frag, combine[sc](cf, fi[:, i]), cf)
+                for cf, fi, (_n, _dt, sc) in zip(cflds, fl, vfields)
+            ]
+        est = do_emit(open_ & (cmax + g - 1 <= wm_rel), est)
+        (slots, e_start, e_end, e_cnt, e_s0, e_s1, e_flds, overflow) = est
+
+        # purge the emitted sessions' cells, write the span back
+        cover = (idx_p[None, None, :] >= e_s0[:, :, None]) & \
+                (idx_p[None, None, :] <= e_s1[:, :, None]) & \
+                (mslots[:, :, None] < slots[:, None, None])
+        purge = jnp.any(cover, axis=1) & vmask            # [K, P]
+        c_new = jnp.where(purge, 0, c)
+        # write back through DROPPED pad columns: pos carries duplicate
+        # padded indices, and a duplicate scatter-set of the unpurged
+        # original would undo the purge of the highest resident slice
+        pos_w = jnp.where(valid, pos, S)
+        cnt = cnt.at[:, pos_w].set(c_new, mode="drop")
+        mn = mn.at[:, pos_w].set(
+            jnp.where(purge, g, mn[:, pos]), mode="drop")
+        mx = mx.at[:, pos_w].set(
+            jnp.where(purge, -1, mx[:, pos]), mode="drop")
+        fields = tuple(
+            f.at[:, pos_w].set(
+                jnp.where(purge, jnp.asarray(ident, f.dtype), f[:, pos]),
+                mode="drop")
+            for f, ident in zip(fields, idents)
+        )
+        live = jnp.any(c_new > 0, axis=0) & valid          # [P]
+        lo_rel = jnp.min(jnp.where(live, idx_p, P))
+        hi_rel = jnp.max(jnp.where(live, idx_p, -1))
+
+        # ONE packed i32 result so a deferred resolve costs a single D2H:
+        # [K+1, (3+nf)*M + 1] = start|end|cnt|fields(bitcast)… blocks, last
+        # column = per-key emit count, extra row = [lo_rel, hi_rel, overflow]
+        blocks = [e_start, e_end, e_cnt]
+        for ef in e_flds:
+            blocks.append(jax.lax.bitcast_convert_type(
+                ef, jnp.int32) if ef.dtype != jnp.int32 else ef)
+        packed = jnp.concatenate(blocks + [slots[:, None]], axis=1)
+        scal = jnp.zeros((1, packed.shape[1]), jnp.int32)
+        scal = scal.at[0, 0].set(lo_rel)
+        scal = scal.at[0, 1].set(hi_rel)
+        scal = scal.at[0, 2].set(overflow.astype(jnp.int32))
+        packed = jnp.concatenate([packed, scal], axis=0)
+        return cnt, mn, mx, fields, packed
 
     return jax.jit(run)
 
@@ -126,6 +261,7 @@ class TpuSessionWindowOperator:
         key_capacity: int = 1 << 12,
         num_slices: int = 64,
         batch_pad: int = 256,
+        defer_emissions: bool = False,
     ):
         agg = resolve(aggregate)
         if agg is None:
@@ -158,6 +294,15 @@ class TpuSessionWindowOperator:
         self._future: List[Tuple[Any, float, int]] = []
         self.output: List[Tuple[Any, Any, Any, int]] = []
         self.num_late_records_dropped = 0
+        # deferred-emission mode (the DeferredEmissions pattern of the fused
+        # pipeline): watermark merge scans are enqueued WITHOUT a device
+        # sync; the packed emission arrays resolve at drain_output (or when
+        # the ring needs fresh bounds). Ring bookkeeping stays conservative
+        # (stale-low ring_lo only widens the next scan's span over provably
+        # empty slices).
+        self.defer_emissions = defer_emissions
+        self._pending: List[dict] = []
+        self._since_dispatch: Optional[Tuple[int, int]] = None
 
     # ------------------------------------------------------------------
     def _init_state(self) -> None:
@@ -230,6 +375,12 @@ class TpuSessionWindowOperator:
         lo = int(s_abs.min())
         if self.ring_lo is not None:
             lo = min(self.ring_lo, lo)
+        if self._pending and self.max_used is not None \
+                and self.max_used - lo >= self.S:
+            self._resolve_pending()    # stale bounds: learn the truth first
+            lo = int(s_abs.min())
+            if self.ring_lo is not None:
+                lo = min(self.ring_lo, lo)
         if self.max_used is not None and self.max_used - lo >= self.S:
             # a record this far BELOW resident fragments cannot be ingested
             # (existing cells cannot be held back retroactively) — the
@@ -245,6 +396,14 @@ class TpuSessionWindowOperator:
         # ring overflow: far-future records wait on host until purge opens
         # space (same hold-back contract as TpuWindowOperator._future)
         over = s_abs >= lo + self.S
+        if over.any() and self._pending:
+            # stale deferred bounds must not park records sync mode would
+            # ingest (parking past a watermark advance turns them late)
+            self._resolve_pending()
+            lo = int(s_abs.min())
+            if self.ring_lo is not None:
+                lo = min(self.ring_lo, lo)
+            over = s_abs >= lo + self.S
         if over.any():
             for i in np.flatnonzero(over):
                 self._future.append((keys[i], float(vals[i]), int(ts[i])))
@@ -277,6 +436,15 @@ class TpuSessionWindowOperator:
         smin, smax = int(s_abs.min()), int(s_abs.max())
         self.ring_lo = smin if self.ring_lo is None else min(self.ring_lo, smin)
         self.max_used = smax if self.max_used is None else max(self.max_used, smax)
+        self._track_ingest(smin, smax)
+
+    def _track_ingest(self, smin: int, smax: int) -> None:
+        """Record post-dispatch ingest bounds so a deferred merge scan's
+        resolved ring bounds can be merged with what arrived after it."""
+        s = self._since_dispatch
+        self._since_dispatch = (
+            (smin, smax) if s is None else (min(s[0], smin), max(s[1], smax))
+        )
 
     def process_batch_staged(self, kid, spos, rel, vals,
                              smin: int, smax: int) -> None:
@@ -288,6 +456,14 @@ class TpuSessionWindowOperator:
         the zero-host-copy path for device-side sources (the session
         analogue of FusedWindowPipeline.plan_superbatch staging)."""
         lo = smin if self.ring_lo is None else min(self.ring_lo, smin)
+        if self._pending and (
+            (self.max_used is not None and self.max_used - lo >= self.S)
+            or smax - lo >= self.S
+        ):
+            # deferred-mode bookkeeping is conservative (stale-low ring_lo);
+            # resolve to learn the true bounds before declaring overflow
+            self._resolve_pending()
+            lo = smin if self.ring_lo is None else min(self.ring_lo, smin)
         if (self.max_used is not None and self.max_used - lo >= self.S) or (
                 smax - lo >= self.S):
             raise ValueError(
@@ -306,6 +482,7 @@ class TpuSessionWindowOperator:
         )
         self.ring_lo = lo
         self.max_used = smax if self.max_used is None else max(self.max_used, smax)
+        self._track_ingest(smin, smax)
 
     def _key_of(self, kid: int):
         return kid if getattr(self, "_dense", False) else self.keydict.key_at(kid)
@@ -323,37 +500,171 @@ class TpuSessionWindowOperator:
         lo, hi = self.ring_lo, self.max_used
         K = self.K
         span = hi - lo + 1
-        pos_arr = np.asarray([(s % S) for s in range(lo, hi + 1)],
-                             dtype=np.int32)
+        # pad the span to a pow2 bucket: the jitted programs compile once
+        # per bucket size instead of retracing on every distinct span
+        P = 1 << (span - 1).bit_length()
+        pos_pad = np.empty(P, dtype=np.int32)
+        pos_pad[:span] = [(s % S) for s in range(lo, hi + 1)]
+        pos_pad[span:] = pos_pad[span - 1]
+        valid = np.arange(P) < span
         import jax.numpy as jnp
 
-        # cheap closable test before the span pull: while no fragment's
-        # standalone window has expired, nothing can emit (break-closed
-        # sessions wait for the watermark to pass their end — exactly the
-        # oracle's trigger time)
+        pos_d = jnp.asarray(pos_pad)
+
+        if (P + 2) * g >= (1 << 31):
+            # span-relative arithmetic would overflow int32 on device
+            return self._watermark_host_path(watermark, lo, hi, span,
+                                             pos_pad, valid)
+
         wm_rel = watermark - lo * g
-        if wm_rel < (1 << 62) and (span + 2) * g < (1 << 31):
+        wm_c = int(np.clip(wm_rel, -(1 << 31) + 1, (1 << 31) - 1))
+        if not self.defer_emissions:
+            # cheap closable test before the merge dispatch: while no
+            # fragment's standalone window has expired, nothing can emit
+            # (break-closed sessions wait for the watermark to pass their
+            # end — exactly the oracle's trigger time). Skipped in deferred
+            # mode: the dispatch itself is async and costs no sync.
             pre = _build_precheck(g)
-            wm_c = int(np.clip(wm_rel, -(1 << 31) + 1, (1 << 31) - 1))
             closable = pre(
-                self._cnt, self._mx, jnp.asarray(pos_arr),
-                jnp.arange(span, dtype=jnp.int32), jnp.int32(wm_c),
+                self._cnt, self._mx, pos_d,
+                jnp.arange(P, dtype=jnp.int32), jnp.int32(wm_c),
+                jnp.asarray(valid),
             )
             if not bool(closable):
                 self._drain_future()
                 return
 
+        if any(np.dtype(dt) not in (np.dtype(np.int32), np.dtype(np.float32))
+               for _n, dt, _s in self._vfields):
+            # the packed emission encoding bitcasts fields to int32 lanes;
+            # wider dtypes keep the exact host path
+            return self._watermark_host_path(watermark, lo, hi, span,
+                                             pos_pad, valid)
+
+        # fused device path: gather + gap-merge scan + emit + purge in ONE
+        # dispatch; emissions come back as one packed array. A P-slice span
+        # closes at most P sessions per key, so M = P+1 cannot overflow;
+        # wide spans cap M at 8 and keep the exact host path as fallback.
+        can_overflow = P > 8
+        M = 8 if can_overflow else P + 1
+        run = _build_merge_scan(K, S, P, M, g, self._vfields, self._idents)
+        old_state = (self._cnt, self._mn, self._mx, self._fields) \
+            if can_overflow else None
+        cnt2, mn2, mx2, flds2, packed = run(
+            self._cnt, self._mn, self._mx, self._fields, pos_d,
+            jnp.asarray(valid), jnp.int32(wm_c),
+        )
+        self._cnt, self._mn, self._mx, self._fields = cnt2, mn2, mx2, flds2
+        entry = {
+            "packed": packed, "lo": lo, "hi": hi, "M": M,
+            "watermark": watermark, "old_state": old_state,
+        }
+        self._since_dispatch = None
+        if self.defer_emissions and not can_overflow:
+            if len(self._pending) >= 32:
+                # bound the in-flight packed buffers (one sync per 32 scans)
+                self._resolve_pending()
+            self._pending.append(entry)
+        else:
+            self._resolve_pending()          # keep emission order
+            self._resolve_entry(entry, last=True)
+        self._drain_future()
+
+    def _resolve_pending(self) -> None:
+        pending, self._pending = self._pending, []
+        for i, entry in enumerate(pending):
+            self._resolve_entry(entry, last=(i == len(pending) - 1))
+        if pending:
+            # bounds are fresh now: records parked while they were stale can
+            # re-enter (or be counted late), matching the sync path's order
+            self._drain_future()
+
+    def _resolve_entry(self, entry: dict, last: bool) -> None:
+        """Pull one merge scan's packed emissions, append outputs, and (for
+        the latest entry) refresh the ring bounds — merged with any ingest
+        that happened after the scan was dispatched."""
+        g = self.g
+        M, lo = entry["M"], entry["lo"]
+        arr = np.asarray(entry["packed"])
+        lo_rel, hi_rel, ovf = int(arr[-1, 0]), int(arr[-1, 1]), int(arr[-1, 2])
+        if ovf:
+            # a key closed > M sessions in one scan (wide-span sync path
+            # only): discard the fused results and redo exactly on host
+            (self._cnt, self._mn, self._mx, self._fields) = entry["old_state"]
+            hi = entry["hi"]
+            span = hi - lo + 1
+            P = 1 << (span - 1).bit_length()
+            pos_pad = np.empty(P, dtype=np.int32)
+            pos_pad[:span] = [(s % self.S) for s in range(lo, hi + 1)]
+            pos_pad[span:] = pos_pad[span - 1]
+            self._watermark_host_path(entry["watermark"], lo, hi, span,
+                                      pos_pad, np.arange(P) < span)
+            return
+        body = arr[:-1]
+        e_n = body[:, -1]
+        total = int(e_n.sum())
+        if total:
+            es = body[:, 0:M]
+            ee = body[:, M:2 * M]
+            ec = body[:, 2 * M:3 * M]
+            kk, mm_ = np.nonzero(np.arange(M)[None, :] < e_n[:, None])
+            start_ts = lo * g + es[kk, mm_].astype(np.int64)
+            end_ts = lo * g + ee[kk, mm_].astype(np.int64)
+            cnts = ec[kk, mm_]
+            fdict = {}
+            for j, (name, dt, _s) in enumerate(self._vfields):
+                block = np.ascontiguousarray(body[:, (3 + j) * M:(4 + j) * M])
+                if np.dtype(dt) == np.float32:
+                    block = block.view(np.float32)   # undo device bitcast
+                elif np.dtype(dt) != np.int32:
+                    block = block.astype(dt)
+                fdict[name] = block[kk, mm_]
+            for f in self.agg.fields:
+                if f.source != VALUE:   # ONE-source fields carry the count
+                    fdict[f.name] = cnts
+            results = np.asarray(self.agg.extract(fdict))
+            # fire order: merged-window end then key id (oracle's timers)
+            order = np.lexsort((kk, end_ts))
+            for i in order:
+                window = TimeWindow(int(start_ts[i]), int(end_ts[i]) + g)
+                self.output.append(
+                    (self._key_of(int(kk[i])), window,
+                     results[i].item(), window.max_timestamp())
+                )
+        if not last:
+            return
+        resolved = (lo + lo_rel, lo + hi_rel) if hi_rel >= 0 else None
+        since = self._since_dispatch
+        if resolved is None:
+            merged = since
+        elif since is None:
+            merged = resolved
+        else:
+            merged = (min(resolved[0], since[0]), max(resolved[1], since[1]))
+        self.ring_lo, self.max_used = merged if merged else (None, None)
+
+    def _watermark_host_path(self, watermark: int, lo: int, hi: int,
+                             span: int, pos_pad: np.ndarray,
+                             valid: np.ndarray) -> None:
+        """Exact host-side merge scan (the fused path's fallback for >M
+        emissions per key per scan and for gap/span sizes beyond int32)."""
+        g, S, K = self.g, self.S, self.K
+        import jax.numpy as jnp
+
+        pos_d = jnp.asarray(pos_pad)
         # pull only the resident span's columns (one gather + two transfers
-        # instead of the full [K, S] state)
+        # instead of the full [K, S] state); padding columns are sliced off
+        # host-side
         take = _build_take(len(self._vfields))
 
         ints_d, flds_d = take(self._cnt, self._mn, self._mx, self._fields,
-                              jnp.asarray(pos_arr))
+                              pos_d)
         ints = np.asarray(ints_d)
-        cnt = ints[0]
-        mn = ints[1].astype(np.int64)
-        mx = ints[2].astype(np.int64)
-        fields = [np.asarray(f) for f in flds_d]
+        cnt = ints[0][:, :span]
+        mn = ints[1][:, :span].astype(np.int64)
+        mx = ints[2][:, :span].astype(np.int64)
+        fields = [np.asarray(f)[:, :span] for f in flds_d]
+        pos_arr = pos_pad[:span]
 
         # vectorized gap-merge scan over the resident slice span
         cur_open = np.zeros(K, dtype=bool)
@@ -471,12 +782,16 @@ class TpuSessionWindowOperator:
         raise NotImplementedError("event-time only")
 
     def drain_output(self) -> List[Tuple[Any, Any, Any, int]]:
+        if self._pending:
+            self._resolve_pending()
         out = self.output
         self.output = []
         return out
 
     # ------------------------------------------------------------------
     def snapshot(self) -> dict:
+        if self._pending:
+            self._resolve_pending()
         return {
             "cnt": np.asarray(self._cnt),
             "mn": np.asarray(self._mn),
@@ -506,3 +821,8 @@ class TpuSessionWindowOperator:
         self._future = list(snap["future"])
         self.num_late_records_dropped = snap["num_late_dropped"]
         self._dense = snap.get("dense", False)
+        # in-flight deferred scans belong to the pre-restore timeline:
+        # resolving them against restored state would replay emissions and
+        # corrupt the restored ring bounds
+        self._pending = []
+        self._since_dispatch = None

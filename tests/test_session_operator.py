@@ -29,18 +29,21 @@ def _run_oracle(agg, gap, batches, wms):
     return out
 
 
-def _run_device(agg, gap, batches, wms, *, snapshot_at=None, num_slices=64):
+def _run_device(agg, gap, batches, wms, *, snapshot_at=None, num_slices=64,
+                defer=False, drain_each=True):
     op = TpuSessionWindowOperator(
         EventTimeSessionWindows.with_gap(gap), agg,
-        key_capacity=64, num_slices=num_slices,
+        key_capacity=64, num_slices=num_slices, defer_emissions=defer,
     )
     out = []
     for i, ((keys, vals, ts), wm) in enumerate(zip(batches, wms)):
         if snapshot_at is not None and i == snapshot_at:
             snap = op.snapshot()
+            out.extend(op.drain_output())   # emissions before the cut
             op = TpuSessionWindowOperator(
                 EventTimeSessionWindows.with_gap(gap), agg,
                 key_capacity=64, num_slices=num_slices,
+                defer_emissions=defer,
             )
             op.restore(snap)
         op.process_batch(
@@ -48,7 +51,8 @@ def _run_device(agg, gap, batches, wms, *, snapshot_at=None, num_slices=64):
             np.asarray(ts, dtype=np.int64),
         )
         op.process_watermark(wm)
-        out.extend(op.drain_output())
+        if drain_each:
+            out.extend(op.drain_output())
     op.process_watermark(1 << 60)
     out.extend(op.drain_output())
     return out
@@ -90,6 +94,101 @@ def test_session_parity_randomized(seed, aggname, agg):
     got = _norm(_run_device(agg, gap, batches, wms))
     assert len(ref) > 0
     assert got == ref
+
+
+@pytest.mark.parametrize("seed", [1, 2, 3])
+@pytest.mark.parametrize("aggname,agg", [
+    ("count", count_agg()), ("sum", sum_agg()),
+])
+def test_session_parity_deferred_emissions(seed, aggname, agg):
+    """defer_emissions=True: merge scans enqueue without syncs and resolve
+    at drain; the emitted session set matches sync mode and the oracle even
+    when draining only at end-of-stream."""
+    gap = 1000
+    batches, wms = _mk_stream(seed, gap=gap)
+    ref = _norm(_run_oracle(agg, gap, batches, wms))
+    got = _norm(_run_device(agg, gap, batches, wms, defer=True,
+                            drain_each=False))
+    assert len(ref) > 0
+    assert got == ref
+
+
+def test_session_deferred_snapshot_resolves_pending():
+    """A checkpoint taken while scans are in flight must capture the
+    post-scan state exactly (snapshot() resolves pending first)."""
+    agg = sum_agg()
+    gap = 1000
+    batches, wms = _mk_stream(7, gap=gap)
+    ref = _norm(_run_device(agg, gap, batches, wms))
+    got = _norm(_run_device(agg, gap, batches, wms, defer=True,
+                            drain_each=False, snapshot_at=6))
+    assert got == ref
+
+
+def test_session_nonpow2_span_purges_highest_slice():
+    """Regression: a span of 3 pads to P=4 with a DUPLICATE position for the
+    highest resident slice; the write-back must not let the pad's unpurged
+    copy undo the purge (which re-emitted the session on the next scan)."""
+    gap = 1000
+    op = TpuSessionWindowOperator(
+        EventTimeSessionWindows.with_gap(gap), count_agg(),
+        key_capacity=64, num_slices=16,
+    )
+    # one key, fragments in slices 0 and 2 -> span 3, two distinct sessions
+    op.process_batch(np.asarray([5, 5]), np.asarray([1.0, 1.0]),
+                     np.asarray([100, 2500], dtype=np.int64))
+    op.process_watermark(10_000)     # closes both sessions
+    first = op.drain_output()
+    assert len(first) == 2
+    # heartbeat watermark with nothing resident: no duplicates may appear
+    op.process_watermark(20_000)
+    assert op.drain_output() == []
+    assert op.ring_lo is None        # ring really emptied
+
+
+def test_session_deferred_future_records_not_lost():
+    """Regression: a record that only LOOKS like ring overflow because
+    deferred bounds are stale must not park (parking past a watermark
+    advance would late-drop it — a divergence from sync mode, which would
+    have ingested it against the true, purged ring)."""
+    gap = 1000
+    op = TpuSessionWindowOperator(
+        EventTimeSessionWindows.with_gap(gap), count_agg(),
+        key_capacity=64, num_slices=4, defer_emissions=True,
+    )
+    op.process_batch(np.asarray([1]), np.asarray([1.0]),
+                     np.asarray([500], dtype=np.int64))
+    op.process_watermark(3_000)      # closes the session (deferred)
+    # with stale bounds (ring_lo still 0, S=4) slice 5 would overflow; the
+    # operator must resolve the pending scan and ingest instead of parking
+    op.process_batch(np.asarray([1]), np.asarray([1.0]),
+                     np.asarray([5_500], dtype=np.int64))
+    assert op._future == []
+    op.process_watermark(9_000)      # closes the second session too
+    out = op.drain_output()
+    assert sorted((k, w.start) for (k, w, _r, _t) in out) == \
+        [(1, 500), (1, 5_500)]
+    assert op.num_late_records_dropped == 0
+
+
+def test_session_restore_discards_inflight_deferred_scans():
+    """Regression: restore() must drop pre-restore pending scans, or the
+    next drain replays their emissions against the restored state."""
+    gap = 1000
+    op = TpuSessionWindowOperator(
+        EventTimeSessionWindows.with_gap(gap), count_agg(),
+        key_capacity=64, num_slices=16, defer_emissions=True,
+    )
+    op.process_batch(np.asarray([1]), np.asarray([1.0]),
+                     np.asarray([100], dtype=np.int64))
+    snap = op.snapshot()             # resolves nothing pending yet
+    op.process_watermark(5_000)      # deferred scan queued
+    assert op._pending
+    op.restore(snap)
+    assert not op._pending
+    op.process_watermark(5_000)
+    out = op.drain_output()
+    assert [(k, w.start) for (k, w, _r, _t) in out] == [(1, 100)]
 
 
 def test_session_merge_across_batches_and_gap_boundary():
