@@ -90,6 +90,11 @@ class DistributedJobSpec(_PickledSpec):
     allowed_lateness: int = 0
     max_parallelism: int = 128
     operator: str = "oracle"          # 'oracle' | 'device'
+    # declared source volume (records) for AUTO parallelism: submitting
+    # with parallelism=0 derives the task count from this, the
+    # AdaptiveBatchScheduler analogue (scheduler/adaptivebatch/ derives
+    # per-stage parallelism from produced bytes)
+    source_records_hint: Optional[int] = None
 
 
 @dataclass
@@ -179,9 +184,11 @@ class JobManagerEndpoint(RpcEndpoint):
         heartbeat_interval: float = 0.5,
         heartbeat_timeout: float = 3.0,
         adaptive: bool = True,
+        auto_records_per_task: int = 1 << 20,
     ):
         super().__init__(name="jobmanager")
         self.rpc = rpc
+        self.auto_records_per_task = auto_records_per_task
         self.blob = BlobServerEndpoint()
         rpc.register(self)
         rpc.register(self.blob)
@@ -268,6 +275,21 @@ class JobManagerEndpoint(RpcEndpoint):
                     "uses DistributedJobSpec"
                 )
             parallelism = stages
+        if parallelism == 0 and not isinstance(spec, GraphJobSpec):
+            # AUTO parallelism (AdaptiveBatchScheduler analogue,
+            # scheduler/adaptivebatch/): derive the task count from the
+            # declared source volume — one task per auto_records_per_task
+            # records — clamped to max_parallelism; with no volume hint,
+            # size to the currently free slots (elastic default)
+            hint = getattr(spec, "source_records_hint", None)
+            if hint:
+                parallelism = -(-int(hint) // self.auto_records_per_task)
+            else:
+                parallelism = max(len(self._free_slots()), 1)
+            parallelism = max(1, min(parallelism, spec.max_parallelism))
+        elif parallelism <= 0:
+            raise ValueError("parallelism must be positive (0 = AUTO is "
+                             "only defined for DistributedJobSpec)")
         job_id = uuid.uuid4().hex[:16]
         self._jobs[job_id] = _JobState(
             job_id, blob_key, parallelism, spec.name,

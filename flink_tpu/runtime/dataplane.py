@@ -232,8 +232,11 @@ class OutputChannel:
         # credit messages in the receive buffer sends RST, which can discard
         # the just-sent eos before the receiver processes it (observed as a
         # downstream stage waiting forever). Shut down the write side only;
-        # _credit_loop closes the socket once the peer answers with FIN.
+        # _credit_loop closes the socket once the peer answers with FIN —
+        # or when the bounded linger below times its blocked recv out (a
+        # hung/partitioned peer must not leak the fd and thread forever).
         try:
+            self._sock.settimeout(30.0)
             self._sock.shutdown(socket.SHUT_WR)
         except OSError:
             try:

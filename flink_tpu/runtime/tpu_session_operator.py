@@ -500,19 +500,15 @@ class TpuSessionWindowOperator:
         lo, hi = self.ring_lo, self.max_used
         K = self.K
         span = hi - lo + 1
-        # pad the span to a pow2 bucket: the jitted programs compile once
-        # per bucket size instead of retracing on every distinct span
-        P = 1 << (span - 1).bit_length()
-        pos_pad = np.empty(P, dtype=np.int32)
-        pos_pad[:span] = [(s % S) for s in range(lo, hi + 1)]
-        pos_pad[span:] = pos_pad[span - 1]
-        valid = np.arange(P) < span
+        P, pos_pad, valid = self._pad_span(lo, hi)
         import jax.numpy as jnp
 
         pos_d = jnp.asarray(pos_pad)
 
         if (P + 2) * g >= (1 << 31):
-            # span-relative arithmetic would overflow int32 on device
+            # span-relative arithmetic would overflow int32 on device; the
+            # host path needs resolved bounds and ordered output first
+            self._resolve_pending()
             return self._watermark_host_path(watermark, lo, hi, span,
                                              pos_pad, valid)
 
@@ -538,6 +534,7 @@ class TpuSessionWindowOperator:
                for _n, dt, _s in self._vfields):
             # the packed emission encoding bitcasts fields to int32 lanes;
             # wider dtypes keep the exact host path
+            self._resolve_pending()
             return self._watermark_host_path(watermark, lo, hi, span,
                                              pos_pad, valid)
 
@@ -565,10 +562,26 @@ class TpuSessionWindowOperator:
                 # bound the in-flight packed buffers (one sync per 32 scans)
                 self._resolve_pending()
             self._pending.append(entry)
+            if self._future:
+                # parked records need the TRUE post-scan bounds now, or the
+                # stale-bounds drain below re-parks them past further
+                # watermark advances (which would late-drop them — a
+                # divergence from sync mode)
+                self._resolve_pending()
         else:
             self._resolve_pending()          # keep emission order
             self._resolve_entry(entry, last=True)
         self._drain_future()
+
+    def _pad_span(self, lo: int, hi: int):
+        """Span positions padded to a pow2 bucket so the jitted programs
+        compile once per bucket size instead of retracing per span length."""
+        span = hi - lo + 1
+        P = 1 << (span - 1).bit_length()
+        pos_pad = np.empty(P, dtype=np.int32)
+        pos_pad[:span] = [(s % self.S) for s in range(lo, hi + 1)]
+        pos_pad[span:] = pos_pad[span - 1]
+        return P, pos_pad, np.arange(P) < span
 
     def _resolve_pending(self) -> None:
         pending, self._pending = self._pending, []
@@ -592,13 +605,9 @@ class TpuSessionWindowOperator:
             # only): discard the fused results and redo exactly on host
             (self._cnt, self._mn, self._mx, self._fields) = entry["old_state"]
             hi = entry["hi"]
-            span = hi - lo + 1
-            P = 1 << (span - 1).bit_length()
-            pos_pad = np.empty(P, dtype=np.int32)
-            pos_pad[:span] = [(s % self.S) for s in range(lo, hi + 1)]
-            pos_pad[span:] = pos_pad[span - 1]
-            self._watermark_host_path(entry["watermark"], lo, hi, span,
-                                      pos_pad, np.arange(P) < span)
+            _P, pos_pad, valid = self._pad_span(lo, hi)
+            self._watermark_host_path(entry["watermark"], lo, hi,
+                                      hi - lo + 1, pos_pad, valid)
             return
         body = arr[:-1]
         e_n = body[:, -1]

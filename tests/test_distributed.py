@@ -216,6 +216,57 @@ def _collect(result):
     return {(k, w[0]): r for k, w, r, _ in result}
 
 
+def test_auto_parallelism_from_source_volume(tmp_path):
+    """AdaptiveBatchScheduler analogue: parallelism=0 derives the task
+    count from the declared source volume (one task per
+    auto_records_per_task records), clamped to max_parallelism; without a
+    hint it sizes to the free slots."""
+    svc_jm = RpcService()
+    jm = JobManagerEndpoint(svc_jm, auto_records_per_task=1000,
+                            heartbeat_interval=0.2, heartbeat_timeout=10.0)
+    svcs, tms = [], []
+    for _ in range(3):
+        svc = RpcService()
+        te = TaskExecutorEndpoint(svc, slots=1)
+        te.connect(svc_jm.address)
+        svcs.append(svc)
+        tms.append(te)
+    client = svc_jm.gateway(svc_jm.address, "jobmanager")
+
+    # 2500 records / 1000 per task -> 3 tasks
+    spec = _make_spec()
+    spec.source_records_hint = 2500
+    job_id = client.submit_job(spec.to_bytes(), 0)
+    assert client.job_status(job_id)["parallelism"] == 3
+
+    deadline = time.time() + 30
+    while time.time() < deadline:
+        st = client.job_status(job_id)
+        if st["status"] in ("FINISHED", "FAILED"):
+            break
+        time.sleep(0.1)
+    assert st["status"] == "FINISHED", st
+    assert _collect(client.job_result(job_id)) == _expected(spec, 3)
+
+    # no hint: size to free slots (all 3 again free after the job finished)
+    spec2 = _make_spec()
+    job2 = client.submit_job(spec2.to_bytes(), 0)
+    assert client.job_status(job2)["parallelism"] == 3
+
+    # hint clamps at max_parallelism
+    spec3 = _make_spec()
+    spec3.source_records_hint = 10_000_000
+    job3 = client.submit_job(spec3.to_bytes(), 0)
+    assert client.job_status(job3)["parallelism"] == spec3.max_parallelism
+
+    for te in tms:
+        te.stop()
+    jm.heartbeats.stop()
+    svc_jm.stop()
+    for svc in svcs:
+        svc.stop()
+
+
 def test_cluster_end_to_end_two_tms():
     svc_jm, svc_tm1, svc_tm2 = RpcService(), RpcService(), RpcService()
     jm = JobManagerEndpoint(svc_jm)
