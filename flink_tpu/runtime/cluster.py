@@ -282,7 +282,7 @@ class JobManagerEndpoint(RpcEndpoint):
             # records — clamped to max_parallelism; with no volume hint,
             # size to the currently free slots (elastic default)
             hint = getattr(spec, "source_records_hint", None)
-            if hint:
+            if hint is not None:
                 parallelism = -(-int(hint) // self.auto_records_per_task)
             else:
                 parallelism = max(len(self._free_slots()), 1)

@@ -232,17 +232,29 @@ class OutputChannel:
         # credit messages in the receive buffer sends RST, which can discard
         # the just-sent eos before the receiver processes it (observed as a
         # downstream stage waiting forever). Shut down the write side only;
-        # _credit_loop closes the socket once the peer answers with FIN —
-        # or when the bounded linger below times its blocked recv out (a
-        # hung/partitioned peer must not leak the fd and thread forever).
+        # _credit_loop closes the socket once the peer answers with FIN.
+        # A hung/partitioned peer never sends that FIN, so a timer forces
+        # shutdown(SHUT_RDWR) after a bounded linger — unlike settimeout or
+        # close(), shutdown DOES wake a recv already blocked in the credit
+        # thread, so the fd and thread cannot leak.
         try:
-            self._sock.settimeout(30.0)
             self._sock.shutdown(socket.SHUT_WR)
         except OSError:
             try:
                 self._sock.close()
             except OSError:
                 pass
+            return
+
+        def _force(sock=self._sock):
+            try:
+                sock.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass   # already closed by the credit loop — the normal case
+
+        t = threading.Timer(30.0, _force)
+        t.daemon = True
+        t.start()
 
 
 class BatchDebloater:

@@ -61,6 +61,9 @@ class Table:
         self._projection: Optional[List[str]] = None
         self._window: Optional[_GroupWindow] = None
         self._keys: List[str] = []
+        self._having: Optional[Tuple[Callable[[dict], bool], str]] = None
+        self._order: List[Tuple[str, bool]] = []
+        self._limit: Optional[int] = None
 
     def _copy(self) -> "Table":
         t = Table(self._tenv, self._name)
@@ -68,6 +71,9 @@ class Table:
         t._projection = list(self._projection) if self._projection else None
         t._window = self._window
         t._keys = list(self._keys)
+        t._having = self._having
+        t._order = list(self._order)
+        t._limit = self._limit
         return t
 
     # -- relational ops ---------------------------------------------------
@@ -92,6 +98,25 @@ class Table:
     def group_by(self, *keys: str) -> "Table":
         t = self._copy()
         t._keys = list(keys)
+        return t
+
+    def having(self, pred: Callable[[dict], bool],
+               label: str = "<callable>") -> "Table":
+        """Post-aggregation filter over OUTPUT rows (SQL HAVING)."""
+        t = self._copy()
+        t._having = (pred, label)
+        return t
+
+    def order_by(self, *cols: str) -> "Table":
+        """Per-window ordering of the aggregate output (streaming top-N
+        when combined with limit); prefix a column with '-' for DESC."""
+        t = self._copy()
+        t._order = [(c.lstrip("-"), c.startswith("-")) for c in cols]
+        return t
+
+    def limit(self, n: int) -> "Table":
+        t = self._copy()
+        t._limit = n
         return t
 
     # -- terminals --------------------------------------------------------
@@ -134,8 +159,11 @@ class Table:
                                     alias=out_name))
         from flink_tpu.table.sql import Query
 
+        having = self._having[0] if self._having else None
         q = Query(items, self._name, None, None, list(self._keys),
-                  self._window.spec)
+                  self._window.spec, having=having,
+                  having_text=self._having[1] if self._having else None,
+                  order_by=list(self._order), limit=self._limit)
         stream = self._base_stream()
         return TableResult(self._tenv,
                            self._tenv._grouped_window_query(q, stream))
@@ -146,6 +174,10 @@ class Table:
             raise ValueError(
                 "window()/group_by() require the aggregate(...) terminal; "
                 "to_stream()/to_list() are projection terminals")
+        if self._having is not None or self._order or self._limit is not None:
+            raise ValueError(
+                "having()/order_by()/limit() apply to windowed aggregates; "
+                "use them with the aggregate(...) terminal")
         stream = self._base_stream()
         if self._projection:
             cols = list(self._projection)

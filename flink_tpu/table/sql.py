@@ -7,6 +7,9 @@ group windows. Supported grammar (case-insensitive keywords):
   FROM <table>
   [WHERE <expr>]
   [GROUP BY <col> [, <col>]* [, <window>]]
+  [HAVING <expr>]                      -- over output rows (aliases visible)
+  [ORDER BY <col> [ASC|DESC] [, ...]] -- per window (streaming top-N)
+  [LIMIT <n>]
 
   <item>   := <col> | <agg>( <col> | * ) [AS <alias>]
             | WINDOW_START [AS alias] | WINDOW_END [AS alias]
@@ -102,6 +105,11 @@ class Query:
     group_by: List[str]
     window: Optional[WindowSpec]
     join: Optional[JoinSpec] = None
+    having: Optional[Callable[[dict], bool]] = None   # over OUTPUT rows
+    having_text: Optional[str] = None
+    order_by: List[Tuple[str, bool]] = dataclasses.field(
+        default_factory=list)                          # (col, descending)
+    limit: Optional[int] = None
 
 
 class _Parser:
@@ -185,6 +193,30 @@ class _Parser:
                     self.next()
                     continue
                 break
+        having = having_text = None
+        if self.peek_upper() == "HAVING":
+            if not group_by and window is None:
+                raise ValueError("HAVING requires GROUP BY")
+            self.next()
+            having, having_text = self.where_expr()
+        order_by: List[Tuple[str, bool]] = []
+        if self.peek_upper() == "ORDER":
+            self.next()
+            self.expect("BY")
+            while True:
+                col = self.next()
+                desc = False
+                if self.peek_upper() in ("ASC", "DESC"):
+                    desc = self.next().upper() == "DESC"
+                order_by.append((col, desc))
+                if self.peek() == ",":
+                    self.next()
+                    continue
+                break
+        limit = None
+        if self.peek_upper() == "LIMIT":
+            self.next()
+            limit = int(self.next())
         if join is None and alias1 != table:
             raise ValueError(
                 "table aliases are only meaningful on join queries; "
@@ -197,12 +229,18 @@ class _Parser:
             jwindow = self.window_spec(time_col_optional=True)
             if self.peek() is not None:
                 raise ValueError(f"trailing tokens: {self.tokens[self.i:]}")
+            if having is not None or order_by or limit is not None:
+                raise ValueError(
+                    "HAVING/ORDER BY/LIMIT are not supported on join queries"
+                )
             return Query(select, table, where, where_text, group_by, None,
                          JoinSpec(join[0], join[1], join[2], join[3],
                                   join[4], jwindow))
         if self.peek() is not None:
             raise ValueError(f"trailing tokens: {self.tokens[self.i:]}")
-        return Query(select, table, where, where_text, group_by, window)
+        return Query(select, table, where, where_text, group_by, window,
+                     having=having, having_text=having_text,
+                     order_by=order_by, limit=limit)
 
     def select_item(self) -> SelectItem:
         t = self.next()

@@ -146,6 +146,16 @@ class TableEnvironment:
 
         aggs = [i for i in q.select if i.kind == "agg"]
         preds = [i for i in q.select if i.kind == "ml_predict"]
+        if not aggs and (q.order_by or q.limit is not None):
+            raise NotImplementedError(
+                "ORDER BY / LIMIT are defined per window (streaming top-N); "
+                "use them on a windowed GROUP BY aggregate query"
+            )
+        if not aggs and (q.having is not None or q.group_by):
+            raise NotImplementedError(
+                "GROUP BY / HAVING require aggregate select items with a "
+                "TUMBLE/HOP/SESSION window"
+            )
         if not aggs:
             # projection (+ optional model inference) query
             cols = [i for i in q.select if i.kind == "column"]
@@ -238,6 +248,7 @@ class TableEnvironment:
         # start = end - size; session windows get end-only fidelity)
         out_items = q.select
         size_ms = q.window.size_ms
+        topn = bool(q.order_by) or q.limit is not None
 
         def to_row(rec, ts):
             key, res = rec
@@ -257,9 +268,50 @@ class TableEnvironment:
                     row[item.output_name] = ts + 1
                 elif item.kind == "window_start":
                     row[item.output_name] = ts + 1 - size_ms
+            if topn:
+                row["__wend"] = ts + 1     # per-window grouping key (internal)
             return row
 
-        return result.map_with_timestamp(to_row, name="sql_output")
+        out = result.map_with_timestamp(to_row, name="sql_output")
+        if q.having is not None:
+            out = out.filter(q.having, name=f"having[{q.having_text}]")
+        if topn:
+            # streaming top-N (the reference expresses this as ROW_NUMBER()
+            # OVER per window; here ORDER BY/LIMIT rank WITHIN each window).
+            # A window's rows all emit in the step its trigger fires, so
+            # ranking groups by window inside the step batch — no
+            # cross-batch state needed. Vectorized flat_map: N rows in,
+            # ranked/cut rows out, timestamps follow the source index.
+            order_by, limit = list(q.order_by), q.limit
+
+            def rank_vec(vals):
+                from itertools import groupby
+
+                import numpy as _np
+
+                from flink_tpu.utils.arrays import obj_array
+
+                rows = list(vals)
+                by_w = sorted(range(len(rows)),
+                              key=lambda i: rows[i]["__wend"])
+                out_vals, out_idx = [], []
+                for _w, grp in groupby(by_w, key=lambda i: rows[i]["__wend"]):
+                    grp = list(grp)
+                    for col, desc in reversed(order_by):
+                        grp.sort(key=lambda i, c=col: rows[i][c],
+                                 reverse=desc)
+                    if limit is not None:
+                        grp = grp[:limit]
+                    for i in grp:
+                        r = dict(rows[i])
+                        r.pop("__wend", None)
+                        out_vals.append(r)
+                        out_idx.append(i)
+                return obj_array(out_vals), _np.asarray(out_idx,
+                                                        dtype=_np.int64)
+
+            out = out.flat_map(rank_vec, name="sql_topn", vectorized=True)
+        return out
 
     def _join_query(self, q: Query) -> DataStream:
         """Windowed equi-join: translated onto DataStream.join (which the

@@ -88,6 +88,65 @@ def test_sql_window_bounds_columns():
         assert r["ws"] % 1000 == 0
 
 
+def test_sql_having_filters_output_rows():
+    tenv, rows = _clicks_env()
+    out = tenv.execute_sql_to_list(
+        "SELECT campaign, SUM(price) AS total FROM clicks "
+        "GROUP BY campaign, TUMBLE(rowtime, INTERVAL '10' SECOND) "
+        "HAVING total > 100"
+    )
+    expected = {}
+    for r in rows:
+        expected[r["campaign"]] = expected.get(r["campaign"], 0) + r["price"]
+    keep = {c: t for c, t in expected.items() if t > 100}
+    assert {r["campaign"]: r["total"] for r in out} == pytest.approx(keep)
+    assert len(keep) < 3   # the clause really filtered something
+
+
+def test_sql_order_by_limit_per_window_topn():
+    """The streaming top-N shape (Nexmark Q5 in SQL): rank within each
+    window by the aggregate, keep N."""
+    tenv, rows = _clicks_env()
+    out = tenv.execute_sql_to_list(
+        "SELECT campaign, COUNT(*) AS n, WINDOW_END AS we FROM clicks "
+        "GROUP BY campaign, TUMBLE(rowtime, INTERVAL '1' SECOND) "
+        "ORDER BY n DESC, campaign ASC LIMIT 2"
+    )
+    # expected: per 1s window, top-2 campaigns by count (ties by name)
+    from collections import Counter, defaultdict
+
+    per_w = defaultdict(Counter)
+    for r in rows:
+        per_w[r["rowtime"] // 1000][r["campaign"]] += 1
+    expect = []
+    for w in sorted(per_w):
+        ranked = sorted(per_w[w].items(), key=lambda kv: (-kv[1], kv[0]))[:2]
+        for c, n in ranked:
+            expect.append((c, n, (w + 1) * 1000))
+    got = [(r["campaign"], r["n"], r["we"]) for r in out]
+    assert sorted(got) == sorted(expect)
+    # rank order WITHIN each window is descending by count
+    for w in {r["we"] for r in out}:
+        ns = [r["n"] for r in out if r["we"] == w]
+        assert ns == sorted(ns, reverse=True)
+
+
+def test_sql_having_requires_group_by():
+    tenv, _ = _clicks_env()
+    with pytest.raises(ValueError, match="HAVING requires GROUP BY"):
+        tenv.execute_sql_to_list(
+            "SELECT campaign FROM clicks HAVING campaign = 'c0'"
+        )
+
+
+def test_sql_order_by_requires_windowed_aggregate():
+    tenv, _ = _clicks_env()
+    with pytest.raises(NotImplementedError, match="per window"):
+        tenv.execute_sql_to_list(
+            "SELECT campaign FROM clicks ORDER BY campaign LIMIT 3"
+        )
+
+
 def test_sql_multi_agg_oracle_path():
     tenv, rows = _clicks_env()
     out = tenv.execute_sql_to_list(
@@ -246,6 +305,37 @@ def test_fluent_table_api_projection_and_session():
     assert sorted((r["campaign"], r["n"]) for r in agg) == [
         ("c0", 34), ("c1", 33), ("c2", 33)
     ]
+
+
+def test_fluent_table_api_having_order_limit():
+    from flink_tpu.table.api import Tumble
+
+    tenv, rows = _clicks_env()
+    out = (
+        tenv.table("clicks")
+        .window(Tumble.of_ms(1000))
+        .group_by("campaign")
+        .aggregate(n=("count",))
+        .to_stream()
+    )
+    # equivalent SQL reference via the same planner
+    tenv2, _ = _clicks_env()
+    ref = tenv2.execute_sql_to_list(
+        "SELECT campaign, COUNT(*) AS n FROM clicks "
+        "GROUP BY campaign, TUMBLE(rowtime, INTERVAL '1' SECOND) "
+        "ORDER BY n DESC, campaign ASC LIMIT 1"
+    )
+    tenv3, _ = _clicks_env()
+    got = (
+        tenv3.table("clicks")
+        .window(Tumble.of_ms(1000))
+        .group_by("campaign")
+        .order_by("-n", "campaign")
+        .limit(1)
+        .aggregate(n=("count",))
+        .to_list()
+    )
+    assert got == ref and len(got) == 10   # one winner per 1s window
 
 
 def test_fluent_table_api_misuse_raises():
