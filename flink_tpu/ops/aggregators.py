@@ -24,7 +24,7 @@ from typing import Any, Callable, Dict, Optional, Tuple
 
 import numpy as np
 
-from flink_tpu.api.functions import AggregateFunction
+from flink_tpu.core.functions import AggregateFunction
 
 # scatter sources
 VALUE = "value"   # scatter the record's value column

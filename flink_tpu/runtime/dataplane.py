@@ -177,6 +177,7 @@ class OutputChannel:
         self._credits = 0
         self._cv = threading.Condition()
         self._seq = 0
+        self._linger_timer: Optional[threading.Timer] = None
         self._send_lock = threading.Lock()
         threading.Thread(target=self._credit_loop, daemon=True,
                          name=f"credits-{channel_id}").start()
@@ -199,6 +200,9 @@ class OutputChannel:
                     self._sock.close()
                 except OSError:
                     pass
+                t = self._linger_timer
+                if t is not None:
+                    t.cancel()     # fast FIN: don't hold the timer thread
                 return
             if msg[0] == "credit" and msg[1] == self.channel_id:
                 with self._cv:
@@ -254,6 +258,7 @@ class OutputChannel:
 
         t = threading.Timer(30.0, _force)
         t.daemon = True
+        self._linger_timer = t
         t.start()
 
 

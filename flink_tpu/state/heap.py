@@ -20,7 +20,7 @@ import dataclasses
 import time
 from typing import Any, Callable, Dict, Iterable, List, Optional, Tuple
 
-from flink_tpu.api.functions import AggregateFunction, ReduceFunction, as_reduce_function
+from flink_tpu.core.functions import AggregateFunction, ReduceFunction, as_reduce_function
 from flink_tpu.core.keygroups import KeyGroupRange, assign_to_key_group
 
 

@@ -282,6 +282,13 @@ class TableEnvironment:
             # ranking groups by window inside the step batch — no
             # cross-batch state needed. Vectorized flat_map: N rows in,
             # ranked/cut rows out, timestamps follow the source index.
+            known = {i.output_name for i in q.select}
+            for col, _desc in q.order_by:
+                if col not in known:
+                    raise ValueError(
+                        f"ORDER BY column {col!r} is not produced by the "
+                        f"SELECT list (available: {sorted(known)})"
+                    )
             order_by, limit = list(q.order_by), q.limit
 
             def rank_vec(vals):
@@ -310,7 +317,11 @@ class TableEnvironment:
                 return obj_array(out_vals), _np.asarray(out_idx,
                                                         dtype=_np.int64)
 
-            out = out.flat_map(rank_vec, name="sql_topn", vectorized=True)
+            # ranking is global per window: pin the rank step to ONE
+            # parallel instance (GlobalPartitioner hint) so a sharded plan
+            # cannot emit per-shard top-Ns
+            out = out.global_().flat_map(rank_vec, name="sql_topn",
+                                         vectorized=True)
         return out
 
     def _join_query(self, q: Query) -> DataStream:

@@ -1,0 +1,93 @@
+"""Architecture tests: layering rules over module imports.
+
+Reference capability: flink-architecture-tests (ArchUnit rules freezing
+layering and API discipline, e.g. ApiAnnotationRules.java / ConnectorRules
+with checked-in violation stores). The analogue here parses each module's
+AST and asserts the layer DAG:
+
+    core, utils          — foundation: import nothing above themselves
+    ops                  — device kernels: no runtime/api/table/cep deps
+    state, graph         — no api/table/cep deps
+    api                  — builds plans; may reach runtime only lazily
+                           (inside functions), never at module import time
+
+Lazy (function-scoped) imports are the sanctioned escape hatch — the same
+role ArchUnit's violation store plays, but enforced structurally: execution
+entry points import the executor when called, so importing the API layer
+can never drag in the whole runtime.
+"""
+
+import ast
+import pathlib
+
+import flink_tpu
+
+PKG = pathlib.Path(flink_tpu.__file__).parent
+
+# layer -> module prefixes it must NOT import at module level
+FORBIDDEN = {
+    "core": ["flink_tpu.runtime", "flink_tpu.api", "flink_tpu.table",
+             "flink_tpu.cep", "flink_tpu.ops", "flink_tpu.state"],
+    "utils": ["flink_tpu.runtime", "flink_tpu.api", "flink_tpu.table",
+              "flink_tpu.cep"],
+    "ops": ["flink_tpu.runtime", "flink_tpu.api", "flink_tpu.table",
+            "flink_tpu.cep"],
+    "state": ["flink_tpu.api", "flink_tpu.table", "flink_tpu.cep"],
+    "graph": ["flink_tpu.table", "flink_tpu.cep", "flink_tpu.runtime"],
+    "api": ["flink_tpu.table", "flink_tpu.runtime"],
+}
+
+
+def _module_level_imports(path: pathlib.Path):
+    """Imports executed at import time: module body + class bodies, but NOT
+    function bodies (lazy imports are the sanctioned layering escape)."""
+    tree = ast.parse(path.read_text())
+    found = []
+
+    def walk(node):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            if isinstance(child, ast.Import):
+                found.extend(a.name for a in child.names)
+            elif isinstance(child, ast.ImportFrom) and child.module:
+                found.append(child.module)
+            else:
+                walk(child)
+
+    walk(tree)
+    return found
+
+
+def test_layering_rules():
+    violations = []
+    for layer, banned in FORBIDDEN.items():
+        layer_dir = PKG / layer
+        files = list(layer_dir.rglob("*.py")) if layer_dir.is_dir() else []
+        assert files, f"layer {layer!r} has no modules?"
+        for f in files:
+            for imp in _module_level_imports(f):
+                for b in banned:
+                    if imp == b or imp.startswith(b + "."):
+                        violations.append(
+                            f"{f.relative_to(PKG.parent)} imports {imp} "
+                            f"(layer {layer!r} must not depend on {b})"
+                        )
+    assert not violations, "\n".join(violations)
+
+
+def test_jax_stays_out_of_the_control_plane():
+    """The cluster control plane (JM/TM endpoints, RPC, blob, heartbeats,
+    HA) must not import jax at module level: an oracle-path worker process
+    must never initialize a TPU backend just by starting up (backend init
+    claims the chip; see _make_operator's device-path-only import)."""
+    control = ["runtime/cluster.py", "runtime/rpc.py", "runtime/blob.py",
+               "runtime/heartbeat.py", "runtime/ha.py",
+               "runtime/ha_kubernetes.py", "runtime/rest.py",
+               "runtime/dataplane.py"]
+    bad = []
+    for rel in control:
+        for imp in _module_level_imports(PKG / rel):
+            if imp == "jax" or imp.startswith("jax."):
+                bad.append(f"{rel} imports {imp} at module level")
+    assert not bad, "\n".join(bad)
