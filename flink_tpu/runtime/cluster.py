@@ -168,6 +168,7 @@ class _JobState:
     steps: Dict[int, int] = field(default_factory=dict)        # shard -> last reported step
     stages: int = 1      # >1: GraphJobSpec split into pipeline stages (slot
     #                      sharing groups); shard index = stage index
+    source_stages: List[int] = field(default_factory=list)  # trigger targets
 
 
 class JobManagerEndpoint(RpcEndpoint):
@@ -263,11 +264,17 @@ class JobManagerEndpoint(RpcEndpoint):
         blob_key = self.blob.put(spec_bytes)
         spec = DistributedJobSpec.from_bytes(spec_bytes)
         stages = 1
+        source_stages: List[int] = []
         if isinstance(spec, GraphJobSpec):
-            from flink_tpu.runtime.stages import num_stages, validate_stages
+            from flink_tpu.runtime.stages import (
+                num_stages,
+                source_stage_indices,
+                validate_stages,
+            )
 
             validate_stages(spec.graph)
             stages = num_stages(spec.graph)
+            source_stages = source_stage_indices(spec.graph)
             if parallelism not in (1, stages):
                 raise ValueError(
                     "GraphJobSpec jobs deploy one task per slot-sharing "
@@ -294,6 +301,7 @@ class JobManagerEndpoint(RpcEndpoint):
         self._jobs[job_id] = _JobState(
             job_id, blob_key, parallelism, spec.name,
             requested_parallelism=parallelism, stages=stages,
+            source_stages=source_stages,
         )
         self._try_schedule(self._jobs[job_id])
         return job_id
@@ -357,12 +365,14 @@ class JobManagerEndpoint(RpcEndpoint):
             # waiting (Executing->Restarting->Executing with lower
             # parallelism, scheduler/adaptive/AdaptiveScheduler.java:192);
             # state re-shards by key-group range on restore
+            # stage-split jobs cannot rescale: shard index = stage index
+            # (their snapshots are per-stage runtimes, not key-group state)
             if not (self.adaptive and slots and job.completed
-                    and job.status == "RESTARTING"):
+                    and job.status == "RESTARTING" and job.stages == 1):
                 return  # WaitingForResources
             job.parallelism = len(slots)
         elif (self.adaptive and job.status == "RESTARTING" and job.completed
-              and len(slots) > job.parallelism):
+              and job.stages == 1 and len(slots) > job.parallelism):
             job.parallelism = min(len(slots), job.requested_parallelism)
         restore = None
         restore_step = 0
@@ -483,23 +493,48 @@ class JobManagerEndpoint(RpcEndpoint):
         job = self._jobs.get(job_id)
         if job is None or job.status != "RUNNING" or self._storage is None:
             return None
-        if job.stages > 1:
-            # pipeline stages progress at independent step counts, so the
-            # step-aligned cut is not consistent across them; multi-stage
-            # jobs fail over by full restart (full-graph failover strategy)
-            return None
         if len(job.steps) < job.parallelism:
             return None
+        if job.stages > 1:
+            # aligned-barrier checkpoint (CheckpointBarrier analogue): the
+            # trigger goes to the SOURCE stages only; they snapshot at
+            # their next step boundary and emit barriers into the
+            # exchanges, downstream stages align, snapshot, forward, ack.
+            # All target TMs are resolved BEFORE allocating the cp: a
+            # half-delivered trigger would emit barriers that a
+            # multi-input downstream stage could never align.
+            if not job.source_stages:
+                return None
+            gws = {}
+            for shard in job.source_stages:
+                tm = self._tms.get(job.assignment.get(shard))
+                if tm is None:
+                    return None
+                gws[shard] = tm["gateway"]
+            cp_id = job.next_checkpoint_id
+            job.next_checkpoint_id += 1
+            job.pending[cp_id] = {}
+            job.pending_target[cp_id] = max(job.steps.values())
+            for shard, gw in gws.items():
+                gw.trigger_checkpoint(
+                    job.job_id, job.attempt, cp_id,
+                    job.steps.get(shard, 0) + 2, shard,
+                )
+            return cp_id
+        gws2 = {}
+        for shard, tm_id in job.assignment.items():
+            tm = self._tms.get(tm_id)
+            if tm is None:
+                return None
+            gws2[shard] = tm["gateway"]
         cp_id = job.next_checkpoint_id
         job.next_checkpoint_id += 1
         target = max(job.steps.values()) + 2
         job.pending[cp_id] = {}
         job.pending_target[cp_id] = target
-        for shard, tm_id in job.assignment.items():
-            tm = self._tms.get(tm_id)
-            if tm is None:
-                return None
-            tm["gateway"].trigger_checkpoint(job.job_id, job.attempt, cp_id, target)
+        for shard, gw in gws2.items():
+            gw.trigger_checkpoint(job.job_id, job.attempt, cp_id, target,
+                                  shard)
         return cp_id
 
     def ack_checkpoint(self, job_id: str, attempt: int, shard: int,
@@ -644,40 +679,93 @@ class _ShardTask:
         JobRuntime; cross-stage edges are exchange channels (stages.py), so
         the stages of the job execute CONCURRENTLY as a pipeline with
         credit backpressure — the PIPELINED-result-partition analogue.
-        Failover is full-restart (no cross-stage checkpoint cut)."""
+
+        Checkpoints use aligned barriers (stages.py module docstring): the
+        JM trigger is this stage's '__source__' barrier (consumed at a step
+        boundary); channel barriers arrive inline with data; when the
+        aligner completes, the snapshot is taken ON the run-loop thread,
+        barriers are forwarded into every out-channel, and the JM is
+        acked. Restore = per-stage snapshot + source rewind; FIFO channels
+        mean no channel state is part of the cut."""
         from flink_tpu.runtime.dataplane import OutputChannel
         from flink_tpu.runtime.executor import (
             JobCancelledException,
             JobRuntime,
             SinkRunner,
         )
-        from flink_tpu.runtime.stages import build_stage_graph, cross_edges
+        from flink_tpu.runtime.stages import (
+            BarrierAligner,
+            build_stage_graph,
+            cross_edges,
+            stage_has_original_sources,
+        )
 
         stage_idx = self.shard
         edges = cross_edges(self.spec.graph)
         ins: Dict[str, object] = {}
         outs: Dict[str, OutputChannel] = {}
+        out_order: List[str] = []
         for e in edges:
             cid = f"{self.job_id}/a{self.attempt}/{e.edge_id}"
             if e.dst_stage == stage_idx:
                 ins[e.edge_id] = self.te.exchange.channel(cid)
             if e.src_stage == stage_idx:
                 outs[e.edge_id] = OutputChannel(self.peers[e.dst_stage], cid)
-        graph = build_stage_graph(
-            self.spec.graph, stage_idx, ins, outs, self.cancelled
-        )
-        rt = JobRuntime(graph, self.spec.config)
+                out_order.append(e.edge_id)
 
         task = self
+        rt_box: list = [None]
+
+        def on_aligned(cp_id: int) -> None:
+            rt = rt_box[0]
+            snap = {"runtime": rt.capture(), "step": task.current_step}
+            for eid in out_order:                 # forward BEFORE new data
+                while True:      # backpressure-tolerant, cancellation-aware
+                    try:
+                        outs[eid].send(("barrier", cp_id), timeout=1.0)
+                        break
+                    except TimeoutError:
+                        if task.cancelled.is_set():
+                            raise JobCancelledException()
+            task.te._local_state[(task.job_id, task.shard)] = (cp_id, snap)
+            task.jm.ack_checkpoint(
+                task.job_id, task.attempt, task.shard, cp_id, snap)
+
+        has_sources = stage_has_original_sources(self.spec.graph, stage_idx)
+        aligner = BarrierAligner(list(ins), has_sources, on_aligned)
+
+        graph = build_stage_graph(
+            self.spec.graph, stage_idx, ins, outs, self.cancelled,
+            aligner=aligner,
+        )
+        rt = JobRuntime(graph, self.spec.config)
+        rt_box[0] = rt
+        self._resolve_local_restore()
+        if self.restore is not None:
+            rt.restore(self.restore["runtime"])
+            self.current_step = self.restore["step"]
 
         class _StepCounter:
-            """Progress for heartbeats; no checkpoints across stages."""
+            """Step progress for heartbeats + the '__source__' barrier: a
+            JM trigger due at this step boundary enters the aligner (for a
+            pure source stage that completes the alignment immediately)."""
 
             def register_on_complete(self, fn):
                 pass
 
             def maybe_trigger(self, capture):
                 task.current_step += 1
+                if not has_sources:
+                    return
+                with task._cp_lock:
+                    due = [r for r in task._cp_requests
+                           if r[1] <= task.current_step]
+                    task._cp_requests = [
+                        r for r in task._cp_requests
+                        if r[1] > task.current_step
+                    ]
+                for cp_id, _target in due:
+                    aligner.on_barrier(BarrierAligner.SOURCE_GATE, cp_id)
 
         try:
             rt.run(coordinator=_StepCounter(),
@@ -1014,9 +1102,15 @@ class TaskExecutorEndpoint(RpcEndpoint):
         return True
 
     def trigger_checkpoint(self, job_id: str, attempt: int, cp_id: int,
-                           target_step: int) -> bool:
-        for (jid, att, _shard), task in self._tasks.items():
-            if jid == job_id and att == attempt and not task.cancelled.is_set():
+                           target_step: int, shard: Optional[int] = None) -> bool:
+        """Deliver a checkpoint request to this TM's task(s) of the job.
+        `shard` addresses ONE task — required when a TM hosts several tasks
+        of the job (fanning the request to co-located tasks would duplicate
+        source barriers on multi-stage jobs); None keeps the legacy
+        broadcast for old callers."""
+        for (jid, att, sh), task in self._tasks.items():
+            if jid == job_id and att == attempt and not task.cancelled.is_set() \
+                    and (shard is None or sh == shard):
                 task.request_checkpoint(cp_id, target_step)
         return True
 

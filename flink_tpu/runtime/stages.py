@@ -23,8 +23,22 @@ iteration head/tail pairs — validated here.
 Protocol on a cross-stage channel (FIFO, credit-controlled):
   ("b", values, timestamps)  — a record batch
   ("w", watermark_ms)        — a watermark advance
+  ("barrier", cp_id)         — an aligned checkpoint barrier
   end-of-stream via the channel's eos frame (OutputChannel.end()).
 Latency markers do not cross stages (sampled per stage instead).
+
+Checkpoints across stages use the reference's aligned-barrier algorithm
+(CheckpointCoordinator → CheckpointBarrier → CheckpointBarrierHandler
+alignment): the JM triggers the SOURCE stages; a source stage snapshots at
+its next step boundary and emits a barrier into every out-channel; a
+downstream stage pauses each input gate as its barrier arrives (alignment
+backpressure — paused gates stop consuming, so post-barrier records never
+enter pre-barrier state), and when every gate plus the local source
+contribution has arrived it snapshots, forwards the barrier, and acks.
+FIFO channels make the cut consistent with NO channel state in the
+snapshot: everything pre-barrier is reflected in some stage's state,
+everything post-barrier is regenerated from the rewound sources on
+restore.
 """
 
 from __future__ import annotations
@@ -229,22 +243,74 @@ class _ChannelWatermarks:
         return _ChannelWatermarkGenerator(self._box)
 
 
-class _StageReader(SourceReader):
-    """Reads ('b', values, ts) / ('w', wm) messages off one exchange
-    channel. Returns an EMPTY batch on poll timeout (keeps the round-robin
-    source loop live for the job's other inputs) and None only at
-    end-of-stream."""
+class BarrierAligner:
+    """Aligned-barrier tracker for one stage task (the
+    CheckpointBarrierHandler analogue). Gates are the stage's cross-input
+    edge ids plus the virtual '__source__' gate when the stage also hosts
+    original sources (its barrier is the JM trigger consumed at a step
+    boundary). A gate that delivered the in-flight barrier is PAUSED —
+    its reader yields empty batches without consuming — until every gate
+    arrives; then `on_complete(cp_id)` runs on the run-loop thread
+    (snapshot + forward + ack) and all gates resume. FIFO channels make
+    one-at-a-time alignment sufficient: a later barrier simply waits in
+    its paused gate's ring."""
 
-    def __init__(self, channel, cancelled: threading.Event, box: _WmBox):
+    SOURCE_GATE = "__source__"
+
+    def __init__(self, gates, has_local_sources: bool, on_complete):
+        self.expected = set(gates)
+        if has_local_sources:
+            self.expected.add(self.SOURCE_GATE)
+        self.on_complete = on_complete
+        self.cp: Optional[int] = None
+        self.arrived: set = set()
+        self._queued: List[tuple] = []   # barriers for LATER checkpoints
+
+    def on_barrier(self, gate: str, cp_id: int) -> None:
+        if self.cp is not None and gate in self.arrived:
+            # a later checkpoint's barrier on an already-aligned gate
+            # (only the virtual source gate can do this — channel gates
+            # pause): queue it for after the in-flight alignment, or it
+            # would be silently merged into the wrong cut
+            self._queued.append((gate, cp_id))
+            return
+        if self.cp is None:
+            self.cp = cp_id
+        self.arrived.add(gate)
+        if self.arrived >= self.expected:
+            cp, self.cp, self.arrived = self.cp, None, set()
+            self.on_complete(cp)
+            queued, self._queued = self._queued, []
+            for g, c in queued:
+                self.on_barrier(g, c)
+
+    def paused(self, gate: str) -> bool:
+        return self.cp is not None and gate in self.arrived
+
+
+class _StageReader(SourceReader):
+    """Reads ('b', values, ts) / ('w', wm) / ('barrier', cp) messages off
+    one exchange channel. Returns an EMPTY batch on poll timeout (keeps
+    the round-robin source loop live for the job's other inputs) and None
+    only at end-of-stream. While this gate's barrier is aligning, the
+    reader yields empty batches WITHOUT consuming (alignment
+    backpressure)."""
+
+    def __init__(self, channel, cancelled: threading.Event, box: _WmBox,
+                 gate: str = "", aligner: Optional[BarrierAligner] = None):
         self._chan = channel
         self._cancelled = cancelled
         self._box = box
+        self._gate = gate
+        self._aligner = aligner
 
     def add_split(self, split: SourceSplit) -> None:
         pass
 
     def poll_batch(self, max_records: int) -> Optional[Batch]:
         while not self._cancelled.is_set():
+            if self._aligner is not None and self._aligner.paused(self._gate):
+                return _EMPTY_BATCH               # aligning: do not consume
             try:
                 msg = self._chan.poll(timeout=0.05)
             except TimeoutError:
@@ -254,6 +320,12 @@ class _StageReader(SourceReader):
             if msg[0] == "w":
                 self._box.wm = max(self._box.wm, int(msg[1]))
                 return _EMPTY_BATCH               # watermark piggybacks next
+            if msg[0] == "barrier":
+                if self._aligner is not None:
+                    # may complete the alignment: the snapshot callback runs
+                    # HERE, on the run-loop thread between batches
+                    self._aligner.on_barrier(self._gate, int(msg[1]))
+                return _EMPTY_BATCH
             return Batch(values=msg[1],
                          timestamps=np.asarray(msg[2], dtype=np.int64))
         return None
@@ -268,16 +340,20 @@ class StageInputSource(Source):
 
     boundedness = "CONTINUOUS_UNBOUNDED"
 
-    def __init__(self, channel, cancelled: threading.Event, box: _WmBox):
+    def __init__(self, channel, cancelled: threading.Event, box: _WmBox,
+                 gate: str = "", aligner: Optional[BarrierAligner] = None):
         self._channel = channel
         self._cancelled = cancelled
         self._box = box
+        self._gate = gate
+        self._aligner = aligner
 
     def create_enumerator(self) -> SplitEnumerator:
         return SplitEnumerator([SourceSplit("stage-input")])
 
     def create_reader(self) -> _StageReader:
-        return _StageReader(self._channel, self._cancelled, self._box)
+        return _StageReader(self._channel, self._cancelled, self._box,
+                            self._gate, self._aligner)
 
 
 class StageOutputRunner:
@@ -355,12 +431,29 @@ class StageOutputRunner:
 # per-stage sub-graph
 # ---------------------------------------------------------------------------
 
+def stage_has_original_sources(graph: StepGraph, stage_idx: int) -> bool:
+    idx = _stage_index(graph)
+    return any(
+        isinstance(edge[0], Transformation)
+        for s in graph.steps if idx[id(s)] == stage_idx
+        for edge in s.inputs
+    )
+
+
+def source_stage_indices(graph: StepGraph) -> List[int]:
+    """Stages hosting original sources — the ones the JM's checkpoint
+    trigger targets (barriers cascade to the rest)."""
+    return [i for i in range(num_stages(graph))
+            if stage_has_original_sources(graph, i)]
+
+
 def build_stage_graph(
     graph: StepGraph,
     stage_idx: int,
     in_channels: Dict[str, Any],
     out_senders: Dict[str, Any],
     cancelled: threading.Event,
+    aligner: Optional[BarrierAligner] = None,
 ) -> StepGraph:
     """Carve stage `stage_idx` out of `graph` (the task's OWN unpickled
     copy — mutated in place): cross-stage inputs become StageInputSource
@@ -378,7 +471,8 @@ def build_stage_graph(
                 "source", f"stage-in:{e.edge_id}", [],
                 {
                     "source": StageInputSource(
-                        in_channels[e.edge_id], cancelled, box),
+                        in_channels[e.edge_id], cancelled, box,
+                        gate=e.edge_id, aligner=aligner),
                     "watermark_strategy": _ChannelWatermarks(box),
                 },
             )
