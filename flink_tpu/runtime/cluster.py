@@ -95,6 +95,9 @@ class DistributedJobSpec(_PickledSpec):
     # AdaptiveBatchScheduler analogue (scheduler/adaptivebatch/ derives
     # per-stage parallelism from produced bytes)
     source_records_hint: Optional[int] = None
+    # device-operator construction knobs (e.g. session num_slices /
+    # key_capacity for skewed/out-of-order streams)
+    operator_options: Optional[Dict[str, Any]] = None
 
 
 @dataclass
@@ -124,7 +127,7 @@ def merge_shard_snapshots(handles: Dict[int, dict]) -> dict:
     for shard in sorted(handles):
         snap = handles[shard]
         op = snap["operator"]
-        if "columnar" in op:
+        if "columnar" in op or "cnt" in op:
             raise ValueError(
                 "device-operator snapshots re-shard by key group inside the "
                 "sharded device state, not via heap-table merge; rescaling "
@@ -883,12 +886,39 @@ class _ShardTask:
         if self.spec.operator == "device":
             # imported only on the device path: pulls in jax (on a TPU host,
             # backend init claims the chip — oracle workers must not)
-            from flink_tpu.runtime.tpu_window_operator import TpuWindowOperator
-
-            return TpuWindowOperator(
-                self.spec.assigner, self.spec.aggregate,
-                allowed_lateness=self.spec.allowed_lateness,
+            from flink_tpu.api.windowing.assigners import (
+                EventTimeSessionWindows,
             )
+
+            if isinstance(self.spec.assigner, EventTimeSessionWindows) \
+                    and self.spec.allowed_lateness == 0:
+                # sessions scale past one chip the cluster way: each shard
+                # owns a key-group range and runs its own device session
+                # operator (sessions never cross keys, so no cross-shard
+                # merge exists by construction). allowed_lateness falls
+                # back to the oracle below — same gate as the single-node
+                # operator selection. Sync emissions: the task loop drains
+                # every step, so deferral would only disable the closable
+                # precheck.
+                from flink_tpu.runtime.tpu_session_operator import (
+                    TpuSessionWindowOperator,
+                )
+
+                return TpuSessionWindowOperator(
+                    self.spec.assigner, self.spec.aggregate,
+                    **(self.spec.operator_options or {}),
+                )
+            if not isinstance(self.spec.assigner, EventTimeSessionWindows):
+                from flink_tpu.runtime.tpu_window_operator import (
+                    TpuWindowOperator,
+                )
+
+                return TpuWindowOperator(
+                    self.spec.assigner, self.spec.aggregate,
+                    allowed_lateness=self.spec.allowed_lateness,
+                )
+            # sessions WITH lateness: only the oracle implements the exact
+            # late-merge semantics — fall through
         agg = resolve(self.spec.aggregate)
         return OracleWindowOperator(
             self.spec.assigner,
@@ -1005,8 +1035,17 @@ class _ShardTask:
                 mt = np.concatenate([p[2] for p in parts])
                 combined_wm = min(wms)
 
-                for i in range(len(mk)):
-                    op.process_record(mk[i], float(mv[i]), int(mt[i]))
+                if hasattr(op, "process_batch") and len(mk):
+                    # columnar feeding for device operators: ONE batched
+                    # ingest instead of a per-record python loop (the
+                    # oracle has no batch form — sessions with lateness
+                    # fall back to it even under operator='device')
+                    op.process_batch(
+                        mk, np.asarray(mv, dtype=np.float32),
+                        np.asarray(mt, dtype=np.int64))
+                else:
+                    for i in range(len(mk)):
+                        op.process_record(mk[i], float(mv[i]), int(mt[i]))
                 if combined_wm > MIN_WATERMARK:
                     op.process_watermark(combined_wm)
                 results.extend(op.drain_output())

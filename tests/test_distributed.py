@@ -216,6 +216,67 @@ def _collect(result):
     return {(k, w[0]): r for k, w, r, _ in result}
 
 
+def test_cluster_device_session_operator_two_shards():
+    """Sessions scale past one device the cluster way: each shard runs its
+    own TpuSessionWindowOperator over its key-group range (sessions never
+    cross keys, so sharding needs no cross-shard merge). Parity vs the
+    oracle MergingWindowSet path on the same sharded stream."""
+    from flink_tpu.api.windowing.assigners import EventTimeSessionWindows
+
+    gap = 1000
+
+    def source_factory(shard, num_shards):
+        rng = np.random.default_rng(50 + shard)
+        batches = []
+        t = 0
+        for s in range(6):
+            keys = np.asarray(
+                [f"k{v}" for v in rng.integers(0, 6, 30)], dtype=object)
+            vals = np.ones(30, dtype=np.float64)
+            ts = (t + rng.integers(0, 600, 30)).astype(np.int64)
+            batches.append((keys, vals, ts, t + 300))
+            # bursts separated by > gap so sessions really close
+            t += 600 + (gap * 2 if s % 2 else 0)
+        return batches
+
+    def mk_spec(operator):
+        return DistributedJobSpec(
+            name=f"sessions-{operator}", source_factory=source_factory,
+            assigner=EventTimeSessionWindows.with_gap(gap),
+            aggregate="sum", max_parallelism=16, operator=operator,
+        )
+
+    def run(operator):
+        svc_jm, svc1, svc2 = RpcService(), RpcService(), RpcService()
+        jm = JobManagerEndpoint(svc_jm, heartbeat_interval=0.2,
+                                heartbeat_timeout=10.0)
+        tes = []
+        for svc in (svc1, svc2):
+            te = TaskExecutorEndpoint(svc, slots=1)
+            te.connect(svc_jm.address)
+            tes.append(te)
+        client = svc_jm.gateway(svc_jm.address, "jobmanager")
+        job_id = client.submit_job(mk_spec(operator).to_bytes(), 2)
+        deadline = time.time() + 40
+        while time.time() < deadline:
+            st = client.job_status(job_id)
+            if st["status"] in ("FINISHED", "FAILED"):
+                break
+            time.sleep(0.1)
+        assert st["status"] == "FINISHED", st
+        out = client.job_result(job_id)
+        for te in tes:
+            te.stop()
+        jm.heartbeats.stop()
+        for svc in (svc_jm, svc1, svc2):
+            svc.stop()
+        return sorted((k, tuple(w), round(float(r), 4)) for k, w, r, _ in out)
+
+    got = run("device")
+    ref = run("oracle")
+    assert got == ref and len(ref) > 0
+
+
 def test_auto_parallelism_from_source_volume(tmp_path):
     """AdaptiveBatchScheduler analogue: parallelism=0 derives the task
     count from the declared source volume (one task per
