@@ -172,6 +172,10 @@ class _JobState:
     stages: int = 1      # >1: GraphJobSpec split into pipeline stages (slot
     #                      sharing groups); shard index = stage index
     source_stages: List[int] = field(default_factory=list)  # trigger targets
+    savepoint_paths: Dict[int, Tuple[str, int]] = field(
+        default_factory=dict)   # cp_id -> (target dir, retry margin)
+    completed_savepoints: List[str] = field(default_factory=list)
+    failed_savepoints: List[str] = field(default_factory=list)
 
 
 class JobManagerEndpoint(RpcEndpoint):
@@ -263,7 +267,8 @@ class JobManagerEndpoint(RpcEndpoint):
                 self._fail_job(job, f"task executor {tm_id} lost (heartbeat timeout)")
 
     # ---- job lifecycle (M2/M3) -------------------------------------------
-    def submit_job(self, spec_bytes: bytes, parallelism: int) -> str:
+    def submit_job(self, spec_bytes: bytes, parallelism: int,
+                   savepoint_path: Optional[str] = None) -> str:
         blob_key = self.blob.put(spec_bytes)
         spec = DistributedJobSpec.from_bytes(spec_bytes)
         stages = 1
@@ -301,11 +306,22 @@ class JobManagerEndpoint(RpcEndpoint):
             raise ValueError("parallelism must be positive (0 = AUTO is "
                              "only defined for DistributedJobSpec)")
         job_id = uuid.uuid4().hex[:16]
-        self._jobs[job_id] = _JobState(
+        job = _JobState(
             job_id, blob_key, parallelism, spec.name,
             requested_parallelism=parallelism, stages=stages,
             source_stages=source_stages,
         )
+        if savepoint_path is not None:
+            # start FROM a savepoint (execution.savepoint.path analogue):
+            # seed the restore chain with the written snapshot set — the
+            # first schedule restores every shard from it
+            st = FsCheckpointStorage(savepoint_path)
+            latest = st.latest()
+            if latest is None:
+                raise ValueError(f"no savepoint found at {savepoint_path!r}")
+            data = st.load(latest[1])
+            job.completed.append((0, data["shards"], data["step"]))
+        self._jobs[job_id] = job
         self._try_schedule(self._jobs[job_id])
         return job_id
 
@@ -315,6 +331,8 @@ class JobManagerEndpoint(RpcEndpoint):
             "status": job.status, "attempt": job.attempt, "name": job.spec_name,
             "parallelism": job.parallelism, "stages": job.stages,
             "tasks": len(job.assignment),
+            "savepoints": list(job.completed_savepoints),
+            "savepoints_failed": list(job.failed_savepoints),
             "failure": job.failure, "restarts": job.restarts,
             "checkpoints": [c[0] for c in job.completed],
         }
@@ -411,6 +429,12 @@ class JobManagerEndpoint(RpcEndpoint):
         job.steps = {}
         job.pending.clear()
         job.pending_target.clear()
+        # in-flight savepoints belong to the dead attempt: report them as
+        # failed (the stale attempt's decline/ack can never complete them)
+        for path, _m in job.savepoint_paths.values():
+            job.failed_savepoints.append(
+                f"{path}: job restarted before the cut completed")
+        job.savepoint_paths.clear()
         origins = job.cp_origins.get(local_cp, {}) if local_cp is not None else {}
         for shard, tm_id in job.assignment.items():
             # local recovery: a shard redeployed onto the TM that produced
@@ -492,10 +516,31 @@ class JobManagerEndpoint(RpcEndpoint):
         self._fail_job(job, f"shard {shard}: {error}")
 
     # ---- checkpoint coordination (S7 analogue, step-aligned) -------------
-    def trigger_checkpoint(self, job_id: str) -> Optional[int]:
+    def trigger_savepoint(self, job_id: str, path: str) -> Optional[int]:
+        """User-requested savepoint (CheckpointCoordinator savepoint
+        analogue): rides the normal trigger/align/ack machinery; on
+        completion the snapshot set is ALSO written to `path` (durable,
+        user-owned, never subsumed). Async: poll job_status()'s
+        'savepoints' for the written path. The target step is computed
+        from heartbeat-stale progress, so a fast job can outrun it —
+        declines re-trigger automatically with a doubled margin until the
+        cut lands (or the job ends)."""
         job = self._jobs.get(job_id)
-        if job is None or job.status != "RUNNING" or self._storage is None:
+        if job is None or job.status != "RUNNING":
             return None
+        cp_id = self.trigger_checkpoint(job_id, for_savepoint=True)
+        if cp_id is not None:
+            job.savepoint_paths[cp_id] = (path, 2)
+        return cp_id
+
+    def trigger_checkpoint(self, job_id: str, for_savepoint: bool = False,
+                           margin: int = 2) -> Optional[int]:
+        job = self._jobs.get(job_id)
+        if job is None or job.status != "RUNNING":
+            return None
+        if self._storage is None and not for_savepoint:
+            return None   # periodic checkpoints need configured storage;
+            #               savepoints carry their own target directory
         if len(job.steps) < job.parallelism:
             return None
         if job.stages > 1:
@@ -532,7 +577,10 @@ class JobManagerEndpoint(RpcEndpoint):
             gws2[shard] = tm["gateway"]
         cp_id = job.next_checkpoint_id
         job.next_checkpoint_id += 1
-        target = max(job.steps.values()) + 2
+        # the cut must land at ONE common step across shards; heartbeat
+        # staleness means fast jobs may already be past it — margin covers
+        # the lag (savepoint declines re-trigger with a doubled margin)
+        target = max(job.steps.values()) + margin
         job.pending[cp_id] = {}
         job.pending_target[cp_id] = target
         for shard, gw in gws2.items():
@@ -556,6 +604,22 @@ class JobManagerEndpoint(RpcEndpoint):
                 self._storage.save(
                     checkpoint_id, {"job": job_id, "shards": handles, "step": step}
                 )
+            sp = job.savepoint_paths.pop(checkpoint_id, None)
+            if sp is not None:
+                # the checkpoint is complete regardless of the savepoint
+                # write: a bad user path must not fail the acking task (and
+                # thereby the healthy job)
+                sp_path, _margin = sp
+                try:
+                    FsCheckpointStorage(sp_path).save(
+                        checkpoint_id,
+                        {"job": job_id, "shards": handles, "step": step,
+                         "savepoint": True},
+                    )
+                    job.completed_savepoints.append(sp_path)
+                except OSError as e:
+                    job.failed_savepoints.append(
+                        f"{sp_path}: {e}")
             job.completed.append((checkpoint_id, handles, step))
             # local recovery (S11): remember which TM produced each shard's
             # snapshot, so a redeploy to the same TM can restore from its
@@ -587,6 +651,22 @@ class JobManagerEndpoint(RpcEndpoint):
         if job is not None and attempt == job.attempt:
             job.pending.pop(checkpoint_id, None)
             job.pending_target.pop(checkpoint_id, None)
+            sp = job.savepoint_paths.pop(checkpoint_id, None)
+            if sp is None:
+                return
+            path, margin = sp
+            if job.status == "RUNNING" and reason.startswith("at step"):
+                # the job outran the target step: retry the savepoint with
+                # a doubled margin until the common cut lands
+                new_cp = self.trigger_checkpoint(
+                    job_id, for_savepoint=True,
+                    margin=min(margin * 2, 1 << 14))
+                if new_cp is not None:
+                    job.savepoint_paths[new_cp] = (path, margin * 2)
+                    return
+            # permanent (a task finished / job no longer running): report
+            # instead of re-triggering at RPC speed forever
+            job.failed_savepoints.append(f"{path}: {reason}")
 
     def _checkpoint_loop(self) -> None:
         while True:

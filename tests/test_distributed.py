@@ -277,6 +277,74 @@ def test_cluster_device_session_operator_two_shards():
     assert got == ref and len(ref) > 0
 
 
+def test_cluster_savepoint_and_resubmit(tmp_path):
+    """Distributed savepoints (S10 on the cluster runtime): a user-triggered
+    savepoint rides the normal trigger/ack machinery and writes a durable
+    snapshot set; a NEW job submitted with savepoint_path restores from it
+    and finishes with the full exact results."""
+    def slow_source(shard, num_shards):
+        rng = np.random.default_rng(100 + shard)
+        batches = []
+        for s in range(600):      # long enough to savepoint mid-run
+            keys = np.asarray(
+                [f"k{v}" for v in rng.integers(0, 5, 30)], dtype=object)
+            vals = np.ones(30, dtype=np.float64)
+            ts = (s * 500 + rng.integers(0, 500, 30)).astype(np.int64)
+            batches.append((keys, vals, ts, s * 500 + 250))
+        return batches
+
+    spec = DistributedJobSpec(
+        name="savepointed", source_factory=slow_source,
+        assigner=TumblingEventTimeWindows.of(2000), aggregate="sum",
+        max_parallelism=16,
+    )
+
+    svc_jm, svc1 = RpcService(), RpcService()
+    # NOTE: no checkpoint_dir — savepoints must work without configured
+    # periodic-checkpoint storage (they carry their own target directory)
+    jm = JobManagerEndpoint(svc_jm, heartbeat_interval=0.2,
+                            heartbeat_timeout=10.0)
+    te1 = TaskExecutorEndpoint(svc1, slots=2)
+    te1.connect(svc_jm.address)
+    client = svc_jm.gateway(svc_jm.address, "jobmanager")
+
+    job_id = client.submit_job(spec.to_bytes(), 2)
+    sp_dir = str(tmp_path / "sp")
+    # trigger once the job reports progress
+    deadline = time.time() + 30
+    cp = None
+    while time.time() < deadline and cp is None:
+        if client.job_status(job_id)["status"] != "RUNNING":
+            break
+        cp = client.trigger_savepoint(job_id, sp_dir)
+        time.sleep(0.05)
+    assert cp is not None, client.job_status(job_id)
+    deadline = time.time() + 30
+    while time.time() < deadline:
+        st = client.job_status(job_id)
+        if st["savepoints"] or st["status"] in ("FINISHED", "FAILED"):
+            break
+        time.sleep(0.05)
+    assert st["savepoints"] == [sp_dir], st
+    client.cancel_job(job_id)
+
+    # new job FROM the savepoint: replays the remainder, full exact result
+    job2 = client.submit_job(spec.to_bytes(), 2, sp_dir)
+    deadline = time.time() + 40
+    while time.time() < deadline:
+        st = client.job_status(job2)
+        if st["status"] in ("FINISHED", "FAILED"):
+            break
+        time.sleep(0.1)
+    assert st["status"] == "FINISHED", st
+    assert _collect(client.job_result(job2)) == _expected(spec, 2)
+
+    te1.stop()
+    jm.heartbeats.stop()
+    svc_jm.stop()
+    svc1.stop()
+
+
 def test_auto_parallelism_from_source_volume(tmp_path):
     """AdaptiveBatchScheduler analogue: parallelism=0 derives the task
     count from the declared source volume (one task per
