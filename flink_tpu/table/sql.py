@@ -10,6 +10,7 @@ group windows. Supported grammar (case-insensitive keywords):
   [HAVING <expr>]                      -- over output rows (aliases visible)
   [ORDER BY <col> [ASC|DESC] [, ...]] -- per window (streaming top-N)
   [LIMIT <n>]
+  [UNION ALL <query>]                 -- concatenate result streams
 
   <item>   := <col> | <agg>( <col> | * ) [AS <alias>]
             | WINDOW_START [AS alias] | WINDOW_END [AS alias]
@@ -110,6 +111,7 @@ class Query:
     order_by: List[Tuple[str, bool]] = dataclasses.field(
         default_factory=list)                          # (col, descending)
     limit: Optional[int] = None
+    union_all: Optional["Query"] = None               # concatenated branch
 
 
 class _Parser:
@@ -227,6 +229,11 @@ class _Parser:
             # makes a streaming equi-join finite)
             self.expect("WINDOW")
             jwindow = self.window_spec(time_col_optional=True)
+            if self.peek_upper() == "UNION":
+                raise ValueError(
+                    "UNION ALL with a join as the LEFT branch is not "
+                    "supported; put the join on the right branch"
+                )
             if self.peek() is not None:
                 raise ValueError(f"trailing tokens: {self.tokens[self.i:]}")
             if having is not None or order_by or limit is not None:
@@ -236,11 +243,16 @@ class _Parser:
             return Query(select, table, where, where_text, group_by, None,
                          JoinSpec(join[0], join[1], join[2], join[3],
                                   join[4], jwindow))
-        if self.peek() is not None:
+        union_all = None
+        if self.peek_upper() == "UNION":
+            self.next()
+            self.expect("ALL")
+            union_all = self.query()       # right-recursive: a UNION chain
+        elif self.peek() is not None:
             raise ValueError(f"trailing tokens: {self.tokens[self.i:]}")
         return Query(select, table, where, where_text, group_by, window,
                      having=having, having_text=having_text,
-                     order_by=order_by, limit=limit)
+                     order_by=order_by, limit=limit, union_all=union_all)
 
     def select_item(self) -> SelectItem:
         t = self.next()

@@ -132,6 +132,23 @@ class TableEnvironment:
     # -- queries ----------------------------------------------------------
     def sql_query(self, sql: str) -> DataStream:
         q = parse_query(sql)
+        return self._translate(q)
+
+    def _translate(self, q: Query) -> DataStream:
+        if q.union_all is not None:
+            # UNION ALL: each branch plans independently, the result
+            # streams concatenate (DataStream.union; watermarks
+            # min-combine across branches as usual). Branch schemas must
+            # agree, as in standard SQL.
+            left_cols = [i.output_name for i in q.select]
+            right_cols = [i.output_name for i in q.union_all.select]
+            if left_cols != right_cols:
+                raise ValueError(
+                    f"UNION ALL branches must produce the same columns: "
+                    f"{left_cols} vs {right_cols} (use AS aliases)"
+                )
+            left = dataclasses.replace(q, union_all=None)
+            return self._translate(left).union(self._translate(q.union_all))
         if q.table not in self._tables:
             raise KeyError(f"unknown table {q.table!r}; registered: {list(self._tables)}")
         table = self._tables[q.table]

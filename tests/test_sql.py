@@ -131,6 +131,37 @@ def test_sql_order_by_limit_per_window_topn():
         assert ns == sorted(ns, reverse=True)
 
 
+def test_sql_union_all():
+    """UNION ALL concatenates independently-planned result streams."""
+    tenv, rows = _clicks_env()
+    out = tenv.execute_sql_to_list(
+        "SELECT campaign, SUM(price) AS total FROM clicks WHERE price >= 5 "
+        "GROUP BY campaign, TUMBLE(rowtime, INTERVAL '10' SECOND) "
+        "UNION ALL "
+        "SELECT campaign, COUNT(*) AS total FROM clicks WHERE price < 5 "
+        "GROUP BY campaign, TUMBLE(rowtime, INTERVAL '10' SECOND)"
+    )
+    hi = {}
+    lo = {}
+    for r in rows:
+        if r["price"] >= 5:
+            hi[r["campaign"]] = hi.get(r["campaign"], 0) + r["price"]
+        else:
+            lo[r["campaign"]] = lo.get(r["campaign"], 0) + 1
+    expect = sorted(
+        [(c, float(t)) for c, t in hi.items()]
+        + [(c, float(t)) for c, t in lo.items()]
+    )
+    assert sorted((r["campaign"], float(r["total"])) for r in out) == expect
+
+    with pytest.raises(ValueError, match="same columns"):
+        tenv.execute_sql_to_list(
+            "SELECT campaign, SUM(price) AS total FROM clicks "
+            "GROUP BY campaign, TUMBLE(rowtime, INTERVAL '10' SECOND) "
+            "UNION ALL SELECT campaign FROM clicks"
+        )
+
+
 def test_sql_having_requires_group_by():
     tenv, _ = _clicks_env()
     with pytest.raises(ValueError, match="HAVING requires GROUP BY"):
