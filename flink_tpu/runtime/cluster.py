@@ -320,7 +320,36 @@ class JobManagerEndpoint(RpcEndpoint):
             if latest is None:
                 raise ValueError(f"no savepoint found at {savepoint_path!r}")
             data = st.load(latest[1])
-            job.completed.append((0, data["shards"], data["step"]))
+            handles = data["shards"]
+            # validate the snapshot set against the submitted spec up front:
+            # a mismatched savepoint would otherwise surface as an opaque
+            # KeyError deep inside _try_schedule/merge_shard_snapshots
+            staged_handles = any(
+                isinstance(h, dict) and "runtime" in h for h in handles.values()
+            )
+            if isinstance(spec, GraphJobSpec):
+                if set(handles) != set(range(stages)) or not all(
+                    isinstance(h, dict) and "runtime" in h
+                    for h in handles.values()
+                ):
+                    raise ValueError(
+                        f"savepoint at {savepoint_path!r} does not hold "
+                        f"per-stage runtime snapshots for stages "
+                        f"0..{stages - 1} (found keys {sorted(handles)}"
+                        f"{'' if staged_handles else ', keyed snapshots'}); "
+                        "staged jobs can only resume from a staged savepoint "
+                        "with a matching stage count (within a stage, state "
+                        "is matched by operator uid, as in the reference's "
+                        "savepoint uid mapping)"
+                    )
+            elif staged_handles:
+                raise ValueError(
+                    f"savepoint at {savepoint_path!r} holds per-stage runtime "
+                    "snapshots from a GraphJobSpec job; it cannot seed a "
+                    "keyed DistributedJobSpec (key-group state is required "
+                    "to re-shard)"
+                )
+            job.completed.append((0, handles, data["step"]))
         self._jobs[job_id] = job
         self._try_schedule(self._jobs[job_id])
         return job_id
@@ -564,9 +593,15 @@ class JobManagerEndpoint(RpcEndpoint):
             job.pending[cp_id] = {}
             job.pending_target[cp_id] = max(job.steps.values())
             for shard, gw in gws.items():
+                # margin is honored for symmetry with the keyed branch, but
+                # staged source gates CONSUME past-target requests at their
+                # next step boundary instead of declining them (the barrier
+                # defines the cut, not the step number), so staged
+                # savepoints never outrun-decline and never need the
+                # doubled-margin retry loop
                 gw.trigger_checkpoint(
                     job.job_id, job.attempt, cp_id,
-                    job.steps.get(shard, 0) + 2, shard,
+                    job.steps.get(shard, 0) + margin, shard,
                 )
             return cp_id
         gws2 = {}

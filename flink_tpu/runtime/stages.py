@@ -277,7 +277,23 @@ class BarrierAligner:
         if self.cp is None:
             self.cp = cp_id
         self.arrived.add(gate)
-        if self.arrived >= self.expected:
+        self._maybe_complete()
+
+    def on_eos(self, gate: str) -> None:
+        """End-of-stream on a gate (EndOfPartition analogue —
+        SingleCheckpointBarrierHandler.processEndOfPartition): an ended
+        channel can never deliver a barrier, so stop expecting it; an ended
+        channel also has no pre-barrier data left, so for alignment purposes
+        it counts as aligned. Without this, a stage whose upstreams end at
+        different lengths stalls forever: the shorter upstream never emits
+        the in-flight barrier, the already-paused gates never resume, and
+        the paused upstream blocks on credits."""
+        self.expected.discard(gate)
+        self.arrived.discard(gate)
+        self._maybe_complete()
+
+    def _maybe_complete(self) -> None:
+        if self.cp is not None and self.arrived >= self.expected:
             cp, self.cp, self.arrived = self.cp, None, set()
             self.on_complete(cp)
             queued, self._queued = self._queued, []
@@ -316,6 +332,8 @@ class _StageReader(SourceReader):
             except TimeoutError:
                 return _EMPTY_BATCH
             if msg is None:
+                if self._aligner is not None:     # ended gates align freely
+                    self._aligner.on_eos(self._gate)
                 return None                       # upstream stage ended
             if msg[0] == "w":
                 self._box.wm = max(self._box.wm, int(msg[1]))

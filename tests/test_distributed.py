@@ -345,6 +345,58 @@ def test_cluster_savepoint_and_resubmit(tmp_path):
     svc1.stop()
 
 
+def test_savepoint_spec_mismatch_rejected(tmp_path):
+    """submit_job(savepoint_path=...) validates the savepoint's snapshot
+    set against the submitted spec up front: per-stage runtime snapshots
+    cannot seed a keyed job (and a staged job needs a matching stage
+    layout) — the failure is a descriptive error at submit time, not a
+    KeyError deep inside scheduling."""
+    from flink_tpu.checkpoint.storage import FsCheckpointStorage
+
+    sp_dir = str(tmp_path / "staged-sp")
+    FsCheckpointStorage(sp_dir).save(1, {
+        "job": "old", "step": 7, "savepoint": True,
+        "shards": {0: {"runtime": {}, "step": 7},
+                   1: {"runtime": {}, "step": 7}},
+    })
+
+    svc_jm = RpcService()
+    jm = JobManagerEndpoint(svc_jm, heartbeat_interval=0.2,
+                            heartbeat_timeout=10.0)
+    client = svc_jm.gateway(svc_jm.address, "jobmanager")
+    with pytest.raises(RemoteRpcError, match="per-stage runtime"):
+        client.submit_job(_make_spec().to_bytes(), 2, sp_dir)
+
+    # the reverse direction: a KEYED savepoint whose shard count happens to
+    # match the stage count must not seed a staged job either
+    keyed_dir = str(tmp_path / "keyed-sp")
+    FsCheckpointStorage(keyed_dir).save(1, {
+        "job": "old", "step": 7, "savepoint": True,
+        "shards": {0: {"operator": {}, "step": 7, "results": []},
+                   1: {"operator": {}, "step": 7, "results": []}},
+    })
+    from flink_tpu.api.datastream import StreamExecutionEnvironment
+    from flink_tpu.core.watermarks import WatermarkStrategy
+    from flink_tpu.graph.transformation import plan
+    from flink_tpu.runtime.cluster import GraphJobSpec
+
+    env = StreamExecutionEnvironment.get_execution_environment()
+    (env.from_collection(
+        [(f"k{i % 3}", i * 250) for i in range(40)],
+        timestamp_fn=lambda v: v[1],
+        watermark_strategy=WatermarkStrategy.for_monotonous_timestamps(),
+    ).map(lambda v: v[0]).key_by(lambda v: v)
+     .window(TumblingEventTimeWindows.of(2000)).count()
+     .slot_sharing_group("agg").collect())
+    from flink_tpu.config import Configuration
+
+    spec2 = GraphJobSpec("staged", plan(env._sinks), Configuration())
+    with pytest.raises(RemoteRpcError, match="keyed snapshots"):
+        client.submit_job(spec2.to_bytes(), 2, keyed_dir)
+    jm.heartbeats.stop()
+    svc_jm.stop()
+
+
 def test_auto_parallelism_from_source_volume(tmp_path):
     """AdaptiveBatchScheduler analogue: parallelism=0 derives the task
     count from the declared source volume (one task per

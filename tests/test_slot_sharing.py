@@ -213,6 +213,32 @@ def test_barrier_aligner_semantics():
     assert done == [7, 8]
 
 
+def test_barrier_aligner_eos_during_alignment():
+    """EndOfPartition handling (SingleCheckpointBarrierHandler
+    .processEndOfPartition analogue): a gate that ends mid-alignment can
+    never deliver its barrier — it must count as aligned so the paused
+    gates resume instead of stalling the stage forever."""
+    from flink_tpu.runtime.stages import BarrierAligner
+
+    done = []
+    a = BarrierAligner(["x0", "x1"], False, done.append)
+    a.on_barrier("x0", 3)
+    assert a.paused("x0") and done == []
+    a.on_eos("x1")                    # shorter upstream ended barrier-less
+    assert done == [3]
+    assert not a.paused("x0")
+    # the ended gate is no longer expected by later alignments either
+    a.on_barrier("x0", 4)
+    assert done == [3, 4]
+
+    # eos with no alignment in flight: silently shrinks expectations
+    done2 = []
+    b = BarrierAligner(["y0", "y1"], False, done2.append)
+    b.on_eos("y0")
+    b.on_barrier("y1", 9)
+    assert done2 == [9]
+
+
 def test_cluster_two_stage_checkpointed_failover(tmp_path):
     """Aligned-barrier checkpoints across pipeline stages: a two-stage job
     checkpoints via barriers flowing through the exchange, a stage task
