@@ -476,6 +476,43 @@ class KeyedStream(DataStream):
         )
         return DataStream(self.env, t)
 
+    def continuous_aggregate(
+        self,
+        specs,
+        key_fields,
+        out_names,
+        mini_batch: Optional[bool] = None,
+        generate_update_before: bool = True,
+        device: Optional[bool] = None,
+        name: str = "group_agg",
+    ) -> "DataStream":
+        """Continuous (non-windowed) group aggregation emitting a retract
+        changelog — the reference's GroupAggFunction
+        (flink-table-runtime .../aggregate/GroupAggFunction.java:33).
+
+        `specs` is a list of (func, col) with func in COUNT/SUM/AVG/MIN/MAX
+        (col ignored for COUNT); `key_fields` name the key parts and
+        `out_names` the aggregate outputs in emitted rows. Input rows may
+        themselves carry changelog kinds (table/changelog.py), so cascaded
+        aggregations compose. `mini_batch=True` emits one transition per
+        distinct key per batch (MiniBatchGroupAggFunction analogue);
+        False gives the exact per-record reference emission order.
+        `device=True` keeps linear accumulators in HBM with one scatter-add
+        dispatch per batch."""
+        t = Transformation(
+            "group_agg", name, [self.transform],
+            {
+                "key_selector": self._scalar_key_selector(),
+                "specs": list(specs),
+                "key_fields": list(key_fields),
+                "out_names": list(out_names),
+                "mini_batch": mini_batch,
+                "generate_update_before": generate_update_before,
+                "device": device,
+            },
+        )
+        return DataStream(self.env, t)
+
 
 class WindowedStream:
     """Builder for windowed aggregations (WindowedStream.java;

@@ -250,6 +250,21 @@ class ExecutionOptions:
         "one (key, value) row per key — emission cost becomes independent of "
         "key cardinality (high-cardinality analytics sinks)."
     )
+    MINI_BATCH_GROUP_AGG = (
+        ConfigOptions.key("execution.group-agg.mini-batch").bool_type().default_value(True)
+    ).with_description(
+        "Continuous (non-windowed) aggregates emit one changelog transition "
+        "per distinct key per step batch (the reference's "
+        "table.exec.mini-batch optimization) instead of per input record. "
+        "Set to false for the exact per-record emission sequence."
+    )
+    DEVICE_GROUP_AGG = (
+        ConfigOptions.key("execution.group-agg.device").bool_type().default_value(False)
+    ).with_description(
+        "Keep continuous-aggregation accumulators in device HBM with one "
+        "scatter-add dispatch per batch (COUNT/SUM/AVG only; MIN/MAX need "
+        "the host retractable multiset)."
+    )
 
 
 class CheckpointingOptions:

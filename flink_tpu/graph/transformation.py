@@ -58,6 +58,7 @@ CHAINABLE = {"map", "map_ts", "map_batch", "flat_map", "filter", "process"}
 # single-input stateful/boundary terminals
 TERMINALS = {
     "window_aggregate", "reduce", "sink", "process_keyed", "async_map", "cep",
+    "group_agg",
     # iteration feedback edges (StreamIterationHead/Tail analogue): the tail
     # references its head out-of-band via config["head"], so the
     # transformation DAG stays acyclic and the cycle exists only at runtime
@@ -65,7 +66,7 @@ TERMINALS = {
 }
 
 # multi-input terminals (DataStream.java:111 union/connect/join surface)
-MULTI_TERMINALS = {"union", "co_map", "co_flat_map", "co_process", "window_join", "co_group", "broadcast_process"}
+MULTI_TERMINALS = {"union", "co_map", "co_flat_map", "co_process", "window_join", "co_group", "broadcast_process", "regular_join"}
 
 
 @dataclasses.dataclass

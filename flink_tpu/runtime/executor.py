@@ -1038,6 +1038,14 @@ def _make_runner(step: Step, config: Configuration) -> StepRunner:
         return BroadcastProcessRunner(step, config)
     if kind in ("window_join", "co_group"):
         return WindowJoinRunner(step, config)
+    if kind == "group_agg":
+        from flink_tpu.runtime.group_agg_operator import GroupAggRunner
+
+        return GroupAggRunner(step, config)
+    if kind == "regular_join":
+        from flink_tpu.runtime.stream_join_operator import StreamingJoinRunner
+
+        return StreamingJoinRunner(step, config)
     if kind == "iteration_head":
         return IterationHeadRunner(step)
     if kind == "iteration_tail":

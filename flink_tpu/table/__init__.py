@@ -7,3 +7,13 @@ SQL runtime uses via tvf/slicing)."""
 
 from flink_tpu.table.table_env import TableEnvironment, TableSchema
 from flink_tpu.table.sql import parse_query
+from flink_tpu.table.changelog import (
+    DELETE,
+    INSERT,
+    ROW_KIND_FIELD,
+    UPDATE_AFTER,
+    UPDATE_BEFORE,
+    materialize,
+    row_kind,
+    with_kind,
+)

@@ -5,8 +5,14 @@ group windows. Supported grammar (case-insensitive keywords):
 
   SELECT <item> [, <item>]*
   FROM <table>
+  [[LEFT|RIGHT [OUTER]|INNER] JOIN <table> ON a.col = b.col [WINDOW <window>]]
+                                      -- with WINDOW: windowed join;
+                                      -- without: regular streaming join
+                                      -- emitting a retract changelog
   [WHERE <expr>]
   [GROUP BY <col> [, <col>]* [, <window>]]
+                                      -- without a window: CONTINUOUS
+                                      -- aggregation (retract changelog)
   [HAVING <expr>]                      -- over output rows (aliases visible)
   [ORDER BY <col> [ASC|DESC] [, ...]] -- per window (streaming top-N)
   [LIMIT <n>]
@@ -86,15 +92,19 @@ class WindowSpec:
 
 @dataclasses.dataclass
 class JoinSpec:
-    """Windowed equi-join (the reference implements stream joins as coGroup
-    over a shared window; JoinedStreams.java:101)."""
+    """Equi-join. With a trailing WINDOW clause: windowed join (the
+    reference implements stream joins as coGroup over a shared window;
+    JoinedStreams.java:101). Without one: a REGULAR streaming join with
+    retraction semantics (StreamingJoinOperator.java:40) — both sides'
+    rows buffer indefinitely and the output is a changelog."""
 
     table2: str
     alias1: str
     alias2: str
     left_col: str           # qualified 'alias.col'
     right_col: str
-    window: WindowSpec
+    window: Optional[WindowSpec] = None
+    join_type: str = "inner"   # 'inner' | 'left' | 'right' (regular only)
 
 
 @dataclasses.dataclass
@@ -152,8 +162,17 @@ class _Parser:
         if self.peek_upper() == "AS":
             self.next()
             alias1 = self.next()
-        if self.peek_upper() == "JOIN":
+        join_type = "inner"
+        has_join = self.peek_upper() == "JOIN"
+        if self.peek_upper() in ("LEFT", "RIGHT", "INNER"):
+            join_type = self.next().lower()
+            if join_type != "inner" and self.peek_upper() == "OUTER":
+                self.next()
+            self.expect("JOIN")
+            has_join = True
+        elif has_join:
             self.next()
+        if has_join:
             table2 = self.next()
             alias2 = table2
             if self.peek_upper() == "AS":
@@ -225,10 +244,17 @@ class _Parser:
                 f"drop 'AS {alias1}' or add a JOIN"
             )
         if join is not None:
-            # joins take a trailing WINDOW <spec> clause (the bound that
-            # makes a streaming equi-join finite)
-            self.expect("WINDOW")
-            jwindow = self.window_spec(time_col_optional=True)
+            # an optional trailing WINDOW <spec> clause bounds the join
+            # (windowed join); without it the join is a REGULAR streaming
+            # join over unbounded state, emitting a changelog
+            jwindow = None
+            if self.peek_upper() == "WINDOW":
+                self.next()
+                jwindow = self.window_spec(time_col_optional=True)
+            if jwindow is not None and join_type != "inner":
+                raise ValueError(
+                    "LEFT/RIGHT OUTER are only supported on regular "
+                    "(non-windowed) joins")
             if self.peek_upper() == "UNION":
                 raise ValueError(
                     "UNION ALL with a join as the LEFT branch is not "
@@ -242,7 +268,7 @@ class _Parser:
                 )
             return Query(select, table, where, where_text, group_by, None,
                          JoinSpec(join[0], join[1], join[2], join[3],
-                                  join[4], jwindow))
+                                  join[4], jwindow, join_type))
         union_all = None
         if self.peek_upper() == "UNION":
             self.next()
@@ -356,7 +382,14 @@ class _Parser:
         if op not in ops:
             raise ValueError(f"unknown comparison operator {op!r}")
         fn = ops[op]
-        return lambda row: fn(lhs(row), rhs(row))
+
+        def compare(row):
+            a, b = lhs(row), rhs(row)
+            if a is None or b is None:
+                return False        # SQL three-valued logic: NULL cmp -> not TRUE
+            return fn(a, b)
+
+        return compare
 
     def operand(self):
         t = self.next()

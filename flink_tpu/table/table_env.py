@@ -226,11 +226,59 @@ class TableEnvironment:
                 "ML_PREDICT inside windowed aggregate queries is not supported; "
                 "apply it in a follow-up projection query"
             )
-        if not q.group_by or q.window is None:
+        if q.window is None:
+            # continuous (non-windowed) aggregation: emits a retract
+            # changelog (GroupAggFunction analogue; table/changelog.py)
+            return self._continuous_agg_query(q, stream)
+        if not q.group_by:
             raise NotImplementedError(
-                "aggregate queries require GROUP BY with a TUMBLE/HOP/SESSION window"
+                "windowed aggregate queries require GROUP BY columns "
+                "alongside the TUMBLE/HOP/SESSION window"
             )
         return self._grouped_window_query(q, stream)
+
+    def _continuous_agg_query(self, q: Query, stream: DataStream) -> DataStream:
+        """Non-windowed GROUP BY: continuous aggregation over the unbounded
+        stream, emitting updates/retractions as the groups evolve — the
+        reference's bread-and-butter streaming SQL
+        (StreamExecGroupAggregate -> GroupAggFunction.java:33). The result
+        is a changelog stream; registering it as a table and aggregating
+        again composes (cascading retraction)."""
+        aggs = [i for i in q.select if i.kind == "agg"]
+        if q.having is not None or q.order_by or q.limit is not None:
+            raise NotImplementedError(
+                "HAVING/ORDER BY/LIMIT on continuous (non-windowed) "
+                "aggregates are not supported; window the query or apply "
+                "them downstream"
+            )
+        if any(i.kind in ("window_start", "window_end") for i in q.select):
+            raise ValueError(
+                "WINDOW_START/WINDOW_END require a TUMBLE/HOP/SESSION window")
+        for i in q.select:
+            if i.kind == "column" and i.name not in q.group_by:
+                raise ValueError(
+                    f"SELECT column {i.name!r} must appear in GROUP BY "
+                    "(non-grouped columns are not defined for aggregates)")
+        group_cols = list(q.group_by)
+        if group_cols:
+            key_fn = (
+                (lambda row, c=group_cols[0]: row[c])
+                if len(group_cols) == 1
+                else (lambda row, cs=tuple(group_cols): tuple(row[c] for c in cs))
+            )
+        else:
+            key_fn = lambda row: 0    # noqa: E731 — global aggregate
+        specs = [(i.func, None if i.name == "*" else i.name) for i in aggs]
+        key_fields = []
+        for c in group_cols:
+            item = next((i for i in q.select
+                         if i.kind == "column" and i.name == c), None)
+            key_fields.append(item.output_name if item is not None else c)
+        out_names = [i.output_name for i in aggs]
+        keyed = stream.key_by(
+            key_fn, name=f"group_by[{','.join(group_cols) or 'GLOBAL'}]")
+        return keyed.continuous_aggregate(
+            specs, key_fields, out_names, name="sql_group_agg")
 
     def _grouped_window_query(self, q: Query, stream: DataStream) -> DataStream:
         """Windowed GROUP BY translation shared by SQL and the fluent Table
@@ -357,7 +405,7 @@ class TableEnvironment:
             raise ValueError("aggregates over a join are not supported yet")
         if any(i.kind == "ml_predict" for i in q.select):
             raise ValueError("ML_PREDICT over a join is not supported yet")
-        if j.window.kind == "session":
+        if j.window is not None and j.window.kind == "session":
             raise ValueError("session windows are not supported for joins")
 
         s1 = self._tables[q.table].stream
@@ -379,28 +427,69 @@ class TableEnvironment:
                     row[k] = v
             return row
 
-        assigner = self._assigner_for(j.window)
-        joined = (
-            s1.join(s2)
-            .where(lambda row, c=lcol: row[c])
-            .equal_to(lambda row, c=rcol: row[c])
-            .window(assigner)
-            .apply(merge, name=f"sql_join[{j.left_col}={j.right_col}]")
-        )
+        if j.window is None:
+            # REGULAR streaming join (no window bound): unbounded two-sided
+            # state with retraction output (StreamingJoinOperator.java:40)
+            from flink_tpu.graph.transformation import Transformation
+
+            t = Transformation(
+                "regular_join",
+                f"sql_regular_join[{j.left_col}={j.right_col}]",
+                [s1.transform, s2.transform],
+                {
+                    "key_selector1": lambda row, c=lcol: row[c],
+                    "key_selector2": lambda row, c=rcol: row[c],
+                    "merge_fn": merge,
+                    "join_type": j.join_type,
+                    # schema-shaped NULL rows so outer-join paddings carry
+                    # every field (as SQL NULL) for downstream predicates
+                    "null_rows": (dict.fromkeys(cols1), dict.fromkeys(cols2)),
+                },
+            )
+            joined = DataStream(self.env, t)
+        else:
+            assigner = self._assigner_for(j.window)
+            joined = (
+                s1.join(s2)
+                .where(lambda row, c=lcol: row[c])
+                .equal_to(lambda row, c=rcol: row[c])
+                .window(assigner)
+                .apply(merge, name=f"sql_join[{j.left_col}={j.right_col}]")
+            )
         if q.where is not None:
             joined = joined.filter(q.where, name=f"where[{q.where_text}]")
         cols = [i for i in q.select if i.kind == "column"]
         if any(i.kind in ("window_start", "window_end") for i in q.select):
             raise ValueError("WINDOW_START/WINDOW_END are not supported on "
                              "join projections yet")
+        from flink_tpu.table.changelog import ROW_KIND_FIELD
 
         def project(row, _cols=cols):
-            return {i.output_name: row[i.name] for i in _cols}
+            # .get: an outer join's NULL-padded side reads as None (SQL
+            # NULL); the changelog kind rides through the projection
+            out = {i.output_name: row.get(i.name) for i in _cols}
+            if ROW_KIND_FIELD in row:
+                out[ROW_KIND_FIELD] = row[ROW_KIND_FIELD]
+            return out
 
         return joined.map(project, name="sql_join_output")
 
     def execute_sql_to_list(self, sql: str) -> List[dict]:
-        """Convenience: run the query to completion, return rows."""
+        """Convenience: run the query to completion, return rows. A
+        changelog result (continuous aggregate / regular join) is
+        MATERIALIZED: the retractions are applied and the surviving rows
+        returned (the reference's retract-sink view of the stream)."""
+        from flink_tpu.table.changelog import ROW_KIND_FIELD, materialize
+
+        rows = self.execute_sql_to_changelog(sql)
+        if any(isinstance(r, dict) and ROW_KIND_FIELD in r for r in rows):
+            return materialize(rows)
+        return rows
+
+    def execute_sql_to_changelog(self, sql: str) -> List[dict]:
+        """Run the query to completion and return the RAW emitted rows —
+        for changelog queries these carry their row kinds
+        (table/changelog.py) in emission order."""
         sink = self.sql_query(sql).collect()
         self.env.execute("sql-query")
         return sink.results

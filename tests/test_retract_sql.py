@@ -1,0 +1,525 @@
+"""Continuous (non-windowed) aggregation and regular joins with
+retraction/changelog semantics.
+
+Reference semantics under test: GroupAggFunction
+(flink-table-runtime .../operators/aggregate/GroupAggFunction.java:33),
+MiniBatchGroupAggFunction (mini-batch emission), StreamingJoinOperator
+(.../operators/join/stream/StreamingJoinOperator.java:40), RowKind
+(flink-core .../types/RowKind.java:28).
+"""
+
+import random
+from collections import Counter
+
+import numpy as np
+import pytest
+
+from flink_tpu.api.datastream import StreamExecutionEnvironment
+from flink_tpu.table import TableEnvironment, TableSchema
+from flink_tpu.table.changelog import (
+    DELETE,
+    INSERT,
+    ROW_KIND_FIELD,
+    UPDATE_AFTER,
+    UPDATE_BEFORE,
+    materialize,
+    row_kind,
+    with_kind,
+)
+
+
+# ---------------------------------------------------------------------------
+# an independent per-record oracle written straight from the reference
+# semantics (GroupAggFunction.processElement)
+# ---------------------------------------------------------------------------
+
+def oracle_changelog(rows, key_of, specs, key_fields, out_names,
+                     update_before=True):
+    state = {}   # key -> {"cnt": int, "sums": [float], "msets": [Counter]}
+    out = []
+
+    def result(st):
+        vals = []
+        li = 0
+        for i, (f, col) in enumerate(specs):
+            if f == "COUNT":
+                vals.append(int(st["sums"][li])); li += 1
+            elif f == "SUM":
+                vals.append(float(st["sums"][li])); li += 1
+            elif f == "AVG":
+                vals.append(float(st["sums"][li]) / st["cnt"]); li += 1
+            elif f == "MIN":
+                vals.append(min(st["msets"][i]))
+            else:
+                vals.append(max(st["msets"][i]))
+        return tuple(vals)
+
+    def to_row(key, res, kind):
+        row = {}
+        parts = key if isinstance(key, tuple) else (key,)
+        for n, p in zip(key_fields, parts):
+            row[n] = p
+        for n, v in zip(out_names, res):
+            row[n] = v
+        row[ROW_KIND_FIELD] = kind
+        return row
+
+    for row in rows:
+        kind = row_kind(row)
+        sign = 1 if kind in ("+I", "+U") else -1
+        key = key_of(row)
+        st = state.get(key)
+        if st is None:
+            st = {"cnt": 0,
+                  "sums": [0.0] * sum(1 for f, _ in specs
+                                      if f in ("COUNT", "SUM", "AVG")),
+                  "msets": {i: Counter() for i, (f, _) in enumerate(specs)
+                            if f in ("MIN", "MAX")}}
+            state[key] = st
+        old = result(st) if st["cnt"] > 0 else None
+        st["cnt"] += sign
+        li = 0
+        for i, (f, col) in enumerate(specs):
+            if f in ("COUNT", "SUM", "AVG"):
+                v = 1.0 if f == "COUNT" else float(row[col])
+                st["sums"][li] += sign * v
+                li += 1
+            else:
+                ms = st["msets"][i]
+                if sign > 0:
+                    ms[row[col]] += 1
+                else:
+                    ms[row[col]] -= 1
+                    if ms[row[col]] == 0:
+                        del ms[row[col]]
+        if st["cnt"] == 0:
+            out.append(to_row(key, old, DELETE))
+            del state[key]
+        elif old is None:
+            out.append(to_row(key, result(st), INSERT))
+        else:
+            new = result(st)
+            if new != old:
+                if update_before:
+                    out.append(to_row(key, old, UPDATE_BEFORE))
+                out.append(to_row(key, new, UPDATE_AFTER))
+    return out
+
+
+def _run_group_agg(rows, specs, key_fields, out_names, **kw):
+    env = StreamExecutionEnvironment.get_execution_environment()
+    sink = (
+        env.from_collection(list(rows))
+        .key_by(lambda r: r["k"])
+        .continuous_aggregate(specs, key_fields, out_names, **kw)
+        .collect()
+    )
+    env.execute("group-agg")
+    return sink.results
+
+
+def _mixed_stream(n=400, n_keys=7, retract_frac=0.3, seed=5):
+    """Inserts plus retractions of previously inserted rows (a consistent
+    changelog: never retracts more than was inserted)."""
+    rng = random.Random(seed)
+    live = []
+    out = []
+    for i in range(n):
+        if live and rng.random() < retract_frac:
+            row = live.pop(rng.randrange(len(live)))
+            out.append(with_kind(row, DELETE))
+        else:
+            row = {"k": f"k{rng.randrange(n_keys)}",
+                   "v": float(rng.randrange(100))}
+            live.append(row)
+            out.append(dict(row))
+    return out
+
+
+def test_per_record_changelog_matches_oracle():
+    rows = _mixed_stream()
+    specs = [("COUNT", None), ("SUM", "v"), ("MIN", "v"), ("MAX", "v"),
+             ("AVG", "v")]
+    out_names = ["c", "s", "mn", "mx", "a"]
+    got = _run_group_agg(rows, specs, ["k"], out_names, mini_batch=False)
+    ref = oracle_changelog(rows, lambda r: r["k"], specs, ["k"], out_names)
+    assert len(got) == len(ref)
+    for g, r in zip(got, ref):
+        assert g[ROW_KIND_FIELD] == r[ROW_KIND_FIELD]
+        assert g["k"] == r["k"]
+        assert g["c"] == r["c"] and g["mn"] == r["mn"] and g["mx"] == r["mx"]
+        assert g["s"] == pytest.approx(r["s"])
+        assert g["a"] == pytest.approx(r["a"])
+
+
+def test_minibatch_materializes_identically():
+    rows = _mixed_stream(seed=11)
+    specs = [("COUNT", None), ("SUM", "v"), ("MIN", "v")]
+    names = ["c", "s", "mn"]
+    per_record = _run_group_agg(rows, specs, ["k"], names, mini_batch=False)
+    mini = _run_group_agg(rows, specs, ["k"], names, mini_batch=True)
+    # mini-batch emits FEWER transitions (one per key per batch)...
+    assert len(mini) <= len(per_record)
+    # ...but the materialized view is identical
+    key = lambda r: r["k"]  # noqa: E731
+    a = sorted(materialize(per_record), key=key)
+    b = sorted(materialize(mini), key=key)
+    assert a == b and len(a) > 0
+
+
+def test_insert_then_full_retract_emits_delete():
+    rows = [
+        {"k": "a", "v": 1.0},
+        {"k": "a", "v": 2.0},
+        with_kind({"k": "a", "v": 1.0}, DELETE),
+        with_kind({"k": "a", "v": 2.0}, DELETE),
+    ]
+    got = _run_group_agg(rows, [("COUNT", None), ("SUM", "v")], ["k"],
+                         ["c", "s"], mini_batch=False)
+    kinds = [r[ROW_KIND_FIELD] for r in got]
+    assert kinds == [INSERT, UPDATE_BEFORE, UPDATE_AFTER, UPDATE_BEFORE,
+                     UPDATE_AFTER, DELETE]
+    assert got[-1]["c"] == 1 and got[-1]["s"] == pytest.approx(2.0)
+    assert materialize(got) == []
+
+
+def test_min_recomputes_on_retraction_of_current_min():
+    rows = [
+        {"k": "a", "v": 5.0},
+        {"k": "a", "v": 3.0},
+        with_kind({"k": "a", "v": 3.0}, DELETE),   # retract the current min
+    ]
+    got = _run_group_agg(rows, [("MIN", "v")], ["k"], ["mn"],
+                         mini_batch=False)
+    assert [r["mn"] for r in got] == [5.0, 5.0, 3.0, 3.0, 5.0]
+    assert [r[ROW_KIND_FIELD] for r in got] == [
+        INSERT, UPDATE_BEFORE, UPDATE_AFTER, UPDATE_BEFORE, UPDATE_AFTER]
+
+
+def test_retracting_unseen_row_raises():
+    rows = [with_kind({"k": "a", "v": 1.0}, DELETE)]
+    with pytest.raises(Exception, match="retract"):
+        _run_group_agg(rows, [("COUNT", None)], ["k"], ["c"],
+                       mini_batch=False)
+
+
+def test_device_group_agg_matches_host():
+    rows = _mixed_stream(seed=23, n=300)
+    specs = [("COUNT", None), ("SUM", "v"), ("AVG", "v")]
+    names = ["c", "s", "a"]
+    host = _run_group_agg(rows, specs, ["k"], names, mini_batch=True)
+    dev = _run_group_agg(rows, specs, ["k"], names, mini_batch=True,
+                         device=True)
+    assert len(host) == len(dev)
+    for h, d in zip(host, dev):
+        assert h[ROW_KIND_FIELD] == d[ROW_KIND_FIELD] and h["k"] == d["k"]
+        assert h["c"] == d["c"]
+        assert d["s"] == pytest.approx(h["s"], rel=1e-5)
+        assert d["a"] == pytest.approx(h["a"], rel=1e-5)
+
+
+def test_group_agg_snapshot_restore():
+    from flink_tpu.config import Configuration
+    from flink_tpu.graph.transformation import Step, Transformation
+    from flink_tpu.runtime.group_agg_operator import GroupAggRunner
+
+    def make():
+        t = Transformation("group_agg", "ga", [], {
+            "key_selector": lambda r: r["k"],
+            "specs": [("COUNT", None), ("SUM", "v"), ("MIN", "v")],
+            "key_fields": ["k"], "out_names": ["c", "s", "mn"],
+            "mini_batch": False, "device": False,
+        })
+        return GroupAggRunner(Step(chain=[], terminal=t, partitioning="forward",
+                                   inputs=[]), Configuration())
+
+    rows = _mixed_stream(seed=31, n=200)
+    half = len(rows) // 2
+
+    collected = []
+
+    class _Sink:
+        def on_batch(self, vals, ts):
+            collected.extend(vals.tolist())
+
+        def on_watermark(self, wm):
+            pass
+
+    r1 = make()
+    r1.downstream = _Sink()
+    from flink_tpu.utils.arrays import obj_array
+
+    r1.on_batch(obj_array(rows[:half]),
+                np.arange(half, dtype=np.int64))
+    snap = r1.snapshot()
+
+    r2 = make()
+    r2.downstream = _Sink()
+    r2.restore(snap)
+    pre = len(collected)
+    r2.on_batch(obj_array(rows[half:]),
+                np.arange(half, len(rows), dtype=np.int64))
+
+    # straight-through run for reference
+    ref_collected = []
+
+    class _RefSink:
+        def on_batch(self, vals, ts):
+            ref_collected.extend(vals.tolist())
+
+        def on_watermark(self, wm):
+            pass
+
+    r3 = make()
+    r3.downstream = _RefSink()
+    r3.on_batch(obj_array(rows), np.arange(len(rows), dtype=np.int64))
+    assert collected == ref_collected
+    assert pre < len(collected)
+
+
+# ---------------------------------------------------------------------------
+# SQL end-to-end
+# ---------------------------------------------------------------------------
+
+def _sql_env(rows, name="t", fields=("k", "v")):
+    tenv = TableEnvironment()
+    tenv.from_rows(name, rows, TableSchema(list(fields)))
+    return tenv
+
+
+def test_sql_continuous_group_by():
+    rows = [{"k": f"k{i % 3}", "v": float(i)} for i in range(30)]
+    tenv = _sql_env(rows)
+    got = tenv.execute_sql_to_list(
+        "SELECT k, COUNT(*) AS c, SUM(v) AS s FROM t GROUP BY k")
+    expect = {}
+    for r in rows:
+        e = expect.setdefault(r["k"], {"k": r["k"], "c": 0, "s": 0.0})
+        e["c"] += 1
+        e["s"] += r["v"]
+    assert sorted(got, key=lambda r: r["k"]) == sorted(
+        expect.values(), key=lambda r: r["k"])
+    # the raw changelog carries retract transitions once the input spans
+    # multiple step batches (mini-batch emits one transition per key per
+    # batch, so a single-batch run is all +I)
+    from flink_tpu.config import Configuration, ExecutionOptions
+
+    conf = Configuration()
+    conf.set(ExecutionOptions.BATCH_SIZE, 8)
+    env = StreamExecutionEnvironment.get_execution_environment(conf)
+    tenv2 = TableEnvironment(env)
+    tenv2.from_rows("t", rows, TableSchema(["k", "v"]))
+    log = tenv2.execute_sql_to_changelog(
+        "SELECT k, COUNT(*) AS c FROM t GROUP BY k")
+    kinds = {r[ROW_KIND_FIELD] for r in log}
+    assert INSERT in kinds and UPDATE_AFTER in kinds and UPDATE_BEFORE in kinds
+    assert sorted(materialize(log), key=lambda r: r["k"]) == sorted(
+        (dict(k=k, c=e["c"]) for k, e in
+         ((k, v) for k, v in expect.items())), key=lambda r: r["k"])
+
+
+def test_sql_global_continuous_aggregate():
+    rows = [{"k": "x", "v": float(i)} for i in range(10)]
+    tenv = _sql_env(rows)
+    got = tenv.execute_sql_to_list("SELECT COUNT(*) AS c, SUM(v) AS s FROM t")
+    assert got == [{"c": 10, "s": float(sum(range(10)))}]
+
+
+def test_sql_cascaded_aggregation():
+    """Count-of-counts: the first aggregate's changelog feeds a second
+    continuous aggregate (cascading retraction — the reason -U/+U exist)."""
+    rows = ([{"k": "a", "v": 1.0}] * 3 + [{"k": "b", "v": 1.0}] * 3
+            + [{"k": "c", "v": 1.0}] * 2)
+    tenv = _sql_env(rows)
+    counts = tenv.sql_query("SELECT k, COUNT(*) AS c FROM t GROUP BY k")
+    tenv.register_table("counts", counts, TableSchema(["k", "c"]))
+    got = tenv.execute_sql_to_list(
+        "SELECT c, COUNT(*) AS n FROM counts GROUP BY c")
+    # two keys end at count 3, one at count 2
+    assert sorted(got, key=lambda r: r["c"]) == [
+        {"c": 2, "n": 1}, {"c": 3, "n": 2}]
+
+
+def test_sql_regular_join_inner():
+    orders = [{"oid": i, "cust": f"c{i % 3}", "amount": float(10 * i)}
+              for i in range(6)]
+    custs = [{"cust": f"c{i}", "region": f"r{i}"} for i in range(3)]
+    tenv = TableEnvironment()
+    tenv.from_rows("orders", orders,
+                   TableSchema(["oid", "cust", "amount"]))
+    tenv.from_rows("customers", custs, TableSchema(["cust", "region"]))
+    got = tenv.execute_sql_to_list(
+        "SELECT oid, region FROM orders AS o JOIN customers AS c "
+        "ON o.cust = c.cust")
+    assert sorted(got, key=lambda r: r["oid"]) == [
+        {"oid": i, "region": f"r{i % 3}"} for i in range(6)]
+
+
+def test_sql_regular_join_retraction():
+    """A retraction on one side retracts the joins it produced."""
+    orders = [{"oid": 1, "cust": "a"}, {"oid": 2, "cust": "a"},
+              with_kind({"oid": 1, "cust": "a"}, DELETE)]
+    custs = [{"cust": "a", "region": "west"}]
+    tenv = TableEnvironment()
+    tenv.from_rows("orders", orders, TableSchema(["oid", "cust"]))
+    tenv.from_rows("customers", custs, TableSchema(["cust", "region"]))
+    got = tenv.execute_sql_to_list(
+        "SELECT oid, region FROM orders AS o JOIN customers AS c "
+        "ON o.cust = c.cust")
+    assert got == [{"oid": 2, "region": "west"}]
+
+
+def test_sql_left_outer_join_padding():
+    """LEFT OUTER: unmatched left rows emit NULL-padded results that are
+    retracted when the first match arrives
+    (StreamingJoinOperator outer-state transitions)."""
+    orders = [{"oid": 1, "cust": "a"}, {"oid": 2, "cust": "zzz"}]
+    custs = [{"cust": "a", "region": "west"}]
+    tenv = TableEnvironment()
+    tenv.from_rows("orders", orders, TableSchema(["oid", "cust"]))
+    tenv.from_rows("customers", custs, TableSchema(["cust", "region"]))
+    got = tenv.execute_sql_to_list(
+        "SELECT oid, region FROM orders AS o LEFT JOIN customers AS c "
+        "ON o.cust = c.cust")
+    assert sorted(got, key=lambda r: r["oid"]) == [
+        {"oid": 1, "region": "west"}, {"oid": 2, "region": None}]
+
+
+def test_sql_windowed_join_still_works():
+    """The WINDOW clause still selects the windowed join path."""
+    q = __import__("flink_tpu.table.sql", fromlist=["parse_query"]).parse_query(
+        "SELECT a FROM t1 AS x JOIN t2 AS y ON x.k = y.k "
+        "WINDOW TUMBLE(INTERVAL '10' SECOND)")
+    assert q.join.window is not None and q.join.window.size_ms == 10_000
+    q2 = __import__("flink_tpu.table.sql", fromlist=["parse_query"]).parse_query(
+        "SELECT a FROM t1 AS x JOIN t2 AS y ON x.k = y.k")
+    assert q2.join.window is None and q2.join.join_type == "inner"
+
+
+def test_sql_null_semantics_in_aggregates():
+    """SQL NULL handling: COUNT(col)/SUM/AVG/MIN ignore NULLs, COUNT(*)
+    counts every row, SUM/MIN over only-NULLs is NULL."""
+    rows = [{"k": "a", "v": 1.0}, {"k": "a", "v": None},
+            {"k": "b", "v": None}]
+    tenv = _sql_env(rows)
+    got = tenv.execute_sql_to_list(
+        "SELECT k, COUNT(*) AS n, COUNT(v) AS nv, SUM(v) AS s, MIN(v) AS mn "
+        "FROM t GROUP BY k")
+    assert sorted(got, key=lambda r: r["k"]) == [
+        {"k": "a", "n": 2, "nv": 1, "s": 1.0, "mn": 1.0},
+        {"k": "b", "n": 1, "nv": 0, "s": None, "mn": None},
+    ]
+
+
+def test_sql_where_over_left_join_padding():
+    """A WHERE predicate over a NULL-padded outer-join row evaluates to
+    not-TRUE (SQL three-valued logic) instead of crashing."""
+    orders = [{"oid": 1, "cust": "a", "amount": 5.0},
+              {"oid": 2, "cust": "zzz", "amount": 7.0}]
+    custs = [{"cust": "a", "region": "west"}]
+    tenv = TableEnvironment()
+    tenv.from_rows("orders", orders, TableSchema(["oid", "cust", "amount"]))
+    tenv.from_rows("customers", custs, TableSchema(["cust", "region"]))
+    got = tenv.execute_sql_to_list(
+        "SELECT oid, region FROM orders AS o LEFT JOIN customers AS c "
+        "ON o.cust = c.cust WHERE region = 'west'")
+    assert got == [{"oid": 1, "region": "west"}]
+
+
+def test_materialize_keeps_duplicate_multiplicity():
+    """Joins can emit identical rows more than once; the materialized view
+    keeps the multiset count."""
+    rows = [{"k": 1}, {"k": 1}, {"k": 1},
+            with_kind({"k": 1}, DELETE)]
+    assert materialize(rows) == [{"k": 1}, {"k": 1}]
+
+
+def test_regular_join_duplicate_rows_multiset():
+    orders = [{"cust": "a", "v": 1.0}, {"cust": "a", "v": 1.0}]  # dup rows
+    custs = [{"cust": "a", "region": "west"}]
+    tenv = TableEnvironment()
+    tenv.from_rows("orders", orders, TableSchema(["cust", "v"]))
+    tenv.from_rows("customers", custs, TableSchema(["cust", "region"]))
+    got = tenv.execute_sql_to_list(
+        "SELECT v, region FROM orders AS o JOIN customers AS c "
+        "ON o.cust = c.cust")
+    assert got == [{"v": 1.0, "region": "west"}] * 2
+
+
+def test_cascaded_aggregate_over_regular_join():
+    """End/watermark discipline across the two-input join: a continuous
+    aggregate downstream of a regular join of two different-length bounded
+    sides must see exactly one end-of-input (no double flush, no premature
+    single-side watermark storm)."""
+    orders = [{"oid": i, "cust": f"c{i % 2}"} for i in range(10)]
+    custs = [{"cust": "c0", "region": "west"},
+             {"cust": "c1", "region": "east"}]
+    tenv = TableEnvironment()
+    tenv.from_rows("orders", orders, TableSchema(["oid", "cust"]))
+    tenv.from_rows("customers", custs, TableSchema(["cust", "region"]))
+    joined = tenv.sql_query(
+        "SELECT oid, region FROM orders AS o JOIN customers AS c "
+        "ON o.cust = c.cust")
+    tenv.register_table("joined", joined, TableSchema(["oid", "region"]))
+    got = tenv.execute_sql_to_list(
+        "SELECT region, COUNT(*) AS n FROM joined GROUP BY region")
+    assert sorted(got, key=lambda r: r["region"]) == [
+        {"region": "east", "n": 5}, {"region": "west", "n": 5}]
+
+
+def test_materialize_rejects_corrupt_changelog():
+    with pytest.raises(ValueError, match="not present"):
+        materialize([with_kind({"a": 1}, DELETE)])
+
+
+def test_continuous_agg_on_cluster():
+    """The continuous aggregate runs under cluster supervision as a
+    GraphJobSpec job and the collected changelog materializes to the same
+    result as the local run."""
+    import time
+
+    from flink_tpu.graph.transformation import plan
+    from flink_tpu.runtime.cluster import (
+        GraphJobSpec,
+        JobManagerEndpoint,
+        TaskExecutorEndpoint,
+    )
+    from flink_tpu.runtime.rpc import RpcService
+    from flink_tpu.config import Configuration, ExecutionOptions
+
+    rows = _mixed_stream(seed=43, n=250)
+    conf = Configuration()
+    conf.set(ExecutionOptions.BATCH_SIZE, 16)
+    env = StreamExecutionEnvironment.get_execution_environment(conf)
+    tenv = TableEnvironment(env)
+    tenv.from_rows("t", rows, TableSchema(["k", "v"]))
+    tenv.sql_query(
+        "SELECT k, COUNT(*) AS c, SUM(v) AS s FROM t GROUP BY k").collect()
+    spec = GraphJobSpec("retract-agg", plan(env._sinks), conf)
+
+    svc_jm, svc1 = RpcService(), RpcService()
+    jm = JobManagerEndpoint(svc_jm, heartbeat_interval=0.2,
+                            heartbeat_timeout=10.0)
+    te1 = TaskExecutorEndpoint(svc1, slots=1)
+    te1.connect(svc_jm.address)
+    client = svc_jm.gateway(svc_jm.address, "jobmanager")
+    job_id = client.submit_job(spec.to_bytes(), 1)
+    deadline = time.time() + 30
+    while time.time() < deadline:
+        st = client.job_status(job_id)
+        if st["status"] in ("FINISHED", "FAILED"):
+            break
+        time.sleep(0.05)
+    assert st["status"] == "FINISHED", st
+    log = client.job_result(job_id)
+    te1.stop()
+    jm.heartbeats.stop()
+    svc_jm.stop()
+    svc1.stop()
+
+    # reference: local per-record oracle, materialized
+    specs = [("COUNT", None), ("SUM", "v")]
+    ref = oracle_changelog(rows, lambda r: r["k"], specs, ["k"], ["c", "s"])
+    key = lambda r: r["k"]  # noqa: E731
+    assert sorted(materialize(log), key=key) == sorted(
+        materialize(ref), key=key)
