@@ -263,7 +263,9 @@ class ExecutionOptions:
     ).with_description(
         "Keep continuous-aggregation accumulators in device HBM with one "
         "scatter-add dispatch per batch (COUNT/SUM/AVG only; MIN/MAX need "
-        "the host retractable multiset)."
+        "the host retractable multiset). COUNT columns are int32 on device "
+        "and stay exact; SUM/AVG accumulate in float32, so very large "
+        "running sums round where the host path's float64 would not."
     )
 
 

@@ -534,6 +534,14 @@ class JobManagerEndpoint(RpcEndpoint):
         if job is None or attempt != job.attempt:
             return
         job.finished[shard] = results
+        # abort in-flight checkpoints this shard never snapshotted: a
+        # finished task can never ack, so the pending entry would hang
+        # forever (reference pre-FLIP-147 behavior: no checkpoints once a
+        # task finishes; savepoints report failure instead of hanging)
+        for cp_id in [c for c, p in job.pending.items() if shard not in p]:
+            self.decline_checkpoint(
+                job_id, attempt, shard, cp_id,
+                f"shard {shard} finished before snapshotting")
         if len(job.finished) == job.parallelism:
             job.status = "FINISHED"
             self._release_job_local_state(job)
@@ -571,6 +579,10 @@ class JobManagerEndpoint(RpcEndpoint):
             return None   # periodic checkpoints need configured storage;
             #               savepoints carry their own target directory
         if len(job.steps) < job.parallelism:
+            return None
+        if job.finished:
+            # a finished shard can never snapshot; a new trigger would
+            # only be aborted by task_finished's own guard anyway
             return None
         if job.stages > 1:
             # aligned-barrier checkpoint (CheckpointBarrier analogue): the

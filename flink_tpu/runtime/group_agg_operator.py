@@ -63,26 +63,32 @@ def _pow2(n: int) -> int:
 
 class _DeviceLinearState:
     """Linear accumulators as device columns: cnt[capacity] (live rows per
-    key) and sums[n_specs, capacity]. One jitted program per (batch-bucket,
-    uniq-bucket) pair does scatter-add + old/new gathers in a single
-    dispatch."""
+    key), sums[n_linear, capacity] (float32 signed value-sums) and
+    nn[n_linear, capacity] (int32 signed non-null counts — COUNT stays
+    EXACT; only SUM/AVG carry the documented float32 rounding). One jitted
+    program per (batch-bucket, uniq-bucket) pair does scatter-add + old/new
+    gathers in a single dispatch."""
 
-    def __init__(self, n_specs: int, capacity: int = 1024):
+    def __init__(self, n_linear: int, capacity: int = 1024):
         import jax.numpy as jnp
 
         self._jnp = jnp
         self.capacity = capacity
         # last slot is a scratch slot for padding lanes (sign 0 writes there)
         self.cnt = jnp.zeros((capacity,), dtype=jnp.int32)
-        self.sums = jnp.zeros((n_specs, capacity), dtype=jnp.float32)
+        self.sums = jnp.zeros((n_linear, capacity), dtype=jnp.float32)
+        self.nn = jnp.zeros((n_linear, capacity), dtype=jnp.int32)
         self._fns: Dict[Tuple[int, int], Any] = {}
 
     def grow(self, capacity: int) -> None:
         jnp = self._jnp
+        n_linear = self.sums.shape[0]
         cnt = jnp.zeros((capacity,), dtype=jnp.int32)
-        sums = jnp.zeros((self.sums.shape[0], capacity), dtype=jnp.float32)
+        sums = jnp.zeros((n_linear, capacity), dtype=jnp.float32)
+        nn = jnp.zeros((n_linear, capacity), dtype=jnp.int32)
         self.cnt = cnt.at[: self.capacity].set(self.cnt)
         self.sums = sums.at[:, : self.capacity].set(self.sums)
+        self.nn = nn.at[:, : self.capacity].set(self.nn)
         self.capacity = capacity
         self._fns.clear()
 
@@ -91,22 +97,25 @@ class _DeviceLinearState:
         if fn is None:
             import jax
 
-            def step(cnt, sums, slots, signs, vals, uniq):
+            def step(cnt, sums, nn, slots, signs, vals, nnvals, uniq):
                 old_cnt = cnt[uniq]
                 old_sums = sums[:, uniq]
+                old_nn = nn[:, uniq]
                 new_cnt = cnt.at[slots].add(signs)
                 new_sums = sums.at[:, slots].add(signs.astype(vals.dtype) * vals)
-                return (new_cnt, new_sums, old_cnt, old_sums,
-                        new_cnt[uniq], new_sums[:, uniq])
+                new_nn = nn.at[:, slots].add(signs * nnvals)
+                return (new_cnt, new_sums, new_nn,
+                        old_cnt, old_sums, old_nn,
+                        new_cnt[uniq], new_sums[:, uniq], new_nn[:, uniq])
 
-            fn = jax.jit(step, donate_argnums=(0, 1))
+            fn = jax.jit(step, donate_argnums=(0, 1, 2))
             self._fns[(b, u)] = fn
         return fn
 
     def apply(self, slots: np.ndarray, signs: np.ndarray, vals: np.ndarray,
-              uniq: np.ndarray):
-        """Returns (old_cnt, old_sums, new_cnt, new_sums) for `uniq` slots
-        (numpy, already sliced to the real uniq length)."""
+              nnvals: np.ndarray, uniq: np.ndarray):
+        """Returns (old_cnt, old_sums, old_nn, new_cnt, new_sums, new_nn)
+        for `uniq` slots (numpy, already sliced to the real uniq length)."""
         b, u = _pow2(len(slots)), _pow2(len(uniq))
         scratch = self.capacity - 1
         pslots = np.full(b, scratch, dtype=np.int32)
@@ -115,22 +124,27 @@ class _DeviceLinearState:
         psigns[: len(slots)] = signs
         pvals = np.zeros((vals.shape[0], b), dtype=np.float32)
         pvals[:, : len(slots)] = vals
+        pnn = np.zeros((nnvals.shape[0], b), dtype=np.int32)
+        pnn[:, : len(slots)] = nnvals
         puniq = np.full(u, scratch, dtype=np.int32)
         puniq[: len(uniq)] = uniq
         fn = self._fn(b, u)
-        self.cnt, self.sums, oc, os_, nc, ns = fn(
-            self.cnt, self.sums, pslots, psigns, pvals, puniq)
+        (self.cnt, self.sums, self.nn, oc, os_, onn, nc, ns, nnn) = fn(
+            self.cnt, self.sums, self.nn, pslots, psigns, pvals, pnn, puniq)
         n = len(uniq)
         return (np.asarray(oc)[:n], np.asarray(os_)[:, :n],
-                np.asarray(nc)[:n], np.asarray(ns)[:, :n])
+                np.asarray(onn)[:, :n], np.asarray(nc)[:n],
+                np.asarray(ns)[:, :n], np.asarray(nnn)[:, :n])
 
-    def to_host(self) -> Tuple[np.ndarray, np.ndarray]:
-        return np.asarray(self.cnt), np.asarray(self.sums)
+    def to_host(self) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+        return np.asarray(self.cnt), np.asarray(self.sums), np.asarray(self.nn)
 
-    def from_host(self, cnt: np.ndarray, sums: np.ndarray) -> None:
+    def from_host(self, cnt: np.ndarray, sums: np.ndarray,
+                  nn: np.ndarray) -> None:
         jnp = self._jnp
         self.cnt = jnp.asarray(cnt)
         self.sums = jnp.asarray(sums)
+        self.nn = jnp.asarray(nn)
         self.capacity = int(cnt.shape[0])
         self._fns.clear()
 
@@ -174,17 +188,19 @@ class GroupAggRunner(StepRunner):
         self._slots: Dict[Any, int] = {}
         self._free: List[int] = []
         self._cap = 1024
-        # two linear rows per COUNT/SUM/AVG spec: the signed value-sum and
-        # the signed NON-NULL count (SQL aggregates ignore NULL inputs;
-        # AVG divides by the non-null count, not the live-row count)
-        n_rows = 2 * len(self._linear_idx)
+        # two linear columns per COUNT/SUM/AVG spec: the signed value-sum
+        # (float) and the signed NON-NULL count (integer — exact on both
+        # paths; SQL aggregates ignore NULL inputs, and AVG divides by the
+        # non-null count, not the live-row count)
+        n_lin = len(self._linear_idx)
         if self.device:
-            self._dev = _DeviceLinearState(n_rows, self._cap)
-            self._cnt = self._sums = None
+            self._dev = _DeviceLinearState(n_lin, self._cap)
+            self._cnt = self._sums = self._nn = None
         else:
             self._dev = None
             self._cnt = np.zeros(self._cap, dtype=np.int64)
-            self._sums = np.zeros((n_rows, self._cap), dtype=np.float64)
+            self._sums = np.zeros((n_lin, self._cap), dtype=np.float64)
+            self._nn = np.zeros((n_lin, self._cap), dtype=np.int64)
         # per-key multisets for MIN/MAX: spec idx -> slot -> Counter
         self._msets: Dict[int, Dict[int, Counter]] = {
             i: {} for i in self._minmax_idx}
@@ -209,21 +225,27 @@ class GroupAggRunner(StepRunner):
                     sums = np.zeros((self._sums.shape[0], self._cap))
                     sums[:, : self._cap // 2] = self._sums
                     self._sums = sums
+                    nn = np.zeros((self._nn.shape[0], self._cap),
+                                  dtype=np.int64)
+                    nn[:, : self._cap // 2] = self._nn
+                    self._nn = nn
         self._slots[key] = slot
         return slot
 
     # -- aggregation --------------------------------------------------------
-    def _result_of(self, slot: int, cnt: int, sums: np.ndarray) -> Optional[tuple]:
-        """Aggregate outputs for one key given its live-row count and the
-        linear sums column (sums[j] for j-th linear spec)."""
+    def _result_of(self, slot: int, cnt: int, sums: np.ndarray,
+                   nns: np.ndarray) -> Optional[tuple]:
+        """Aggregate outputs for one key given its live-row count, the
+        linear sums column and the non-null count column (index j for the
+        j-th linear spec)."""
         if cnt <= 0:
             return None
         out: List[Any] = []
         li = 0
         for i, (f, _c) in enumerate(self.specs):
             if f in LINEAR_FUNCS:
-                s = float(sums[2 * li])
-                nn = int(round(float(sums[2 * li + 1])))
+                s = float(sums[li])
+                nn = int(nns[li])
                 if f == "COUNT":
                     out.append(nn)
                 elif f == "SUM":
@@ -255,9 +277,11 @@ class GroupAggRunner(StepRunner):
 
     def _apply(self, rows, tss) -> None:
         n = len(rows)
+        L = len(self._linear_idx)
         slots = np.empty(n, dtype=np.int32)
         signs = np.empty(n, dtype=np.int32)
-        vals = np.zeros((2 * len(self._linear_idx), n), dtype=np.float64)
+        vals = np.zeros((L, n), dtype=np.float64)
+        nnvals = np.zeros((L, n), dtype=np.int64)
         keys_of: Dict[int, Any] = {}
         for i, row in enumerate(rows):
             kind = row_kind(row)
@@ -274,33 +298,39 @@ class GroupAggRunner(StepRunner):
             for j, si in enumerate(self._linear_idx):
                 f, col = self.specs[si]
                 if col is None:                       # COUNT(*)
-                    v, nn = 1.0, 1.0
+                    v, nn = 1.0, 1
                 else:
                     raw = row.get(col)
                     if raw is None:                   # SQL: NULL is ignored
-                        v, nn = 0.0, 0.0
+                        v, nn = 0.0, 0
                     else:
                         v = 1.0 if f == "COUNT" else float(raw)
-                        nn = 1.0
-                vals[2 * j, i] = v
-                vals[2 * j + 1, i] = nn
+                        nn = 1
+                vals[j, i] = v
+                nnvals[j, i] = nn
         _, first_idx = np.unique(slots, return_index=True)
         uniq = slots[np.sort(first_idx)]   # distinct, first-appearance order
 
         if self._dev is not None:
-            old_cnt, old_sums, new_cnt, new_sums = self._dev.apply(
-                slots, signs, vals.astype(np.float32), uniq)
+            (old_cnt, old_sums, old_nn, new_cnt, new_sums,
+             new_nn) = self._dev.apply(
+                slots, signs, vals.astype(np.float32),
+                nnvals.astype(np.int32), uniq)
         else:
             old_cnt = self._cnt[uniq].copy()
             old_sums = self._sums[:, uniq].copy()
+            old_nn = self._nn[:, uniq].copy()
             np.add.at(self._cnt, slots, signs)
             np.add.at(self._sums.T, slots,
                       (signs.astype(np.float64) * vals).T)
+            np.add.at(self._nn.T, slots, (signs * nnvals).T)
             new_cnt = self._cnt[uniq]
             new_sums = self._sums[:, uniq]
+            new_nn = self._nn[:, uniq]
 
         # old results BEFORE multiset mutation
-        old_res = [self._result_of(int(s), int(c), old_sums[:, k])
+        old_res = [self._result_of(int(s), int(c), old_sums[:, k],
+                                   old_nn[:, k])
                    for k, (s, c) in enumerate(zip(uniq, old_cnt))]
         for i in range(n):
             slot = int(slots[i])
@@ -331,7 +361,8 @@ class GroupAggRunner(StepRunner):
                 raise ValueError(
                     f"negative live-row count for key {keys_of[slot]!r}: the "
                     "input changelog retracted more rows than it inserted")
-            new_res = self._result_of(slot, cnt_new, new_sums[:, k])
+            new_res = self._result_of(slot, cnt_new, new_sums[:, k],
+                                      new_nn[:, k])
             old = old_res[k]
             if old is None and new_res is None:
                 self._drop_key(keys_of[slot], slot)
@@ -365,9 +396,11 @@ class GroupAggRunner(StepRunner):
         if self._dev is not None:
             self._dev.cnt = self._dev.cnt.at[slot].set(0)
             self._dev.sums = self._dev.sums.at[:, slot].set(0.0)
+            self._dev.nn = self._dev.nn.at[:, slot].set(0)
         else:
             self._cnt[slot] = 0
             self._sums[:, slot] = 0.0
+            self._nn[:, slot] = 0
 
     def _row(self, key, res: tuple, kind: str) -> dict:
         row: Dict[str, Any] = {}
@@ -382,14 +415,15 @@ class GroupAggRunner(StepRunner):
 
     # -- checkpointing ------------------------------------------------------
     def snapshot(self) -> dict:
-        cnt, sums = (self._dev.to_host() if self._dev is not None
-                     else (self._cnt, self._sums))
+        cnt, sums, nn = (self._dev.to_host() if self._dev is not None
+                         else (self._cnt, self._sums, self._nn))
         return {
             "slots": dict(self._slots),
             "free": list(self._free),
             "cap": self._cap,
             "cnt": np.asarray(cnt).copy(),
             "sums": np.asarray(sums).copy(),
+            "nn": np.asarray(nn).copy(),
             "msets": {i: {s: dict(c) for s, c in d.items()}
                       for i, d in self._msets.items()},
         }
@@ -400,9 +434,19 @@ class GroupAggRunner(StepRunner):
         self._cap = snap["cap"]
         self._msets = {i: {s: Counter(c) for s, c in d.items()}
                        for i, d in snap["msets"].items()}
+        if "nn" not in snap:
+            # migrate the pre-r5 interleaved layout (savepoints are durable
+            # and user-owned): sums was [2L, cap] with value-sums on even
+            # rows and non-null counts on odd rows
+            old = np.asarray(snap["sums"])
+            snap = dict(snap)
+            snap["sums"] = old[0::2]
+            snap["nn"] = np.rint(old[1::2]).astype(np.int64)
         if self._dev is not None:
             self._dev.from_host(snap["cnt"].astype(np.int32),
-                                snap["sums"].astype(np.float32))
+                                snap["sums"].astype(np.float32),
+                                snap["nn"].astype(np.int32))
         else:
             self._cnt = snap["cnt"].astype(np.int64).copy()
             self._sums = snap["sums"].astype(np.float64).copy()
+            self._nn = snap["nn"].astype(np.int64).copy()

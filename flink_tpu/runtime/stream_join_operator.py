@@ -103,7 +103,10 @@ class StreamingJoinRunner(StepRunner):
             row = strip_kind(v)
             key = ks(row)
             f = _freeze(row)
-            matches = other.get(key)
+            # SQL equi-join: NULL never matches (not even NULL = NULL) —
+            # a NULL-keyed row joins nothing; on the outer side it stays a
+            # NULL-padded row for its whole lifetime
+            matches = None if key is None else other.get(key)
             if is_additive(kind):
                 if matches:
                     for orow, cnt in matches.values():
@@ -153,7 +156,8 @@ class StreamingJoinRunner(StepRunner):
                         del padded[f]
                         if not padded:
                             del self._padded[key]
-                if 1 - ordinal == outer and (bucket is None or key not in mine):
+                if key is not None and 1 - ordinal == outer and (
+                        bucket is None or key not in mine):
                     # this side's buffer for the key just emptied: the outer
                     # side's surviving rows fall back to NULL paddings
                     surv = other.get(key)

@@ -52,6 +52,15 @@ def with_kind(row: dict, kind: str) -> dict:
     return out
 
 
+def carry_kind(out: dict, src: dict) -> dict:
+    """Copy `src`'s changelog kind onto `out` (in place) when present — THE
+    way for projections/maps to forward a changelog row's kind; dropping it
+    silently turns retractions into inserts downstream."""
+    if ROW_KIND_FIELD in src:
+        out[ROW_KIND_FIELD] = src[ROW_KIND_FIELD]
+    return out
+
+
 def strip_kind(row: dict) -> dict:
     if ROW_KIND_FIELD not in row:
         return row

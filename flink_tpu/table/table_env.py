@@ -175,6 +175,8 @@ class TableEnvironment:
             )
         if not aggs:
             # projection (+ optional model inference) query
+            from flink_tpu.table.changelog import carry_kind
+
             cols = [i for i in q.select if i.kind == "column"]
             if preds:
                 providers = []
@@ -199,7 +201,11 @@ class TableEnvironment:
                     # per step batch (MLPredictRunner batching, on-device)
                     import numpy as _np
 
-                    outs = [{c.output_name: r[c.name] for c in _cols} for r in rows]
+                    # a changelog input's row kinds ride through inference
+                    outs = [
+                        carry_kind({c.output_name: r[c.name] for c in _cols}, r)
+                        for r in rows
+                    ]
                     for item, provider in _providers:
                         args = item.args or provider.feature_cols
                         feats = _np.asarray(
@@ -217,10 +223,11 @@ class TableEnvironment:
                     return outs
 
                 return stream.map_batch(infer_batch, name="ml_predict")
-            return stream.map(
-                lambda row, _cols=cols: {c.output_name: row[c.name] for c in _cols},
-                name="project",
-            )
+            def project(row, _cols=cols):
+                return carry_kind(
+                    {c.output_name: row[c.name] for c in _cols}, row)
+
+            return stream.map(project, name="project")
         if preds:
             raise NotImplementedError(
                 "ML_PREDICT inside windowed aggregate queries is not supported; "
@@ -277,8 +284,22 @@ class TableEnvironment:
         out_names = [i.output_name for i in aggs]
         keyed = stream.key_by(
             key_fn, name=f"group_by[{','.join(group_cols) or 'GLOBAL'}]")
-        return keyed.continuous_aggregate(
+        result = keyed.continuous_aggregate(
             specs, key_fields, out_names, name="sql_group_agg")
+        # SQL projection: GROUP BY columns not in the SELECT list must not
+        # appear in output rows (the operator needs the full key to name
+        # its fields; trim here, keeping the changelog kind — retraction
+        # stays sound because -U/-D carry the full PROJECTED row and
+        # materialization is multiset-based)
+        selected = [i.output_name for i in q.select]
+        if any(kf not in selected for kf in key_fields):
+            from flink_tpu.table.changelog import carry_kind
+
+            def trim(row, _sel=tuple(selected)):
+                return carry_kind({c: row[c] for c in _sel}, row)
+
+            result = result.map(trim, name="sql_group_agg_project")
+        return result
 
     def _grouped_window_query(self, q: Query, stream: DataStream) -> DataStream:
         """Windowed GROUP BY translation shared by SQL and the fluent Table
@@ -449,6 +470,14 @@ class TableEnvironment:
             joined = DataStream(self.env, t)
         else:
             assigner = self._assigner_for(j.window)
+            # SQL equi-join: NULL never matches (not even NULL = NULL).
+            # The windowed path is inner-only, so NULL-keyed rows can
+            # never contribute — filter them before the join buckets
+            # them under a shared None key
+            s1 = s1.filter(lambda row, c=lcol: row[c] is not None,
+                           name="null_key_filter_l")
+            s2 = s2.filter(lambda row, c=rcol: row[c] is not None,
+                           name="null_key_filter_r")
             joined = (
                 s1.join(s2)
                 .where(lambda row, c=lcol: row[c])
@@ -462,15 +491,13 @@ class TableEnvironment:
         if any(i.kind in ("window_start", "window_end") for i in q.select):
             raise ValueError("WINDOW_START/WINDOW_END are not supported on "
                              "join projections yet")
-        from flink_tpu.table.changelog import ROW_KIND_FIELD
+        from flink_tpu.table.changelog import carry_kind
 
         def project(row, _cols=cols):
             # .get: an outer join's NULL-padded side reads as None (SQL
             # NULL); the changelog kind rides through the projection
-            out = {i.output_name: row.get(i.name) for i in _cols}
-            if ROW_KIND_FIELD in row:
-                out[ROW_KIND_FIELD] = row[ROW_KIND_FIELD]
-            return out
+            return carry_kind(
+                {i.output_name: row.get(i.name) for i in _cols}, row)
 
         return joined.map(project, name="sql_join_output")
 

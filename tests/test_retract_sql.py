@@ -523,3 +523,97 @@ def test_continuous_agg_on_cluster():
     key = lambda r: r["k"]  # noqa: E731
     assert sorted(materialize(log), key=key) == sorted(
         materialize(ref), key=key)
+
+
+def test_plain_projection_preserves_row_kinds():
+    """A simple SELECT of columns over a changelog table must carry the
+    row kinds through (ADVICE r4: the plain projection dropped them, so
+    retracted states reappeared as live rows after materialization)."""
+    from flink_tpu.config import Configuration, ExecutionOptions
+
+    rows = [{"k": f"k{i % 3}", "v": float(i)} for i in range(30)]
+    conf = Configuration()
+    conf.set(ExecutionOptions.BATCH_SIZE, 8)   # multi-batch => -U/+U exist
+    env = StreamExecutionEnvironment.get_execution_environment(conf)
+    tenv = TableEnvironment(env)
+    tenv.from_rows("t", rows, TableSchema(["k", "v"]))
+    counts = tenv.sql_query("SELECT k, COUNT(*) AS c FROM t GROUP BY k")
+    tenv.register_table("counts", counts, TableSchema(["k", "c"]))
+    got = tenv.execute_sql_to_list("SELECT k, c FROM counts")
+    assert sorted(got, key=lambda r: r["k"]) == [
+        {"k": "k0", "c": 10}, {"k": "k1", "c": 10}, {"k": "k2", "c": 10}]
+
+
+def test_sql_null_join_keys_never_match():
+    """SQL equi-join semantics: NULL = NULL is not TRUE — NULL-keyed rows
+    match nothing; on the outer side they stay NULL-padded."""
+    orders = [{"oid": 1, "cust": None}, {"oid": 2, "cust": "a"}]
+    custs = [{"cust": None, "region": "limbo"}, {"cust": "a", "region": "west"}]
+    tenv = TableEnvironment()
+    tenv.from_rows("orders", orders, TableSchema(["oid", "cust"]))
+    tenv.from_rows("customers", custs, TableSchema(["cust", "region"]))
+    got = tenv.execute_sql_to_list(
+        "SELECT oid, region FROM orders AS o JOIN customers AS c "
+        "ON o.cust = c.cust")
+    assert got == [{"oid": 2, "region": "west"}]
+
+    tenv2 = TableEnvironment()
+    tenv2.from_rows("orders", orders, TableSchema(["oid", "cust"]))
+    tenv2.from_rows("customers", custs, TableSchema(["cust", "region"]))
+    got2 = tenv2.execute_sql_to_list(
+        "SELECT oid, region FROM orders AS o LEFT JOIN customers AS c "
+        "ON o.cust = c.cust")
+    assert sorted(got2, key=lambda r: r["oid"]) == [
+        {"oid": 1, "region": None}, {"oid": 2, "region": "west"}]
+
+
+def test_sql_null_join_key_retraction():
+    """Retracting a NULL-keyed outer row retracts its padding (and only
+    its padding)."""
+    orders = [{"oid": 1, "cust": None}, with_kind({"oid": 1, "cust": None}, DELETE),
+              {"oid": 2, "cust": None}]
+    custs = [{"cust": None, "region": "limbo"}]
+    tenv = TableEnvironment()
+    tenv.from_rows("orders", orders, TableSchema(["oid", "cust"]))
+    tenv.from_rows("customers", custs, TableSchema(["cust", "region"]))
+    got = tenv.execute_sql_to_list(
+        "SELECT oid, region FROM orders AS o LEFT JOIN customers AS c "
+        "ON o.cust = c.cust")
+    assert got == [{"oid": 2, "region": None}]
+
+
+def test_groupby_column_not_in_select_is_projected_away():
+    """SELECT COUNT(*) FROM t GROUP BY k must not leak 'k' into output
+    rows (SQL projection; ADVICE r4)."""
+    rows = [{"k": "a"}, {"k": "a"}, {"k": "b"}]
+    tenv = _sql_env(rows, fields=("k",))
+    got = tenv.execute_sql_to_list("SELECT COUNT(*) AS c FROM t GROUP BY k")
+    assert sorted(r["c"] for r in got) == [1, 2]
+    assert all(set(r) == {"c"} for r in got)
+
+
+def test_checkpoint_aborted_when_shard_finishes_before_ack():
+    """A shard that finishes while a checkpoint/savepoint is pending can
+    never ack it; the JM must abort/decline the pending entry instead of
+    hanging silently (ADVICE r4; reference: no checkpoints after tasks
+    finish, pre-FLIP-147)."""
+    from flink_tpu.runtime.cluster import JobManagerEndpoint, _JobState
+    from flink_tpu.runtime.rpc import RpcService
+
+    svc = RpcService()
+    try:
+        jm = JobManagerEndpoint(svc, heartbeat_interval=60, heartbeat_timeout=60)
+        job = _JobState(job_id="j", blob_key="b", parallelism=2,
+                        spec_name="s", status="RUNNING")
+        job.steps = {0: 5, 1: 5}
+        job.pending[7] = {0: {"step": 5}}       # shard 1 never acked
+        job.pending_target[7] = 6
+        job.savepoint_paths[7] = ("/tmp/sp", 2)
+        jm._jobs["j"] = job
+        jm.task_finished("j", 0, 1, [])
+        assert 7 not in job.pending and 7 not in job.pending_target
+        assert job.failed_savepoints and "finished" in job.failed_savepoints[0]
+        # and no NEW trigger is accepted once a shard has finished
+        assert jm.trigger_checkpoint("j", for_savepoint=True) is None
+    finally:
+        svc.stop()
