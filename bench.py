@@ -83,6 +83,24 @@ def _emit(obj):
 #   watermark after step t: (t+1)*STEP_MS - WM_DELAY_MS
 # ---------------------------------------------------------------------------
 
+def hbm_gbps(events: int, elapsed_s: float, *, batch: int,
+             num_keys: int = NUM_KEYS, num_slices: int = 32,
+             bytes_per_record: int = 8) -> float:
+    """Achieved HBM bandwidth implied by a measured run (roofline seed).
+
+    Pure arithmetic from quantities already in hand (T, B, K, S) — no
+    profiler: each ingested record streams its key + slice id through the
+    kernel (2 x int32 = 8 B; value aggs pass bytes_per_record=12), and
+    every step reads AND writes the [K, S] int32 slice ring
+    (2*K*S*4 B, steps = events/batch). Fire/purge readbacks and padding
+    are ignored, so this is a LOWER bound on real traffic — paired with
+    the chip's HBM spec it answers "how close to the roofline?" for
+    BENCH_*.json consumers."""
+    steps = events / max(batch, 1)
+    bytes_moved = events * bytes_per_record + steps * 2 * num_keys * num_slices * 4
+    return bytes_moved / max(elapsed_s, 1e-9) / 1e9
+
+
 def step_bounds(t: int, B: int, slide_ms: int = SLIDE_MS):
     """Inclusive (smin, smax) slice bounds of step t's records."""
     smin = max((t * STEP_MS + STEP_MS // B - OOO_MS) // slide_ms, 0)
@@ -360,12 +378,14 @@ def child_tpu(T: int, B: int, spans: int) -> None:
     _emit({"event": "backend_ready", "platform": devs[0].platform,
            "init_s": round(time.perf_counter() - t0, 1)})
 
-    def result_json(tps, vsb, parity, checked, lat_ms, events, extra):
+    def result_json(tps, vsb, parity, checked, lat_ms, events, extra,
+                    batch_size=B):
         res = {
             "metric": "ysb_sliding_count_tuples_per_sec",
             "value": round(tps, 1),
             "unit": "tuples/s/chip",
             "vs_baseline": round(vsb, 3),
+            "hbm_gbps": float(f"{hbm_gbps(events, events / max(tps, 1e-9), batch=batch_size):.3g}"),
             "parity": parity,
             "windows_checked": checked,
             "p99_flush_latency_ms": round(
@@ -407,7 +427,8 @@ def child_tpu(T: int, B: int, spans: int) -> None:
                tiny_tps, tiny_tps / cpu_tps_est, bool(ok), checked,
                last["span_latency_ms"], last["events"],
                {"partial": True, "scale": "small",
-                "wall_from_backend_ready_s": round(time.perf_counter() - t0, 1)})})
+                "wall_from_backend_ready_s": round(time.perf_counter() - t0, 1)},
+               batch_size=tiny_B)})
 
     # ---- main run ----
     t_compile = time.perf_counter()
@@ -790,6 +811,7 @@ def child_cpu(T: int, B: int, spans: int) -> None:
         "value": round(tps, 1),
         "unit": "tuples/s/chip",
         "vs_baseline": round(tps / cpu_tps, 3),
+        "hbm_gbps": float(f"{hbm_gbps(n, elapsed, batch=B):.3g}"),
         "cpu_baseline_tuples_per_sec": round(cpu_tps, 1),
         "parity": bool(ok),
         "windows_checked": checked,

@@ -264,7 +264,9 @@ class ExecutionOptions:
         "Keep continuous-aggregation accumulators in device HBM with one "
         "scatter-add dispatch per batch (COUNT/SUM/AVG only; MIN/MAX need "
         "the host retractable multiset). COUNT columns are int32 on device "
-        "and stay exact; SUM/AVG accumulate in float32, so very large "
+        "and stay exact up to int32 range — a key whose count ever exceeds "
+        "~2.1e9 increments (2**31 - 1) wraps where the host path's Python "
+        "ints would not; SUM/AVG accumulate in float32, so very large "
         "running sums round where the host path's float64 would not."
     )
 
@@ -287,6 +289,74 @@ class DeviceOptions:
 class MetricOptions:
     LATENCY_INTERVAL_MS = ConfigOptions.key("metrics.latency.interval").duration_ms_type().default_value(0)
     REPORTERS = ConfigOptions.key("metrics.reporters").list_type().default_value([])
+
+
+class SecurityOptions:
+    """Transport security (reference: SecurityOptions + security.ssl.internal.*).
+
+    One per-cluster shared secret authenticates every internal plane (RPC,
+    dataplane exchange, blob) via a connection handshake + per-frame HMACs,
+    and derives the REST bearer token. Resolution order for the secret:
+    `security.transport.secret` > `security.transport.secret-file` (e.g. a
+    mounted K8s Secret) > `FLINK_TPU_SECURITY_TRANSPORT_SECRET[_FILE]` env
+    > an auto-generated per-user secret file (0600) shared by all local
+    processes. See flink_tpu/security/transport.py."""
+
+    TRANSPORT_ENABLED = (
+        ConfigOptions.key("security.transport.enabled").bool_type().default_value(True)
+    ).with_description(
+        "Authenticate and MAC-sign every internal network frame (RPC, "
+        "dataplane, blob) and deserialize through the restricted allowlist. "
+        "Set to false to restore the legacy plaintext protocol for local "
+        "debugging — never on a network you do not fully trust."
+    )
+    TRANSPORT_SECRET = (
+        ConfigOptions.key("security.transport.secret").string_type().no_default_value()
+    ).with_description(
+        "Per-cluster shared secret. Prefer security.transport.secret-file "
+        "(or the env vars) so the secret stays out of config files."
+    )
+    TRANSPORT_SECRET_FILE = (
+        ConfigOptions.key("security.transport.secret-file").string_type().no_default_value()
+    ).with_fallback_keys(
+        # Configuration.from_env maps FLINK_TPU_SECURITY_TRANSPORT_SECRET_FILE
+        # to the all-dots form; accept both spellings
+        "security.transport.secret.file",
+    ).with_description(
+        "Path to a file holding the cluster secret (e.g. a mounted "
+        "Kubernetes Secret; see flink_tpu/deploy/kubernetes.py)."
+    )
+    TRANSPORT_CLUSTER_ID = (
+        ConfigOptions.key("security.transport.cluster-id").string_type().default_value("flink-tpu")
+    ).with_fallback_keys("security.transport.cluster.id").with_description(
+        "Cluster identity exchanged in the connection handshake; peers from "
+        "a different cluster are rejected even when they share a secret."
+    )
+    SSL_INTERNAL_ENABLED = (
+        ConfigOptions.key("security.ssl.internal.enabled").bool_type().default_value(False)
+    ).with_description(
+        "Layer TLS (stdlib ssl) under the HMAC framing on internal "
+        "connections, mirroring the reference's security.ssl.internal.*."
+    )
+    SSL_INTERNAL_CERT = (
+        ConfigOptions.key("security.ssl.internal.cert").string_type().no_default_value()
+    ).with_description("PEM certificate chain presented by this process.")
+    SSL_INTERNAL_KEY = (
+        ConfigOptions.key("security.ssl.internal.key").string_type().no_default_value()
+    ).with_description("PEM private key for security.ssl.internal.cert.")
+    SSL_INTERNAL_CA = (
+        ConfigOptions.key("security.ssl.internal.ca").string_type().no_default_value()
+    ).with_description(
+        "PEM CA bundle peers must chain to; when set on the server side, "
+        "client certificates are required (mutual TLS)."
+    )
+    REST_AUTH_ENABLED = (
+        ConfigOptions.key("security.rest.auth.enabled").bool_type().default_value(False)
+    ).with_description(
+        "Require `Authorization: Bearer <token>` on the REST API, with the "
+        "token derived from the cluster secret "
+        "(flink_tpu.security.rest_bearer_token)."
+    )
 
 
 class RestartOptions:

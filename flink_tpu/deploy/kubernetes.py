@@ -13,11 +13,19 @@ Apply with: `kubectl apply -f <(python -m flink_tpu.deploy.kubernetes ...)`.
 
 from __future__ import annotations
 
+import base64
 import json
+import secrets as _secrets
 from typing import Callable, Dict, List, Optional
 
 
 DEFAULT_IMAGE = "flink-tpu:latest"
+
+# where the cluster transport secret (flink_tpu/security) is mounted in
+# every JM/TM pod; the runtime picks it up via the env var below
+SECRET_MOUNT_PATH = "/etc/flink-tpu/secret"
+SECRET_FILE_KEY = "transport.secret"
+SECRET_ENV_VAR = "FLINK_TPU_SECURITY_TRANSPORT_SECRET_FILE"
 
 
 def _container(name: str, args: List[str], image: str, env: Dict[str, str],
@@ -47,6 +55,8 @@ class KubernetesClusterDescriptor:
         tpu_chips_per_tm: int = 0,             # google.com/tpu resource count
         jm_port: int = 6123,
         pod_decorator: Optional[Callable[[dict], dict]] = None,
+        transport_secret: Optional[str] = None,
+        secret_name: Optional[str] = None,
     ):
         self.cluster_id = cluster_id
         self.namespace = namespace
@@ -57,6 +67,22 @@ class KubernetesClusterDescriptor:
         self.tpu_chips_per_tm = tpu_chips_per_tm
         self.jm_port = jm_port
         self.pod_decorator = pod_decorator or (lambda pod: pod)
+        # transport auth (flink_tpu/security): every pod mounts one K8s
+        # Secret and points the runtime at it. Pass secret_name to reference
+        # an EXISTING Secret (holding key 'transport.secret') and keep the
+        # secret value out of the rendered manifests; otherwise a fresh
+        # random secret is generated per descriptor and rendered inline.
+        # CAUTION with the generated form: every new render carries a NEW
+        # random value, so re-applying regenerated manifests to a live
+        # cluster rotates the secret mid-flight and splits old/new pods
+        # until all restart — for anything long-lived, provision the Secret
+        # once (`kubectl create secret generic <name>
+        # --from-literal=transport.secret=$(openssl rand -hex 32)`) and
+        # render with secret_name=<name> (CLI: --secret-name), or pin the
+        # value with transport_secret= (CLI: --secret-file).
+        self.secret_name = secret_name or f"{cluster_id}-transport-secret"
+        self.render_secret = secret_name is None
+        self.transport_secret = transport_secret or _secrets.token_hex(32)
 
     # -- manifests ----------------------------------------------------------
     def jobmanager_service(self) -> dict:
@@ -75,8 +101,35 @@ class KubernetesClusterDescriptor:
             },
         }
 
+    def transport_secret_manifest(self) -> dict:
+        """The cluster transport secret as a K8s Secret (Opaque). Only part
+        of manifests() when this descriptor GENERATED the secret; with
+        secret_name= the operator provisions it out of band:
+        `kubectl create secret generic <name> --from-literal=transport.secret=...`"""
+        return {
+            "apiVersion": "v1",
+            "kind": "Secret",
+            "metadata": {"name": self.secret_name, "namespace": self.namespace,
+                         "labels": {"app": self.cluster_id}},
+            "type": "Opaque",
+            "data": {SECRET_FILE_KEY: base64.b64encode(
+                self.transport_secret.encode()).decode()},
+        }
+
+    def _mount_transport_secret(self, container: dict) -> dict:
+        container.setdefault("volumeMounts", []).append(
+            {"name": "transport-secret", "mountPath": SECRET_MOUNT_PATH,
+             "readOnly": True})
+        container["env"].append(
+            {"name": SECRET_ENV_VAR,
+             "value": f"{SECRET_MOUNT_PATH}/{SECRET_FILE_KEY}"})
+        return container
+
     def _pod(self, component: str, container: dict, extra_spec: Optional[dict] = None) -> dict:
-        spec: dict = {"containers": [container]}
+        spec: dict = {"containers": [self._mount_transport_secret(container)],
+                      "volumes": [{"name": "transport-secret",
+                                   "secret": {"secretName": self.secret_name,
+                                              "defaultMode": 0o400}}]}
         if component == "taskmanager" and self.tpu_type:
             # TPU scheduling: nodeSelector + resource request per GKE conventions
             spec["nodeSelector"] = {
@@ -137,7 +190,8 @@ class KubernetesClusterDescriptor:
         }
 
     def manifests(self) -> List[dict]:
-        return [
+        out = [self.transport_secret_manifest()] if self.render_secret else []
+        return out + [
             self.jobmanager_service(),
             self.jobmanager_deployment(),
             self.taskmanager_deployment(),
@@ -173,11 +227,30 @@ def main(argv=None) -> None:
     p.add_argument("--slots", type=int, default=1)
     p.add_argument("--tpu-type", default=None)
     p.add_argument("--tpu-chips", type=int, default=0)
+    p.add_argument("--secret-name", default=None,
+                   help="reference an existing K8s Secret (key "
+                        "'transport.secret') instead of rendering a fresh "
+                        "random one — REQUIRED for stable re-renders of a "
+                        "live cluster")
+    p.add_argument("--secret-file", default=None,
+                   help="pin the rendered Secret's value from a local file")
     a = p.parse_args(argv)
+    if a.secret_name and a.secret_file:
+        p.error("--secret-name and --secret-file are mutually exclusive: a "
+                "referenced Secret is provisioned out of band, so a pinned "
+                "local value would be silently ignored")
+    secret_value = None
+    if a.secret_file:
+        # same read path as the runtime: rejects an empty/whitespace file
+        # instead of silently rendering a fresh random secret in its place
+        from flink_tpu.security.transport import _read_secret_file
+
+        secret_value = _read_secret_file(a.secret_file).decode()
     print(KubernetesClusterDescriptor(
         a.cluster_id, namespace=a.namespace, image=a.image,
         taskmanagers=a.taskmanagers, slots_per_tm=a.slots,
         tpu_type=a.tpu_type, tpu_chips_per_tm=a.tpu_chips,
+        transport_secret=secret_value, secret_name=a.secret_name,
     ).render())
 
 

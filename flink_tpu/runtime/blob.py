@@ -4,6 +4,14 @@ Analogue of runtime/blob/BlobServer.java:88: the JobManager hosts a blob
 endpoint; TaskExecutors fetch job payloads (pickled plans, UDF closures —
 the JAR analogue) by content hash and cache them on local disk, so a plan
 is shipped once per host regardless of how many shards run there.
+
+Security: the blob endpoint rides the JM's RPC service, so every fetch is
+behind the transport handshake + per-frame MACs (flink_tpu/security) — an
+unauthenticated peer is disconnected at the JM RPC port before any request
+parses. Content addressing doubles as end-to-end integrity: BlobCache
+re-hashes fetched AND disk-cached bytes against the requested key, so a
+tampered store or cache directory cannot smuggle a different payload into
+`trusted_loads` (the reference's BlobUtils checksum discipline).
 """
 
 from __future__ import annotations
@@ -68,8 +76,16 @@ class BlobCache:
         if os.path.exists(path):
             with open(path, "rb") as f:
                 data = f.read()
-        else:
+            if hashlib.sha256(data).hexdigest() != key:
+                # corrupted/tampered local cache entry: refetch from the JM
+                os.unlink(path)
+                data = None
+        if data is None:
             data = self._gw.get(key)
+            if hashlib.sha256(data).hexdigest() != key:
+                raise ValueError(
+                    f"blob {key} failed content-hash verification after fetch"
+                )
             tmp = path + ".tmp"
             with open(tmp, "wb") as f:
                 f.write(data)

@@ -52,6 +52,7 @@ from flink_tpu.runtime.blob import BlobCache, BlobServerEndpoint
 from flink_tpu.runtime.dataplane import ExchangeServer, OutputChannel
 from flink_tpu.runtime.heartbeat import HeartbeatManager
 from flink_tpu.runtime.rpc import RpcEndpoint, RpcService
+from flink_tpu.security.framing import trusted_loads
 
 
 # ---------------------------------------------------------------------------
@@ -61,7 +62,12 @@ from flink_tpu.runtime.rpc import RpcEndpoint, RpcService
 class _PickledSpec:
     """Serialization shared by job specs: cloudpickle (when present) ships
     closures/lambdas the way the reference ships user JARs; plain picklable
-    specs need only stdlib."""
+    specs need only stdlib.
+
+    Specs are code by definition (they carry user closures), so they bypass
+    the transport allowlist — but only ever deserialize AFTER the carrying
+    connection authenticated (security/framing.py trusted_loads): the
+    user-JAR trust model of the reference."""
 
     def to_bytes(self) -> bytes:
         try:
@@ -73,7 +79,7 @@ class _PickledSpec:
 
     @staticmethod
     def from_bytes(b: bytes):
-        return pickle.loads(b)
+        return trusted_loads(b)
 
 
 @dataclass
@@ -840,7 +846,9 @@ class _ShardTask:
             if e.dst_stage == stage_idx:
                 ins[e.edge_id] = self.te.exchange.channel(cid)
             if e.src_stage == stage_idx:
-                outs[e.edge_id] = OutputChannel(self.peers[e.dst_stage], cid)
+                outs[e.edge_id] = OutputChannel(
+                    self.peers[e.dst_stage], cid,
+                    security=self.te.exchange.security)
                 out_order.append(e.edge_id)
 
         task = self
@@ -1098,7 +1106,8 @@ class _ShardTask:
         outs: Dict[int, OutputChannel] = {}
         for dst in range(P):
             outs[dst] = OutputChannel(
-                self.peers[dst], f"{self.job_id}/a{self.attempt}/{self.shard}->{dst}"
+                self.peers[dst], f"{self.job_id}/a{self.attempt}/{self.shard}->{dst}",
+                security=self.te.exchange.security,
             )
         ins = {src: self.te.exchange.channel(self._channel_id(src)) for src in range(P)}
 
@@ -1206,7 +1215,9 @@ class TaskExecutorEndpoint(RpcEndpoint):
         self.tm_id = tm_id or f"tm-{uuid.uuid4().hex[:8]}"
         self.rpc = rpc
         self.slots = slots
-        self.exchange = ExchangeServer()
+        # one SecurityConfig governs both of this TM's planes: the exchange
+        # handshakes with the same cluster secret as the RPC service
+        self.exchange = ExchangeServer(security=rpc.security)
         self._tasks: Dict[Tuple[str, int, int], _ShardTask] = {}
         # task-local state store (S11): latest acked snapshot per (job, shard)
         self._local_state: Dict[Tuple[str, int], Tuple[int, dict]] = {}
@@ -1309,6 +1320,8 @@ def main(argv: Optional[List[str]] = None) -> None:
     """`python -m flink_tpu.runtime.cluster jobmanager|taskmanager ...`"""
     import argparse
 
+    from flink_tpu.security.transport import SecurityConfig
+
     p = argparse.ArgumentParser(prog="flink_tpu.runtime.cluster")
     sub = p.add_subparsers(dest="role", required=True)
     jm = sub.add_parser("jobmanager")
@@ -1319,10 +1332,63 @@ def main(argv: Optional[List[str]] = None) -> None:
     tm = sub.add_parser("taskmanager")
     tm.add_argument("--jobmanager", required=True, help="host:port of the JM RPC service")
     tm.add_argument("--slots", type=int, default=1)
+    for sp in (jm, tm):
+        sp.add_argument(
+            "--conf", default=None,
+            help="configuration file (JSON or `key: value` subset); the "
+                 "security.* option group resolves from it, layered under "
+                 "FLINK_TPU_* env dynamic properties")
+        sp.add_argument(
+            "--secret-file", default=None,
+            help="file holding the cluster transport secret "
+                 "(default: FLINK_TPU_SECURITY_TRANSPORT_SECRET[_FILE] env, "
+                 "else an auto-generated per-user secret)")
+        sp.add_argument(
+            "--cluster-id", default=None,
+            help="handshake cluster identity (security.transport.cluster-id)")
+        sp.add_argument(
+            "--insecure", action="store_true",
+            help="disable transport auth (legacy plaintext wire; local "
+                 "debugging only)")
     args = p.parse_args(argv)
 
+    if args.insecure:
+        security = SecurityConfig.disabled()
+    else:
+        # layering: conf file (with env dynamic properties) is the base;
+        # --secret-file/--cluster-id overlay it, so e.g. ssl.internal.*
+        # from --conf still applies when the secret comes from a flag
+        security = None   # process default: env > per-user secret file
+        if args.conf:
+            from flink_tpu.config import Configuration
+
+            security = SecurityConfig.resolve(
+                Configuration.load(args.conf).add_all(Configuration.from_env()))
+        if args.secret_file or args.cluster_id:
+            import dataclasses as _dc
+            import os as _os
+
+            from flink_tpu.security.transport import (
+                ENV_CLUSTER_ID,
+                _env_or_default_secret,
+                _read_secret_file,
+            )
+
+            # the flag-less fields must match what env-only processes of
+            # the same cluster resolve (_process_default), or a flag-started
+            # JM and an env-started TM could never authenticate
+            base = security if security is not None else SecurityConfig(
+                enabled=True, secret=_env_or_default_secret(),
+                cluster_id=_os.environ.get(ENV_CLUSTER_ID, "flink-tpu"))
+            overlay = {}
+            if args.secret_file:
+                overlay["secret"] = _read_secret_file(args.secret_file)
+            if args.cluster_id:
+                overlay["cluster_id"] = args.cluster_id
+            security = _dc.replace(base, enabled=True, **overlay)
+
     if args.role == "jobmanager":
-        svc = RpcService(args.host, args.port)
+        svc = RpcService(args.host, args.port, security=security)
         JobManagerEndpoint(
             svc,
             checkpoint_dir=args.checkpoint_dir,
@@ -1330,7 +1396,7 @@ def main(argv: Optional[List[str]] = None) -> None:
         )
         print(f"jobmanager listening on {svc.address}", flush=True)
     else:
-        svc = RpcService()
+        svc = RpcService(security=security)
         te = TaskExecutorEndpoint(svc, slots=args.slots)
         te.connect(args.jobmanager)
         print(f"taskmanager {te.tm_id} registered with {args.jobmanager} "

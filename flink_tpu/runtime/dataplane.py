@@ -16,30 +16,34 @@ plane — the DCN counterpart of the reference's Netty shuffle
   trading latency for amortization the way buffer debloating resizes
   network buffers.
 
-Wire: 4-byte length + pickle of ("data", channel, seq, payload) /
+Wire (flink_tpu/security): the same handshake + MAC-signed framing as the
+RPC plane, carrying restricted-pickled ("data", channel, seq, payload) /
 ("credit", channel, n) / ("eos", channel). Payloads are columnar dicts of
-numpy arrays (the host-side RecordBatch), ready for device staging.
+numpy arrays (the host-side RecordBatch), ready for device staging. An
+exchange port is reachable from every peer host, so frames are MAC-verified
+before deserialization exactly like RPC frames; `security.transport.enabled:
+false` restores the legacy plain-pickle wire.
 """
 
 from __future__ import annotations
 
-import pickle
 import socket
 import socketserver
 import threading
 from collections import deque
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
-from flink_tpu.runtime.rpc import _recv_frame, _send_frame
-
-
-def _send_msg(sock: socket.socket, obj) -> None:
-    _send_frame(sock, pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL))
-
-
-def _recv_msg(sock: socket.socket):
-    frame = _recv_frame(sock)
-    return None if frame is None else pickle.loads(frame)
+from flink_tpu.security.framing import FrameAuthError, RestrictedUnpicklingError
+from flink_tpu.security.transport import (
+    SecurityConfig,
+    client_handshake,
+    recv_obj,
+    send_obj,
+    server_handshake,
+    validate_server_config,
+    wrap_client_socket,
+    wrap_server_socket,
+)
 
 
 class InputChannel:
@@ -87,8 +91,11 @@ class ExchangeServer:
     """One per task executor: accepts peer connections, routes messages to
     registered input channels, sends credits back on the same socket."""
 
-    def __init__(self, host: str = "127.0.0.1", port: int = 0, capacity: int = 8):
+    def __init__(self, host: str = "127.0.0.1", port: int = 0, capacity: int = 8,
+                 security: Optional[SecurityConfig] = None):
         self.capacity = capacity
+        self.security = SecurityConfig.resolve() if security is None else security
+        validate_server_config(self.security)
         self._channels: Dict[str, InputChannel] = {}
         self._lock = threading.Lock()
         server_self = self
@@ -96,20 +103,31 @@ class ExchangeServer:
         class Handler(socketserver.BaseRequestHandler):
             def handle(self):
                 sock = self.request
+                codec = None
+                if server_self.security.enabled:
+                    try:
+                        sock.settimeout(server_self.security.handshake_timeout_s)
+                        sock = wrap_server_socket(sock, server_self.security)
+                        codec = server_handshake(sock, server_self.security)
+                        sock.settimeout(None)
+                    except (FrameAuthError, OSError, ValueError):
+                        return   # unauthenticated peer: drop pre-parse
                 sock_lock = threading.Lock()
 
                 def grant_for(channel: str):
                     def grant(n: int):
                         try:
                             with sock_lock:
-                                _send_msg(sock, ("credit", channel, n))
+                                send_obj(sock, ("credit", channel, n), codec)
                         except OSError:
                             pass
                     return grant
 
                 while True:
                     try:
-                        msg = _recv_msg(sock)
+                        msg = recv_obj(sock, codec)
+                    except (FrameAuthError, RestrictedUnpicklingError):
+                        return   # tampered frame / disallowed global: drop
                     except OSError:
                         return   # abrupt peer disconnect (task cancel/kill)
                     if msg is None:
@@ -118,7 +136,7 @@ class ExchangeServer:
                     if kind == "open":
                         ch = server_self._ensure(channel, grant_for(channel))
                         with sock_lock:
-                            _send_msg(sock, ("credit", channel, ch.capacity))
+                            send_obj(sock, ("credit", channel, ch.capacity), codec)
                     elif kind == "data":
                         ch = server_self._channels.get(channel)
                         if ch is not None:
@@ -169,10 +187,21 @@ class OutputChannel:
     """Sender side: one channel to a remote InputChannel; send() blocks when
     out of credit (the reference's writer blocking on LocalBufferPool)."""
 
-    def __init__(self, address: str, channel_id: str, connect_timeout: float = 10.0):
+    def __init__(self, address: str, channel_id: str, connect_timeout: float = 10.0,
+                 security: Optional[SecurityConfig] = None):
         host, port = address.rsplit(":", 1)
-        self._sock = socket.create_connection((host, int(port)), timeout=connect_timeout)
-        self._sock.settimeout(None)
+        self.security = SecurityConfig.resolve() if security is None else security
+        sock = socket.create_connection((host, int(port)), timeout=connect_timeout)
+        self._codec = None
+        if self.security.enabled:
+            try:
+                sock = wrap_client_socket(sock, self.security)
+                self._codec = client_handshake(sock, self.security)
+            except BaseException:
+                sock.close()
+                raise
+        sock.settimeout(None)
+        self._sock = sock
         self.channel_id = channel_id
         self._credits = 0
         self._cv = threading.Condition()
@@ -182,13 +211,13 @@ class OutputChannel:
         threading.Thread(target=self._credit_loop, daemon=True,
                          name=f"credits-{channel_id}").start()
         with self._send_lock:
-            _send_msg(self._sock, ("open", channel_id))
+            send_obj(self._sock, ("open", channel_id), self._codec)
 
     def _credit_loop(self) -> None:
         while True:
             try:
-                msg = _recv_msg(self._sock)
-            except OSError:
+                msg = recv_obj(self._sock, self._codec)
+            except (OSError, FrameAuthError, RestrictedUnpicklingError):
                 msg = None
             if msg is None:
                 with self._cv:
@@ -220,7 +249,8 @@ class OutputChannel:
                 raise ConnectionError(f"exchange channel {self.channel_id} closed")
             self._credits -= 1
         with self._send_lock:
-            _send_msg(self._sock, ("data", self.channel_id, self._seq, payload))
+            send_obj(self._sock, ("data", self.channel_id, self._seq, payload),
+                     self._codec)
         self._seq += 1
 
     def available_credits(self) -> int:
@@ -229,7 +259,7 @@ class OutputChannel:
 
     def end(self) -> None:
         with self._send_lock:
-            _send_msg(self._sock, ("eos", self.channel_id))
+            send_obj(self._sock, ("eos", self.channel_id), self._codec)
 
     def close(self) -> None:
         # graceful FIN, not a hard close: an immediate close() with unread

@@ -246,6 +246,79 @@ class ChainRunner(StepRunner):
             self.downstream.on_batch(vals, ts)
 
 
+def _max_source_out_of_orderness(step: Step) -> Optional[int]:
+    """Largest bounded-out-of-orderness delay (ms) among the source
+    watermark strategies feeding `step`, walking the step DAG back to its
+    source transformations. Returns None when any reachable source uses a
+    generator whose bound is not statically knowable (punctuated/custom)."""
+    from flink_tpu.core.watermarks import BoundedOutOfOrdernessWatermarks
+
+    bound = 0
+    seen = set()
+    stack = [step]
+    while stack:
+        s = stack.pop()
+        if id(s) in seen:
+            continue
+        seen.add(id(s))
+        for edge in s.inputs:
+            producer = edge[0]
+            if isinstance(producer, Step):
+                stack.append(producer)
+                continue
+            cfg = producer.config
+            if "out_of_orderness_hint" in cfg:
+                # carved stage boundary (runtime/stages.py): the channel
+                # strategy only forwards watermarks, but the hint carries
+                # the ORIGINAL job sources' disorder bound across it
+                hint = cfg["out_of_orderness_hint"]
+                if hint is None:
+                    return None
+                bound = max(bound, hint)
+                continue
+            strategy = cfg.get("watermark_strategy")
+            if strategy is None:
+                continue     # no watermarks: never advances event time
+            gen = strategy.create_generator()
+            if not isinstance(gen, BoundedOutOfOrdernessWatermarks):
+                return None
+            bound = max(bound, gen._delay)
+    return bound
+
+
+def _session_disorder_within_gap(step: Step, assigner) -> bool:
+    """Device-session routing gate: the device operator's late contract
+    (drop records whose standalone session is already expired) matches the
+    merging oracle only while watermark out-of-orderness stays BELOW the
+    session gap — with bound >= gap a record can arrive late enough that
+    the oracle would still merge it into an open session the device path
+    already expired, i.e. silent data loss. Refuse the device operator for
+    such pipelines and fall back to the oracle with a warning.
+
+    Deliberate fail-OPEN on an unknowable bound (custom/punctuated
+    generators return None): demoting those would leave users of custom
+    strategies no way to ever select the device operator, and the common
+    in-repo opaque case (stage boundaries) now carries the real bound via
+    out_of_orderness_hint. A custom generator's author owns keeping its
+    effective lag below the session gap — the DEVICE_SESSIONS option
+    description states the contract; set it false to force the oracle."""
+    bound = _max_source_out_of_orderness(step)
+    if bound is None or bound < assigner.gap:
+        return True
+    import warnings
+
+    warnings.warn(
+        f"session windows: watermark out-of-orderness bound ({bound} ms) >= "
+        f"session gap ({assigner.gap} ms) — using the per-record oracle "
+        "operator instead of the device session operator, whose late "
+        "contract would silently drop records the oracle merges. Shrink the "
+        "out-of-orderness bound below the gap to re-enable the device path, "
+        "or set execution.window.device-sessions false to silence this.",
+        RuntimeWarning,
+    )
+    return False
+
+
 class WindowStepRunner(StepRunner):
     """Keyed window aggregation step wrapping the device or oracle operator."""
 
@@ -313,6 +386,7 @@ class WindowStepRunner(StepRunner):
             and self.window_fn is None
             and cfg["allowed_lateness"] == 0
             and not cfg["side_output_late"]
+            and _session_disorder_within_gap(step, assigner)
         ):
             # device-path sessions: per-slice fragments + vectorized
             # gap-merge (the MergingWindowSet re-design; see

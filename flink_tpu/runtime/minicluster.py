@@ -164,8 +164,15 @@ class JobClient:
 class MiniCluster:
     _shared: Optional["MiniCluster"] = None
 
-    def __init__(self):
+    def __init__(self, security=None):
         self.jobs: Dict[str, JobClient] = {}
+        # the cluster's transport-security identity (auth ON by default):
+        # in-process jobs never cross a socket, but everything layered on a
+        # MiniCluster that DOES (RestServer bearer derivation, distributed
+        # hand-off) shares this one resolved secret/cluster-id
+        from flink_tpu.security.transport import SecurityConfig
+
+        self.security = SecurityConfig.resolve() if security is None else security
 
     @classmethod
     def get_shared(cls) -> "MiniCluster":

@@ -245,12 +245,39 @@ class RestServer:
     """Threaded REST server bound to a MiniCluster (WebMonitorEndpoint)."""
 
     def __init__(self, cluster: Optional[MiniCluster] = None, port: int = 0,
-                 auth_token: Optional[str] = None):
+                 auth_token: Optional[str] = None, config=None):
         """auth_token: when set, every request must carry
-        `Authorization: Bearer <token>` (D16-minimal; the reference's SSL/
-        Kerberos plumbing is deployment-level — TLS terminates at the
-        ingress in the K8s deployment, this guards the API itself)."""
+        `Authorization: Bearer <token>` (the reference's SSL/Kerberos
+        plumbing is deployment-level — TLS terminates at the ingress in the
+        K8s deployment, this guards the API itself).
+
+        With `config` given and `security.rest.auth.enabled: true`, the
+        token derives from the SAME cluster secret that authenticates the
+        internal planes (flink_tpu.security.rest_bearer_token) — one secret
+        to provision for the whole cluster."""
         self.cluster = cluster or MiniCluster.get_shared()
+        if auth_token is None and config is not None:
+            from flink_tpu.config import SecurityOptions
+            from flink_tpu.security import SecurityConfig, rest_bearer_token
+
+            if config.get(SecurityOptions.REST_AUTH_ENABLED):
+                # explicit security.transport.* settings win; otherwise the
+                # token derives from the bound cluster's own resolved
+                # identity so REST and the internal planes share ONE secret
+                explicit = any(config.contains(o) for o in (
+                    SecurityOptions.TRANSPORT_ENABLED,
+                    SecurityOptions.TRANSPORT_SECRET,
+                    SecurityOptions.TRANSPORT_SECRET_FILE,
+                ))
+                sec = (SecurityConfig.resolve(config) if explicit
+                       else self.cluster.security)
+                if not sec.enabled:
+                    raise ValueError(
+                        "security.rest.auth.enabled requires "
+                        "security.transport.enabled (the bearer token "
+                        "derives from the transport secret)"
+                    )
+                auth_token = rest_bearer_token(sec)
         handler = type("BoundHandler", (_Handler,),
                        {"cluster": self.cluster, "auth_token": auth_token})
         self._httpd = ThreadingHTTPServer(("127.0.0.1", port), handler)

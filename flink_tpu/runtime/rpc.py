@@ -9,43 +9,37 @@ one dedicated main thread — the single-threaded actor discipline that the
 reference enforces with MainThreadValidatorUtil (MainThreadValidatorUtil.java:35)
 — so endpoint state needs no locks.
 
-Wire format: 4-byte big-endian length + pickle of
-(endpoint, method, args, kwargs) / (ok, payload). This is the DCN control
-plane; the data plane (record batches, credits) lives in dataplane.py.
+Wire format (flink_tpu/security): connection handshake (version +
+cluster-id + nonce challenge against the cluster secret), then 4-byte
+big-endian length + HMAC-signed frame of the restricted-pickled
+(endpoint, method, args, kwargs) / (ok, payload). Frames are MAC-verified
+BEFORE deserialization and deserialized through the security allowlist;
+`security.transport.enabled: false` restores the legacy plain-pickle wire.
+This is the DCN control plane; the data plane (record batches, credits)
+lives in dataplane.py.
 """
 
 from __future__ import annotations
 
-import pickle
 import socket
 import socketserver
-import struct
 import threading
 import traceback
 import uuid
 from concurrent.futures import Future
 from typing import Any, Callable, Dict, Optional
 
-
-def _send_frame(sock: socket.socket, payload: bytes) -> None:
-    sock.sendall(struct.pack(">I", len(payload)) + payload)
-
-
-def _recv_frame(sock: socket.socket) -> Optional[bytes]:
-    hdr = b""
-    while len(hdr) < 4:
-        chunk = sock.recv(4 - len(hdr))
-        if not chunk:
-            return None
-        hdr += chunk
-    (n,) = struct.unpack(">I", hdr)
-    buf = bytearray()
-    while len(buf) < n:
-        chunk = sock.recv(min(1 << 20, n - len(buf)))
-        if not chunk:
-            return None
-        buf += chunk
-    return bytes(buf)
+from flink_tpu.security.framing import FrameAuthError, RestrictedUnpicklingError
+from flink_tpu.security.transport import (
+    SecurityConfig,
+    client_handshake,
+    recv_obj,
+    send_obj,
+    server_handshake,
+    validate_server_config,
+    wrap_client_socket,
+    wrap_server_socket,
+)
 
 
 class RpcEndpoint:
@@ -105,21 +99,44 @@ class RpcEndpoint:
 
 
 class RpcService:
-    """Hosts endpoints on one TCP port; builds gateways to remote services."""
+    """Hosts endpoints on one TCP port; builds gateways to remote services.
 
-    def __init__(self, host: str = "127.0.0.1", port: int = 0):
+    `security` defaults to the process-wide SecurityConfig (auth ON): every
+    accepted connection must complete the cluster handshake before a single
+    request byte is parsed, and every frame is MAC-verified before
+    deserialization."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0,
+                 security: Optional[SecurityConfig] = None):
         self._endpoints: Dict[str, RpcEndpoint] = {}
         self._lock = threading.Lock()
+        self.security = SecurityConfig.resolve() if security is None else security
+        validate_server_config(self.security)
         service = self
 
         class Handler(socketserver.BaseRequestHandler):
             def handle(self):
+                sock = self.request
+                codec = None
+                if service.security.enabled:
+                    try:
+                        sock.settimeout(service.security.handshake_timeout_s)
+                        sock = wrap_server_socket(sock, service.security)
+                        codec = server_handshake(sock, service.security)
+                        sock.settimeout(None)
+                    except (FrameAuthError, OSError, ValueError):
+                        return   # unauthenticated peer: drop pre-parse
                 while True:
-                    frame = _recv_frame(self.request)
-                    if frame is None:
+                    try:
+                        msg = recv_obj(sock, codec)
+                    except (FrameAuthError, RestrictedUnpicklingError):
+                        return   # tampered frame / disallowed global: drop
+                    except OSError:
+                        return
+                    if msg is None:
                         return
                     try:
-                        endpoint, method, args, kwargs = pickle.loads(frame)
+                        endpoint, method, args, kwargs = msg
                         with service._lock:
                             ep = service._endpoints.get(endpoint)
                         if ep is None:
@@ -129,7 +146,7 @@ class RpcService:
                     except BaseException as e:  # noqa: BLE001 — shipped back
                         reply = (False, (type(e).__name__, str(e), traceback.format_exc()))
                     try:
-                        _send_frame(self.request, pickle.dumps(reply))
+                        send_obj(sock, reply, codec)
                     except OSError:
                         return
 
@@ -157,7 +174,7 @@ class RpcService:
             self._endpoints.pop(name, None)
 
     def gateway(self, address: str, endpoint: str, timeout: float = 10.0) -> "RpcGateway":
-        return RpcGateway(address, endpoint, timeout)
+        return RpcGateway(address, endpoint, timeout, security=self.security)
 
     def stop(self) -> None:
         with self._lock:
@@ -181,20 +198,30 @@ class RpcGateway:
     One TCP connection per gateway, serialized calls (matching the
     per-endpoint ordering guarantee of the reference's actor mailbox)."""
 
-    def __init__(self, address: str, endpoint: str, timeout: float = 10.0):
+    def __init__(self, address: str, endpoint: str, timeout: float = 10.0,
+                 security: Optional[SecurityConfig] = None):
         self._address = address
         self._endpoint = endpoint
         self._timeout = timeout
+        self._security = SecurityConfig.resolve() if security is None else security
         self._sock: Optional[socket.socket] = None
+        self._codec = None
         self._lock = threading.Lock()
 
     def _connect(self) -> socket.socket:
         if self._sock is None:
             host, port = self._address.rsplit(":", 1)
             sock = socket.create_connection((host, int(port)), timeout=self._timeout)
-            # the timeout guards CONNECT only: leaving it armed would make
-            # any invocation whose reply takes > timeout raise mid-frame and
-            # poison the connection for every later call on this gateway
+            if self._security.enabled:
+                try:
+                    sock = wrap_client_socket(sock, self._security)
+                    self._codec = client_handshake(sock, self._security)
+                except BaseException:
+                    sock.close()
+                    raise
+            # the timeout guards CONNECT + handshake only: leaving it armed
+            # would make any invocation whose reply takes > timeout raise
+            # mid-frame and poison the connection for every later call
             sock.settimeout(None)
             self._sock = sock
         return self._sock
@@ -211,6 +238,7 @@ class RpcGateway:
                 self._sock.close()
             finally:
                 self._sock = None
+                self._codec = None
 
     def __getattr__(self, method: str):
         if method.startswith("_"):
@@ -220,15 +248,15 @@ class RpcGateway:
             with self._lock:
                 sock = self._connect()
                 try:
-                    _send_frame(sock, pickle.dumps((self._endpoint, method, args, kwargs)))
-                    frame = _recv_frame(sock)
-                except OSError:
+                    send_obj(sock, (self._endpoint, method, args, kwargs), self._codec)
+                    reply = recv_obj(sock, self._codec)
+                except (OSError, FrameAuthError, RestrictedUnpicklingError):
                     self._close_locked()
                     raise
-                if frame is None:
+                if reply is None:
                     self._close_locked()
                     raise ConnectionError(f"rpc connection to {self._address} closed")
-            ok, payload = pickle.loads(frame)
+            ok, payload = reply
             if ok:
                 return payload
             raise RemoteRpcError(*payload)

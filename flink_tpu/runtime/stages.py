@@ -243,6 +243,29 @@ class _ChannelWatermarks:
         return _ChannelWatermarkGenerator(self._box)
 
 
+def _graph_disorder_bound(graph) -> "int | None":
+    """Largest bounded-out-of-orderness delay (ms) across the FULL job's
+    original sources, or None if any bound is not statically knowable.
+    Stage-in sources carry this as `out_of_orderness_hint` so operator
+    selection inside a carved stage (executor._max_source_out_of_orderness)
+    still sees the job's real disorder bound — a _ChannelWatermarks
+    generator alone would make the device-session routing gate fail open
+    across every stage boundary. Conservative: the max is over all sources,
+    not only those reaching a given window step."""
+    from flink_tpu.core.watermarks import BoundedOutOfOrdernessWatermarks
+
+    bound = 0
+    for src in graph.sources:
+        strategy = src.config.get("watermark_strategy")
+        if strategy is None:
+            continue
+        gen = strategy.create_generator()
+        if not isinstance(gen, BoundedOutOfOrdernessWatermarks):
+            return None
+        bound = max(bound, gen._delay)
+    return bound
+
+
 class BarrierAligner:
     """Aligned-barrier tracker for one stage task (the
     CheckpointBarrierHandler analogue). Gates are the stage's cross-input
@@ -480,6 +503,7 @@ def build_stage_graph(
     idx = _stage_index(graph)
     edges = cross_edges(graph)
     mine = [s for s in graph.steps if idx[id(s)] == stage_idx]
+    disorder_hint = _graph_disorder_bound(graph)   # before sources mutate
 
     for e in edges:
         if e.dst_stage == stage_idx:
@@ -492,6 +516,7 @@ def build_stage_graph(
                         in_channels[e.edge_id], cancelled, box,
                         gate=e.edge_id, aligner=aligner),
                     "watermark_strategy": _ChannelWatermarks(box),
+                    "out_of_orderness_hint": disorder_hint,
                 },
             )
             src_t.uid = f"stage-in-{e.edge_id}"

@@ -106,6 +106,15 @@ class StreamingJoinRunner(StepRunner):
             # SQL equi-join: NULL never matches (not even NULL = NULL) —
             # a NULL-keyed row joins nothing; on the outer side it stays a
             # NULL-padded row for its whole lifetime
+            if key is None and ordinal != outer:
+                # on every OTHER side (both sides of an inner join, the
+                # non-outer side of an outer join) a NULL-keyed row can
+                # never match and never pads: buffering it would only grow
+                # state without bound under NULL-keyed streams, so inserts
+                # and their retractions pass through without touching state
+                if not (is_additive(kind) or is_retractive(kind)):
+                    raise ValueError(f"unknown row kind {kind!r}")
+                continue
             matches = None if key is None else other.get(key)
             if is_additive(kind):
                 if matches:

@@ -1,0 +1,27 @@
+"""Authenticated, pickle-free transport for the runtime's network planes.
+
+- `framing`: restricted (allowlisted) deserialization + per-frame HMACs.
+- `transport`: cluster secret resolution, connection handshake, TLS.
+
+See the module docstrings for the threat model; runtime/rpc.py,
+runtime/dataplane.py, and runtime/rest.py are the integration points.
+"""
+
+from flink_tpu.security.framing import (
+    FrameAuthError,
+    FrameCodec,
+    RestrictedUnpicklingError,
+    restricted_loads,
+    trusted_loads,
+)
+from flink_tpu.security.transport import SecurityConfig, rest_bearer_token
+
+__all__ = [
+    "FrameAuthError",
+    "FrameCodec",
+    "RestrictedUnpicklingError",
+    "SecurityConfig",
+    "rest_bearer_token",
+    "restricted_loads",
+    "trusted_loads",
+]

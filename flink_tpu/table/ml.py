@@ -22,7 +22,20 @@ import numpy as np
 
 
 class PredictRuntimeProvider:
-    """SPI: batched model inference over feature columns."""
+    """SPI: batched model inference over feature columns.
+
+    **Determinism contract (changelog inputs).** ML_PREDICT over a retract
+    stream re-scores each row twice: once for the +I and once for the -D
+    that retracts it. The runtime matches a retraction to the row it
+    retracts BY VALUE (StreamingJoinRunner / group-agg multisets), so
+    `predict_batch` MUST be a pure function of its features — the -D's
+    re-scored row must reproduce the +I's scored row bit-for-bit, or the
+    retraction will not cancel its insert downstream (phantom rows in
+    joins/aggregates). Providers with dropout left on, sampling
+    temperature, non-deterministic kernels, or remote models that drift
+    between calls violate this; freeze the model (eval mode, fixed
+    weights, greedy decoding) or materialize scores before the changelog
+    fans into stateful operators."""
 
     feature_cols: List[str]
     output_names: List[str]

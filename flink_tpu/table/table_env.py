@@ -201,7 +201,13 @@ class TableEnvironment:
                     # per step batch (MLPredictRunner batching, on-device)
                     import numpy as _np
 
-                    # a changelog input's row kinds ride through inference
+                    # a changelog input's row kinds ride through inference.
+                    # NOTE: a -D row is re-scored independently of the +I it
+                    # retracts, and downstream multiset state matches the
+                    # pair BY VALUE — the provider must therefore be
+                    # deterministic over its features (see the
+                    # PredictRuntimeProvider determinism contract in
+                    # table/ml.py) or retractions will not cancel
                     outs = [
                         carry_kind({c.output_name: r[c.name] for c in _cols}, r)
                         for r in rows

@@ -9,7 +9,35 @@ drain_output / snapshot / restore)."""
 
 from __future__ import annotations
 
+import contextlib
+import secrets as _secrets
 from typing import Any, Callable, List, Optional, Tuple
+
+
+def ephemeral_transport_security(cluster_id: str = "flink-tpu-test"):
+    """A fresh random-secret SecurityConfig for one test cluster, isolated
+    from the per-user default secret (two clusters built from separate
+    calls cannot authenticate to each other)."""
+    from flink_tpu.security.transport import SecurityConfig
+
+    return SecurityConfig.with_secret(_secrets.token_hex(16), cluster_id)
+
+
+@contextlib.contextmanager
+def transport_security(sec=None):
+    """Context manager pinning the PROCESS-DEFAULT SecurityConfig — every
+    RpcService/ExchangeServer/OutputChannel/RpcGateway constructed inside
+    (without an explicit `security=`) uses `sec`. Tests run with auth on by
+    default; this is how a test opts into a known secret or into
+    SecurityConfig.disabled() for the legacy wire."""
+    from flink_tpu.security.transport import _set_process_default
+
+    sec = ephemeral_transport_security() if sec is None else sec
+    prev = _set_process_default(sec)
+    try:
+        yield sec
+    finally:
+        _set_process_default(prev)
 
 
 class KeyedWindowOperatorHarness:

@@ -84,10 +84,56 @@ def test_jax_stays_out_of_the_control_plane():
     control = ["runtime/cluster.py", "runtime/rpc.py", "runtime/blob.py",
                "runtime/heartbeat.py", "runtime/ha.py",
                "runtime/ha_kubernetes.py", "runtime/rest.py",
-               "runtime/dataplane.py"]
+               "runtime/dataplane.py",
+               "security/framing.py", "security/transport.py"]
     bad = []
     for rel in control:
         for imp in _module_level_imports(PKG / rel):
             if imp == "jax" or imp.startswith("jax."):
                 bad.append(f"{rel} imports {imp} at module level")
+    assert not bad, "\n".join(bad)
+
+
+def _pickle_load_sites(path: pathlib.Path):
+    """Every way raw deserialization can be spelled, anywhere in the file
+    (function bodies included — unlike _module_level_imports this must see
+    lazy code paths too): `pickle.loads/load(...)`, `pickle.Unpickler`
+    references, and `from pickle import loads/load/Unpickler` (which would
+    make later bare-name calls invisible to attribute matching — the
+    import itself is the violation)."""
+    tree = ast.parse(path.read_text())
+    found = []
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ImportFrom) and node.module in (
+                "pickle", "cloudpickle"):
+            for a in node.names:
+                if a.name in ("loads", "load", "Unpickler", "*"):
+                    found.append(
+                        (node.module, f"import {a.name}", node.lineno))
+        elif isinstance(node, ast.Attribute) and isinstance(node.value, ast.Name) \
+                and node.value.id in ("pickle", "cloudpickle"):
+            if node.attr in ("loads", "load", "Unpickler"):
+                found.append((node.value.id, node.attr, node.lineno))
+    return found
+
+
+def test_no_bare_pickle_loads_on_network_planes():
+    """Everything under flink_tpu/runtime/ and flink_tpu/fs/ handles bytes
+    that can originate from a socket (RPC frames, exchange batches, blob
+    payloads, object-store reads), so NO module there may deserialize with
+    pickle directly — loads/load calls, Unpickler subclassing, and
+    `from pickle import loads` are all banned; deserialization goes through
+    flink_tpu/security (restricted_loads after MAC verification;
+    trusted_loads for post-auth job specs). This lint keeps the ISSUE-1
+    fix from regressing: a new raw-pickle path on a network plane fails CI
+    before it fails an incident review."""
+    bad = []
+    for layer in ("runtime", "fs"):
+        for f in sorted((PKG / layer).rglob("*.py")):
+            for mod, what, line in _pickle_load_sites(f):
+                bad.append(
+                    f"{f.relative_to(PKG.parent)}:{line} uses {mod}.{what} "
+                    "— route it through flink_tpu.security.framing "
+                    "(restricted_loads/trusted_loads)"
+                )
     assert not bad, "\n".join(bad)

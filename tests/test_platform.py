@@ -133,13 +133,15 @@ def test_k8s_manifests_shape():
     )
     doc = json.loads(desc.render())
     kinds = [m["kind"] for m in doc["items"]]
-    assert kinds == ["Service", "Deployment", "Deployment"]
-    tm = doc["items"][2]
+    # the transport secret (flink_tpu/security) ships as a K8s Secret
+    # mounted into every pod; see tests/test_security.py for its contents
+    assert kinds == ["Secret", "Service", "Deployment", "Deployment"]
+    tm = doc["items"][3]
     assert tm["spec"]["replicas"] == 3
     tpl = tm["spec"]["template"]["spec"]
     assert tpl["nodeSelector"]["cloud.google.com/gke-tpu-accelerator"] == "v5litepod-8"
     assert tpl["containers"][0]["resources"]["limits"]["google.com/tpu"] == 4
-    jm_args = doc["items"][1]["spec"]["template"]["spec"]["containers"][0]["args"]
+    jm_args = doc["items"][2]["spec"]["template"]["spec"]["containers"][0]["args"]
     assert "jobmanager" in jm_args
 
 

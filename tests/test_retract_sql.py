@@ -617,3 +617,93 @@ def test_checkpoint_aborted_when_shard_finishes_before_ack():
         assert jm.trigger_checkpoint("j", for_savepoint=True) is None
     finally:
         svc.stop()
+
+
+# ---------------------------------------------------------------------------
+# NULL-key join state (ADVICE r5 #2): rows that can never match nor pad
+# must not be buffered
+# ---------------------------------------------------------------------------
+
+class _Capture:
+    def __init__(self):
+        self.rows = []
+
+    def on_batch(self, values, ts):
+        self.rows.extend(list(values))
+
+
+def _join_runner(join_type):
+    from flink_tpu.config import Configuration
+    from flink_tpu.graph.transformation import Step, Transformation
+    from flink_tpu.runtime.stream_join_operator import StreamingJoinRunner
+
+    t = Transformation("regular_join", "join", [], config={
+        "key_selector1": lambda r: r.get("k"),
+        "key_selector2": lambda r: r.get("k"),
+        "merge_fn": lambda a, b: {**a, **{"r": b.get("r")}},
+        "join_type": join_type,
+        "null_rows": ({"k": None, "v": None}, {"k": None, "r": None}),
+    })
+    step = Step(chain=[], terminal=t, partitioning="forward")
+    r = StreamingJoinRunner(step, Configuration())
+    r.downstream = _Capture()
+    return r
+
+
+def _feed(runner, ordinal, rows):
+    from flink_tpu.utils.arrays import obj_array
+
+    runner.on_batch_n(ordinal, obj_array(rows),
+                      np.zeros(len(rows), dtype=np.int64))
+
+
+def test_join_state_stays_bounded_under_null_keyed_stream():
+    """Inner join: NULL-keyed rows can never match on either side — a
+    stream of them must leave the per-key multiset state EMPTY instead of
+    growing without bound, and their retractions must pass through without
+    the 'retracts a row that is not buffered' error."""
+    r = _join_runner("inner")
+    null_rows = [{"k": None, "v": float(i)} for i in range(500)]
+    _feed(r, 0, null_rows)
+    _feed(r, 1, [{"k": None, "r": "x"}] * 500)
+    assert r._state[0] == {} and r._state[1] == {}       # nothing buffered
+    assert r.downstream.rows == []                       # nothing emitted
+    _feed(r, 0, [with_kind(dict(row), DELETE) for row in null_rows[:100]])
+    assert r._state[0] == {}
+    # keyed rows still join normally around the NULL traffic
+    _feed(r, 0, [{"k": "a", "v": 1.0}])
+    _feed(r, 1, [{"k": "a", "r": "west"}])
+    assert r.downstream.rows == [
+        {"k": "a", "v": 1.0, "r": "west", ROW_KIND_FIELD: INSERT}]
+
+
+def test_left_join_null_key_pads_on_outer_side_only():
+    """LEFT OUTER: a NULL-keyed LEFT row stays a NULL-padded row for its
+    whole lifetime (emitted, buffered, retractable); a NULL-keyed RIGHT
+    row can never match or pad and must not be buffered."""
+    r = _join_runner("left")
+    _feed(r, 1, [{"k": None, "r": f"r{i}"} for i in range(300)])
+    assert r._state[1] == {}                 # non-outer side: not buffered
+    _feed(r, 0, [{"k": None, "v": 7.0}])
+    assert None in r._state[0]               # outer side: buffered (padded)
+    assert r.downstream.rows == [
+        {"k": None, "v": 7.0, "r": None, ROW_KIND_FIELD: INSERT}]
+    r.downstream.rows.clear()
+    _feed(r, 0, [with_kind({"k": None, "v": 7.0}, DELETE)])
+    assert r._state[0] == {} and r._padded == {}
+    assert [row_kind(o) for o in r.downstream.rows] == [DELETE]  # pad retracted
+
+
+def test_sql_inner_join_ignores_null_keys_end_to_end():
+    """SQL surface: NULL join keys produce no matches (NULL = NULL is not
+    TRUE) and no state blowup on either side."""
+    orders = [{"oid": 1, "cust": None}, {"oid": 2, "cust": "a"},
+              {"oid": 3, "cust": None}]
+    custs = [{"cust": "a", "region": "west"}, {"cust": None, "region": "void"}]
+    tenv = TableEnvironment()
+    tenv.from_rows("orders", orders, TableSchema(["oid", "cust"]))
+    tenv.from_rows("customers", custs, TableSchema(["cust", "region"]))
+    got = tenv.execute_sql_to_list(
+        "SELECT oid, region FROM orders AS o JOIN customers AS c "
+        "ON o.cust = c.cust")
+    assert got == [{"oid": 2, "region": "west"}]

@@ -382,3 +382,124 @@ def test_session_staged_ingest_matches_host_path():
     # totals per key count)
     assert sorted(x[1:] for x in norm_h) == sorted(x[1:] for x in norm_d)
     assert len({x[0] for x in norm_h}) == len({x[0] for x in norm_d})
+
+
+def test_disorder_bound_at_or_above_gap_routes_to_oracle():
+    """Routing-semantics gate (executor.py operator selection): a watermark
+    strategy whose out-of-orderness bound >= the session gap would let the
+    device operator silently drop records the oracle merges (its late
+    contract expires a standalone session after one gap of watermark
+    progress). The planner must fall back to the oracle — and the late
+    record must actually be INCLUDED in the merged session."""
+    from flink_tpu.api.datastream import StreamExecutionEnvironment
+    from flink_tpu.core.watermarks import WatermarkStrategy
+    from flink_tpu.graph.transformation import plan
+    from flink_tpu.runtime.executor import WindowStepRunner, build_runners
+
+    gap = 2000
+    # u1's record at t=100 arrives AFTER 9000/9100 — 5s late, within the
+    # bound-5000 watermark lag but far beyond the 2000ms gap
+    data = [("u1", 9000), ("u1", 9100), ("u1", 100), ("u1", 1900),
+            ("u2", 500)]
+
+    def build():
+        env = StreamExecutionEnvironment.get_execution_environment()
+        sink = (
+            env.from_collection(
+                data, timestamp_fn=lambda x: x[1],
+                watermark_strategy=WatermarkStrategy.for_bounded_out_of_orderness(5000),
+            )
+            .key_by(lambda x: x[0])
+            .window(EventTimeSessionWindows.with_gap(gap))
+            .count()
+            .collect()
+        )
+        return env, sink
+
+    env, sink = build()
+    with pytest.warns(RuntimeWarning, match="out-of-orderness"):
+        runners, _ = build_runners(plan(env._sinks), env.config)
+    wr = [r for r in runners if isinstance(r, WindowStepRunner)]
+    assert len(wr) == 1 and isinstance(wr[0].op, OracleWindowOperator)
+
+    env2, sink2 = build()
+    with pytest.warns(RuntimeWarning, match="out-of-orderness"):
+        env2.execute()
+    # the merging oracle keeps every record: u1 {100, 1900} merges into one
+    # 2-record session, {9000, 9100} another; a silent device-side drop
+    # would have lost the t=100 record entirely
+    assert sorted(sink2.results) == [("u1", 2), ("u1", 2), ("u2", 1)]
+
+
+def test_disorder_bound_below_gap_keeps_device_operator():
+    """The gate must NOT demote eligible pipelines: bound < gap keeps the
+    device session operator selected (no warning)."""
+    import warnings as _warnings
+
+    from flink_tpu.api.datastream import StreamExecutionEnvironment
+    from flink_tpu.core.watermarks import WatermarkStrategy
+    from flink_tpu.graph.transformation import plan
+    from flink_tpu.runtime.executor import WindowStepRunner, build_runners
+
+    env = StreamExecutionEnvironment.get_execution_environment()
+    (
+        env.from_collection(
+            [("u", 0), ("u", 100)], timestamp_fn=lambda x: x[1],
+            watermark_strategy=WatermarkStrategy.for_bounded_out_of_orderness(500),
+        )
+        .key_by(lambda x: x[0])
+        .window(EventTimeSessionWindows.with_gap(2000))
+        .count()
+        .collect()
+    )
+    with _warnings.catch_warnings():
+        _warnings.simplefilter("error", RuntimeWarning)
+        runners, _ = build_runners(plan(env._sinks), env.config)
+    wr = [r for r in runners if isinstance(r, WindowStepRunner)]
+    assert isinstance(wr[0].op, TpuSessionWindowOperator)
+
+
+def test_disorder_gate_sees_bound_across_stage_boundaries():
+    """A window step carved into a downstream pipeline stage loses its
+    original source (stages.py swaps in a channel-fed stage-in source whose
+    watermark strategy is opaque); the stage-in carries the original job's
+    disorder bound as out_of_orderness_hint so the device-session routing
+    gate still fails over to the oracle when bound >= gap."""
+    from flink_tpu.graph.transformation import Step, Transformation
+    from flink_tpu.runtime.executor import _max_source_out_of_orderness
+
+    def stage_in(hint):
+        t = Transformation("source", "stage-in:e0", [], {
+            "source": object(), "watermark_strategy": object(),
+            "out_of_orderness_hint": hint,
+        })
+        return Step(chain=[], terminal=None, partitioning="key_group",
+                    inputs=[(t, 0, None)])
+
+    assert _max_source_out_of_orderness(stage_in(5000)) == 5000
+    assert _max_source_out_of_orderness(stage_in(0)) == 0
+    assert _max_source_out_of_orderness(stage_in(None)) is None  # unknowable
+
+
+def test_stage_graph_propagates_disorder_hint():
+    """build_stage_graph stamps the full job's source disorder bound onto
+    every stage-in source transformation."""
+    from flink_tpu.api.datastream import StreamExecutionEnvironment
+    from flink_tpu.core.watermarks import WatermarkStrategy
+    from flink_tpu.graph.transformation import plan
+    from flink_tpu.runtime.stages import _graph_disorder_bound
+
+    env = StreamExecutionEnvironment.get_execution_environment()
+    (
+        env.from_collection(
+            [("u", 0)], timestamp_fn=lambda x: x[1],
+            watermark_strategy=WatermarkStrategy.for_bounded_out_of_orderness(3000),
+        )
+        .key_by(lambda x: x[0])
+        .window(EventTimeSessionWindows.with_gap(1000))
+        .count()
+        .slot_sharing_group("agg")
+        .collect()
+    )
+    graph = plan(env._sinks)
+    assert _graph_disorder_bound(graph) == 3000
