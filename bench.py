@@ -73,6 +73,31 @@ def _emit(obj):
     print(json.dumps(obj), flush=True)
 
 
+def observability_snapshot(stage_time_s: Optional[dict], elapsed_s: float) -> dict:
+    """Per-stage device-time attribution + backpressure ratio for the bench
+    result JSON, plus a measured overhead check of the metric hot path (one
+    histogram update is what a latency marker costs per operator hop)."""
+    from flink_tpu.metrics.registry import Histogram
+
+    h = Histogram()
+    n = 10_000
+    t0 = time.perf_counter()
+    for i in range(n):
+        h.update(float(i))
+    marker_us = (time.perf_counter() - t0) / n * 1e6
+    stage_ms = {k: round(v * 1000.0, 1)
+                for k, v in (stage_time_s or {}).items()}
+    resolve_s = (stage_time_s or {}).get("superscan_resolve_block", 0.0)
+    return {
+        "per_stage_device_time_ms": stage_ms,
+        # host blocked on device readback / wall — the run loop's
+        # backPressuredTimeRatio analogue for the bench pipeline
+        "backpressure_ratio": round(resolve_s / max(elapsed_s, 1e-9), 4),
+        "marker_record_us": round(marker_us, 3),
+        "overhead_ok": marker_us < 50.0,
+    }
+
+
 # ---------------------------------------------------------------------------
 # deterministic stream schedule (integer math, identical on host and device)
 #
@@ -327,10 +352,17 @@ def run_tpu_stream(T: int, B: int, spans: int, depth: int, t0_step: int = 0,
         wpipe.process_superbatch(None, None, staged=stage(wpipe, t0_step))
         del wpipe
 
+    # observability: host time split per pipeline stage — plan+generate+
+    # enqueue (dispatch) vs blocked in resolve (readback; the host's
+    # "backpressured by the device" condition)
+    stage_time = {"plan_stage_dispatch": 0.0, "superscan_resolve_block": 0.0}
+
     def enqueue(i):
+        t0 = time.perf_counter()
         d = pipe.process_superbatch(
             None, None, staged=stage(pipe, t0_step + i * T), defer=True,
         )
+        stage_time["plan_stage_dispatch"] += time.perf_counter() - t0
         return d, time.perf_counter()
 
     fired = {}
@@ -343,11 +375,13 @@ def run_tpu_stream(T: int, B: int, spans: int, depth: int, t0_step: int = 0,
     resolved = 0
     while inflight:
         d, t_enq = inflight.pop(0)
+        t_res0 = time.perf_counter()
         for window, counts, fields in d.resolve():
             row = fields[resolve_field] if resolve_field else counts
             if postproc is not None:
                 row = postproc(counts, row)
             fired[window.start // slide_ms] = row
+        stage_time["superscan_resolve_block"] += time.perf_counter() - t_res0
         span_lat.append((time.perf_counter() - t_enq) * 1000.0)
         resolved += 1
         if next_i < spans:
@@ -360,6 +394,7 @@ def run_tpu_stream(T: int, B: int, spans: int, depth: int, t0_step: int = 0,
             "elapsed": elapsed,
             "fired": fired,
             "span_latency_ms": span_lat,
+            "stage_time_s": dict(stage_time),
             "final": not yield_partial,
         }
 
@@ -427,6 +462,8 @@ def child_tpu(T: int, B: int, spans: int) -> None:
                tiny_tps, tiny_tps / cpu_tps_est, bool(ok), checked,
                last["span_latency_ms"], last["events"],
                {"partial": True, "scale": "small",
+                "observability": observability_snapshot(
+                    last.get("stage_time_s"), last["elapsed"]),
                 "wall_from_backend_ready_s": round(time.perf_counter() - t0, 1)},
                batch_size=tiny_B)})
 
@@ -463,7 +500,9 @@ def child_tpu(T: int, B: int, spans: int) -> None:
         {"cpu_baseline_tuples_per_sec": round(cpu_tps, 1),
          "span_steps": T, "batch": B, "spans": spans,
          "pipeline_depth": PIPE_DEPTH,
-         "late_dropped": 0},
+         "late_dropped": 0,
+         "observability": observability_snapshot(
+             last.get("stage_time_s"), last["elapsed"])},
     )
     _emit({"event": "result", "result": res})
 
@@ -784,6 +823,7 @@ def child_cpu(T: int, B: int, spans: int) -> None:
 
     fired = {}
     lat = []
+    stage_time = {"plan_stage_dispatch": 0.0, "superscan_resolve_block": 0.0}
     t0 = time.perf_counter()
     prev = None
     n = 0
@@ -791,16 +831,21 @@ def child_cpu(T: int, B: int, spans: int) -> None:
         lo, hi = i * T, (i + 1) * T
         t_enq = time.perf_counter()
         d = pipe.process_superbatch(steps_data[lo:hi], wms[lo:hi], defer=True)
+        stage_time["plan_stage_dispatch"] += time.perf_counter() - t_enq
         if prev is not None:
             pd, pt, pn = prev
+            t_res = time.perf_counter()
             for w, c, _f in pd.resolve():
                 fired[w.start // SLIDE_MS] = c
+            stage_time["superscan_resolve_block"] += time.perf_counter() - t_res
             lat.append((time.perf_counter() - pt) * 1000.0)
             n += pn
         prev = (d, t_enq, sum(len(b[2]) for b in steps_data[lo:hi]))
     pd, pt, pn = prev
+    t_res = time.perf_counter()
     for w, c, _f in pd.resolve():
         fired[w.start // SLIDE_MS] = c
+    stage_time["superscan_resolve_block"] += time.perf_counter() - t_res
     lat.append((time.perf_counter() - pt) * 1000.0)
     n += pn
     elapsed = time.perf_counter() - t0
@@ -819,6 +864,7 @@ def child_cpu(T: int, B: int, spans: int) -> None:
         "events": n,
         "device": "cpu-jit",
         "kernel": "xla_superscan",
+        "observability": observability_snapshot(stage_time, elapsed),
     }})
 
 

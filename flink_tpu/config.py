@@ -291,6 +291,71 @@ class MetricOptions:
     REPORTERS = ConfigOptions.key("metrics.reporters").list_type().default_value([])
 
 
+class ObservabilityOptions:
+    """The streaming observability plane (reference: LatencyMarker emission,
+    TaskIOMetricGroup busy/idle/backPressured sampling, the REST backpressure
+    handlers, and flame-graph/profiler capture). All knobs default to a
+    configuration whose steady-state overhead is negligible (< 2% on the
+    bench hot path): markers piggyback on source batches, ratio sampling is
+    arithmetic over counters the run loop already maintains, and the
+    profiler is off."""
+
+    MARKER_INTERVAL_MS = (
+        ConfigOptions.key("observability.latency-markers.interval-ms")
+        .duration_ms_type().default_value(0)
+    ).with_description(
+        "Minimum wall-clock spacing between latency markers stamped at each "
+        "source (LatencyMarker analogue). 0 stamps one marker per source "
+        "batch; -1 disables marker emission entirely. Markers forwarded "
+        "from an upstream stage over the dataplane always pass through "
+        "regardless of this interval."
+    )
+    SAMPLING_INTERVAL_MS = (
+        ConfigOptions.key("observability.sampling.interval-ms")
+        .duration_ms_type().default_value(100)
+    ).with_description(
+        "Window over which busy/idle/backPressured time deltas are sampled "
+        "into the *MsPerSecond gauges (the reference's backpressure "
+        "sampling period). Lifetime ratios are maintained continuously and "
+        "are unaffected."
+    )
+    DEVICE_TIMING_ENABLED = (
+        ConfigOptions.key("observability.device-timing.enabled")
+        .bool_type().default_value(True)
+    ).with_description(
+        "Time the host-side device sections of each window step (kernel "
+        "dispatch + any blocking readback) into per-operator "
+        "deviceDispatchMs histograms and deviceTimeMsTotal gauges. Timing "
+        "is host-clock around already-synchronous sections — it never "
+        "inserts extra block_until_ready syncs into deferred pipelines."
+    )
+    PROFILER_ENABLED = (
+        ConfigOptions.key("observability.profiler.enabled")
+        .bool_type().default_value(False)
+    ).with_description(
+        "Capture a jax.profiler trace for the duration of each job attempt "
+        "(written under observability.profiler.dir). Heavyweight: device "
+        "tracing serializes dispatches — for offline analysis only, never "
+        "in production."
+    )
+    PROFILER_DIR = (
+        ConfigOptions.key("observability.profiler.dir")
+        .string_type().default_value("/tmp/flink-tpu-profile")
+    ).with_description(
+        "Output directory for observability.profiler.enabled trace dumps "
+        "(TensorBoard-loadable)."
+    )
+    SHIPPING_INTERVAL_MS = (
+        ConfigOptions.key("observability.shipping.interval-ms")
+        .duration_ms_type().default_value(500)
+    ).with_description(
+        "How often a TaskExecutor ships metric snapshots and trace spans to "
+        "the JobManager over the authenticated RPC plane (piggybacked on "
+        "the heartbeat; the JM aggregates and serves them via REST and "
+        "Prometheus)."
+    )
+
+
 class SecurityOptions:
     """Transport security (reference: SecurityOptions + security.ssl.internal.*).
 

@@ -30,9 +30,11 @@ def _attr_value(v: Any) -> Dict[str, Any]:
 
 
 def span_to_otlp(span: Span, trace_id: Optional[str] = None) -> Dict[str, Any]:
-    """One Span -> OTLP/JSON span object (hex ids, unix-nano timestamps)."""
+    """One Span -> OTLP/JSON span object (hex ids, unix-nano timestamps).
+    Precedence for the trace id: explicit argument > the span's own
+    correlation id (traces.job_trace_id propagation) > a fresh random id."""
     return {
-        "traceId": trace_id or secrets.token_hex(16),
+        "traceId": trace_id or getattr(span, "trace_id", None) or secrets.token_hex(16),
         "spanId": secrets.token_hex(8),
         "name": f"{span.scope}.{span.name}",
         "kind": 1,  # SPAN_KIND_INTERNAL

@@ -155,7 +155,25 @@ class MetricRegistry:
         key = ".".join(scope + (name,))
         with self._lock:
             existing = self._metrics.get(key)
-            if existing is not None and type(existing) is type(metric):
+            if existing is not None:
+                if type(existing) is not type(metric):
+                    # collision: keep the FIRST registration (the reference
+                    # registry logs and refuses the replacement —
+                    # MetricRegistryImpl "Name collision" warning) and hand
+                    # the caller its new metric UNREGISTERED: it is the
+                    # right type for the caller's code (updates just go
+                    # nowhere), whereas returning the existing wrong-typed
+                    # metric would defer the failure to a crash at the
+                    # first update call
+                    import logging
+
+                    logging.getLogger("flink_tpu.metrics").warning(
+                        "metric %r already registered as %s; the conflicting "
+                        "%s registration is ignored (detached instance "
+                        "returned)",
+                        key, type(existing).__name__, type(metric).__name__,
+                    )
+                    return metric
                 return existing
             self._metrics[key] = metric
         return metric
@@ -197,25 +215,168 @@ class LoggingReporter(Reporter):
             self._log.info("%s = %s", k, m.value())
 
 
-def prometheus_text(metrics: Dict[str, Any]) -> str:
+def _prom_name(name: str) -> str:
+    """Sanitize to the Prometheus metric-name grammar
+    [a-zA-Z_:][a-zA-Z0-9_:]* — non-conforming characters become '_' and a
+    leading digit gets an '_' prefix (a dotted scope like '0ff.x' must not
+    produce an invalid exposition)."""
+    s = "".join(c if c.isalnum() or c == "_" else "_" for c in name)
+    if not s or s[0].isdigit():
+        s = "_" + s
+    return s
+
+
+def _prom_label_value(value: Any) -> str:
+    """Escape a label value per the text exposition format (backslash,
+    double-quote, and newline must be escaped inside the quotes)."""
+    return (str(value).replace("\\", "\\\\").replace('"', '\\"')
+            .replace("\n", "\\n"))
+
+
+def _render_labels(labels: Optional[Dict[str, Any]]) -> str:
+    """'{k="v",...}' or '' — base labelset attached to every sample."""
+    if not labels:
+        return ""
+    pairs = ",".join(
+        f'{_prom_name(str(k))}="{_prom_label_value(v)}"'
+        for k, v in sorted(labels.items()))
+    return "{" + pairs + "}"
+
+
+def _with_extra_label(lbl: str, extra: str) -> str:
+    """Join a rendered base labelset with one more pair."""
+    return lbl[:-1] + "," + extra + "}" if lbl else "{" + extra + "}"
+
+
+def _render_summary(name: str, stats: Dict[str, Any], lbl: str) -> List[str]:
+    """`# TYPE ... summary` + quantile series + _count for one histogram
+    family — the ONE rendering both the live-metric and snapshot
+    expositions use, so shard samples of a family can never drift to
+    different quantile sets."""
+    lines = [f"# TYPE {name} summary"]
+    for q, stat in (("0.5", "p50"), ("0.95", "p95"), ("0.99", "p99")):
+        v = stats.get(stat)
+        if isinstance(v, (int, float)) and not (
+                isinstance(v, float) and math.isnan(v)):
+            extra = f'quantile="{q}"'
+            lines.append(f"{name}{_with_extra_label(lbl, extra)} {v}")
+    lines.append(f'{name}_count{lbl} {stats.get("count", 0)}')
+    return lines
+
+
+def prometheus_text(metrics: Dict[str, Any],
+                    labels: Optional[Dict[str, Any]] = None) -> str:
     """Prometheus text exposition format (flink-metrics-prometheus
     PrometheusReporter analogue — here as an encoding; the REST server
-    exposes it at /metrics)."""
+    exposes it at /metrics). Emits `# TYPE` metadata per family: Counter ->
+    counter, Gauge/Meter -> gauge, Histogram -> summary (quantile series +
+    _count, the reference reporter's HistogramSummaryProxy shape).
+    `labels` (e.g. {'job': id}) attach to every sample — required whenever
+    several registries share family names in one exposition, or the merged
+    document would carry duplicate samples."""
 
-    def sanitize(name: str) -> str:
-        return "".join(c if c.isalnum() or c == "_" else "_" for c in name)
-
+    lbl = _render_labels(labels)
     lines = []
     for key, metric in sorted(metrics.items()):
-        name = sanitize(key)
+        name = _prom_name(key)
         val = metric.value()
         if isinstance(metric, Histogram):
-            for stat, v in val.items():
-                if not (isinstance(v, float) and math.isnan(v)):
-                    lines.append(f'{name}{{stat="{stat}"}} {v}')
+            lines.extend(_render_summary(name, val, lbl))
         elif isinstance(val, (int, float)) and not isinstance(val, bool):
-            lines.append(f"{name} {val}")
+            kind = "counter" if isinstance(metric, Counter) else "gauge"
+            lines.append(f"# TYPE {name} {kind}")
+            lines.append(f"{name}{lbl} {val}")
     return "\n".join(lines) + "\n"
+
+
+def prometheus_text_from_snapshot(snapshot: Dict[str, Any],
+                                  labels: Optional[Dict[str, Any]] = None) -> str:
+    """Exposition for a PLAIN-DATA metric snapshot (metrics_snapshot form —
+    what TaskExecutors ship to the JobManager over RPC): numeric values
+    become untyped gauges, histogram-stat dicts become quantile series.
+    `labels` (e.g. {'shard': 3}) are attached to every sample."""
+    lbl = _render_labels(labels)
+    lines = []
+    for key, val in sorted(snapshot.items()):
+        name = _prom_name(key)
+        if isinstance(val, dict):
+            lines.extend(_render_summary(name, val, lbl))
+        elif isinstance(val, (int, float)) and not isinstance(val, bool):
+            lines.append(f"# TYPE {name} gauge")
+            lines.append(f"{name}{lbl} {val}")
+    return "\n".join(lines) + "\n"
+
+
+def merge_prometheus_text(texts: "List[str]") -> str:
+    """Merge several expositions into one valid document: the text format
+    allows at most ONE `# TYPE` line per metric family with all of the
+    family's samples grouped under it, so naive concatenation of per-job /
+    per-shard expositions (repeated TYPE lines, interleaved families) is
+    rejected by strict parsers. Keeps the first declared type per family
+    and groups samples; a summary's `_count`/`_sum` series stay with their
+    parent family."""
+    types: Dict[str, str] = {}
+    samples: Dict[str, List[str]] = {}
+    order: List[str] = []
+    summaries = set()
+
+    def family_of(sample_line: str) -> str:
+        name = sample_line.split("{", 1)[0].split(" ", 1)[0]
+        for suffix in ("_count", "_sum"):
+            if name.endswith(suffix) and name[: -len(suffix)] in summaries:
+                return name[: -len(suffix)]
+        return name
+
+    for text in texts:
+        for line in text.splitlines():
+            if not line.strip():
+                continue
+            if line.startswith("# TYPE "):
+                _, _, name, kind = line.split(" ", 3)
+                if name not in types:
+                    types[name] = kind
+                    order.append(name)
+                    if kind == "summary":
+                        summaries.add(name)
+                continue
+            if line.startswith("#"):
+                continue
+            fam = family_of(line)
+            if fam not in samples and fam not in types:
+                order.append(fam)
+            samples.setdefault(fam, []).append(line)
+    out = []
+    for fam in order:
+        kind = types.get(fam)
+        if kind:
+            out.append(f"# TYPE {fam} {kind}")
+        out.extend(samples.get(fam, ()))
+    return "\n".join(out) + "\n"
+
+
+def metrics_snapshot(metrics: Dict[str, Any]) -> Dict[str, Any]:
+    """Plain-data view of a metric table — int/float scalars and histogram
+    stat dicts only — safe to JSON-encode or ship over the restricted RPC
+    wire (TM -> JM metric shipping)."""
+    out: Dict[str, Any] = {}
+    for key, metric in metrics.items():
+        try:
+            val = metric.value()
+        except Exception:  # a gauge closure over torn-down state must not
+            continue       # poison the whole snapshot
+        if hasattr(val, "item"):   # numpy scalar
+            val = val.item()
+        if isinstance(val, bool):
+            continue
+        if isinstance(val, dict):
+            out[key] = {
+                str(k): (v.item() if hasattr(v, "item") else v)
+                for k, v in val.items()
+                if isinstance(v, (int, float)) or hasattr(v, "item")
+            }
+        elif isinstance(val, (int, float)):
+            out[key] = val
+    return out
 
 
 class PrometheusReporter(Reporter):

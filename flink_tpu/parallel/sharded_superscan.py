@@ -39,10 +39,7 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-try:  # jax >= 0.8 moved shard_map to the top level
-    from jax import shard_map
-except ImportError:  # pragma: no cover
-    from jax.experimental.shard_map import shard_map
+from flink_tpu.utils.jax_compat import shard_map
 
 from flink_tpu.api.windowing.assigners import WindowAssigner
 from flink_tpu.ops.aggregators import VALUE, resolve

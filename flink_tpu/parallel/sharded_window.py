@@ -29,7 +29,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
-from jax import shard_map
+from flink_tpu.utils.jax_compat import shard_map
 
 from flink_tpu.core.keygroups import key_groups_for_hashes, UPPER_BOUND_MAX_PARALLELISM
 from flink_tpu.core.records import hash_keys

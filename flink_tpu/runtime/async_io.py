@@ -172,6 +172,14 @@ class AsyncMapRunner:
         self.on_end()
 
     def on_marker(self, wall_ms):
+        # record-then-forward, like every other runner (StepRunner.on_marker):
+        # without the histogram here, a slow async stage would show up as
+        # latency at the operator AFTER it
+        h = getattr(self, "_marker_hist", None)
+        if h is not None:
+            import time as _time
+
+            h.update(_time.time() * 1000.0 - wall_ms)
         if self.downstream:
             self.downstream.on_marker(wall_ms)
 
@@ -191,6 +199,7 @@ class AsyncMapRunner:
 
     def register_metrics(self, group) -> None:
         self.records_in_counter = group.counter("numRecordsIn")
+        self._marker_hist = group.histogram("latencyMs")
 
     def on_batch(self, values: np.ndarray, timestamps: np.ndarray) -> None:
         out = self.executor.process(values)

@@ -48,10 +48,18 @@ from flink_tpu.core.keygroups import (
 )
 from flink_tpu.core.time import MAX_WATERMARK, MIN_WATERMARK
 from flink_tpu.checkpoint.storage import FsCheckpointStorage
+from flink_tpu.metrics.registry import MetricRegistry, metrics_snapshot
+from flink_tpu.metrics.task_io import backpressure_level
+from flink_tpu.metrics.traces import Span, job_trace_id
 from flink_tpu.runtime.blob import BlobCache, BlobServerEndpoint
 from flink_tpu.runtime.dataplane import ExchangeServer, OutputChannel
 from flink_tpu.runtime.heartbeat import HeartbeatManager
-from flink_tpu.runtime.rpc import RpcEndpoint, RpcService
+from flink_tpu.runtime.rpc import (
+    RpcEndpoint,
+    RpcService,
+    current_trace_id,
+    trace_context,
+)
 from flink_tpu.security.framing import trusted_loads
 
 
@@ -182,6 +190,66 @@ class _JobState:
         default_factory=dict)   # cp_id -> (target dir, retry margin)
     completed_savepoints: List[str] = field(default_factory=list)
     failed_savepoints: List[str] = field(default_factory=list)
+    # observability plane: per-job correlation id, latest per-shard metric
+    # snapshot shipped by the TMs, and the bounded span feed (JM trigger
+    # spans + TM ack spans, all carrying trace_id)
+    trace_id: str = ""
+    metric_snapshots: Dict[int, dict] = field(default_factory=dict)
+    spans: List[dict] = field(default_factory=list)
+
+
+_MAX_JOB_SPANS = 1024
+
+
+def _shard_combine(key: str) -> str:
+    """How a metric key folds across shards: per-task fractions (ratios,
+    pool occupancy, busy/idle/backPressured TimeMsPerSecond — each bounded
+    per task) average; watermark positions take the MIN (the job-level
+    combined watermark is what EVERY subtask has reached — averaging would
+    report progress a straggler shard has not made); everything else
+    (counters, totals, and THROUGHPUT rates like numRecordsInPerSecond,
+    which is work done) sums. Matches on the full key, not just the leaf:
+    per-channel gauges like exchange.inPoolUsage.<n> have a numeric leaf."""
+    leaf = key.rsplit(".", 1)[-1]
+    if leaf.startswith("current"):
+        return "min"
+    if "Ratio" in leaf or leaf.endswith("TimeMsPerSecond") \
+            or "inPoolUsage" in key:
+        return "mean"
+    return "sum"
+
+
+def aggregate_shard_metrics(per_shard: Dict[int, dict]) -> dict:
+    """Fold per-shard metric snapshots into one job-level view per
+    _shard_combine (sum / mean / min); histogram stat dicts merge by
+    max-of-p99 / min-of-min / summed count (cheap percentile union —
+    exact merging would need the reservoirs, which stay TM-local)."""
+    scalars: Dict[str, List[float]] = {}
+    agg: dict = {}
+    for snap in per_shard.values():
+        for key, val in snap.items():
+            if isinstance(val, dict):
+                cur = agg.setdefault(key, {})
+                for stat, v in val.items():
+                    if not isinstance(v, (int, float)):
+                        continue
+                    if stat == "count":
+                        cur[stat] = cur.get(stat, 0) + v
+                    elif stat == "min":
+                        cur[stat] = min(cur.get(stat, v), v)
+                    else:   # max / mean / percentiles: upper envelope
+                        cur[stat] = max(cur.get(stat, v), v)
+            elif isinstance(val, (int, float)):
+                scalars.setdefault(key, []).append(val)
+    for key, vals in scalars.items():
+        how = _shard_combine(key)
+        if how == "min":
+            agg[key] = min(vals)
+        elif how == "mean":
+            agg[key] = sum(vals) / len(vals)
+        else:
+            agg[key] = sum(vals)
+    return agg
 
 
 class JobManagerEndpoint(RpcEndpoint):
@@ -253,13 +321,29 @@ class JobManagerEndpoint(RpcEndpoint):
             pass  # scheduling trouble must not fail the registration
         return {"registered": True, "jm_blob": "blob"}
 
-    def heartbeat_tm(self, tm_id: str, steps: Optional[dict] = None) -> bool:
+    def heartbeat_tm(self, tm_id: str, steps: Optional[dict] = None,
+                     metrics: Optional[dict] = None,
+                     spans: Optional[list] = None) -> bool:
         self.heartbeats.receive_heartbeat(tm_id)
         if steps:
             for (job_id, shard), step in steps.items():
                 job = self._jobs.get(job_id)
                 if job is not None:
                     job.steps[shard] = step
+        if metrics:
+            # TM-shipped metric snapshots (authenticated RPC plane): latest
+            # snapshot per shard wins — the JM serves aggregates, history
+            # lives in whatever scrapes /metrics
+            for (job_id, shard), snap in metrics.items():
+                job = self._jobs.get(job_id)
+                if job is not None:
+                    job.metric_snapshots[shard] = snap
+        if spans:
+            for sd in spans:
+                job = self._jobs.get(sd.get("attributes", {}).get("jobId"))
+                if job is not None:
+                    job.spans.append(sd)
+                    del job.spans[:-_MAX_JOB_SPANS]
         return True
 
     def _on_tm_dead(self, tm_id: str) -> None:
@@ -315,7 +399,7 @@ class JobManagerEndpoint(RpcEndpoint):
         job = _JobState(
             job_id, blob_key, parallelism, spec.name,
             requested_parallelism=parallelism, stages=stages,
-            source_stages=source_stages,
+            source_stages=source_stages, trace_id=job_trace_id(job_id),
         )
         if savepoint_path is not None:
             # start FROM a savepoint (execution.savepoint.path analogue):
@@ -370,7 +454,61 @@ class JobManagerEndpoint(RpcEndpoint):
             "savepoints_failed": list(job.failed_savepoints),
             "failure": job.failure, "restarts": job.restarts,
             "checkpoints": [c[0] for c in job.completed],
+            "trace_id": job.trace_id,
         }
+
+    # ---- observability queries (served to REST via rest.py jm bridge) ----
+    def list_jobs(self) -> list:
+        return [
+            {"id": job_id, "name": job.spec_name, "status": job.status}
+            for job_id, job in self._jobs.items()
+        ]
+
+    def job_metrics(self, job_id: str) -> dict:
+        """Aggregated + per-shard metric view of the TM-shipped snapshots."""
+        job = self._jobs[job_id]
+        per_shard = {int(s): dict(snap) for s, snap in job.metric_snapshots.items()}
+        return {
+            "job": aggregate_shard_metrics(per_shard),
+            "per_shard": per_shard,
+            "trace_id": job.trace_id,
+        }
+
+    def job_spans(self, job_id: str) -> list:
+        """Span feed (plain dicts) for the job: JM trigger/complete spans
+        and TM-shipped ack spans, all stamped with the job's trace_id."""
+        return list(self._jobs[job_id].spans)
+
+    def job_backpressure(self, job_id: str) -> dict:
+        """Per-shard busy/idle/backPressured ratios from the latest shipped
+        snapshots (JobVertexBackPressureHandler analogue)."""
+        job = self._jobs[job_id]
+        subtasks = []
+        worst = 0.0
+        for shard in sorted(job.metric_snapshots):
+            snap = job.metric_snapshots[shard]
+            ratio = float(snap.get("job.backPressuredTimeRatio", 0.0))
+            worst = max(worst, ratio)
+            subtasks.append({
+                "subtask": shard,
+                "backPressuredRatio": ratio,
+                "busyRatio": float(snap.get("job.busyTimeRatio", 0.0)),
+                "idleRatio": float(snap.get("job.idleTimeRatio", 0.0)),
+                "backpressureLevel": backpressure_level(ratio),
+            })
+        return {
+            "status": "ok" if subtasks else "deprecated",
+            "backpressureLevel": backpressure_level(worst),
+            "subtasks": subtasks,
+        }
+
+    def _job_span(self, job: _JobState, scope: str, name: str,
+                  start_ms: float, **attrs) -> None:
+        now = time.time() * 1000.0
+        attrs.setdefault("jobId", job.job_id)
+        job.spans.append(Span(scope, name, start_ms, now, attrs,
+                              trace_id=job.trace_id).to_dict())
+        del job.spans[:-_MAX_JOB_SPANS]
 
     def job_result(self, job_id: str) -> Optional[list]:
         job = self._jobs[job_id]
@@ -512,6 +650,8 @@ class JobManagerEndpoint(RpcEndpoint):
             return
         job.restarts += 1
         job.status = "RESTARTING"
+        self._job_span(job, "recovery", "JobRestart", time.time() * 1000.0,
+                       attempt=job.restarts, cause=reason[:200])
 
         def delayed():
             time.sleep(self.restart_delay)
@@ -610,17 +750,21 @@ class JobManagerEndpoint(RpcEndpoint):
             job.next_checkpoint_id += 1
             job.pending[cp_id] = {}
             job.pending_target[cp_id] = max(job.steps.values())
-            for shard, gw in gws.items():
-                # margin is honored for symmetry with the keyed branch, but
-                # staged source gates CONSUME past-target requests at their
-                # next step boundary instead of declining them (the barrier
-                # defines the cut, not the step number), so staged
-                # savepoints never outrun-decline and never need the
-                # doubled-margin retry loop
-                gw.trigger_checkpoint(
-                    job.job_id, job.attempt, cp_id,
-                    job.steps.get(shard, 0) + margin, shard,
-                )
+            trig_t0 = time.time() * 1000.0
+            with trace_context(job.trace_id):
+                for shard, gw in gws.items():
+                    # margin is honored for symmetry with the keyed branch,
+                    # but staged source gates CONSUME past-target requests
+                    # at their next step boundary instead of declining them
+                    # (the barrier defines the cut, not the step number), so
+                    # staged savepoints never outrun-decline and never need
+                    # the doubled-margin retry loop
+                    gw.trigger_checkpoint(
+                        job.job_id, job.attempt, cp_id,
+                        job.steps.get(shard, 0) + margin, shard,
+                    )
+            self._job_span(job, "checkpointing", "CheckpointTrigger",
+                           trig_t0, checkpointId=cp_id)
             return cp_id
         gws2 = {}
         for shard, tm_id in job.assignment.items():
@@ -636,9 +780,13 @@ class JobManagerEndpoint(RpcEndpoint):
         target = max(job.steps.values()) + margin
         job.pending[cp_id] = {}
         job.pending_target[cp_id] = target
-        for shard, gw in gws2.items():
-            gw.trigger_checkpoint(job.job_id, job.attempt, cp_id, target,
-                                  shard)
+        trig_t0 = time.time() * 1000.0
+        with trace_context(job.trace_id):
+            for shard, gw in gws2.items():
+                gw.trigger_checkpoint(job.job_id, job.attempt, cp_id, target,
+                                      shard)
+        self._job_span(job, "checkpointing", "CheckpointTrigger",
+                       trig_t0, checkpointId=cp_id)
         return cp_id
 
     def ack_checkpoint(self, job_id: str, attempt: int, shard: int,
@@ -674,6 +822,9 @@ class JobManagerEndpoint(RpcEndpoint):
                     job.failed_savepoints.append(
                         f"{sp_path}: {e}")
             job.completed.append((checkpoint_id, handles, step))
+            self._job_span(job, "checkpointing", "CheckpointComplete",
+                           time.time() * 1000.0, checkpointId=checkpoint_id,
+                           status="COMPLETED", step=step)
             # local recovery (S11): remember which TM produced each shard's
             # snapshot, so a redeploy to the same TM can restore from its
             # task-local copy (TaskLocalStateStoreImpl analogue)
@@ -759,6 +910,18 @@ class _ShardTask:
         self.current_step = restore_step
         self._cp_requests: List[Tuple[int, int]] = []   # (cp_id, target_step)
         self._cp_lock = threading.Lock()
+        # observability: per-task metric registry (shipped to the JM on the
+        # heartbeat) and span buffer. The correlation id is DERIVED from the
+        # job id — the id already rides every RPC frame of this job, so JM
+        # and TM agree on the trace id with zero extra context shipping.
+        self.registry = MetricRegistry()
+        self.spans: List[dict] = []
+        self._span_lock = threading.Lock()
+        self.trace_id = job_trace_id(job_id)
+        # trace ctx the JM's trigger RPC carried, per checkpoint id (equals
+        # the derived id in practice; kept separate so a caller-supplied
+        # context always wins, as with a real traceparent header)
+        self._cp_trace: Dict[int, str] = {}
         self.thread = threading.Thread(
             target=self._run_safe, daemon=True,
             name=f"task-{job_id[:6]}-a{attempt}-s{shard}",
@@ -767,7 +930,36 @@ class _ShardTask:
     def start(self) -> None:
         self.thread.start()
 
-    def request_checkpoint(self, cp_id: int, target_step: int) -> None:
+    def record_span(self, scope: str, name: str, start_ms: float, **attrs) -> None:
+        """Buffer one span (plain dict) for the next heartbeat shipment.
+        Checkpoint spans prefer the trace ctx their trigger RPC carried."""
+        attrs.setdefault("jobId", self.job_id)
+        attrs.setdefault("shard", self.shard)
+        tid = self._cp_trace.get(attrs.get("checkpointId"), self.trace_id)
+        with self._span_lock:
+            self.spans.append(Span(scope, name, start_ms, time.time() * 1000.0,
+                                   attrs, trace_id=tid).to_dict())
+            del self.spans[:-256]
+
+    def drain_spans(self) -> List[dict]:
+        """Atomically take the buffered spans (heartbeat shipping); the
+        caller re-inserts on a failed shipment (restore_spans)."""
+        with self._span_lock:
+            out, self.spans = self.spans, []
+        return out
+
+    def restore_spans(self, spans: List[dict]) -> None:
+        with self._span_lock:
+            self.spans[:0] = spans
+            del self.spans[:-256]
+
+    def request_checkpoint(self, cp_id: int, target_step: int,
+                           trace_id: Optional[str] = None) -> None:
+        if trace_id is not None:
+            self._cp_trace[cp_id] = trace_id
+            if len(self._cp_trace) > 64:
+                for k in sorted(self._cp_trace)[:-64]:
+                    self._cp_trace.pop(k, None)
         with self._cp_lock:
             if not self.done.is_set():
                 self._cp_requests.append((cp_id, target_step))
@@ -850,11 +1042,17 @@ class _ShardTask:
                     self.peers[e.dst_stage], cid,
                     security=self.te.exchange.security)
                 out_order.append(e.edge_id)
+        # input-side ring occupancy (inPoolUsage analogue): persistently
+        # full = THIS stage is the bottleneck, empty = starved by upstream
+        exch_group = self.registry.group("job", "exchange")
+        for eid, ch in ins.items():
+            exch_group.gauge(f"inPoolUsage.{eid}", ch.occupancy)
 
         task = self
         rt_box: list = [None]
 
         def on_aligned(cp_id: int) -> None:
+            ack_t0 = time.time() * 1000.0
             rt = rt_box[0]
             snap = {"runtime": rt.capture(), "step": task.current_step}
             for eid in out_order:                 # forward BEFORE new data
@@ -868,6 +1066,8 @@ class _ShardTask:
             task.te._local_state[(task.job_id, task.shard)] = (cp_id, snap)
             task.jm.ack_checkpoint(
                 task.job_id, task.attempt, task.shard, cp_id, snap)
+            task.record_span("checkpointing", "CheckpointAck", ack_t0,
+                             checkpointId=cp_id)
 
         has_sources = stage_has_original_sources(self.spec.graph, stage_idx)
         aligner = BarrierAligner(list(ins), has_sources, on_aligned)
@@ -876,7 +1076,7 @@ class _ShardTask:
             self.spec.graph, stage_idx, ins, outs, self.cancelled,
             aligner=aligner,
         )
-        rt = JobRuntime(graph, self.spec.config)
+        rt = JobRuntime(graph, self.spec.config, registry=self.registry)
         rt_box[0] = rt
         self._resolve_local_restore()
         if self.restore is not None:
@@ -935,7 +1135,8 @@ class _ShardTask:
             SinkRunner,
         )
 
-        rt = JobRuntime(self.spec.graph, self.spec.config)
+        rt = JobRuntime(self.spec.graph, self.spec.config,
+                        registry=self.registry)
         self._resolve_local_restore()
         if self.restore is not None:
             rt.restore(self.restore["runtime"])
@@ -959,11 +1160,14 @@ class _ShardTask:
                         r for r in task._cp_requests if r[1] > task.current_step
                     ]
                 for cp_id, _target in due:
+                    ack_t0 = time.time() * 1000.0
                     snap = {"runtime": capture(), "step": task.current_step}
                     task.te._local_state[(task.job_id, task.shard)] = (
                         cp_id, snap)
                     task.jm.ack_checkpoint(
                         task.job_id, task.attempt, task.shard, cp_id, snap)
+                    task.record_span("checkpointing", "CheckpointAck",
+                                     ack_t0, checkpointId=cp_id)
                     # single-shard job: the ack completes the checkpoint
                     # inside the JM before returning, so completion
                     # callbacks (2PC sink epoch commits) fire now
@@ -1070,9 +1274,36 @@ class _ShardTask:
             if num_stages(self.spec.graph) > 1:
                 return self._run_graph_stage()
             return self._run_graph()
+        from flink_tpu.config import ObservabilityOptions
+        from flink_tpu.metrics.task_io import TaskIOMetrics
+
+        # DistributedJobSpec carries no Configuration; honor the sampling
+        # knob when a config rides the spec, else use the option default
+        cfg = getattr(self.spec, "config", None)
+        sampling_ms = (cfg.get(ObservabilityOptions.SAMPLING_INTERVAL_MS)
+                       if cfg is not None
+                       else ObservabilityOptions.SAMPLING_INTERVAL_MS.default)
+
         P = self.parallelism
         batches = self.spec.source_factory(self.shard, P)
         op = self._make_operator()
+        # task-scope observability for the keyed hot path: throughput,
+        # busy/idle/backPressured ratios (busy = partition/send + operator
+        # sections; credit waits measured at the senders are subtracted;
+        # channel-merge polling is idle), plus the window operator's HBM
+        # footprint / key cardinality gauges
+        job_group = self.registry.group("job")
+        records_in = job_group.counter("numRecordsIn")
+        io = TaskIOMetrics()
+        io.register(job_group)
+        op_group = self.registry.group("job", "operator", "keyed-window")
+        for gauge_name, attr in (("stateBytes", "state_bytes"),
+                                 ("stateKeyCount", "state_key_count")):
+            fn = getattr(op, attr, None)
+            if fn is not None:
+                op_group.gauge(gauge_name, fn)
+        op_group.gauge("numLateRecordsDropped",
+                       lambda: getattr(op, "num_late_records_dropped", 0))
         results: list = []
         self._resolve_local_restore()
         if self.restore is not None:
@@ -1109,7 +1340,11 @@ class _ShardTask:
                 self.peers[dst], f"{self.job_id}/a{self.attempt}/{self.shard}->{dst}",
                 security=self.te.exchange.security,
             )
+            io.add_backpressure_source(
+                lambda ch=outs[dst]: ch.backpressured_s)
         ins = {src: self.te.exchange.channel(self._channel_id(src)) for src in range(P)}
+        for src, ch in ins.items():
+            job_group.gauge(f"exchange.inPoolUsage.{src}", ch.occupancy)
 
         step = self.restore_step
         n_steps = len(batches)
@@ -1121,6 +1356,7 @@ class _ShardTask:
                     self._cp_requests = [r for r in self._cp_requests if r[1] > step]
                 for cp_id, target in due:
                     if target == step:
+                        ack_t0 = time.time() * 1000.0
                         snap = {"operator": op.snapshot(), "step": step,
                                 "results": list(results)}
                         # task-local state store (S11): keep the latest
@@ -1130,6 +1366,8 @@ class _ShardTask:
                         self.jm.ack_checkpoint(
                             self.job_id, self.attempt, self.shard, cp_id, snap
                         )
+                        self.record_span("checkpointing", "CheckpointAck",
+                                         ack_t0, checkpointId=cp_id)
                     else:  # already past the target: cannot form the cut
                         self.jm.decline_checkpoint(
                             self.job_id, self.attempt, self.shard, cp_id,
@@ -1138,17 +1376,23 @@ class _ShardTask:
 
                 if step >= n_steps:
                     break
+                loop_t0 = time.perf_counter()
                 keys, vals, ts, wm = batches[step]
 
                 # ---- keyBy partition: bucket by owning shard ---------------
+                busy_t0 = time.perf_counter()
                 hashes = np.asarray([key_hash(k) for k in keys], dtype=np.int64)
                 kgs = key_groups_for_hashes(hashes, self.spec.max_parallelism)
                 owner = (kgs.astype(np.int64) * P) // self.spec.max_parallelism
                 for dst in range(P):
                     m = owner == dst
                     outs[dst].send((keys[m], vals[m], ts[m], int(wm), step))
+                busy_dt = time.perf_counter() - busy_t0
 
                 # ---- merge one batch per input channel (min watermark) -----
+                # (channel polling is the task's IDLE time — excluded from
+                # busy; credit waits inside send() above are subtracted by
+                # TaskIOMetrics via the senders' backpressured_s)
                 parts = []
                 wms = []
                 for src in range(P):
@@ -1166,10 +1410,12 @@ class _ShardTask:
                     assert s == step, f"step skew: got {s} expected {step}"
                     parts.append((k, v, t))
                     wms.append(w)
+                busy_t0 = time.perf_counter()
                 mk = np.concatenate([p[0] for p in parts])
                 mv = np.concatenate([p[1] for p in parts])
                 mt = np.concatenate([p[2] for p in parts])
                 combined_wm = min(wms)
+                records_in.inc(len(mk))
 
                 if hasattr(op, "process_batch") and len(mk):
                     # columnar feeding for device operators: ONE batched
@@ -1185,6 +1431,9 @@ class _ShardTask:
                 if combined_wm > MIN_WATERMARK:
                     op.process_watermark(combined_wm)
                 results.extend(op.drain_output())
+                busy_dt += time.perf_counter() - busy_t0
+                io.record_step(busy_dt, time.perf_counter() - loop_t0)
+                io.maybe_sample(sampling_ms)
 
                 step += 1
                 self.current_step = step
@@ -1210,11 +1459,16 @@ class _ShardTask:
 class TaskExecutorEndpoint(RpcEndpoint):
     """TM RPC endpoint (D1 scope): deploy/cancel/checkpoint tasks."""
 
-    def __init__(self, rpc: RpcService, *, tm_id: Optional[str] = None, slots: int = 1):
+    def __init__(self, rpc: RpcService, *, tm_id: Optional[str] = None,
+                 slots: int = 1, shipping_interval_ms: int = 500):
         super().__init__(name="taskexecutor")
         self.tm_id = tm_id or f"tm-{uuid.uuid4().hex[:8]}"
         self.rpc = rpc
         self.slots = slots
+        # observability.shipping.interval-ms: how often metric snapshots and
+        # span buffers piggyback on the heartbeat
+        self.shipping_interval_ms = shipping_interval_ms
+        self._last_ship = 0.0
         # one SecurityConfig governs both of this TM's planes: the exchange
         # handshakes with the same cluster secret as the RPC service
         self.exchange = ExchangeServer(security=rpc.security)
@@ -1246,7 +1500,38 @@ class TaskExecutorEndpoint(RpcEndpoint):
                     for t in self._tasks.values()
                     if not t.cancelled.is_set()
                 }
-                self._jm_gateway.heartbeat_tm(self.tm_id, steps)
+                metrics = None
+                spans = None
+                drained: List[Tuple["_ShardTask", List[dict]]] = []
+                now = time.monotonic()
+                shipping = (now - self._last_ship) * 1000.0 \
+                    >= self.shipping_interval_ms
+                if shipping:
+                    metrics = {}
+                    spans = []
+                    for t in list(self._tasks.values()):
+                        if t.cancelled.is_set():
+                            continue
+                        snap = metrics_snapshot(t.registry.all_metrics())
+                        if snap:
+                            metrics[(t.job_id, t.shard)] = snap
+                        sp = t.drain_spans()
+                        if sp:
+                            spans.extend(sp)
+                            drained.append((t, sp))
+                try:
+                    self._jm_gateway.heartbeat_tm(self.tm_id, steps,
+                                                  metrics, spans)
+                except Exception:
+                    # shipment failed: put the drained spans back for the
+                    # next beat (bounded by the task buffer cap); _last_ship
+                    # stays untouched so metrics re-ship on the next beat
+                    # instead of waiting out another full interval
+                    for t, sp in drained:
+                        t.restore_spans(sp)
+                    raise
+                if shipping:
+                    self._last_ship = now
             except Exception:
                 pass
 
@@ -1285,10 +1570,11 @@ class TaskExecutorEndpoint(RpcEndpoint):
         of the job (fanning the request to co-located tasks would duplicate
         source barriers on multi-stage jobs); None keeps the legacy
         broadcast for old callers."""
+        trace_id = current_trace_id()   # ctx the JM attached to this frame
         for (jid, att, sh), task in self._tasks.items():
             if jid == job_id and att == attempt and not task.cancelled.is_set() \
                     and (shard is None or sh == shard):
-                task.request_checkpoint(cp_id, target_step)
+                task.request_checkpoint(cp_id, target_step, trace_id)
         return True
 
     def release_job_state(self, job_id: str) -> bool:
@@ -1397,7 +1683,14 @@ def main(argv: Optional[List[str]] = None) -> None:
         print(f"jobmanager listening on {svc.address}", flush=True)
     else:
         svc = RpcService(security=security)
-        te = TaskExecutorEndpoint(svc, slots=args.slots)
+        ship_ms = 500
+        if args.conf:
+            from flink_tpu.config import Configuration, ObservabilityOptions
+
+            ship_ms = Configuration.load(args.conf).get(
+                ObservabilityOptions.SHIPPING_INTERVAL_MS)
+        te = TaskExecutorEndpoint(svc, slots=args.slots,
+                                  shipping_interval_ms=ship_ms)
         te.connect(args.jobmanager)
         print(f"taskmanager {te.tm_id} registered with {args.jobmanager} "
               f"(rpc {svc.address}, exchange {te.exchange.address})", flush=True)

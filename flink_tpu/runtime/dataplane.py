@@ -30,6 +30,7 @@ from __future__ import annotations
 import socket
 import socketserver
 import threading
+import time
 from collections import deque
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
@@ -80,6 +81,15 @@ class InputChannel:
                 return None
         self._grant(1)  # slot freed -> one more credit to the sender
         return batch
+
+    def occupancy(self) -> float:
+        """Fraction of ring slots holding unconsumed batches (0..1) — the
+        inPoolUsage analogue, registered as a per-channel gauge on staged
+        tasks (cluster._run_graph_stage): a persistently full ring means
+        THIS task is the bottleneck (its upstream is backpressured); a
+        persistently empty one means it is starved."""
+        with self._cv:
+            return min(len(self._ring) / max(self.capacity, 1), 1.0)
 
     @property
     def ended(self) -> bool:
@@ -208,6 +218,11 @@ class OutputChannel:
         self._seq = 0
         self._linger_timer: Optional[threading.Timer] = None
         self._send_lock = threading.Lock()
+        # cumulative seconds send() spent blocked waiting for credits — the
+        # task-side backpressure signal (TaskIOMetrics reads this; the
+        # reference's backPressuredTimeMsPerSecond measures the same wait
+        # on LocalBufferPool)
+        self.backpressured_s = 0.0
         threading.Thread(target=self._credit_loop, daemon=True,
                          name=f"credits-{channel_id}").start()
         with self._send_lock:
@@ -240,11 +255,17 @@ class OutputChannel:
 
     def send(self, payload, timeout: Optional[float] = 30.0) -> None:
         with self._cv:
-            while self._credits == 0:
-                if not self._cv.wait(timeout=timeout):
-                    raise TimeoutError(
-                        f"no credit on {self.channel_id} (receiver backpressured)"
-                    )
+            if self._credits == 0:
+                t0 = time.perf_counter()
+                try:
+                    while self._credits == 0:
+                        if not self._cv.wait(timeout=timeout):
+                            raise TimeoutError(
+                                f"no credit on {self.channel_id} "
+                                "(receiver backpressured)"
+                            )
+                finally:
+                    self.backpressured_s += time.perf_counter() - t0
             if self._credits < 0:
                 raise ConnectionError(f"exchange channel {self.channel_id} closed")
             self._credits -= 1

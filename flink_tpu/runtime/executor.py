@@ -26,7 +26,12 @@ from typing import Any, Callable, Dict, List, Optional
 import numpy as np
 
 from flink_tpu.api.functions import AggregateFunction, ProcessFunction, ReduceAggregate
-from flink_tpu.config import Configuration, ExecutionOptions, PipelineOptions
+from flink_tpu.config import (
+    Configuration,
+    ExecutionOptions,
+    ObservabilityOptions,
+    PipelineOptions,
+)
 from flink_tpu.core.time import MAX_WATERMARK, MIN_TIMESTAMP, MIN_WATERMARK
 from flink_tpu.core.watermarks import WatermarkStrategy
 from flink_tpu.graph.transformation import Step, StepGraph, Transformation
@@ -35,6 +40,7 @@ from flink_tpu.runtime.oracle_window_operator import OracleWindowOperator
 from flink_tpu.runtime.tpu_window_operator import TpuWindowOperator
 from flink_tpu.runtime.timers import InternalTimerService
 from flink_tpu.metrics.registry import MetricRegistry
+from flink_tpu.metrics.task_io import DeviceTimer, TaskIOMetrics
 from flink_tpu.state.heap import HeapKeyedStateBackend, value_state
 from flink_tpu.utils.arrays import obj_array
 from flink_tpu.core.keygroups import KeyGroupRange
@@ -105,6 +111,11 @@ class StepRunner:
     def register_metrics(self, group) -> None:
         # operator-scope IO metrics (TaskIOMetricGroup.java:48 analogue)
         self.records_in_counter = group.counter("numRecordsIn")
+        # source->operator transit latency per latency marker (the
+        # per-operator LatencyStats histogram of the reference): updated as
+        # each marker PASSES this operator, so a slow stage shows up as the
+        # step where the percentile jumps
+        self._marker_hist = group.histogram("latencyMs")
 
     # -- input-gate protocol (multi-input valve) --------------------------
     def on_batch_n(self, ordinal: int, values: np.ndarray,
@@ -147,7 +158,12 @@ class StepRunner:
         """Latency marker (LatencyMarker analogue): a wall-clock stamp from
         the source that flows straight through every operator — windows and
         buffers forward it immediately, so a sink's (now - stamp) measures
-        true pipeline transit latency rather than event-time residence."""
+        true pipeline transit latency rather than event-time residence.
+        Each operator it passes records (now - stamp) into its own latency
+        histogram before forwarding."""
+        h = getattr(self, "_marker_hist", None)
+        if h is not None:
+            h.update(time.time() * 1000.0 - wall_ms)
         if self.downstream:
             self.downstream.on_marker(wall_ms)
         if self.sides:
@@ -408,6 +424,10 @@ class WindowStepRunner(StepRunner):
             from flink_tpu.runtime.fused_window_operator import FusedWindowOperator
 
             batch_size = config.get(ExecutionOptions.BATCH_SIZE)
+            # only the fused operator's drain is a blocking device readback
+            # (deferred superbatch resolution); everywhere else drain is a
+            # host list swap and timing it would inflate deviceDispatches
+            self._drain_resolves_device = True
             self.op = FusedWindowOperator(
                 assigner,
                 device_agg,
@@ -446,6 +466,15 @@ class WindowStepRunner(StepRunner):
             self.device = False
         self.processing_time = not assigner.is_event_time
         self.uid = t.uid
+        # per-fused-stage device-time attribution (host clock around the
+        # already-synchronous dispatch/readback sections; never adds syncs)
+        self._drain_resolves_device = getattr(
+            self, "_drain_resolves_device", False)
+        self.device_timer = (
+            DeviceTimer()
+            if self.device and config.get(ObservabilityOptions.DEVICE_TIMING_ENABLED)
+            else None
+        )
 
     def on_batch(self, values: np.ndarray, timestamps: np.ndarray) -> None:
         if self.device:
@@ -468,7 +497,11 @@ class WindowStepRunner(StepRunner):
                     )
             else:  # pure-count aggregates ignore the value column
                 nums = np.zeros(len(values), dtype=np.float32)
-            self.op.process_batch(keys, nums, timestamps)
+            if self.device_timer is not None:
+                with self.device_timer.section():
+                    self.op.process_batch(keys, nums, timestamps)
+            else:
+                self.op.process_batch(keys, nums, timestamps)
         else:
             if self.processing_time:
                 # PT windows: assignment & timers use wall clock, not event ts
@@ -492,7 +525,11 @@ class WindowStepRunner(StepRunner):
                 self._drain()
 
     def on_watermark(self, watermark: int) -> None:
-        self.op.process_watermark(watermark)
+        if self.device_timer is not None:
+            with self.device_timer.section():
+                self.op.process_watermark(watermark)
+        else:
+            self.op.process_watermark(watermark)
         self._drain()
         # fused operators emit asynchronously (superbatch granularity):
         # forward only the watermark their resolved output already covers,
@@ -528,7 +565,14 @@ class WindowStepRunner(StepRunner):
                     self.emit_side(tag_id, vals, tss)
                 # rows without a consumer are dropped, not accumulated
                 op_sides[tag_id] = []
-        out = self.op.drain_output()
+        if self.device_timer is not None and self._drain_resolves_device:
+            # the fused operator resolves deferred dispatches here — drain
+            # IS the blocking readback section; other operators' drain is a
+            # host list swap and is deliberately not timed
+            with self.device_timer.section():
+                out = self.op.drain_output()
+        else:
+            out = self.op.drain_output()
         if out and self.downstream:
             vals = obj_array(
                 [
@@ -550,6 +594,16 @@ class WindowStepRunner(StepRunner):
                 getattr(getattr(self.op, "timer_service", None), "current_watermark", 0),
             ),
         )
+        if self.device_timer is not None:
+            self.device_timer._hist = group.histogram("deviceDispatchMs")
+            self.device_timer.register(group)
+        state_bytes = getattr(self.op, "state_bytes", None)
+        if state_bytes is not None:
+            # HBM-resident state footprint of this operator's device arrays
+            group.gauge("stateBytes", state_bytes)
+        key_count = getattr(self.op, "state_key_count", None)
+        if key_count is not None:
+            group.gauge("stateKeyCount", key_count)
 
     def snapshot(self) -> dict:
         return {"operator": self.op.snapshot()}
@@ -1215,6 +1269,7 @@ class JobRuntime:
             self.done = False
             self.finished_signalled = False
             self.feeds = feeds              # [(runner, ordinal)]
+            self.last_marker_wall = 0.0     # marker-interval throttle state
 
         def emit_batch(self, values, ts) -> None:
             for r, o in self.feeds:
@@ -1294,17 +1349,27 @@ class JobRuntime:
             r for r in self.runners if isinstance(r, IterationHeadRunner)
         ]
         self.records_in = 0
-        # observability (O1/O3): job-scope throughput, busy-ratio, step latency
+        # observability: job-scope throughput, busy/idle/backpressure
+        # ratios (TaskIOMetricGroup analogue), step latency, device time
         self.registry = registry or MetricRegistry()
         register_runner_metrics(self.runners, self.registry)
         job_group = self.registry.group("job")
         self.records_meter = job_group.meter("numRecordsInPerSecond")
         self.step_latency = job_group.histogram("stepLatencyMs")
-        self._busy_time = 0.0
-        self._loop_time = 1e-9
         self._last_pt_tick = 0.0
-        job_group.gauge("busyTimeRatio", lambda: self._busy_time / self._loop_time)
+        self.io = TaskIOMetrics()
+        for r in self.runners:
+            bp = getattr(r, "backpressure_seconds", None)
+            if bp is not None:   # stage-output senders blocked on credits
+                self.io.add_backpressure_source(bp)
+        self.io.register(job_group)
         job_group.gauge("numRecordsIn", lambda: self.records_in)
+        job_group.gauge("deviceTimeMsTotal", lambda: sum(
+            r.device_timer.total_s * 1000.0
+            for r in self.runners
+            if getattr(r, "device_timer", None) is not None))
+        self._marker_interval = config.get(ObservabilityOptions.MARKER_INTERVAL_MS)
+        self._sampling_interval = config.get(ObservabilityOptions.SAMPLING_INTERVAL_MS)
 
     # -- checkpoint surface ----------------------------------------------
     def capture(self) -> dict:
@@ -1356,6 +1421,38 @@ class JobRuntime:
         batch_size = self.config.get(ExecutionOptions.BATCH_SIZE)
         if coordinator is not None:
             coordinator.register_on_complete(self.commit_sinks)
+        profiling = False
+        if self.config.get(ObservabilityOptions.PROFILER_ENABLED):
+            try:
+                import jax.profiler
+
+                jax.profiler.start_trace(
+                    self.config.get(ObservabilityOptions.PROFILER_DIR))
+                profiling = True
+            except Exception as e:  # noqa: BLE001 — observability never
+                import warnings      # fails the job
+
+                warnings.warn(f"jax.profiler trace capture unavailable: {e!r}",
+                              RuntimeWarning)
+        try:
+            self._run_loop(batch_size, coordinator, cancel_check,
+                           savepoint_request)
+        finally:
+            if profiling:
+                try:
+                    import jax.profiler
+
+                    jax.profiler.stop_trace()
+                except Exception:
+                    pass
+
+    def _run_loop(
+        self,
+        batch_size: int,
+        coordinator,
+        cancel_check: Optional[Callable[[], bool]],
+        savepoint_request: Optional[Callable[[], Optional[str]]],
+    ) -> None:
         for d in self.sources:
             if d.current_split is None and not d.done:
                 d.current_split = d.enumerator.next_split()
@@ -1381,16 +1478,17 @@ class JobRuntime:
                 batch = d.reader.poll_batch(batch_size)
                 if batch is None:
                     d.current_split = d.enumerator.next_split()
+                    busy_dt = 0.0
                     if d.current_split is None:
                         d.done = True
                         # a finished source must not hold back the combined
                         # watermark of still-running inputs
                         busy_t0 = time.perf_counter()
                         d.finish()
-                        self._busy_time += time.perf_counter() - busy_t0
+                        busy_dt = time.perf_counter() - busy_t0
                     else:
                         d.reader.add_split(d.current_split)
-                    self._loop_time += time.perf_counter() - loop_t0
+                    self.io.record_step(busy_dt, time.perf_counter() - loop_t0)
                     continue
                 values = batch.values
                 ts = batch.timestamps
@@ -1403,10 +1501,23 @@ class JobRuntime:
                 self.records_meter.mark(len(batch))
                 busy_t0 = time.perf_counter()
                 # latency marker stamped BEFORE the synchronous push so the
-                # sink's (now - stamp) measures this batch's real transit
-                t_mark = time.time() * 1000.0
+                # sink's (now - stamp) measures this batch's real transit.
+                # A stage-input reader forwards the UPSTREAM stage's marker
+                # (take_marker) so transit accumulates across the dataplane
+                # instead of resetting at every stage boundary; fresh stamps
+                # honor observability.latency-markers.interval-ms.
+                t_mark = None
+                take = getattr(d.reader, "take_marker", None)
+                if take is not None:
+                    t_mark = take()
+                elif self._marker_interval >= 0:
+                    now_wall = time.time() * 1000.0
+                    if now_wall - d.last_marker_wall >= self._marker_interval:
+                        d.last_marker_wall = now_wall
+                        t_mark = now_wall
                 d.emit_batch(values, ts)
-                d.emit_marker(t_mark)
+                if t_mark is not None:
+                    d.emit_marker(t_mark)
                 if d.generator is not None:
                     wm = (
                         d.generator.on_batch_np(ts)
@@ -1424,7 +1535,6 @@ class JobRuntime:
                     # checkpoints capture (almost) no in-flight feedback
                     self._drain_iterations()
                 step_dt = time.perf_counter() - busy_t0
-                self._busy_time += step_dt
                 self.step_latency.update(step_dt * 1000)
                 # step boundary: checkpoints/savepoints align here for free
                 if coordinator is not None:
@@ -1436,10 +1546,12 @@ class JobRuntime:
                 now_ms = time.time() * 1000.0
                 if now_ms - self._last_pt_tick >= 50.0:
                     # ProcessingTimeService tick: drive wall-clock timers
+                    # and sample the busy/idle/backpressure window
                     self._last_pt_tick = now_ms
                     for r in self.runners:
                         r.on_processing_time(int(now_ms))
-                self._loop_time += time.perf_counter() - loop_t0
+                    self.io.maybe_sample(self._sampling_interval)
+                self.io.record_step(step_dt, time.perf_counter() - loop_t0)
 
         # end of input: every source's final watermark + end signal has been
         # (or is now) delivered, firing all remaining windows downstream

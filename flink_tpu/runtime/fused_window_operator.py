@@ -520,6 +520,18 @@ class FusedWindowOperator:
     def num_late_records_dropped(self) -> int:
         return self.pipe.num_late_records_dropped
 
+    # -- observability gauges ------------------------------------------
+    def state_bytes(self) -> int:
+        """HBM footprint of the slice-ring arrays (0 until the pipeline's
+        first dispatch materializes them)."""
+        state = getattr(self.pipe, "_state", None) or {}
+        n = sum(int(getattr(a, "nbytes", 0)) for a in state.values())
+        n += int(getattr(getattr(self.pipe, "_count", None), "nbytes", 0) or 0)
+        return n
+
+    def state_key_count(self) -> int:
+        return len(self.keydict)
+
     def snapshot(self) -> dict:
         # flush buffered steps so keyed state lives in exactly one place
         # (the device arrays); fires this triggers land in "output" below

@@ -209,10 +209,15 @@ class MiniCluster:
     ) -> None:
         from flink_tpu.metrics.otel import OtlpJsonTraceReporter
         from flink_tpu.metrics.registry import MetricRegistry
-        from flink_tpu.metrics.traces import TraceRegistry
+        from flink_tpu.metrics.traces import TraceRegistry, job_trace_id
 
         client.metrics = MetricRegistry()
-        client.traces = TraceRegistry()
+        # one correlation id per job: every span this job emits (checkpoint
+        # lifecycle, restarts) carries it, and any process that knows the
+        # job id derives the same id (traces.job_trace_id) — JM- and
+        # TM-side spans stitch into one trace
+        client.trace_id = job_trace_id(client.job_id)
+        client.traces = TraceRegistry(trace_id=client.trace_id)
         # OTel-shape export: buffered OTLP/JSON, served at /jobs/<id>/traces
         client.otel = OtlpJsonTraceReporter(service_name="flink-tpu")
         client.traces.add_reporter(client.otel)
@@ -280,8 +285,15 @@ class MiniCluster:
                     return
                 client.num_restarts = attempt
                 client._set_status(JobStatus.RESTARTING)
+                restart_span = client.traces.span("recovery", "JobRestart") \
+                    .set_attribute("attempt", attempt) \
+                    .set_attribute("delayMs", delay) \
+                    .set_attribute("cause", repr(e)[:200])
                 time.sleep(delay / 1000.0)
                 restore_snap = coordinator.latest_snapshot() if coordinator else None
+                client.traces.report(restart_span.set_attribute(
+                    "restoredCheckpoint",
+                    bool(restore_snap)).end())
 
     def _savepoint_hook(self, client: JobClient, runtime: JobRuntime) -> Optional[str]:
         path = client._poll_savepoint_request()

@@ -12,12 +12,22 @@ view). Endpoints:
   PATCH/POST /jobs/<id>/cancel→ cancel
   POST /jobs/<id>/savepoints  → {"target-directory": dir} → trigger savepoint
   GET  /jobs/<id>/metrics     → metrics JSON
+  GET  /jobs/<id>/vertices/<uid>/backpressure
+                              → busy/idle/backPressured ratios + level
+                                (JobVertexBackPressureHandler analogue)
   GET  /metrics               → Prometheus text exposition (all jobs)
   POST /jars/run              → {"module": "/path/script.py", "entry": "main"}
                                 application-mode submission: the script builds
                                 an env and returns it (or calls execute_async)
 
 Implementation: stdlib http.server (threaded), JSON payloads.
+
+Distributed bridge: constructed with `jm_gateway` (an RPC gateway to a
+JobManagerEndpoint), the same routes ALSO serve that cluster's jobs — the
+JM aggregates the metric snapshots and trace spans its TaskExecutors ship
+on the authenticated RPC plane, and this server renders them as JSON,
+OTLP/JSON traces, and Prometheus text (per-shard samples labeled
+{job,shard}).
 """
 
 from __future__ import annotations
@@ -29,7 +39,12 @@ import uuid
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
 
-from flink_tpu.metrics.registry import prometheus_text
+from flink_tpu.metrics.registry import (
+    merge_prometheus_text,
+    prometheus_text,
+    prometheus_text_from_snapshot,
+)
+from flink_tpu.metrics.task_io import backpressure_level
 from flink_tpu.runtime.minicluster import JobStatus, MiniCluster
 
 
@@ -38,6 +53,7 @@ from flink_tpu.runtime.web_dashboard import DASHBOARD_HTML
 
 class _Handler(BaseHTTPRequestHandler):
     cluster: MiniCluster = None  # set by RestServer
+    jm = None                    # optional JobManagerEndpoint RPC gateway
 
     # -- plumbing ---------------------------------------------------------
     def log_message(self, fmt, *args):  # quiet
@@ -89,20 +105,39 @@ class _Handler(BaseHTTPRequestHandler):
                 by_status[c.status().value] = by_status.get(c.status().value, 0) + 1
             return self._json(200, {"jobs": len(self.cluster.jobs), "by_status": by_status})
         if parts == ["jobs"]:
-            return self._json(
-                200,
-                {
-                    "jobs": [
-                        {"id": c.job_id, "name": c.job_name, "status": c.status().value}
-                        for c in self.cluster.jobs.values()
-                    ]
-                },
-            )
+            jobs = [
+                {"id": c.job_id, "name": c.job_name, "status": c.status().value}
+                for c in self.cluster.jobs.values()
+            ]
+            if self.jm is not None:
+                try:
+                    jobs.extend(self.jm.list_jobs())
+                except Exception:
+                    pass   # an unreachable JM must not break local jobs
+            return self._json(200, {"jobs": jobs})
         if parts == ["metrics"]:
-            text = ""
+            texts = []
             for c in self.cluster.jobs.values():
                 if hasattr(c, "metrics"):
-                    text += prometheus_text(c.metrics.all_metrics())
+                    # every sample labeled by job id: two jobs share family
+                    # names, and unlabeled duplicates are invalid exposition
+                    texts.append(prometheus_text(c.metrics.all_metrics(),
+                                                 labels={"job": c.job_id}))
+            if self.jm is not None:
+                # distributed jobs: per-shard snapshots the TMs shipped over
+                # RPC, labeled so Prometheus keeps shards distinguishable
+                try:
+                    for j in self.jm.list_jobs():
+                        shards = self.jm.job_metrics(j["id"])["per_shard"]
+                        for shard, snap in shards.items():
+                            texts.append(prometheus_text_from_snapshot(
+                                snap, labels={"job": j["id"], "shard": shard}))
+                except Exception:
+                    pass
+            # one TYPE line per family, samples grouped — naive
+            # concatenation is invalid exposition once two jobs/shards
+            # share a family name
+            text = merge_prometheus_text(texts) if texts else ""
             return self._send(200, text.encode(), "text/plain; version=0.0.4")
         if parts == ["flamegraph"]:
             # on-demand thread sampling (JobVertexFlameGraphHandler analogue);
@@ -124,6 +159,8 @@ class _Handler(BaseHTTPRequestHandler):
         if len(parts) >= 2 and parts[0] == "jobs":
             client = self._job(parts[1])
             if client is None:
+                if self.jm is not None:
+                    return self._jm_job_routes(parts)
                 return self._json(404, {"error": f"unknown job {parts[1]}"})
             if len(parts) == 2:
                 return self._json(
@@ -135,9 +172,13 @@ class _Handler(BaseHTTPRequestHandler):
                         "records_in": client.records_in,
                         "num_restarts": client.num_restarts,
                         "num_checkpoints": getattr(client, "num_checkpoints", 0),
+                        "trace_id": getattr(client, "trace_id", None),
                         "error": repr(client.error) if client.error else None,
                     },
                 )
+            if parts[2] == "vertices" and len(parts) == 5 \
+                    and parts[4] == "backpressure":
+                return self._backpressure(client, parts[3])
             if parts[2] == "traces":
                 # OTLP/JSON resourceSpans (OpenTelemetryTraceReporter SPI)
                 if not hasattr(client, "otel"):
@@ -168,6 +209,75 @@ class _Handler(BaseHTTPRequestHandler):
                     return self._json(409, {"error": str(e)})
                 return self._json(200, _jsonable(result))
         self._json(404, {"error": f"no route {self.path}"})
+
+    # -- observability helpers --------------------------------------------
+    def _backpressure(self, client, uid: str):
+        """Backpressure view of an in-process (MiniCluster) job: the job
+        runs as ONE task, so the task-level busy/idle/backPressured ratios
+        are its single subtask's sample; vertex-scoped metrics (latency
+        histogram, device time, state bytes) ride along for the vertex."""
+        if not hasattr(client, "metrics"):
+            return self._json(200, {"status": "deprecated", "subtasks": []})
+        snap = {}
+        for k, m in client.metrics.all_metrics().items():
+            try:
+                snap[k] = m.value()
+            except Exception:
+                continue
+        bp = float(snap.get("job.backPressuredTimeRatio", 0.0) or 0.0)
+        busy = float(snap.get("job.busyTimeRatio", 0.0) or 0.0)
+        idle = float(snap.get("job.idleTimeRatio", 0.0) or 0.0)
+        prefix = f"job.operator.{uid}."
+        vertex_metrics = {
+            k[len(prefix):]: v for k, v in snap.items() if k.startswith(prefix)
+        }
+        return self._json(200, _jsonable({
+            "status": "ok",
+            "vertex": uid,
+            "backpressureLevel": backpressure_level(bp),
+            "busyRatio": busy,
+            "idleRatio": idle,
+            "backPressuredRatio": bp,
+            "subtasks": [{
+                "subtask": 0,
+                "backpressureLevel": backpressure_level(bp),
+                "backPressuredRatio": bp,
+                "busyRatio": busy,
+                "idleRatio": idle,
+            }],
+            "metrics": vertex_metrics,
+        }))
+
+    def _jm_job_routes(self, parts):
+        """Serve a distributed job from the bridged JobManagerEndpoint (the
+        aggregates its TaskExecutors shipped over the RPC plane)."""
+        job_id = parts[1]
+        try:
+            if len(parts) == 2:
+                st = self.jm.job_status(job_id)
+                return self._json(200, {
+                    "id": job_id, "name": st["name"], "status": st["status"],
+                    "num_restarts": st["restarts"],
+                    "trace_id": st.get("trace_id"),
+                    "checkpoints": st["checkpoints"],
+                    "error": st.get("failure"),
+                })
+            if parts[2] == "metrics" and len(parts) == 3:
+                return self._json(200, _jsonable(self.jm.job_metrics(job_id)))
+            if parts[2] == "traces" and len(parts) == 3:
+                from flink_tpu.metrics.otel import span_to_otlp, spans_to_otlp
+                from flink_tpu.metrics.traces import Span
+
+                enc = [span_to_otlp(Span.from_dict(d))
+                       for d in self.jm.job_spans(job_id)]
+                return self._json(200, spans_to_otlp(enc, "flink-tpu"))
+            if parts[2] == "vertices" and len(parts) == 5 \
+                    and parts[4] == "backpressure":
+                return self._json(200, _jsonable(
+                    self.jm.job_backpressure(job_id)))
+        except Exception as e:  # noqa: BLE001 — JM lookup failures -> 404
+            return self._json(404, {"error": repr(e)})
+        return self._json(404, {"error": f"no route {self.path}"})
 
     # -- POST/PATCH -------------------------------------------------------
     def do_POST(self):
@@ -245,7 +355,8 @@ class RestServer:
     """Threaded REST server bound to a MiniCluster (WebMonitorEndpoint)."""
 
     def __init__(self, cluster: Optional[MiniCluster] = None, port: int = 0,
-                 auth_token: Optional[str] = None, config=None):
+                 auth_token: Optional[str] = None, config=None,
+                 jm_gateway=None):
         """auth_token: when set, every request must carry
         `Authorization: Bearer <token>` (the reference's SSL/Kerberos
         plumbing is deployment-level — TLS terminates at the ingress in the
@@ -279,7 +390,8 @@ class RestServer:
                     )
                 auth_token = rest_bearer_token(sec)
         handler = type("BoundHandler", (_Handler,),
-                       {"cluster": self.cluster, "auth_token": auth_token})
+                       {"cluster": self.cluster, "auth_token": auth_token,
+                        "jm": jm_gateway})
         self._httpd = ThreadingHTTPServer(("127.0.0.1", port), handler)
         self.port = self._httpd.server_port
         self._thread: Optional[threading.Thread] = None

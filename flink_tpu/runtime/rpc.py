@@ -12,7 +12,9 @@ reference enforces with MainThreadValidatorUtil (MainThreadValidatorUtil.java:35
 Wire format (flink_tpu/security): connection handshake (version +
 cluster-id + nonce challenge against the cluster secret), then 4-byte
 big-endian length + HMAC-signed frame of the restricted-pickled
-(endpoint, method, args, kwargs) / (ok, payload). Frames are MAC-verified
+(endpoint, method, args, kwargs[, trace_id]) / (ok, payload) — the
+optional fifth element is the caller's trace context (W3C-traceparent
+analogue; see trace_context/current_trace_id). Frames are MAC-verified
 BEFORE deserialization and deserialized through the security allowlist;
 `security.transport.enabled: false` restores the legacy plain-pickle wire.
 This is the DCN control plane; the data plane (record batches, credits)
@@ -21,6 +23,7 @@ lives in dataplane.py.
 
 from __future__ import annotations
 
+import contextlib
 import socket
 import socketserver
 import threading
@@ -28,6 +31,34 @@ import traceback
 import uuid
 from concurrent.futures import Future
 from typing import Any, Callable, Dict, Optional
+
+
+# ---------------------------------------------------------------------------
+# trace-context propagation (W3C-traceparent-lite over the RPC frame)
+# ---------------------------------------------------------------------------
+
+_trace_ctx = threading.local()
+
+
+def current_trace_id() -> Optional[str]:
+    """Trace id of the RPC invocation currently executing on this endpoint
+    main thread (None outside an invocation or when the caller sent none).
+    The observability analogue of reading the traceparent header."""
+    return getattr(_trace_ctx, "incoming", None)
+
+
+@contextlib.contextmanager
+def trace_context(trace_id: Optional[str]):
+    """Attach `trace_id` to every RPC this thread issues inside the block:
+    the gateway appends it to the invocation frame, the receiving endpoint
+    exposes it via current_trace_id() for the duration of the handler —
+    spans emitted on both sides of the wire stitch into one trace."""
+    prev = getattr(_trace_ctx, "outgoing", None)
+    _trace_ctx.outgoing = trace_id
+    try:
+        yield
+    finally:
+        _trace_ctx.outgoing = prev
 
 from flink_tpu.security.framing import FrameAuthError, RestrictedUnpicklingError
 from flink_tpu.security.transport import (
@@ -91,11 +122,22 @@ class RpcEndpoint:
             self._cv.notify_all()
 
     # called by the server
-    def _invoke(self, method: str, args, kwargs):
+    def _invoke(self, method: str, args, kwargs, trace_id: Optional[str] = None):
         fn = getattr(self, method, None)
         if fn is None or method.startswith("_"):
             raise AttributeError(f"{self.name} has no rpc method {method!r}")
-        return self.run_in_main_thread(fn, *args, **kwargs)
+        if trace_id is None:
+            return self.run_in_main_thread(fn, *args, **kwargs)
+
+        def with_ctx(*a, **kw):
+            # surface the caller's trace id to the handler (main thread)
+            _trace_ctx.incoming = trace_id
+            try:
+                return fn(*a, **kw)
+            finally:
+                _trace_ctx.incoming = None
+
+        return self.run_in_main_thread(with_ctx, *args, **kwargs)
 
 
 class RpcService:
@@ -136,12 +178,19 @@ class RpcService:
                     if msg is None:
                         return
                     try:
-                        endpoint, method, args, kwargs = msg
+                        # 4-tuple = legacy frame; 5th element carries the
+                        # optional trace context (traceparent analogue)
+                        trace_id = None
+                        if len(msg) == 5:
+                            endpoint, method, args, kwargs, trace_id = msg
+                        else:
+                            endpoint, method, args, kwargs = msg
                         with service._lock:
                             ep = service._endpoints.get(endpoint)
                         if ep is None:
                             raise LookupError(f"no endpoint {endpoint!r}")
-                        result = ep._invoke(method, args, kwargs).result()
+                        result = ep._invoke(method, args, kwargs,
+                                            trace_id).result()
                         reply = (True, result)
                     except BaseException as e:  # noqa: BLE001 — shipped back
                         reply = (False, (type(e).__name__, str(e), traceback.format_exc()))
@@ -245,10 +294,14 @@ class RpcGateway:
             raise AttributeError(method)
 
         def call(*args, **kwargs):
+            trace_id = getattr(_trace_ctx, "outgoing", None)
+            frame = ((self._endpoint, method, args, kwargs, trace_id)
+                     if trace_id is not None
+                     else (self._endpoint, method, args, kwargs))
             with self._lock:
                 sock = self._connect()
                 try:
-                    send_obj(sock, (self._endpoint, method, args, kwargs), self._codec)
+                    send_obj(sock, frame, self._codec)
                     reply = recv_obj(sock, self._codec)
                 except (OSError, FrameAuthError, RestrictedUnpicklingError):
                     self._close_locked()

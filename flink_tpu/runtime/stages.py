@@ -23,9 +23,16 @@ iteration head/tail pairs — validated here.
 Protocol on a cross-stage channel (FIFO, credit-controlled):
   ("b", values, timestamps)  — a record batch
   ("w", watermark_ms)        — a watermark advance
+  ("m", wall_ms)             — a latency marker (source wall-clock stamp)
   ("barrier", cp_id)         — an aligned checkpoint barrier
   end-of-stream via the channel's eos frame (OutputChannel.end()).
-Latency markers do not cross stages (sampled per stage instead).
+Latency markers cross stages: the producer forwards its marker stamp as an
+("m", wall_ms) frame (throttled to one per ~100 ms per channel — markers
+are samples, and an unthrottled forward would cost one credit per batch)
+and the consuming stage's input reader hands it to the run loop
+(take_marker), so a sink's (now - stamp) measures END-TO-END transit
+across every stage and exchange hop rather than resetting at each
+boundary.
 
 Checkpoints across stages use the reference's aligned-barrier algorithm
 (CheckpointCoordinator → CheckpointBarrier → CheckpointBarrierHandler
@@ -45,6 +52,7 @@ from __future__ import annotations
 
 import dataclasses
 import threading
+import time
 from typing import Any, Dict, List, Optional
 
 import numpy as np
@@ -342,9 +350,19 @@ class _StageReader(SourceReader):
         self._box = box
         self._gate = gate
         self._aligner = aligner
+        self._pending_marker: Optional[float] = None
 
     def add_split(self, split: SourceSplit) -> None:
         pass
+
+    def take_marker(self) -> Optional[float]:
+        """Latest upstream latency-marker stamp received on this channel
+        (cleared on read). The run loop attaches it to the next batch it
+        pushes, preserving cross-stage transit measurement; markers are
+        samples, so keeping only the latest between batches is lossless for
+        percentile purposes."""
+        m, self._pending_marker = self._pending_marker, None
+        return m
 
     def poll_batch(self, max_records: int) -> Optional[Batch]:
         while not self._cancelled.is_set():
@@ -361,6 +379,9 @@ class _StageReader(SourceReader):
             if msg[0] == "w":
                 self._box.wm = max(self._box.wm, int(msg[1]))
                 return _EMPTY_BATCH               # watermark piggybacks next
+            if msg[0] == "m":
+                self._pending_marker = float(msg[1])
+                return _EMPTY_BATCH               # marker rides the next batch
             if msg[0] == "barrier":
                 if self._aligner is not None:
                     # may complete the alignment: the snapshot callback runs
@@ -409,16 +430,30 @@ class StageOutputRunner:
     sides = None
     num_inputs = 1
 
+    MARKER_FORWARD_SPACING_MS = 100.0
+
     def __init__(self, step: Step):
         t = step.terminal
         self.uid = t.uid
         self.sender = t.config["sender"]
         self.cancelled: threading.Event = t.config["cancelled"]
         self._ended = False
+        self._last_marker_fwd = 0.0
         self.records_out = None
 
     def register_metrics(self, group) -> None:
         self.records_out = group.counter("numRecordsOut")
+        # exchange-side observability: credits left (outPoolUsage inverse —
+        # 0 while the downstream stage lags) and cumulative time this task
+        # spent blocked on them (the task's backPressured contribution)
+        group.gauge("availableCredits", self.sender.available_credits)
+        group.gauge("backPressuredTimeMsTotal",
+                    lambda: self.backpressure_seconds() * 1000.0)
+
+    def backpressure_seconds(self) -> float:
+        """Cumulative seconds blocked waiting for downstream credits; the
+        task's TaskIOMetrics subtracts this from busy time."""
+        return getattr(self.sender, "backpressured_s", 0.0)
 
     def _send(self, msg) -> None:
         while True:
@@ -451,7 +486,19 @@ class StageOutputRunner:
         self._send(("w", int(watermark)))
 
     def on_marker(self, wall_ms: float) -> None:
-        pass  # latency markers are per-stage
+        # forward the stamp across the exchange so downstream stages (and
+        # ultimately the sinks) measure end-to-end transit; the send shares
+        # the data channel's credit discipline, which is exactly right — a
+        # marker delayed by backpressure reports latency that backpressure
+        # really added. Forwarding is throttled (markers are samples): with
+        # per-batch markers at the source, an unthrottled forward would add
+        # one exchange frame — one credit — per batch on the hot path.
+        if self._ended:
+            return
+        now = time.monotonic() * 1000.0
+        if now - self._last_marker_fwd >= self.MARKER_FORWARD_SPACING_MS:
+            self._last_marker_fwd = now
+            self._send(("m", float(wall_ms)))
 
     def on_processing_time(self, now_ms: int) -> None:
         pass

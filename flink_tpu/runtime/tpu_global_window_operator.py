@@ -183,6 +183,16 @@ class TpuGlobalWindowOperator:
         self.output = []
         return out
 
+    # -- observability gauges ---------------------------------------------
+    def state_bytes(self) -> int:
+        n = sum(int(getattr(a, "nbytes", 0)) for a in self.acc.values())
+        n += int(getattr(self.count, "nbytes", 0))
+        n += int(getattr(self.fired, "nbytes", 0))
+        return n
+
+    def state_key_count(self) -> int:
+        return len(self.keydict)
+
     # -- snapshot ---------------------------------------------------------
     def snapshot(self) -> dict:
         self.flush()

@@ -797,6 +797,16 @@ class TpuSessionWindowOperator:
         self.output = []
         return out
 
+    # -- observability gauges ------------------------------------------
+    def state_bytes(self) -> int:
+        n = sum(int(getattr(a, "nbytes", 0))
+                for a in (self._cnt, self._mn, self._mx))
+        n += sum(int(getattr(f, "nbytes", 0)) for f in self._fields)
+        return n
+
+    def state_key_count(self) -> int:
+        return len(self.keydict)
+
     # ------------------------------------------------------------------
     def snapshot(self) -> dict:
         if self._pending:

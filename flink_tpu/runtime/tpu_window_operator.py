@@ -408,6 +408,13 @@ class TpuWindowOperator:
         self.output = []
         return out
 
+    # -- observability gauges ------------------------------------------
+    def state_bytes(self) -> int:
+        return self.state.state_bytes()
+
+    def state_key_count(self) -> int:
+        return len(self.state.keydict)
+
     # ------------------------------------------------------------------
     # snapshot / restore
     # ------------------------------------------------------------------

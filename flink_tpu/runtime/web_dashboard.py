@@ -72,6 +72,34 @@ function kv(obj) {
     ([k, v]) => `<div><span>${k}</span>${v}</div>`).join("") + "</div>";
 }
 
+const bpClass = (r) => r > 0.5 ? "FAILED" : (r > 0.1 ? "CANCELED" : "RUNNING");
+
+function operatorTable(metrics) {
+  // per-operator observability: latency-marker percentiles, device time,
+  // HBM state footprint — parsed from the job.operator.<uid>.* scope
+  const ops = {};
+  for (const [k, v] of Object.entries(metrics)) {
+    const m = k.match(/^job\\.operator\\.([^.]+)\\.(.+)$/);
+    if (m) (ops[m[1]] ??= {})[m[2]] = v;
+  }
+  const rows = Object.entries(ops).map(([uid, m]) => {
+    const lat = m["latencyMs"] || {};
+    const disp = m["deviceDispatchMs"] || {};
+    return `<tr><td>${esc(uid)}</td>
+      <td>${fmt(lat.p50)} / ${fmt(lat.p99)}</td>
+      <td>${fmt(disp.p50)} / ${fmt(disp.p99)}</td>
+      <td>${fmt(m["deviceTimeMsTotal"])}</td>
+      <td>${fmt(m["stateBytes"])}</td>
+      <td>${fmt(m["stateKeyCount"])}</td>
+      <td>${fmt(m["numLateRecordsDropped"])}</td></tr>`;
+  });
+  if (!rows.length) return "";
+  return `<table><thead><tr><th>operator</th><th>latency p50/p99 ms</th>
+    <th>dispatch p50/p99 ms</th><th>device ms</th><th>state bytes</th>
+    <th>keys</th><th>late dropped</th></tr></thead>
+    <tbody>${rows.join("")}</tbody></table>`;
+}
+
 async function detailRow(id) {
   const [info, metrics, traces] = await Promise.all([
     j(`/jobs/${id}`), j(`/jobs/${id}/metrics`),
@@ -83,17 +111,23 @@ async function detailRow(id) {
     const at = Object.fromEntries(
       s.attributes.map(a => [a.key, Object.values(a.value)[0]]));
     return esc(`${s.name} #${at.checkpointId ?? ""} ${ms.toFixed(1)}ms ` +
-               `${at.status ?? ""} ${fmt(Number(at.stateSizeBytes))}B`);
+               `${at.status ?? ""} ${fmt(Number(at.stateSizeBytes))}B ` +
+               `trace:${(s.traceId ?? "").slice(0, 8)}`);
   }).join("<br>");
   const latency = metrics["job.stepLatencyMs"] || {};
+  const bp = metrics["job.backPressuredTimeRatio"] ?? 0;
   return kv({
     "records/s": fmt(metrics["job.numRecordsInPerSecond"]),
     "busy ratio": fmt(metrics["job.busyTimeRatio"], 2),
+    "idle ratio": fmt(metrics["job.idleTimeRatio"], 2),
+    "backpressured": `<span class="${bpClass(bp)}">${fmt(bp, 2)}</span>`,
     "step p50 ms": fmt(latency.p50), "step p99 ms": fmt(latency.p99),
+    "device ms total": fmt(metrics["job.deviceTimeMsTotal"]),
     "late dropped": fmt(Object.entries(metrics).find(
         ([k]) => k.endsWith("numLateRecordsDropped"))?.[1]),
     "error": esc(info.error ?? "none"),
-  }) + (spanRows ? `<div class="spans">${spanRows}</div>` : "");
+  }) + operatorTable(metrics)
+    + (spanRows ? `<div class="spans">${spanRows}</div>` : "");
 }
 
 async function refresh() {

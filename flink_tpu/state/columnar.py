@@ -251,6 +251,15 @@ class ColumnarWindowState:
         self.acc, self.count = segment_ops.init_state_arrays(self.agg, self.K, self.S)
         self.last_touch = None
 
+    def state_bytes(self) -> int:
+        """HBM footprint of the resident device arrays (observability
+        gauge; key-dictionary host memory not included)."""
+        n = sum(int(getattr(a, "nbytes", 0)) for a in self.acc.values())
+        n += int(getattr(self.count, "nbytes", 0))
+        if self.last_touch is not None:
+            n += int(getattr(self.last_touch, "nbytes", 0))
+        return n
+
     # ------------------------------------------------------------------
     def snapshot(self) -> dict:
         return {

@@ -1,0 +1,43 @@
+"""Compatibility shims over moving jax APIs.
+
+`shard_map` has lived in three places across the jax versions this repo
+meets in the wild: `jax.experimental.shard_map.shard_map` (<= 0.4.x),
+`jax.shard_map` (>= 0.8), with the replication-check kwarg renamed
+`check_rep` -> `check_vma` along the way. Importing through this module
+gives every caller one spelling (`shard_map`, new-style `check_vma`
+kwarg) and a single flag (`HAS_SHARD_MAP`) to gate tests and optional
+fan-out paths on builds where neither form exists.
+"""
+
+from __future__ import annotations
+
+import inspect
+
+HAS_SHARD_MAP = True
+
+try:  # jax >= 0.8: top-level export, `check_vma` kwarg
+    from jax import shard_map as _shard_map
+except ImportError:
+    try:  # older jax: experimental module, `check_rep` kwarg
+        from jax.experimental.shard_map import shard_map as _shard_map
+    except ImportError:  # pragma: no cover - no shard_map at all
+        _shard_map = None
+        HAS_SHARD_MAP = False
+
+
+if _shard_map is None:  # pragma: no cover - exercised only on crippled builds
+    def shard_map(*_args, **_kwargs):
+        raise NotImplementedError(
+            "this jax build provides neither jax.shard_map nor "
+            "jax.experimental.shard_map; multi-shard execution is unavailable"
+        )
+else:
+    _params = inspect.signature(_shard_map).parameters
+    if "check_vma" in _params:
+        shard_map = _shard_map
+    else:
+        def shard_map(*args, **kwargs):
+            # translate the new-style kwarg for pre-rename jax
+            if "check_vma" in kwargs:
+                kwargs["check_rep"] = kwargs.pop("check_vma")
+            return _shard_map(*args, **kwargs)

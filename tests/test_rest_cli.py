@@ -93,7 +93,9 @@ def test_rest_submit_list_info_metrics(cluster_server, tmp_path):
     assert metrics["job.numRecordsIn"] == 500
 
     status, body = _get(f"{server.url}/metrics")
-    assert b"job_numRecordsIn 500" in body
+    # samples are labeled per job so several jobs' families merge validly
+    assert f'job_numRecordsIn{{job="{job_id}"}} 500'.encode() in body
+    assert b"# TYPE job_numRecordsIn gauge" in body
 
     status, body = _get(f"{server.url}/overview")
     assert json.loads(body)["by_status"]["FINISHED"] >= 1

@@ -8,7 +8,11 @@ from jax.sharding import PartitionSpec as P
 
 from flink_tpu.parallel.mesh import build_mesh
 from flink_tpu.parallel.ring import ring_all_gather, ring_all_reduce, ring_global_topk
-from jax.experimental.shard_map import shard_map
+from flink_tpu.utils.jax_compat import HAS_SHARD_MAP
+
+pytestmark = pytest.mark.skipif(
+    not HAS_SHARD_MAP, reason="this jax build lacks shard_map")
+from flink_tpu.utils.jax_compat import shard_map
 
 
 @pytest.fixture(scope="module")
