@@ -927,6 +927,103 @@ class Child:
 _CHILDREN: list = []
 
 
+# ---------------------------------------------------------------------------
+# dataplane microbench: localhost exchange, 1 MiB columnar batches
+# ---------------------------------------------------------------------------
+
+def dataplane_microbench(batches: int = 24, max_sweeps: int = 12,
+                         min_sweeps: int = 6, budget_s: float = 120.0) -> dict:
+    """Cross-host exchange throughput over the REAL dataplane stack
+    (ExchangeServer + OutputChannel on loopback): 1 MiB columnar batches
+    — 64k float64 values + 64k int64 timestamps — on the zero-copy binary
+    columnar wire vs the legacy pickle wire, with transport auth on and
+    off. Emits exchange_gbps_{pickle,binary}[_noauth] so the serialization
+    tax removed by ISSUE-3 stays tracked in the bench trajectory.
+
+    Protocol: configurations are sampled in interleaved sweeps (so a calm
+    or noisy scheduling window hits all of them, not just one) and each
+    reports the BEST sweep — throughput microbenchmarks on shared or
+    sandboxed hosts see multi-x scheduler noise, and max-of-N estimates
+    the wire's capability the way min-of-N estimates latency. Ring
+    capacity exceeds the batch count so credit flow never throttles the
+    measurement. Sweeping stops early only on CONVERGENCE — two
+    consecutive sweeps that improve no configuration's best by more than
+    3% — never on the value of the ratio itself, so the stop rule cannot
+    bias the reported numbers toward any threshold."""
+    import threading as _threading
+
+    from flink_tpu.runtime.dataplane import ExchangeServer, OutputChannel
+    from flink_tpu.security.transport import SecurityConfig
+
+    vals = np.random.default_rng(0).random(1 << 16)       # 512 KiB float64
+    ts = np.arange(1 << 16, dtype=np.int64)               # 512 KiB int64
+    payload = ("b", vals, ts)
+    nbytes = vals.nbytes + ts.nbytes
+
+    def one_rep(wire_format: str, security) -> float:
+        warm = 4
+        server = ExchangeServer(capacity=batches + warm + 1,
+                                wire_format=wire_format, security=security)
+        ch = server.channel("bench")
+        out = OutputChannel(server.address, "bench",
+                            wire_format=wire_format, security=security)
+        done = _threading.Event()
+
+        def consume():
+            for _ in range(batches + warm):
+                ch.poll(timeout=30)
+            done.set()
+
+        t = _threading.Thread(target=consume, daemon=True)
+        t.start()
+        for _ in range(warm):
+            out.send(payload)
+        t0 = time.perf_counter()
+        for _ in range(batches):
+            out.send(payload)
+        done.wait(timeout=60)
+        dt = time.perf_counter() - t0
+        out.end()
+        out.close()
+        server.stop()
+        return batches * nbytes / dt / 1e9
+
+    configs = {
+        "exchange_gbps_pickle": ("pickle", None),
+        "exchange_gbps_binary": ("binary", None),
+        "exchange_gbps_pickle_noauth": ("pickle", SecurityConfig.disabled()),
+        "exchange_gbps_binary_noauth": ("binary", SecurityConfig.disabled()),
+    }
+    seen: dict = {k: 0.0 for k in configs}
+    sweeps = 0
+    flat_sweeps = 0
+    # hard wall-clock cap: the microbench shares the bench's fixed budget
+    # with the TPU attempts — a deadlocked exchange (60 s rep timeouts)
+    # must not eat the window that produces the headline metric
+    bench_deadline = time.perf_counter() + budget_s
+    for sweep in range(max_sweeps):
+        improved = False
+        for key, (fmt, sec) in configs.items():
+            if time.perf_counter() > bench_deadline:
+                break
+            got = one_rep(fmt, sec)
+            if got > seen[key] * 1.03:
+                improved = True
+            seen[key] = max(seen[key], got)
+        sweeps = sweep + 1
+        flat_sweeps = 0 if improved else flat_sweeps + 1
+        if sweeps >= min_sweeps and flat_sweeps >= 2:
+            break
+        if time.perf_counter() > bench_deadline:
+            break
+
+    res: dict = {"batch_bytes": nbytes, "batches": batches, "sweeps": sweeps}
+    res.update({k: round(v, 3) for k, v in seen.items()})
+    res["binary_vs_pickle_auth"] = round(
+        res["exchange_gbps_binary"] / max(res["exchange_gbps_pickle"], 1e-9), 2)
+    return res
+
+
 def parent_main() -> None:
     deadline = time.monotonic() + BUDGET_S - 15
     best = {
@@ -938,6 +1035,14 @@ def parent_main() -> None:
     }
     best_rank = -1
     lock = threading.Lock()
+
+    # host-only, a few seconds: the exchange microbench never touches the
+    # chip, so it runs up front and rides every outcome of the TPU attempts
+    try:
+        dataplane = dataplane_microbench()
+    except Exception as e:  # noqa: BLE001 — the headline must survive
+        dataplane = {"error": repr(e)[:300]}
+    _emit({"event": "dataplane_microbench", "result": dataplane})
 
     def consider(res, rank):
         nonlocal best, best_rank
@@ -952,6 +1057,7 @@ def parent_main() -> None:
     def finish():
         if not printed.is_set():
             printed.set()
+            best["dataplane"] = dataplane
             print(json.dumps(best), flush=True)
             for c in _CHILDREN:
                 # never orphan a TPU child: it would keep the single-client

@@ -271,6 +271,52 @@ class ExecutionOptions:
     )
 
 
+class ExchangeOptions:
+    """The cross-host dataplane exchange (runtime/dataplane.py — the DCN
+    counterpart of the reference's Netty shuffle and its
+    taskmanager.network.* options). Wire format and credit cadence are
+    negotiated per connection, so mixed-version clusters interoperate:
+    a peer that does not speak the binary wire downgrades that channel to
+    the legacy pickled frames transparently."""
+
+    WIRE_FORMAT = (
+        ConfigOptions.key("exchange.wire-format").string_type().default_value("binary")
+    ).with_description(
+        "Encoding for record batches on cross-host exchange channels. "
+        "'binary' (default) is the zero-copy columnar wire "
+        "(flink_tpu/security/wire.py): little-endian header + raw array "
+        "buffers sent with scatter-gather I/O and incrementally MACed — no "
+        "serialization copy for contiguous numeric columns. 'pickle' forces "
+        "the legacy restricted-pickle frames everywhere (debugging / "
+        "downgrade). Control frames always stay on the pickle codec."
+    )
+    CREDIT_BATCH = (
+        ConfigOptions.key("exchange.credit-batch").int_type().default_value(0)
+    ).with_description(
+        "Coalescing grain for credit grants: the receiver banks freed ring "
+        "slots and sends one credit frame per this many slots instead of "
+        "one per consumed batch. 0 (default) derives capacity/4 from the "
+        "ring capacity; 1 restores per-batch grants. Backpressure blocking "
+        "semantics are unchanged — only the control-frame rate drops."
+    )
+    DEBLOAT_ENABLED = (
+        ConfigOptions.key("exchange.debloat.enabled").bool_type().default_value(True)
+    ).with_description(
+        "Adaptive batch sizing on stage-boundary senders (BufferDebloater "
+        "analogue): each sender EMAs its observed send throughput and "
+        "splits outgoing batches larger than throughput x target latency, "
+        "so a backpressured channel carries smaller batches (lower queueing "
+        "latency) while a fast channel passes batches through whole."
+    )
+    DEBLOAT_TARGET_LATENCY_MS = (
+        ConfigOptions.key("exchange.debloat.target-latency-ms")
+        .duration_ms_type().default_value(200)
+    ).with_description(
+        "Target per-batch transit latency the debloater sizes toward "
+        "(taskmanager.network.memory.buffer-debloat.target analogue)."
+    )
+
+
 class CheckpointingOptions:
     INTERVAL_MS = ConfigOptions.key("execution.checkpointing.interval").duration_ms_type().default_value(0)
     DIRECTORY = ConfigOptions.key("execution.checkpointing.dir").string_type().no_default_value()

@@ -42,18 +42,31 @@ class Gauge:
 
 
 class Meter:
-    """Rate over a sliding 60s window + lifetime count (MeterView analogue)."""
+    """Rate over a sliding 60s window + lifetime count (MeterView analogue).
+
+    Marks COALESCE into 100 ms buckets, so memory stays O(window) no matter
+    the event rate (the reference MeterView keeps fixed per-second buckets
+    for the same reason) — a dataplane channel marking per frame must not
+    grow a tuple per frame. Lock-protected: senders mark() from their own
+    threads while the heartbeat/snapshot thread reads rate()."""
+
+    BUCKET_S = 0.1
 
     def __init__(self, clock=time.monotonic):
         self._clock = clock
-        self._events = deque()  # (t, n)
+        self._events = deque()  # [bucket_start_t, n] buckets, oldest first
         self._count = 0
+        self._lock = threading.Lock()
 
     def mark(self, n: int = 1) -> None:
         now = self._clock()
-        self._events.append((now, n))
-        self._count += n
-        self._trim(now)
+        with self._lock:
+            self._count += n
+            if self._events and now - self._events[-1][0] < self.BUCKET_S:
+                self._events[-1][1] += n
+            else:
+                self._events.append([now, n])
+            self._trim(now)
 
     def _trim(self, now: float) -> None:
         while self._events and now - self._events[0][0] > 60.0:
@@ -61,15 +74,17 @@ class Meter:
 
     @property
     def count(self) -> int:
-        return self._count
+        with self._lock:
+            return self._count
 
     def rate(self) -> float:
         now = self._clock()
-        self._trim(now)
-        if not self._events:
-            return 0.0
-        span = max(now - self._events[0][0], 1e-9)
-        return sum(n for _, n in self._events) / span
+        with self._lock:
+            self._trim(now)
+            if not self._events:
+                return 0.0
+            span = max(now - self._events[0][0], 1e-9)
+            return sum(n for _, n in self._events) / span
 
     def value(self):
         return self.rate()

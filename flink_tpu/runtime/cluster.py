@@ -1015,7 +1015,9 @@ class _ShardTask:
         barriers are forwarded into every out-channel, and the JM is
         acked. Restore = per-stage snapshot + source rewind; FIFO channels
         mean no channel state is part of the cut."""
-        from flink_tpu.runtime.dataplane import OutputChannel
+        from flink_tpu.config import ExchangeOptions
+        from flink_tpu.metrics.exchange import register_channel_metrics
+        from flink_tpu.runtime.dataplane import BatchDebloater, OutputChannel
         from flink_tpu.runtime.executor import (
             JobCancelledException,
             JobRuntime,
@@ -1028,11 +1030,14 @@ class _ShardTask:
             stage_has_original_sources,
         )
 
+        cfg = self.spec.config
+        wire_fmt = cfg.get(ExchangeOptions.WIRE_FORMAT)
         stage_idx = self.shard
         edges = cross_edges(self.spec.graph)
         ins: Dict[str, object] = {}
         outs: Dict[str, OutputChannel] = {}
         out_order: List[str] = []
+        debloaters: Dict[str, BatchDebloater] = {}
         for e in edges:
             cid = f"{self.job_id}/a{self.attempt}/{e.edge_id}"
             if e.dst_stage == stage_idx:
@@ -1040,13 +1045,22 @@ class _ShardTask:
             if e.src_stage == stage_idx:
                 outs[e.edge_id] = OutputChannel(
                     self.peers[e.dst_stage], cid,
-                    security=self.te.exchange.security)
+                    security=self.te.exchange.security,
+                    wire_format=wire_fmt)
                 out_order.append(e.edge_id)
+                if cfg.get(ExchangeOptions.DEBLOAT_ENABLED):
+                    debloaters[e.edge_id] = BatchDebloater(
+                        target_latency_s=cfg.get(
+                            ExchangeOptions.DEBLOAT_TARGET_LATENCY_MS) / 1000.0)
         # input-side ring occupancy (inPoolUsage analogue): persistently
-        # full = THIS stage is the bottleneck, empty = starved by upstream
+        # full = THIS stage is the bottleneck, empty = starved by upstream;
+        # per-channel byte counters/rates on both ends (numBytesIn/Out)
         exch_group = self.registry.group("job", "exchange")
         for eid, ch in ins.items():
             exch_group.gauge(f"inPoolUsage.{eid}", ch.occupancy)
+            register_channel_metrics(exch_group, eid, inbound=ch)
+        for eid, och in outs.items():
+            register_channel_metrics(exch_group, eid, outbound=och)
 
         task = self
         rt_box: list = [None]
@@ -1074,7 +1088,7 @@ class _ShardTask:
 
         graph = build_stage_graph(
             self.spec.graph, stage_idx, ins, outs, self.cancelled,
-            aligner=aligner,
+            aligner=aligner, debloaters=debloaters,
         )
         rt = JobRuntime(graph, self.spec.config, registry=self.registry)
         rt_box[0] = rt
@@ -1334,17 +1348,26 @@ class _ShardTask:
             results.extend(self.restore.get("results", []))
 
         # output channels to every shard (incl. self, for uniformity)
+        from flink_tpu.config import ExchangeOptions
+        from flink_tpu.metrics.exchange import register_channel_metrics
+
+        wire_fmt = (cfg.get(ExchangeOptions.WIRE_FORMAT) if cfg is not None
+                    else ExchangeOptions.WIRE_FORMAT.default)
+        exch_metrics_group = self.registry.group("job", "exchange")
         outs: Dict[int, OutputChannel] = {}
         for dst in range(P):
             outs[dst] = OutputChannel(
                 self.peers[dst], f"{self.job_id}/a{self.attempt}/{self.shard}->{dst}",
-                security=self.te.exchange.security,
+                security=self.te.exchange.security, wire_format=wire_fmt,
             )
             io.add_backpressure_source(
                 lambda ch=outs[dst]: ch.backpressured_s)
+            register_channel_metrics(exch_metrics_group, str(dst),
+                                     outbound=outs[dst])
         ins = {src: self.te.exchange.channel(self._channel_id(src)) for src in range(P)}
         for src, ch in ins.items():
             job_group.gauge(f"exchange.inPoolUsage.{src}", ch.occupancy)
+            register_channel_metrics(exch_metrics_group, str(src), inbound=ch)
 
         step = self.restore_step
         n_steps = len(batches)
@@ -1460,7 +1483,8 @@ class TaskExecutorEndpoint(RpcEndpoint):
     """TM RPC endpoint (D1 scope): deploy/cancel/checkpoint tasks."""
 
     def __init__(self, rpc: RpcService, *, tm_id: Optional[str] = None,
-                 slots: int = 1, shipping_interval_ms: int = 500):
+                 slots: int = 1, shipping_interval_ms: int = 500,
+                 config=None):
         super().__init__(name="taskexecutor")
         self.tm_id = tm_id or f"tm-{uuid.uuid4().hex[:8]}"
         self.rpc = rpc
@@ -1470,8 +1494,19 @@ class TaskExecutorEndpoint(RpcEndpoint):
         self.shipping_interval_ms = shipping_interval_ms
         self._last_ship = 0.0
         # one SecurityConfig governs both of this TM's planes: the exchange
-        # handshakes with the same cluster secret as the RPC service
-        self.exchange = ExchangeServer(security=rpc.security)
+        # handshakes with the same cluster secret as the RPC service.
+        # `config` (a Configuration, e.g. from the taskmanager's --conf)
+        # sets the TM-level exchange knobs: what wire format this receiver
+        # advertises and the credit-coalescing grain.
+        exch_kw = {}
+        if config is not None:
+            from flink_tpu.config import ExchangeOptions
+
+            exch_kw = dict(
+                wire_format=config.get(ExchangeOptions.WIRE_FORMAT),
+                credit_batch=config.get(ExchangeOptions.CREDIT_BATCH),
+            )
+        self.exchange = ExchangeServer(security=rpc.security, **exch_kw)
         self._tasks: Dict[Tuple[str, int, int], _ShardTask] = {}
         # task-local state store (S11): latest acked snapshot per (job, shard)
         self._local_state: Dict[Tuple[str, int], Tuple[int, dict]] = {}
@@ -1684,13 +1719,14 @@ def main(argv: Optional[List[str]] = None) -> None:
     else:
         svc = RpcService(security=security)
         ship_ms = 500
+        conf = None
         if args.conf:
             from flink_tpu.config import Configuration, ObservabilityOptions
 
-            ship_ms = Configuration.load(args.conf).get(
-                ObservabilityOptions.SHIPPING_INTERVAL_MS)
+            conf = Configuration.load(args.conf).add_all(Configuration.from_env())
+            ship_ms = conf.get(ObservabilityOptions.SHIPPING_INTERVAL_MS)
         te = TaskExecutorEndpoint(svc, slots=args.slots,
-                                  shipping_interval_ms=ship_ms)
+                                  shipping_interval_ms=ship_ms, config=conf)
         te.connect(args.jobmanager)
         print(f"taskmanager {te.tm_id} registered with {args.jobmanager} "
               f"(rpc {svc.address}, exchange {te.exchange.address})", flush=True)

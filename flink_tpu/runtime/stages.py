@@ -437,6 +437,11 @@ class StageOutputRunner:
         self.uid = t.uid
         self.sender = t.config["sender"]
         self.cancelled: threading.Event = t.config["cancelled"]
+        # BufferDebloater analogue at batch granularity: observes this
+        # sender's achieved throughput and splits oversized batches toward
+        # throughput x target latency, so a backpressured exchange carries
+        # smaller batches (None = exchange.debloat.enabled: false)
+        self.debloater = t.config.get("debloater")
         self._ended = False
         self._last_marker_fwd = 0.0
         self.records_out = None
@@ -449,6 +454,8 @@ class StageOutputRunner:
         group.gauge("availableCredits", self.sender.available_credits)
         group.gauge("backPressuredTimeMsTotal",
                     lambda: self.backpressure_seconds() * 1000.0)
+        if self.debloater is not None:
+            group.gauge("debloatedBatchSize", self.debloater.batch_size)
 
     def backpressure_seconds(self) -> float:
         """Cumulative seconds blocked waiting for downstream credits; the
@@ -477,10 +484,31 @@ class StageOutputRunner:
         self.on_end()
 
     def on_batch(self, values, timestamps) -> None:
-        if len(timestamps):
-            if self.records_out is not None:
-                self.records_out.inc(len(timestamps))
+        n = len(timestamps)
+        if not n:
+            return
+        if self.records_out is not None:
+            self.records_out.inc(n)
+        d = self.debloater
+        if d is None:
             self._send(("b", values, timestamps))
+            return
+        # split-only debloating: an oversized batch is sent in target-sized
+        # slices (views, no copies). Splitting is stateless, so it composes
+        # with aligned checkpoints — nothing is ever buffered across a
+        # barrier. Until the first observation the batch passes through
+        # whole (min_size would shred it for no reason).
+        target = max(d.batch_size(), 1) if d.observed else n
+        t0 = time.perf_counter()
+        if n > target:
+            for lo in range(0, n, target):
+                self._send(("b", values[lo:lo + target],
+                            timestamps[lo:lo + target]))
+        else:
+            self._send(("b", values, timestamps))
+        # send time includes any credit wait — exactly the signal that
+        # should shrink batches under backpressure
+        d.observe(n, time.perf_counter() - t0)
 
     def on_watermark(self, watermark: int) -> None:
         self._send(("w", int(watermark)))
@@ -542,6 +570,7 @@ def build_stage_graph(
     out_senders: Dict[str, Any],
     cancelled: threading.Event,
     aligner: Optional[BarrierAligner] = None,
+    debloaters: Optional[Dict[str, Any]] = None,
 ) -> StepGraph:
     """Carve stage `stage_idx` out of `graph` (the task's OWN unpickled
     copy — mutated in place): cross-stage inputs become StageInputSource
@@ -585,7 +614,8 @@ def build_stage_graph(
             producer = graph.steps[e.producer_step]
             out_t = Transformation(
                 "stage_output", f"stage-out:{e.edge_id}", [],
-                {"sender": out_senders[e.edge_id], "cancelled": cancelled},
+                {"sender": out_senders[e.edge_id], "cancelled": cancelled,
+                 "debloater": (debloaters or {}).get(e.edge_id)},
             )
             out_t.uid = f"stage-out-{e.edge_id}"
             out_t.id = f"stage-out-{e.edge_id}"   # collision-proof (see above)

@@ -116,6 +116,12 @@ async function detailRow(id) {
   }).join("<br>");
   const latency = metrics["job.stepLatencyMs"] || {};
   const bp = metrics["job.backPressuredTimeRatio"] ?? 0;
+  // per-channel exchange byte rates (job.exchange.numBytes{In,Out}PerSecond.<ch>)
+  // summed to task totals — nonzero only for jobs with cross-host exchanges
+  const exch = dir => Object.entries(metrics)
+    .filter(([k]) => k.includes(`exchange.numBytes${dir}PerSecond`))
+    .reduce((a, [, v]) => a + (Number(v) || 0), 0);
+  const exchOut = exch("Out"), exchIn = exch("In");
   return kv({
     "records/s": fmt(metrics["job.numRecordsInPerSecond"]),
     "busy ratio": fmt(metrics["job.busyTimeRatio"], 2),
@@ -123,6 +129,7 @@ async function detailRow(id) {
     "backpressured": `<span class="${bpClass(bp)}">${fmt(bp, 2)}</span>`,
     "step p50 ms": fmt(latency.p50), "step p99 ms": fmt(latency.p99),
     "device ms total": fmt(metrics["job.deviceTimeMsTotal"]),
+    "exchange out B/s": fmt(exchOut), "exchange in B/s": fmt(exchIn),
     "late dropped": fmt(Object.entries(metrics).find(
         ([k]) => k.endsWith("numLateRecordsDropped"))?.[1]),
     "error": esc(info.error ?? "none"),

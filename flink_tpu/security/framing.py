@@ -166,21 +166,40 @@ class FrameCodec:
         self._send_seq = 0
         self._recv_seq = 0
 
-    def _mac(self, direction: bytes, seq: int, payload: bytes) -> bytes:
-        msg = direction + struct.pack(">Q", seq) + payload
-        return hmac.new(self._key, msg, hashlib.sha256).digest()
+    def _mac(self, direction: bytes, seq: int, parts) -> bytes:
+        h = hmac.new(self._key, direction + struct.pack(">Q", seq),
+                     hashlib.sha256)
+        for p in parts:
+            h.update(p)
+        return h.digest()
 
     def seal(self, payload: bytes) -> bytes:
-        mac = self._mac(self._send_dir, self._send_seq, payload)
+        return self.seal_parts((payload,)) + payload
+
+    def seal_parts(self, parts) -> bytes:
+        """MAC for a scatter-gather frame: HMAC(session_key, dir || seq ||
+        part0 || part1 || ...) computed INCREMENTALLY (`hmac.update` per
+        part), so a multi-buffer frame — e.g. the binary columnar wire's
+        header + sidecar + array buffers (security/wire.py) — is
+        authenticated without ever materializing the concatenated copy.
+        Consumes one send-sequence slot; the caller transmits the returned
+        MAC alongside the same parts in the same order."""
+        mac = self._mac(self._send_dir, self._send_seq, parts)
         self._send_seq += 1
-        return mac + payload
+        return mac
 
     def open(self, frame: bytes) -> bytes:
         if len(frame) < MAC_LEN:
             raise FrameAuthError("frame shorter than its MAC")
         mac, payload = frame[:MAC_LEN], frame[MAC_LEN:]
-        want = self._mac(self._recv_dir, self._recv_seq, payload)
+        self.open_parts(mac, (payload,))
+        return payload
+
+    def open_parts(self, mac: bytes, parts) -> None:
+        """Verify a scatter-gather frame's MAC (constant-time compare)
+        BEFORE any part is parsed or deserialized; consumes one
+        recv-sequence slot. Raises FrameAuthError on mismatch."""
+        want = self._mac(self._recv_dir, self._recv_seq, parts)
         if not hmac.compare_digest(mac, want):
             raise FrameAuthError("frame MAC verification failed")
         self._recv_seq += 1
-        return payload

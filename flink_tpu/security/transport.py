@@ -39,7 +39,14 @@ import tempfile
 import threading
 from typing import Optional
 
-from flink_tpu.security.framing import FrameAuthError, FrameCodec, dumps, restricted_loads
+from flink_tpu.security.framing import (
+    MAC_LEN,
+    FrameAuthError,
+    FrameCodec,
+    dumps,
+    restricted_loads,
+)
+from flink_tpu.security import wire
 
 MAGIC = b"FTPU"
 PROTOCOL_VERSION = 1
@@ -64,14 +71,8 @@ def send_frame(sock: socket.socket, payload: bytes) -> None:
     sock.sendall(struct.pack(">I", len(payload)) + payload)
 
 
-def recv_frame(sock: socket.socket) -> Optional[bytes]:
-    hdr = b""
-    while len(hdr) < 4:
-        chunk = sock.recv(4 - len(hdr))
-        if not chunk:
-            return None
-        hdr += chunk
-    (n,) = struct.unpack(">I", hdr)
+def _read_n(sock: socket.socket, n: int) -> Optional[bytes]:
+    """Exactly n bytes off the socket, or None if the peer closes first."""
     buf = bytearray()
     while len(buf) < n:
         chunk = sock.recv(min(1 << 20, n - len(buf)))
@@ -79,6 +80,27 @@ def recv_frame(sock: socket.socket) -> Optional[bytes]:
             return None
         buf += chunk
     return bytes(buf)
+
+
+def _recv_into_exact(sock: socket.socket, buf) -> bool:
+    """Fill `buf` straight from the kernel (`recv_into`, no intermediate
+    chunk accumulation); False if the peer closes mid-frame."""
+    view = memoryview(buf)
+    got = 0
+    while got < len(buf):
+        r = sock.recv_into(view[got:])
+        if r == 0:
+            return False
+        got += r
+    return True
+
+
+def recv_frame(sock: socket.socket) -> Optional[bytes]:
+    hdr = _read_n(sock, 4)
+    if hdr is None:
+        return None
+    (n,) = struct.unpack(">I", hdr)
+    return _read_n(sock, n)
 
 
 def _recv_exact(sock: socket.socket, n: int) -> bytes:
@@ -371,9 +393,22 @@ def client_handshake(sock: socket.socket, sec: SecurityConfig) -> FrameCodec:
 # object send/recv used by every plane
 # ---------------------------------------------------------------------------
 
-def send_obj(sock: socket.socket, obj, codec: Optional[FrameCodec]) -> None:
+def send_obj(sock: socket.socket, obj, codec: Optional[FrameCodec]) -> int:
+    """Restricted-pickle frame send; returns bytes written to the wire (the
+    dataplane's numBytesOut accounting reads this)."""
     payload = dumps(obj)
-    send_frame(sock, codec.seal(payload) if codec is not None else payload)
+    mac_len = MAC_LEN if codec is not None else 0
+    if len(payload) + mac_len >= wire.DATA_FLAG:
+        # bit 31 of the length prefix marks binary data frames on the
+        # dataplane; a >= 2 GiB pickled frame would be misparsed there, so
+        # fail loudly at the sender (any plane: a single 2 GiB frame means
+        # something upstream is already deeply wrong). Checked BEFORE
+        # seal(): a refused frame must not consume a codec sequence slot.
+        raise ValueError(
+            f"frame too large ({len(payload) + mac_len} bytes >= 2 GiB)")
+    frame = codec.seal(payload) if codec is not None else payload
+    send_frame(sock, frame)
+    return 4 + len(frame)
 
 
 def recv_obj(sock: socket.socket, codec: Optional[FrameCodec]):
@@ -387,3 +422,110 @@ def recv_obj(sock: socket.socket, codec: Optional[FrameCodec]):
     import pickle
 
     return pickle.loads(frame)
+
+
+# ---------------------------------------------------------------------------
+# binary columnar data frames (dataplane only; see security/wire.py)
+# ---------------------------------------------------------------------------
+
+def _advance(views, n: int):
+    """Drop the first n bytes from a scatter-gather view list (partial
+    sendmsg): fully-sent views fall off, the boundary view is sliced."""
+    for i, v in enumerate(views):
+        if n >= len(v):
+            n -= len(v)
+            continue
+        rest = views[i:] if n == 0 else [v[n:]] + views[i + 1:]
+        return rest
+    return []
+
+
+try:
+    _IOV_MAX = os.sysconf("SC_IOV_MAX")
+except (AttributeError, OSError, ValueError):
+    _IOV_MAX = 1024
+if _IOV_MAX <= 0:
+    _IOV_MAX = 1024  # sysconf may report 'indeterminate' as -1, not raise
+
+
+def _send_parts(sock: socket.socket, parts) -> None:
+    """Scatter-gather send (`socket.sendmsg`) of a list of buffers without
+    concatenating them, at most IOV_MAX buffers per call (a many-column
+    payload can exceed the kernel's iovec limit — EMSGSIZE — so the send
+    loops in capped groups); falls back to one joined `sendall` where
+    sendmsg is unavailable (TLS sockets raise NotImplementedError before
+    any byte is written, so the fallback never double-sends)."""
+    views = [memoryview(p) for p in parts if len(p)]
+    sendmsg = getattr(sock, "sendmsg", None)
+    if sendmsg is not None:
+        try:
+            while views:
+                views = _advance(views, sendmsg(views[:_IOV_MAX]))
+            return
+        except NotImplementedError:
+            pass  # ssl.SSLSocket: refuses up front, nothing sent yet
+    sock.sendall(b"".join(views))
+
+
+def send_data_frame(sock: socket.socket, channel: str, seq: int, cols,
+                    sidecar: bytes, codec: Optional[FrameCodec]) -> int:
+    """One binary columnar data frame: length prefix with the DATA_FLAG bit,
+    optional incremental MAC over every part, then the parts themselves via
+    scatter-gather I/O — contiguous numeric columns leave the process with
+    zero copies. Returns bytes written."""
+    parts, body_len = wire.encode_frame(channel, seq, cols, sidecar)
+    mac_len = MAC_LEN if codec is not None else 0
+    total = body_len + mac_len
+    if total >= wire.DATA_FLAG:
+        raise ValueError(f"data frame too large ({total} bytes)")
+    prefix = struct.pack(">I", total | wire.DATA_FLAG)
+    if codec is not None:
+        mac = codec.seal_parts(parts)
+        _send_parts(sock, [prefix, mac, *parts])
+    else:
+        _send_parts(sock, [prefix, *parts])
+    return 4 + total
+
+
+def recv_msg(sock: socket.socket, codec: Optional[FrameCodec]):
+    """Next dataplane message as ``(msg, nbytes)``; ``(None, 0)`` at EOF.
+
+    Legacy frames decode exactly like `recv_obj`. Binary columnar data
+    frames — flag bit set in the length prefix — are read into ONE
+    preallocated buffer with `recv_into`, MAC-verified over that buffer
+    BEFORE any parsing, then decoded to ``("data", channel, seq, payload)``
+    with the payload's raw columns as zero-copy `np.frombuffer` views
+    (security/wire.py). `nbytes` is the frame's full wire size, feeding the
+    receiver's numBytesIn accounting."""
+    hdr = _read_n(sock, 4)
+    if hdr is None:
+        return None, 0
+    (n,) = struct.unpack(">I", hdr)
+    if not (n & wire.DATA_FLAG):
+        body = _read_n(sock, n)
+        if body is None:
+            return None, 0
+        if codec is not None:
+            return restricted_loads(codec.open(body)), 4 + n
+        import pickle
+
+        return pickle.loads(body), 4 + n
+    n &= wire.DATA_FLAG - 1
+    total = 4 + n
+    if codec is not None:
+        if n < MAC_LEN:
+            raise FrameAuthError("binary frame shorter than its MAC")
+        # one allocation, one recv_into stream for MAC + body together,
+        # with the body (byte MAC_LEN) placed on the alignment grid
+        buf = wire.alloc_body(n, lead=MAC_LEN)
+        if not _recv_into_exact(sock, buf):
+            return None, 0
+        body = memoryview(buf)[MAC_LEN:]
+        codec.open_parts(bytes(buf[:MAC_LEN]), (body,))
+        channel, seq, payload = wire.decode_frame(body)
+    else:
+        buf = wire.alloc_body(n)
+        if not _recv_into_exact(sock, buf):
+            return None, 0
+        channel, seq, payload = wire.decode_frame(buf, trusted_pickle=True)
+    return ("data", channel, seq, payload), total

@@ -138,6 +138,47 @@ def test_every_config_option_is_documented():
     )
 
 
+def test_dataplane_data_path_is_serialization_free():
+    """runtime/dataplane.py may not serialize batch payloads itself — no
+    pickle/cloudpickle import, no `dumps(`/`loads(` call anywhere in the
+    module. Batch bytes cross the process boundary only through
+    flink_tpu.security: the zero-copy binary columnar wire
+    (security/wire.py via transport.send_data_frame/recv_msg) or the
+    legacy restricted-pickle codec (transport.send_obj/recv_obj). This
+    pins the ISSUE-3 zero-copy property: a convenience `dumps(batch)`
+    creeping back into the data path reintroduces the full-copy
+    serialization tax (and, on the receive side, a deserialize-before-MAC
+    hazard) that the binary wire exists to remove."""
+    path = PKG / "runtime" / "dataplane.py"
+    tree = ast.parse(path.read_text())
+    bad = []
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                if a.name in ("pickle", "cloudpickle"):
+                    bad.append(f"line {node.lineno}: import {a.name}")
+        elif isinstance(node, ast.ImportFrom):
+            if node.module in ("pickle", "cloudpickle"):
+                bad.append(f"line {node.lineno}: from {node.module} import ...")
+            elif node.module and any(
+                    a.name in ("dumps", "loads", "dump", "load")
+                    for a in node.names):
+                bad.append(
+                    f"line {node.lineno}: from {node.module} imports a "
+                    "serializer name"
+                )
+        elif isinstance(node, ast.Call):
+            fn = node.func
+            name = fn.id if isinstance(fn, ast.Name) else (
+                fn.attr if isinstance(fn, ast.Attribute) else None)
+            if name in ("dumps", "loads", "dump", "load"):
+                bad.append(f"line {node.lineno}: call to {name}(...)")
+    assert not bad, (
+        "runtime/dataplane.py serializes on the data path — route batches "
+        "through security.transport/security.wire instead:\n" + "\n".join(bad)
+    )
+
+
 def test_no_bare_pickle_loads_on_network_planes():
     """Everything under flink_tpu/runtime/ and flink_tpu/fs/ handles bytes
     that can originate from a socket (RPC frames, exchange batches, blob

@@ -159,9 +159,277 @@ def test_exchange_credit_backpressure():
 def test_batch_debloater_tracks_rate():
     d = BatchDebloater(target_latency_s=0.1, min_size=10, max_size=100_000)
     assert d.batch_size() == 10
+    assert not d.observed
     for _ in range(10):
         d.observe(50_000, 0.1)  # 500k rec/s -> 50k per 100ms
+    assert d.observed
     assert 40_000 <= d.batch_size() <= 50_000
+
+
+# ---------------------------------------------------------------------------
+# data plane: binary columnar wire
+# ---------------------------------------------------------------------------
+
+def _exchange_pair(capacity=4, credit_batch=0, server_fmt="binary",
+                   sender_fmt="binary", security=None, channel="bw"):
+    server = ExchangeServer(capacity=capacity, credit_batch=credit_batch,
+                            wire_format=server_fmt, security=security)
+    ch = server.channel(channel)
+    out = OutputChannel(server.address, channel, wire_format=sender_fmt,
+                        security=security)
+    return server, ch, out
+
+
+def test_binary_wire_end_to_end_with_auth():
+    """Batches negotiate onto the binary columnar wire (auth on by default
+    in tests), arrive bit-exact, and non-batch payloads interleave on the
+    legacy codec over the same connection."""
+    server, ch, out = _exchange_pair()
+    vals = np.random.default_rng(3).random(512)
+    ts = np.arange(512, dtype=np.int64)
+    keys = np.asarray([f"k{i % 5}" for i in range(512)], dtype=object)
+    out.send(("b", vals, ts))
+    out.send(("w", 1234))                    # control payload: legacy frame
+    out.send((keys, vals, ts, 777, 3))       # keyed 5-tuple
+    b1 = ch.poll(timeout=2)
+    assert b1[0] == "b"
+    np.testing.assert_array_equal(b1[1], vals)
+    np.testing.assert_array_equal(b1[2], ts)
+    assert ch.poll(timeout=2) == ("w", 1234)
+    b3 = ch.poll(timeout=2)
+    np.testing.assert_array_equal(b3[0], keys)
+    assert b3[3] == 777 and b3[4] == 3
+    assert out._wire == "binary"
+    # byte accounting on both ends (numBytesIn/Out feed the metrics plane)
+    assert out.bytes_out > vals.nbytes and ch.bytes_in > vals.nbytes
+    assert out.out_rate() > 0 and ch.in_rate() > 0
+    out.end()
+    assert ch.poll(timeout=2) is None
+    out.close()
+    server.stop()
+
+
+@pytest.mark.parametrize("server_fmt,sender_fmt", [
+    ("pickle", "binary"),   # old/forced-pickle receiver: sender downgrades
+    ("binary", "pickle"),   # sender pinned to pickle: never offers binary
+])
+def test_wire_format_downgrade_interop(server_fmt, sender_fmt):
+    """exchange.wire-format negotiation: when either side only speaks
+    pickle the channel transparently falls back to the legacy frames with
+    identical payload semantics — the old-wire x new-wire handshake path."""
+    server, ch, out = _exchange_pair(server_fmt=server_fmt,
+                                     sender_fmt=sender_fmt)
+    vals = np.arange(64, dtype=np.float64)
+    out.send(("b", vals, np.arange(64, dtype=np.int64)))
+    got = ch.poll(timeout=2)
+    assert got[0] == "b"
+    np.testing.assert_array_equal(got[1], vals)
+    if sender_fmt == "binary":
+        assert out._wire == "pickle"   # receiver's reply forced the downgrade
+    out.end()
+    out.close()
+    server.stop()
+
+
+def test_legacy_open_tuple_still_served():
+    """A sender that never learned the format offer (the old 2-tuple open)
+    keeps working against a new server — the reply's extra element is
+    ignored by old credit loops, asserted here by driving the old open
+    shape through a new OutputChannel pinned to pickle."""
+    server, ch, out = _exchange_pair(sender_fmt="pickle")
+    out.send({"n": 1})
+    assert ch.poll(timeout=2) == {"n": 1}
+    out.close()
+    server.stop()
+
+
+def test_binary_wire_many_column_payload_exceeds_iov_max():
+    """A payload with more scatter-gather parts than the kernel's IOV_MAX
+    (1024 iovecs) must still send — the sendmsg loop caps each call's
+    group instead of dying with EMSGSIZE."""
+    server, ch, out = _exchange_pair()
+    payload = tuple(np.full(2, float(i)) for i in range(600))  # ~1200 iovecs
+    out.send(payload)
+    got = ch.poll(timeout=5)
+    assert len(got) == 600
+    np.testing.assert_array_equal(got[599], np.full(2, 599.0))
+    assert out._wire == "binary"
+    out.end()
+    out.close()
+    server.stop()
+
+
+def test_binary_wire_decoded_columns_are_64_byte_aligned():
+    """The alignment promise holds END-TO-END with auth enabled: the
+    32-byte MAC prefix shares the receive allocation, so the body must be
+    placed on the grid or every column lands at addr % 64 == 32."""
+    server, ch, out = _exchange_pair()
+    out.send(("b", np.arange(256, dtype=np.float64),
+              np.arange(256, dtype=np.int64)))
+    got = ch.poll(timeout=5)
+    assert out._wire == "binary"
+    for col in (got[1], got[2]):
+        assert col.ctypes.data % 64 == 0, hex(col.ctypes.data)
+    out.end()
+    out.close()
+    server.stop()
+
+
+def test_output_channel_seq_is_contiguous_under_concurrent_senders():
+    """Two threads sharing one OutputChannel: sequence numbers are assigned
+    under the send lock, so the receiver observes a gapless sequence (the
+    pre-fix race interleaved seq against frame order, which the receiver
+    now rejects as corruption)."""
+    server, ch, out = _exchange_pair(capacity=64)
+    n_per_thread = 40
+
+    def sender():
+        for _ in range(n_per_thread):
+            out.send(("b", np.arange(4, dtype=np.float64),
+                      np.arange(4, dtype=np.int64)))
+
+    threads = [threading.Thread(target=sender) for _ in range(2)]
+    [t.start() for t in threads]
+    got = 0
+    while got < 2 * n_per_thread:
+        assert ch.poll(timeout=5) is not None
+        got += 1
+    [t.join() for t in threads]
+    out.end()
+    assert ch.poll(timeout=2) is None      # no seq-gap error raised
+    out.close()
+    server.stop()
+
+
+def test_refused_frame_does_not_burn_a_sequence_number(monkeypatch):
+    """A frame refused at the sender BEFORE any byte hits the wire (e.g.
+    the >=2GiB size guard) must not consume a channel seq — the next good
+    frame would otherwise be misdiagnosed as a sequence gap."""
+    import flink_tpu.runtime.dataplane as dpmod
+
+    server, ch, out = _exchange_pair()
+    real = dpmod.send_data_frame
+    state = {"failed": False}
+
+    def flaky(*a, **kw):
+        if not state["failed"]:
+            state["failed"] = True
+            raise ValueError("frame too large (simulated)")
+        return real(*a, **kw)
+
+    monkeypatch.setattr(dpmod, "send_data_frame", flaky)
+    payload = ("b", np.arange(8, dtype=np.float64),
+               np.arange(8, dtype=np.int64))
+    with pytest.raises(ValueError, match="too large"):
+        out.send(payload)
+    out.send(payload)                      # retries as seq 0, not seq 1
+    got = ch.poll(timeout=5)
+    np.testing.assert_array_equal(got[1], np.arange(8, dtype=np.float64))
+    out.end()
+    assert ch.poll(timeout=2) is None      # clean eos, no gap error
+    out.close()
+    server.stop()
+
+
+def test_input_channel_rejects_sequence_gap():
+    """A dropped/reordered frame is a loud error: the valid ring prefix
+    drains, then poll raises instead of silently skipping data."""
+    from flink_tpu.runtime.dataplane import InputChannel
+
+    ch = InputChannel("gap", capacity=8, grant=lambda n: None)
+    assert ch._on_data(0, "first", 10)
+    assert not ch._on_data(2, "third", 10)     # gap: handler drops the conn
+    assert ch.poll(timeout=1) == "first"
+    with pytest.raises(ConnectionError, match="sequence gap"):
+        ch.poll(timeout=1)
+
+
+def test_credit_coalescing_grants_in_batches_and_preserves_backpressure():
+    """exchange.credit-batch: grants return in coalesced frames (none until
+    credit_batch slots free) while the blocking discipline is unchanged —
+    the sender still stalls exactly while the ring is full."""
+    server, ch, out = _exchange_pair(capacity=4, credit_batch=2)
+    deadline = time.time() + 2
+    while out.available_credits() < 4 and time.time() < deadline:
+        time.sleep(0.01)
+    assert out.available_credits() == 4
+
+    for i in range(4):
+        out.send({"n": i})
+    assert out.available_credits() == 0
+    with pytest.raises(TimeoutError, match="backpressured"):
+        out.send({"n": 99}, timeout=0.2)       # ring full: sender blocks
+
+    assert ch.poll(timeout=1)["n"] == 0        # one slot freed -> banked,
+    time.sleep(0.15)                           # NOT granted yet
+    assert out.available_credits() == 0
+    assert ch.poll(timeout=1)["n"] == 1        # second slot -> grant of 2
+    deadline = time.time() + 2
+    while out.available_credits() < 2 and time.time() < deadline:
+        time.sleep(0.01)
+    assert out.available_credits() == 2
+    out.send({"n": 4}, timeout=2)              # and the sender resumes
+    assert ch.poll(timeout=1)["n"] == 2
+    assert ch.poll(timeout=1)["n"] == 3
+    assert ch.poll(timeout=1)["n"] == 4
+    out.end()
+    assert ch.poll(timeout=1) is None and ch.ended
+    out.close()
+    server.stop()
+
+
+def test_stage_output_runner_debloats_oversized_batches():
+    """BatchDebloater wired into StageOutputRunner: after observations
+    establish a low send rate, oversized batches are split into
+    target-sized slices; the gauge exposes the current batch size."""
+    from flink_tpu.graph.transformation import Step, Transformation
+    from flink_tpu.metrics.registry import MetricRegistry
+    from flink_tpu.runtime.stages import StageOutputRunner
+
+    class _Sink:
+        def __init__(self):
+            self.sent = []
+            self.backpressured_s = 0.0
+
+        def send(self, msg, timeout=None):
+            self.sent.append(msg)
+
+        def available_credits(self):
+            return 1
+
+        def end(self):
+            self.sent.append(("eos",))
+
+    sink = _Sink()
+    debloater = BatchDebloater(target_latency_s=0.1, min_size=8,
+                               max_size=1 << 20)
+    t = Transformation("stage_output", "out", [], {
+        "sender": sink, "cancelled": threading.Event(),
+        "debloater": debloater,
+    })
+    t.uid = "out"
+    runner = StageOutputRunner(Step(chain=[], terminal=t,
+                                    partitioning="forward", inputs=[]))
+    registry = MetricRegistry()
+    runner.register_metrics(registry.group("op"))
+    assert "op.debloatedBatchSize" in registry.all_metrics()
+
+    vals = np.arange(100, dtype=np.float64)
+    ts = np.arange(100, dtype=np.int64)
+    # before any observation the batch passes through whole
+    assert not debloater.observed
+    runner.on_batch(vals, ts)
+    assert len(sink.sent) == 1
+    # the runner observed its own (instant) send above; drive the EMA back
+    # down to a slow channel: 100 rec/s x 0.1s target -> 10 records/batch
+    while debloater.batch_size() != 10:
+        debloater.observe(10, 0.1)
+    runner.on_batch(vals, ts)                  # 100 records -> 10 slices
+    slices = sink.sent[1:]
+    assert len(slices) == 10
+    assert all(m[0] == "b" and len(m[2]) == 10 for m in slices)
+    np.testing.assert_array_equal(np.concatenate([m[1] for m in slices]), vals)
+    np.testing.assert_array_equal(np.concatenate([m[2] for m in slices]), ts)
 
 
 # ---------------------------------------------------------------------------

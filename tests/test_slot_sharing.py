@@ -136,7 +136,13 @@ def test_groups_are_noop_locally():
 # distributed: each group deploys as its own pipelined stage task
 # ---------------------------------------------------------------------------
 
-def test_cluster_runs_two_stage_pipeline(tmp_path):
+@pytest.mark.parametrize("wire_format", ["binary", "pickle"])
+def test_cluster_runs_two_stage_pipeline(tmp_path, wire_format):
+    """The staged pipeline end-to-end on BOTH exchange wire formats:
+    exchange.wire-format=binary is the default zero-copy columnar wire,
+    =pickle pins the legacy frames (and the config plumbing that selects
+    them) — identical results either way."""
+    from flink_tpu.config import ExchangeOptions
     from flink_tpu.runtime.cluster import (
         GraphJobSpec,
         JobManagerEndpoint,
@@ -146,6 +152,7 @@ def test_cluster_runs_two_stage_pipeline(tmp_path):
 
     conf = Configuration()
     conf.set(ExecutionOptions.BATCH_SIZE, 8)
+    conf.set(ExchangeOptions.WIRE_FORMAT, wire_format)
     env = StreamExecutionEnvironment.get_execution_environment(conf)
     expected_sink = _pipeline(env, group_on_window="agg")
     # reference result from local execution of an identical pipeline

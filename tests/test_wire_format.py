@@ -1,0 +1,237 @@
+"""Binary columnar wire format (security/wire.py): round-trip property
+coverage across dtypes, empty batches and object columns, MAC-before-parse
+tamper rejection, and a golden-bytes test pinning the header layout so
+format drift breaks loudly (an old-header peer would mis-parse offsets —
+the wire version byte plus this pin keep the format an explicit contract).
+"""
+
+import struct
+
+import numpy as np
+import pytest
+
+from flink_tpu.security import wire
+from flink_tpu.security.framing import FrameAuthError, FrameCodec
+
+
+def _roundtrip(payload, trusted=False):
+    enc = wire.extract_columns(payload)
+    assert enc is not None, "payload should be binary-eligible"
+    cols, sidecar = enc
+    parts, body_len = wire.encode_frame("ch", 9, cols, sidecar)
+    body = bytearray(b"".join(bytes(p) for p in parts))
+    assert len(body) == body_len
+    channel, seq, out = wire.decode_frame(body, trusted_pickle=trusted)
+    assert channel == "ch" and seq == 9
+    assert len(out) == len(payload)
+    return out
+
+
+NUMERIC_DTYPES = [
+    np.bool_, np.int8, np.int16, np.int32, np.int64,
+    np.uint8, np.uint16, np.uint32, np.uint64,
+    np.float16, np.float32, np.float64,
+    np.complex64, np.complex128,
+]
+
+
+@pytest.mark.parametrize("dtype", NUMERIC_DTYPES)
+def test_roundtrip_every_numeric_dtype(dtype):
+    rng = np.random.default_rng(7)
+    arr = (rng.random(17) * 50).astype(dtype)
+    out = _roundtrip(("b", arr, np.arange(17, dtype=np.int64)))
+    assert out[0] == "b"
+    assert out[1].dtype == arr.dtype
+    np.testing.assert_array_equal(out[1], arr)
+
+
+@pytest.mark.parametrize("arr", [
+    np.arange(12, dtype=np.float64).reshape(3, 4),       # 2-D
+    np.arange(24, dtype=np.int32).reshape(2, 3, 4),      # 3-D
+    np.asarray(["aa", "b", "cccc"], dtype="<U4"),        # fixed unicode
+    np.asarray([b"xy", b"z"], dtype="|S2"),              # fixed bytes
+    np.arange(0, 10, dtype="datetime64[ms]"),            # datetime64
+    np.asfortranarray(np.arange(6, dtype=np.float32).reshape(2, 3)),  # F-order
+    np.arange(20, dtype=np.float64)[::2],                # non-contiguous view
+])
+def test_roundtrip_shapes_and_layouts(arr):
+    out = _roundtrip(("b", arr, np.arange(len(arr), dtype=np.int64)))
+    assert out[1].shape == arr.shape and out[1].dtype == arr.dtype
+    np.testing.assert_array_equal(out[1], arr)
+
+
+def test_roundtrip_empty_batch():
+    out = _roundtrip(("b", np.asarray([], dtype=np.float64),
+                      np.asarray([], dtype=np.int64)))
+    assert out[1].shape == (0,) and out[2].shape == (0,)
+
+
+def test_roundtrip_object_column_rides_sidecar():
+    keys = np.asarray(["k1", "k22", None, ("t", 3)], dtype=object)
+    vals = np.ones(4, dtype=np.float64)
+    out = _roundtrip(("b", keys, vals))
+    np.testing.assert_array_equal(out[1], keys)
+    np.testing.assert_array_equal(out[2], vals)
+
+
+def test_roundtrip_keyed_shard_payload():
+    """The keyed hot-path 5-tuple: object keys via sidecar, values and
+    timestamps as raw buffers, scalars in the skeleton."""
+    keys = np.asarray(["a", "b", "c"], dtype=object)
+    vals = np.asarray([1.0, 2.0, 3.0])
+    ts = np.asarray([10, 20, 30], dtype=np.int64)
+    out = _roundtrip((keys, vals, ts, 1500, 7))
+    np.testing.assert_array_equal(out[0], keys)
+    np.testing.assert_array_equal(out[1], vals)
+    np.testing.assert_array_equal(out[2], ts)
+    assert out[3] == 1500 and out[4] == 7
+
+
+def test_decoded_arrays_are_zero_copy_views_and_writable():
+    arr = np.arange(1000, dtype=np.float64)
+    out = _roundtrip(("b", arr, np.arange(1000, dtype=np.int64)))
+    assert out[1].base is not None           # a view into the recv buffer
+    out[1][0] = 42.0                          # device staging may mutate
+
+
+def test_ineligible_payloads_fall_back_to_legacy():
+    assert wire.extract_columns({"n": 1}) is None          # not a tuple
+    assert wire.extract_columns(("w", 1234)) is None       # no raw column
+    assert wire.extract_columns(("barrier", 5)) is None
+    assert wire.extract_columns([np.arange(3)]) is None    # list, not tuple
+    # object-only tuple: nothing raw-encodable
+    assert wire.extract_columns((np.asarray([1, None], dtype=object),)) is None
+
+
+def test_buffer_alignment():
+    enc = wire.extract_columns(("b", np.arange(5, dtype=np.int8),
+                                np.arange(3, dtype=np.float64)))
+    parts, body_len = wire.encode_frame("c", 0, *enc)
+    body = b"".join(bytes(p) for p in parts)
+    _, _, out = wire.decode_frame(bytearray(body))
+    # every raw column's declared offset is 64-byte aligned in the body
+    hlen = struct.unpack_from("<I", body, 4)[0]
+    assert hlen >= 24
+    for a in (out[1], out[2]):
+        assert a.base is not None
+
+
+# ---------------------------------------------------------------------------
+# authentication: MAC over header AND each buffer, verified before parse
+# ---------------------------------------------------------------------------
+
+def _sealed_frame():
+    enc = wire.extract_columns(("b", np.arange(64, dtype=np.float64),
+                                np.arange(64, dtype=np.int64)))
+    parts, body_len = wire.encode_frame("c", 0, *enc)
+    send = FrameCodec(b"secret" * 6, is_client=True)
+    mac = send.seal_parts(parts)
+    body = bytearray(b"".join(bytes(p) for p in parts))
+    return mac, body
+
+
+@pytest.mark.parametrize("victim", ["header", "sidecar", "buffer", "mac"])
+def test_tampered_binary_frame_rejected(victim):
+    mac, body = _sealed_frame()
+    recv = FrameCodec(b"secret" * 6, is_client=False)
+    hlen = struct.unpack_from("<I", body, 4)[0]
+    if victim == "header":
+        body[8] ^= 1                      # flip a seq bit
+    elif victim == "sidecar":
+        body[hlen] ^= 1
+    elif victim == "buffer":
+        body[-1] ^= 1                     # last byte of the last column
+    else:
+        mac = bytes([mac[0] ^ 1]) + mac[1:]
+    with pytest.raises(FrameAuthError):
+        recv.open_parts(mac, (body,))
+
+
+def test_untampered_frame_verifies_and_replay_rejected():
+    mac, body = _sealed_frame()
+    recv = FrameCodec(b"secret" * 6, is_client=False)
+    recv.open_parts(mac, (body,))         # consumes recv seq 0
+    with pytest.raises(FrameAuthError):
+        recv.open_parts(mac, (body,))     # replay at seq 1 fails
+
+
+def test_incremental_mac_equals_contiguous_mac():
+    """seal_parts over the scatter-gather list == a MAC over the joined
+    body: the receiver verifies its single recv_into buffer against the
+    sender's incremental MAC."""
+    enc = wire.extract_columns(("b", np.arange(16, dtype=np.float32),))
+    parts, _ = wire.encode_frame("c", 0, *enc)
+    a = FrameCodec(b"k" * 32, is_client=True)
+    b = FrameCodec(b"k" * 32, is_client=True)
+    assert a.seal_parts(parts) == b.seal_parts(
+        (b"".join(bytes(p) for p in parts),))
+
+
+# ---------------------------------------------------------------------------
+# structural validation (reachable pre-MAC only when auth is off)
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("mutate", [
+    lambda b: b.__setitem__(slice(0, 2), b"XX"),               # bad magic
+    lambda b: b.__setitem__(2, 99),                            # bad version
+    lambda b: struct.pack_into("<I", b, 4, 2 ** 31),           # header overrun
+    # out-of-bounds buffer offset in the last column's table entry
+    lambda b: struct.pack_into(
+        "<QQ", b, struct.unpack_from("<I", b, 4)[0] - 16, 2 ** 40, 64),
+])
+def test_malformed_frames_raise_wire_format_error(mutate):
+    enc = wire.extract_columns(("b", np.arange(8, dtype=np.float64),))
+    parts, _ = wire.encode_frame("c", 0, *enc)
+    body = bytearray(b"".join(bytes(p) for p in parts))
+    mutate(body)
+    with pytest.raises(wire.WireFormatError):
+        wire.decode_frame(body)
+
+
+def test_truncated_frame_rejected():
+    enc = wire.extract_columns(("b", np.arange(8, dtype=np.float64),))
+    parts, _ = wire.encode_frame("c", 0, *enc)
+    body = bytearray(b"".join(bytes(p) for p in parts))
+    with pytest.raises(wire.WireFormatError):
+        wire.decode_frame(body[: len(body) - 9])
+
+
+# ---------------------------------------------------------------------------
+# golden bytes: the header layout is a wire contract
+# ---------------------------------------------------------------------------
+
+GOLDEN_HEADER_HEX = (
+    "4642"                              # magic "FB"
+    "01"                                # wire version 1
+    "00"                                # flags
+    "64000000"                          # header_len = 100
+    "0300000000000000"                  # seq = 3
+    "0400" "676f6c64"                   # channel "gold"
+    "0200"                              # ncols = 2
+    "19000000"                          # sidecar_len = 25
+    # column "1": int64[4] at offset 128
+    "0100" "31" "03" "3c6938" "01" "0400000000000000"
+    "8000000000000000" "2000000000000000"
+    # column "2": float64[1,2] at offset 192
+    "0100" "32" "03" "3c6638" "02" "0100000000000000" "0200000000000000"
+    "c000000000000000" "1000000000000000"
+)
+
+
+def test_golden_header_bytes():
+    """Pin the exact header byte layout for a fixed payload. If this test
+    breaks, the wire format changed: bump WIRE_VERSION and handle the old
+    layout explicitly — silent drift would desynchronize mixed-version
+    clusters."""
+    payload = ("b", np.arange(4, dtype="<i8"),
+               np.array([[1.5, 2.5]], dtype="<f8"))
+    cols, sidecar = wire.extract_columns(payload)
+    parts, body_len = wire.encode_frame("gold", 3, cols, sidecar)
+    assert bytes(parts[0]).hex() == GOLDEN_HEADER_HEX
+    assert body_len == 208
+    # and the pinned layout still decodes to the source payload
+    ch, seq, out = wire.decode_frame(
+        bytearray(b"".join(bytes(p) for p in parts)))
+    assert (ch, seq, out[0]) == ("gold", 3, "b")
+    np.testing.assert_array_equal(out[1], payload[1])
+    np.testing.assert_array_equal(out[2], payload[2])
