@@ -1024,6 +1024,117 @@ def dataplane_microbench(batches: int = 24, max_sweeps: int = 12,
     return res
 
 
+def checkpoint_microbench(events: int = 100_000, reps: int = 2) -> dict:
+    """Checkpoint overhead on the windowed hot path: the keyed tumbling
+    pipeline at a FIXED event count, checkpointing off vs on (Fs storage,
+    25 ms interval — several snapshots per run), best-of-reps each (wall
+    time is latency-like: min-of-N estimates the cost floor). Emits
+    checkpoint.{overhead_pct, last_duration_ms, last_size_bytes} so the
+    fault-tolerance tax stays tracked in the bench trajectory alongside
+    the throughput headline."""
+    import shutil
+    import tempfile
+
+    from flink_tpu.api.datastream import StreamExecutionEnvironment
+    from flink_tpu.api.windowing.assigners import TumblingEventTimeWindows
+    from flink_tpu.config import (
+        CheckpointingOptions,
+        Configuration,
+        ExecutionOptions,
+    )
+    from flink_tpu.connectors.sink import CollectSink
+    from flink_tpu.connectors.source import Batch, DataGeneratorSource
+    from flink_tpu.core.watermarks import WatermarkStrategy
+    from flink_tpu.utils.arrays import obj_array
+
+    def gen(idx):
+        vals = obj_array([(int(i) & 63, 1.0) for i in idx])
+        return Batch(vals, (idx * 10).astype(np.int64))
+
+    def run_once(chk_dir):
+        config = Configuration()
+        config.set(ExecutionOptions.BATCH_SIZE, 8192)
+        if chk_dir is not None:
+            config.set(CheckpointingOptions.INTERVAL_MS, 25)
+            config.set(CheckpointingOptions.DIRECTORY, chk_dir)
+        env = StreamExecutionEnvironment(config)
+        stream = env.from_source(
+            DataGeneratorSource(gen, count=events),
+            watermark_strategy=WatermarkStrategy.for_monotonous_timestamps(),
+        )
+        (stream.key_by(lambda x: x[0])
+               .window(TumblingEventTimeWindows.of(1000)).count()
+               .sink_to(CollectSink()))
+        t0 = time.perf_counter()
+        client = env.execute_async("checkpoint-bench")
+        status = client.wait(240)
+        dt = time.perf_counter() - t0
+        if status.value != "FINISHED":
+            raise RuntimeError(f"bench job ended {status.value}")
+        return dt, client
+
+    best_off = best_on = float("inf")
+    best_on_client = None
+    run_once(None)        # warmup: jit compiles must not bill the OFF config
+    for _ in range(reps):
+        dt, _c = run_once(None)
+        best_off = min(best_off, dt)
+        chk = tempfile.mkdtemp(prefix="flink-tpu-cpbench-")
+        try:
+            dt, client = run_once(chk)
+        finally:
+            shutil.rmtree(chk, ignore_errors=True)
+        if dt < best_on:
+            best_on, best_on_client = dt, client
+    gauges = best_on_client.checkpoint_stats.gauge_values()
+    return {
+        "events": events,
+        "elapsed_off_s": round(best_off, 3),
+        "elapsed_on_s": round(best_on, 3),
+        "checkpoints_completed": int(gauges["numberOfCompletedCheckpoints"]),
+        "overhead_pct": round((best_on - best_off) / max(best_off, 1e-9) * 100, 2),
+        "last_duration_ms": round(float(gauges["lastCheckpointDuration"]), 3),
+        "last_size_bytes": int(gauges["lastCheckpointSize"]),
+    }
+
+
+def child_checkpoint() -> None:
+    """Checkpoint-microbench child: CPU-pinned like child_cpu (the relay is
+    single-client — a jax backend probe from the parent would wedge the TPU
+    attempt), and the control-plane cost being measured is host-side."""
+    _emit({"event": "start", "device": "cpu-checkpoint", "pid": os.getpid()})
+    try:
+        import jax
+        from jax._src import xla_bridge as _xb
+
+        jax.config.update("jax_platforms", "cpu")
+        _xb._backend_factories.pop("axon", None)
+        _xb._topology_factories.pop("axon", None)
+    except Exception:
+        pass
+    _emit({"event": "result", "result": checkpoint_microbench()})
+
+
+def run_checkpoint_microbench_child(timeout_s: float = 300.0) -> dict:
+    """Run the checkpoint microbench in a JAX_PLATFORMS=cpu subprocess and
+    return its result event (or an error dict — the headline must survive)."""
+    try:
+        r = subprocess.run(
+            [sys.executable, os.path.abspath(__file__), "--child",
+             "checkpoint", "0", "0", "0"],
+            stdout=subprocess.PIPE, stderr=subprocess.DEVNULL, text=True,
+            timeout=timeout_s, env={**os.environ, "JAX_PLATFORMS": "cpu"},
+        )
+        for line in reversed(r.stdout.splitlines()):
+            if line.startswith("{"):
+                obj = json.loads(line)
+                if obj.get("event") == "result":
+                    return obj["result"]
+        return {"error": "no result event from checkpoint child"}
+    except Exception as e:  # noqa: BLE001
+        return {"error": repr(e)[:300]}
+
+
 def parent_main() -> None:
     deadline = time.monotonic() + BUDGET_S - 15
     best = {
@@ -1044,6 +1155,12 @@ def parent_main() -> None:
         dataplane = {"error": repr(e)[:300]}
     _emit({"event": "dataplane_microbench", "result": dataplane})
 
+    # checkpoint-overhead microbench: also host-only, but it builds window
+    # operators — run it in a CPU-pinned child so the parent never imports
+    # a jax backend out from under the TPU attempts
+    checkpoint = run_checkpoint_microbench_child()
+    _emit({"event": "checkpoint_microbench", "result": checkpoint})
+
     def consider(res, rank):
         nonlocal best, best_rank
         if res is None:
@@ -1058,6 +1175,7 @@ def parent_main() -> None:
         if not printed.is_set():
             printed.set()
             best["dataplane"] = dataplane
+            best["checkpoint"] = checkpoint
             print(json.dumps(best), flush=True)
             for c in _CHILDREN:
                 # never orphan a TPU child: it would keep the single-client
@@ -1144,6 +1262,8 @@ def main() -> None:
         spans = int(sys.argv[5])
         if label == "tpu":
             child_tpu(T, 1 << int(sys.argv[4]), spans)
+        elif label == "checkpoint":
+            child_checkpoint()
         else:
             child_cpu(T, 1 << int(sys.argv[4]), spans)
     else:

@@ -20,7 +20,7 @@ sinks, identical contract to the reference (SURVEY.md §5 checkpoint/resume).
 from __future__ import annotations
 
 import time
-from typing import Callable, List, Optional, Tuple
+from typing import Callable, Dict, List, Optional, Tuple
 
 from flink_tpu.checkpoint.storage import CheckpointStorage
 
@@ -33,6 +33,7 @@ class CheckpointCoordinator:
         max_retained: int = 3,
         clock: Callable[[], float] = time.monotonic,
         traces=None,
+        stats=None,
     ):
         self.storage = storage
         self.interval_s = interval_ms / 1000.0
@@ -43,6 +44,14 @@ class CheckpointCoordinator:
         self.num_completed = 0
         self._on_complete: List[Callable[[int], None]] = []
         self.traces = traces  # TraceRegistry; checkpoint lifecycle spans (O2)
+        # CheckpointStatsTracker (metrics/checkpoint_stats.py): per-checkpoint
+        # records + lifetime counters, fed here and read by REST/Prometheus.
+        # Stats flow OUTWARD through this callback-shaped seam — the
+        # checkpoint layer never reaches into the runtime (architecture lint).
+        self.stats = stats
+        # optional per-operator state-bytes provider (the runtime's
+        # state_bytes() gauges), re-pointed at every attempt's JobRuntime
+        self.state_bytes_fn: Optional[Callable[[], Dict[str, int]]] = None
 
     def register_on_complete(self, fn: Callable[[int], None]) -> None:
         self._on_complete.append(fn)
@@ -61,18 +70,78 @@ class CheckpointCoordinator:
     def trigger(self, capture_fn: Callable[[], dict]) -> int:
         cid = self._next_id
         span = self.traces.span("checkpointing", "Checkpoint") if self.traces else None
-        data = capture_fn()
+        if self.stats is not None:
+            self.stats.report_pending(cid)
+        # sync phase: pull device state to host + source positions (the
+        # reference's synchronous snapshot part)
+        cap_span = (self.traces.span("checkpointing", "CheckpointCapture")
+                    if self.traces else None)
+        t_cap = self._clock()
+        try:
+            data = capture_fn()
+        except BaseException as e:  # noqa: BLE001 — record, close spans, re-raise
+            self._abort(cid, e, span, cap_span)
+            raise
+        sync_ms = (self._clock() - t_cap) * 1000.0
+        if cap_span is not None:
+            self.traces.report(cap_span.set_attribute("checkpointId", cid).end())
         data["checkpoint_id"] = cid
-        self.storage.save(cid, data)
+        # async phase: persist to checkpoint storage. A failed persist must
+        # not leak the open spans or leave the tracker PENDING forever —
+        # the record flips to FAILED and the spans close with the cause.
+        persist_span = (self.traces.span("checkpointing", "CheckpointPersist")
+                        if self.traces else None)
+        t_save = self._clock()
+        try:
+            self.storage.save(cid, data)
+        except BaseException as e:  # noqa: BLE001
+            self._abort(cid, e, span, persist_span)
+            raise
+        async_ms = (self._clock() - t_save) * 1000.0
+        if persist_span is not None:
+            self.traces.report(
+                persist_span.set_attribute("checkpointId", cid).end())
         self._next_id += 1
         self._last_trigger = self._clock()
         self.num_completed += 1
+        if self.stats is not None:
+            per_op = None
+            if self.state_bytes_fn is not None:
+                try:
+                    per_op = self.state_bytes_fn()
+                except Exception:
+                    per_op = None
+            self.stats.report_completed(
+                cid,
+                sync_duration_ms=sync_ms,
+                async_duration_ms=async_ms,
+                state_size_bytes=getattr(self.storage, "last_save_bytes", None),
+                operator_bytes=per_op,
+            )
         for fn in self._on_complete:
             fn(cid)
         self._retain()
         if span is not None:
-            self.traces.report(span.set_attribute("checkpointId", cid).end())
+            self.traces.report(
+                span.set_attribute("checkpointId", cid)
+                .set_attribute("status", "COMPLETED").end())
         return cid
+
+    def _abort(self, cid: int, exc: BaseException, span, phase_span) -> None:
+        """A checkpoint phase raised: flip the tracker record to FAILED and
+        close the open spans with the failure attribute (the caller
+        re-raises — failure handling belongs to the job's restart policy)."""
+        if self.stats is not None:
+            self.stats.report_failed(cid, repr(exc))
+        if phase_span is not None:
+            self.traces.report(
+                phase_span.set_attribute("checkpointId", cid)
+                .set_attribute("status", "FAILED").end())
+        if span is not None:
+            self.traces.report(
+                span.set_attribute("checkpointId", cid)
+                .set_attribute("status", "FAILED")
+                .set_attribute("failureCause", repr(exc)[:200]).end())
 
     def _retain(self) -> None:
         cps = self.storage.list_checkpoints()

@@ -18,6 +18,12 @@ from typing import Dict, List, Optional, Tuple
 
 
 class CheckpointStorage:
+    # size of the most recent save()'s persisted artifact, reported to the
+    # CheckpointStatsTracker (the handle knows its size in the reference's
+    # StreamStateHandle.getStateSize; here the storage remembers the last
+    # write — saves are serialized per coordinator)
+    last_save_bytes: int = 0
+
     def save(self, checkpoint_id: int, data: dict) -> str:
         raise NotImplementedError
 
@@ -41,7 +47,9 @@ class MemoryCheckpointStorage(CheckpointStorage):
         self._store: Dict[int, bytes] = {}
 
     def save(self, checkpoint_id: int, data: dict) -> str:
-        self._store[checkpoint_id] = pickle.dumps(data, protocol=pickle.HIGHEST_PROTOCOL)
+        blob = pickle.dumps(data, protocol=pickle.HIGHEST_PROTOCOL)
+        self._store[checkpoint_id] = blob
+        self.last_save_bytes = len(blob)
         return f"mem:{checkpoint_id}"
 
     def load(self, handle: str) -> dict:
@@ -73,6 +81,7 @@ class FsCheckpointStorage(CheckpointStorage):
             with os.fdopen(fd, "wb") as f:
                 pickle.dump(data, f, protocol=pickle.HIGHEST_PROTOCOL)
             os.replace(tmp, final)  # atomic completion marker
+            self.last_save_bytes = os.path.getsize(final)
         finally:
             if os.path.exists(tmp):
                 os.unlink(tmp)

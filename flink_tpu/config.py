@@ -400,6 +400,25 @@ class ObservabilityOptions:
         "the heartbeat; the JM aggregates and serves them via REST and "
         "Prometheus)."
     )
+    CHECKPOINT_HISTORY_SIZE = (
+        ConfigOptions.key("observability.checkpoint-history.size")
+        .int_type().default_value(10)
+    ).with_description(
+        "Per-checkpoint stat records retained in the CheckpointStatsTracker "
+        "ring per job (trigger timestamp, capture/persist durations, "
+        "per-task ack latency, state sizes, status and failure cause), "
+        "served at /jobs/:id/checkpoints. Lifetime counters and the "
+        "last-checkpoint gauges are unaffected by the ring size."
+    )
+    EXCEPTION_HISTORY_SIZE = (
+        ConfigOptions.key("observability.exception-history.size")
+        .int_type().default_value(16)
+    ).with_description(
+        "Exception-history entries and recovery-timeline records retained "
+        "per job (timestamp, task/TaskManager attribution, root-cause "
+        "chain, restart number; restore duration, rewound checkpoint id, "
+        "replay depth, downtime), served at /jobs/:id/exceptions."
+    )
 
 
 class SecurityOptions:

@@ -48,6 +48,12 @@ from flink_tpu.core.keygroups import (
 )
 from flink_tpu.core.time import MAX_WATERMARK, MIN_WATERMARK
 from flink_tpu.checkpoint.storage import FsCheckpointStorage
+from flink_tpu.metrics.checkpoint_stats import (
+    CheckpointStatsTracker,
+    ExceptionHistory,
+    operator_bytes_from_snapshot,
+    snapshot_bytes_estimate,
+)
 from flink_tpu.metrics.registry import MetricRegistry, metrics_snapshot
 from flink_tpu.metrics.task_io import backpressure_level
 from flink_tpu.metrics.traces import Span, job_trace_id
@@ -174,7 +180,6 @@ class _JobState:
     attempt: int = 0
     assignment: Dict[int, str] = field(default_factory=dict)   # shard -> tm_id
     finished: Dict[int, list] = field(default_factory=dict)    # shard -> results
-    failure: Optional[str] = None
     restarts: int = 0
     # checkpointing
     next_checkpoint_id: int = 1
@@ -196,6 +201,18 @@ class _JobState:
     trace_id: str = ""
     metric_snapshots: Dict[int, dict] = field(default_factory=dict)
     spans: List[dict] = field(default_factory=list)
+    # fault-tolerance observability: per-checkpoint stat records + lifetime
+    # counters, and the bounded exception/restart history that replaced the
+    # single overwritten failure string (sizes set by the JM at submit)
+    stats: CheckpointStatsTracker = field(default_factory=CheckpointStatsTracker)
+    exceptions: ExceptionHistory = field(default_factory=ExceptionHistory)
+
+    @property
+    def failure(self) -> Optional[str]:
+        """Latest failure cause (legacy single-string view of the bounded
+        exception history)."""
+        latest = self.exceptions.latest()
+        return latest["exception"] if latest is not None else None
 
 
 _MAX_JOB_SPANS = 1024
@@ -241,14 +258,28 @@ def aggregate_shard_metrics(per_shard: Dict[int, dict]) -> dict:
                         cur[stat] = max(cur.get(stat, v), v)
             elif isinstance(val, (int, float)):
                 scalars.setdefault(key, []).append(val)
+    wm_skews = []
     for key, vals in scalars.items():
         how = _shard_combine(key)
         if how == "min":
             agg[key] = min(vals)
+            # job-level watermark skew: max-min currentWatermark across the
+            # subtasks of one operator — how far the combined (MIN) watermark
+            # trails the fastest subtask, i.e. the straggler's lag in event
+            # time. The job gauge is the worst skew over all operators.
+            # Subtasks still at the MIN_WATERMARK sentinel (no watermark
+            # yet) are excluded: differencing against -(1<<63) would export
+            # a ~9.2e18 garbage value that wrecks dashboards and alerts.
+            if key.rsplit(".", 1)[-1] == "currentWatermark":
+                real = [v for v in vals if v > MIN_WATERMARK]
+                wm_skews.append(max(real) - min(real) if len(real) >= 2
+                                else 0.0)
         elif how == "mean":
             agg[key] = sum(vals) / len(vals)
         else:
             agg[key] = sum(vals)
+    if wm_skews:
+        agg["job.watermarkSkewMs"] = max(wm_skews)
     return agg
 
 
@@ -267,10 +298,15 @@ class JobManagerEndpoint(RpcEndpoint):
         heartbeat_timeout: float = 3.0,
         adaptive: bool = True,
         auto_records_per_task: int = 1 << 20,
+        checkpoint_history_size: int = 10,
+        exception_history_size: int = 16,
     ):
         super().__init__(name="jobmanager")
         self.rpc = rpc
         self.auto_records_per_task = auto_records_per_task
+        # observability.checkpoint-history.size / .exception-history.size
+        self.checkpoint_history_size = checkpoint_history_size
+        self.exception_history_size = exception_history_size
         self.blob = BlobServerEndpoint()
         rpc.register(self)
         rpc.register(self.blob)
@@ -354,7 +390,9 @@ class JobManagerEndpoint(RpcEndpoint):
         self.heartbeats.unmonitor(tm_id)
         for job in self._jobs.values():
             if job.status == "RUNNING" and tm_id in job.assignment.values():
-                self._fail_job(job, f"task executor {tm_id} lost (heartbeat timeout)")
+                self._fail_job(
+                    job, f"task executor {tm_id} lost (heartbeat timeout)",
+                    task_manager=tm_id)
 
     # ---- job lifecycle (M2/M3) -------------------------------------------
     def submit_job(self, spec_bytes: bytes, parallelism: int,
@@ -400,6 +438,9 @@ class JobManagerEndpoint(RpcEndpoint):
             job_id, blob_key, parallelism, spec.name,
             requested_parallelism=parallelism, stages=stages,
             source_stages=source_stages, trace_id=job_trace_id(job_id),
+            stats=CheckpointStatsTracker(
+                history_size=self.checkpoint_history_size),
+            exceptions=ExceptionHistory(size=self.exception_history_size),
         )
         if savepoint_path is not None:
             # start FROM a savepoint (execution.savepoint.path analogue):
@@ -465,14 +506,44 @@ class JobManagerEndpoint(RpcEndpoint):
         ]
 
     def job_metrics(self, job_id: str) -> dict:
-        """Aggregated + per-shard metric view of the TM-shipped snapshots."""
+        """Aggregated + per-shard metric view of the TM-shipped snapshots,
+        plus the JM-side control-plane gauges (`jm`): checkpoint stats and
+        restart/downtime — these live on the coordinator, not on any TM, so
+        they ride as their own labeled snapshot in /metrics."""
         job = self._jobs[job_id]
         per_shard = {int(s): dict(snap) for s, snap in job.metric_snapshots.items()}
+        agg = aggregate_shard_metrics(per_shard)
+        jm_gauges = job.stats.gauge_values(prefix="job.")
+        jm_gauges.update(job.exceptions.gauge_values(prefix="job."))
+        if "job.watermarkSkewMs" in agg:
+            jm_gauges["job.watermarkSkewMs"] = agg["job.watermarkSkewMs"]
+        agg.update(jm_gauges)
         return {
-            "job": aggregate_shard_metrics(per_shard),
+            "job": agg,
             "per_shard": per_shard,
+            "jm": jm_gauges,
             "trace_id": job.trace_id,
         }
+
+    def job_checkpoints(self, job_id: str) -> dict:
+        """Checkpoint statistics payload (/jobs/:id/checkpoints shape):
+        counts, summary, latest completed/failed/restored, bounded
+        per-checkpoint history."""
+        return self._jobs[job_id].stats.payload()
+
+    def job_checkpoint(self, job_id: str, checkpoint_id: int) -> dict:
+        """One retained checkpoint's record (/jobs/:id/checkpoints/:cid)."""
+        rec = self._jobs[job_id].stats.checkpoint(int(checkpoint_id))
+        if rec is None:
+            raise KeyError(
+                f"no retained stats for checkpoint {checkpoint_id} "
+                f"of job {job_id}")
+        return rec
+
+    def job_exceptions(self, job_id: str) -> dict:
+        """Bounded exception history + recovery timeline
+        (/jobs/:id/exceptions shape)."""
+        return self._jobs[job_id].exceptions.payload()
 
     def job_spans(self, job_id: str) -> list:
         """Span feed (plain dicts) for the job: JM trigger/complete spans
@@ -489,12 +560,16 @@ class JobManagerEndpoint(RpcEndpoint):
             snap = job.metric_snapshots[shard]
             ratio = float(snap.get("job.backPressuredTimeRatio", 0.0))
             worst = max(worst, ratio)
+            idle_ratio = float(snap.get("job.idleTimeRatio", 0.0))
             subtasks.append({
                 "subtask": shard,
                 "backPressuredRatio": ratio,
                 "busyRatio": float(snap.get("job.busyTimeRatio", 0.0)),
-                "idleRatio": float(snap.get("job.idleTimeRatio", 0.0)),
+                "idleRatio": idle_ratio,
                 "backpressureLevel": backpressure_level(ratio),
+                # idle-subtask indicator: a subtask spending nearly all its
+                # loop time waiting is starved (skewed keys / slow source)
+                "idle": idle_ratio >= 0.95,
             })
         return {
             "status": "ok" if subtasks else "deprecated",
@@ -609,6 +684,8 @@ class JobManagerEndpoint(RpcEndpoint):
                 f"{path}: job restarted before the cut completed")
         job.savepoint_paths.clear()
         origins = job.cp_origins.get(local_cp, {}) if local_cp is not None else {}
+        restored_cp = job.completed[-1][0] if job.completed else None
+        t_deploy = time.perf_counter()
         for shard, tm_id in job.assignment.items():
             # local recovery: a shard redeployed onto the TM that produced
             # its snapshot restores from the TM-local copy — the snapshot is
@@ -631,6 +708,20 @@ class JobManagerEndpoint(RpcEndpoint):
                 job.status = "RESTARTING"
                 return
         job.status = "RUNNING"
+        # recovery timeline: the attempt is live again — rewound checkpoint
+        # id, restore (redeploy) duration, rewind depth in steps, and
+        # downtime measured fail -> RUNNING. A restart with no completed
+        # checkpoint replays from scratch (restored_cp None). The savepoint-
+        # seeded first schedule records the restore but has no open
+        # recovery, so complete_recovery is a no-op there.
+        restore_ms = (time.perf_counter() - t_deploy) * 1000.0
+        if restore is not None:
+            job.stats.report_restore(restored_cp, restore_ms)
+        job.exceptions.complete_recovery(
+            restored_checkpoint_id=restored_cp,
+            restore_duration_ms=restore_ms,
+            restored_step=restore_step,
+        )
 
     def _cancel_tasks(self, job: _JobState) -> None:
         for tm_id in set(job.assignment.values()):
@@ -641,8 +732,16 @@ class JobManagerEndpoint(RpcEndpoint):
                 except Exception:
                     pass
 
-    def _fail_job(self, job: _JobState, reason: str) -> None:
-        job.failure = reason
+    def _fail_job(self, job: _JobState, reason: str,
+                  task: Optional[str] = None,
+                  task_manager: Optional[str] = None) -> None:
+        job.exceptions.record_failure(
+            reason, task=task, task_manager=task_manager,
+            restart_number=job.restarts)
+        # in-flight checkpoints belong to the dead attempt: their acks can
+        # never complete, so their stat records flip to FAILED now
+        for cp_id in list(job.pending):
+            job.stats.report_failed(cp_id, f"job failure: {reason}")
         self._cancel_tasks(job)
         if job.restarts >= self.restart_attempts:
             job.status = "FAILED"
@@ -650,6 +749,9 @@ class JobManagerEndpoint(RpcEndpoint):
             return
         job.restarts += 1
         job.status = "RESTARTING"
+        job.exceptions.begin_recovery(
+            job.restarts, cause=reason,
+            steps_at_failure=max(job.steps.values(), default=0))
         self._job_span(job, "recovery", "JobRestart", time.time() * 1000.0,
                        attempt=job.restarts, cause=reason[:200])
 
@@ -696,7 +798,9 @@ class JobManagerEndpoint(RpcEndpoint):
         job = self._jobs.get(job_id)
         if job is None or attempt != job.attempt or job.status != "RUNNING":
             return
-        self._fail_job(job, f"shard {shard}: {error}")
+        self._fail_job(job, f"shard {shard}: {error}",
+                       task=f"shard-{shard}",
+                       task_manager=job.assignment.get(shard))
 
     # ---- checkpoint coordination (S7 analogue, step-aligned) -------------
     def trigger_savepoint(self, job_id: str, path: str) -> Optional[int]:
@@ -751,6 +855,8 @@ class JobManagerEndpoint(RpcEndpoint):
             job.pending[cp_id] = {}
             job.pending_target[cp_id] = max(job.steps.values())
             trig_t0 = time.time() * 1000.0
+            job.stats.report_pending(cp_id, is_savepoint=for_savepoint,
+                                     trigger_ts_ms=trig_t0)
             with trace_context(job.trace_id):
                 for shard, gw in gws.items():
                     # margin is honored for symmetry with the keyed branch,
@@ -781,6 +887,8 @@ class JobManagerEndpoint(RpcEndpoint):
         job.pending[cp_id] = {}
         job.pending_target[cp_id] = target
         trig_t0 = time.time() * 1000.0
+        job.stats.report_pending(cp_id, is_savepoint=for_savepoint,
+                                 trigger_ts_ms=trig_t0)
         with trace_context(job.trace_id):
             for shard, gw in gws2.items():
                 gw.trigger_checkpoint(job.job_id, job.attempt, cp_id, target,
@@ -798,13 +906,36 @@ class JobManagerEndpoint(RpcEndpoint):
         if pending is None:
             return
         pending[shard] = snapshot
+        # per-task ack record: latency from the trigger timestamp + the
+        # shard snapshot's in-memory footprint (the persisted artifact is
+        # the whole set, sized below)
+        job.stats.report_ack(checkpoint_id, f"shard-{shard}",
+                             state_size_bytes=snapshot_bytes_estimate(snapshot))
         if len(pending) == job.parallelism:
             handles = job.pending.pop(checkpoint_id)
             step = job.pending_target.pop(checkpoint_id)
+            persist_ms = None
+            state_bytes = None
             if self._storage is not None:
-                self._storage.save(
-                    checkpoint_id, {"job": job_id, "shards": handles, "step": step}
-                )
+                t_save = time.perf_counter()
+                try:
+                    self._storage.save(
+                        checkpoint_id,
+                        {"job": job_id, "shards": handles, "step": step}
+                    )
+                except BaseException as e:  # noqa: BLE001 — record, re-raise
+                    # the entry already left job.pending, so _fail_job's
+                    # pending sweep can never reach it — flip it here or the
+                    # record stays PENDING forever (local-path _abort parity)
+                    job.stats.report_failed(
+                        checkpoint_id, f"persist failed: {e!r}")
+                    raise
+                persist_ms = (time.perf_counter() - t_save) * 1000.0
+                state_bytes = self._storage.last_save_bytes
+                self._job_span(job, "checkpointing", "CheckpointPersist",
+                               time.time() * 1000.0 - persist_ms,
+                               checkpointId=checkpoint_id,
+                               stateSizeBytes=state_bytes)
             sp = job.savepoint_paths.pop(checkpoint_id, None)
             if sp is not None:
                 # the checkpoint is complete regardless of the savepoint
@@ -822,6 +953,17 @@ class JobManagerEndpoint(RpcEndpoint):
                     job.failed_savepoints.append(
                         f"{sp_path}: {e}")
             job.completed.append((checkpoint_id, handles, step))
+            # per-operator breakdown from the stateBytes gauges the TMs
+            # already ship on the heartbeat (latest snapshot per shard)
+            per_op: Dict[str, int] = {}
+            for snap_metrics in job.metric_snapshots.values():
+                operator_bytes_from_snapshot(snap_metrics, into=per_op)
+            job.stats.report_completed(
+                checkpoint_id,
+                async_duration_ms=persist_ms,
+                state_size_bytes=state_bytes,
+                operator_bytes=per_op,
+            )
             self._job_span(job, "checkpointing", "CheckpointComplete",
                            time.time() * 1000.0, checkpointId=checkpoint_id,
                            status="COMPLETED", step=step)
@@ -853,7 +995,9 @@ class JobManagerEndpoint(RpcEndpoint):
                            checkpoint_id: int, reason: str) -> None:
         job = self._jobs.get(job_id)
         if job is not None and attempt == job.attempt:
-            job.pending.pop(checkpoint_id, None)
+            if job.pending.pop(checkpoint_id, None) is not None:
+                job.stats.report_failed(
+                    checkpoint_id, f"declined by shard {shard}: {reason}")
             job.pending_target.pop(checkpoint_id, None)
             sp = job.savepoint_paths.pop(checkpoint_id, None)
             if sp is None:
@@ -1710,10 +1854,22 @@ def main(argv: Optional[List[str]] = None) -> None:
 
     if args.role == "jobmanager":
         svc = RpcService(args.host, args.port, security=security)
+        hist_kw = {}
+        if args.conf:
+            from flink_tpu.config import Configuration, ObservabilityOptions
+
+            conf = Configuration.load(args.conf).add_all(Configuration.from_env())
+            hist_kw = dict(
+                checkpoint_history_size=conf.get(
+                    ObservabilityOptions.CHECKPOINT_HISTORY_SIZE),
+                exception_history_size=conf.get(
+                    ObservabilityOptions.EXCEPTION_HISTORY_SIZE),
+            )
         JobManagerEndpoint(
             svc,
             checkpoint_dir=args.checkpoint_dir,
             checkpoint_interval=args.checkpoint_interval,
+            **hist_kw,
         )
         print(f"jobmanager listening on {svc.address}", flush=True)
     else:

@@ -1411,6 +1411,21 @@ class JobRuntime:
             if isinstance(r, SinkRunner):
                 r.commit_epoch(str(checkpoint_id))
 
+    def operator_state_bytes(self) -> Dict[str, int]:
+        """Per-operator state footprint from the operators' own
+        state_bytes() (the same source as the stateBytes gauges) — the
+        per-operator breakdown attached to completed checkpoint records."""
+        out: Dict[str, int] = {}
+        for idx, r in enumerate(self.runners):
+            fn = getattr(getattr(r, "op", None), "state_bytes", None)
+            if fn is None:
+                continue
+            try:
+                out[getattr(r, "uid", f"runner-{idx}")] = int(fn())
+            except Exception:   # a torn-down operator must not fail a
+                continue        # checkpoint's bookkeeping
+        return out
+
     # -- the loop ---------------------------------------------------------
     def run(
         self,

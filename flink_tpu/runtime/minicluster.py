@@ -207,6 +207,12 @@ class MiniCluster:
         config: Configuration,
         savepoint_restore_path: Optional[str],
     ) -> None:
+        from flink_tpu.config import ObservabilityOptions
+        from flink_tpu.metrics.checkpoint_stats import (
+            CheckpointStatsTracker,
+            ExceptionHistory,
+            failing_task,
+        )
         from flink_tpu.metrics.otel import OtlpJsonTraceReporter
         from flink_tpu.metrics.registry import MetricRegistry
         from flink_tpu.metrics.traces import TraceRegistry, job_trace_id
@@ -224,12 +230,24 @@ class MiniCluster:
         interval = config.get(CheckpointingOptions.INTERVAL_MS)
         chk_dir = config.get(CheckpointingOptions.DIRECTORY)
         storage = FsCheckpointStorage(chk_dir) if chk_dir else MemoryCheckpointStorage()
+        # fault-tolerance observability: per-checkpoint stats (bounded ring
+        # + the standard gauges on the job's registry, so /metrics and
+        # /jobs/:id/checkpoints see them) and a bounded exception/recovery
+        # history replacing a single overwritten error
+        job_group = client.metrics.group("job")
+        client.checkpoint_stats = CheckpointStatsTracker(
+            history_size=config.get(ObservabilityOptions.CHECKPOINT_HISTORY_SIZE))
+        client.checkpoint_stats.register_metrics(job_group)
+        client.exceptions = ExceptionHistory(
+            size=config.get(ObservabilityOptions.EXCEPTION_HISTORY_SIZE))
+        client.exceptions.register_metrics(job_group)
         coordinator = (
             CheckpointCoordinator(
                 storage,
                 interval,
                 config.get(CheckpointingOptions.MAX_RETAINED),
                 traces=client.traces,
+                stats=client.checkpoint_stats,
             )
             if interval > 0
             else None
@@ -242,6 +260,7 @@ class MiniCluster:
         attempt = 0
 
         restore_snap = None
+        restore_ms = 0.0
         if savepoint_restore_path is not None:
             sp_storage = FsCheckpointStorage(savepoint_restore_path)
             latest = sp_storage.latest()
@@ -251,15 +270,33 @@ class MiniCluster:
                 )
                 client._set_status(JobStatus.FAILED)
                 return
+            t_restore = time.perf_counter()
             restore_snap = sp_storage.load(latest[1])
+            restore_ms = (time.perf_counter() - t_restore) * 1000.0
 
         while True:
             runtime = JobRuntime(graph, config, registry=client.metrics)
             client._runtime = runtime  # queryable-state surface (S13)
+            if coordinator is not None:
+                # per-operator breakdown for completed checkpoint records
+                # comes from THIS attempt's operators
+                coordinator.state_bytes_fn = runtime.operator_state_bytes
             try:
                 if restore_snap is not None:
                     runtime.restore(restore_snap)
+                    client.checkpoint_stats.report_restore(
+                        restore_snap.get("checkpoint_id"), restore_ms)
                 client._set_status(JobStatus.RUNNING)
+                # the restarted attempt is live again: close the recovery
+                # timeline record (downtime = fail -> RUNNING)
+                client.exceptions.complete_recovery(
+                    restored_checkpoint_id=(restore_snap or {}).get(
+                        "checkpoint_id"),
+                    restore_duration_ms=restore_ms,
+                    events_replayed=(
+                        client.records_in - restore_snap.get("records_in", 0)
+                        if restore_snap is not None else client.records_in),
+                )
 
                 def cancel_check():
                     client.records_in = runtime.records_in  # progress gauge
@@ -279,18 +316,31 @@ class MiniCluster:
             except BaseException as e:  # noqa: BLE001 — failover boundary
                 attempt += 1
                 client.error = e
+                # bounded exception history (ExceptionHistoryEntry analogue):
+                # timestamp, failing-operator attribution, root-cause chain
+                client.exceptions.record_failure(
+                    repr(e),
+                    task=failing_task(e) or client.job_name,
+                    restart_number=attempt - 1,
+                    exception=e,
+                )
                 delay = strategy.next_delay_ms(attempt)
                 if delay is None:
                     client._set_status(JobStatus.FAILED)
                     return
                 client.num_restarts = attempt
                 client._set_status(JobStatus.RESTARTING)
+                client.exceptions.begin_recovery(
+                    attempt, cause=repr(e),
+                    events_at_failure=client.records_in)
                 restart_span = client.traces.span("recovery", "JobRestart") \
                     .set_attribute("attempt", attempt) \
                     .set_attribute("delayMs", delay) \
                     .set_attribute("cause", repr(e)[:200])
                 time.sleep(delay / 1000.0)
+                t_restore = time.perf_counter()
                 restore_snap = coordinator.latest_snapshot() if coordinator else None
+                restore_ms = (time.perf_counter() - t_restore) * 1000.0
                 client.traces.report(restart_span.set_attribute(
                     "restoredCheckpoint",
                     bool(restore_snap)).end())

@@ -15,6 +15,14 @@ view). Endpoints:
   GET  /jobs/<id>/vertices/<uid>/backpressure
                               → busy/idle/backPressured ratios + level
                                 (JobVertexBackPressureHandler analogue)
+  GET  /jobs/<id>/checkpoints → checkpoint statistics: counts, summary,
+                                latest completed/failed/restored, bounded
+                                per-checkpoint history
+                                (CheckpointingStatisticsHandler analogue)
+  GET  /jobs/<id>/checkpoints/<cid>
+                              → one retained checkpoint's record
+  GET  /jobs/<id>/exceptions  → bounded exception history + recovery
+                                timeline (JobExceptionsHandler analogue)
   GET  /metrics               → Prometheus text exposition (all jobs)
   POST /jars/run              → {"module": "/path/script.py", "entry": "main"}
                                 application-mode submission: the script builds
@@ -128,10 +136,17 @@ class _Handler(BaseHTTPRequestHandler):
                 # RPC, labeled so Prometheus keeps shards distinguishable
                 try:
                     for j in self.jm.list_jobs():
-                        shards = self.jm.job_metrics(j["id"])["per_shard"]
-                        for shard, snap in shards.items():
+                        jm_metrics = self.jm.job_metrics(j["id"])
+                        for shard, snap in jm_metrics["per_shard"].items():
                             texts.append(prometheus_text_from_snapshot(
                                 snap, labels={"job": j["id"], "shard": shard}))
+                        # JM-side control-plane gauges (checkpoint stats,
+                        # restart/downtime, watermark skew) live on the
+                        # coordinator, not any shard — own labeled snapshot
+                        jm_side = jm_metrics.get("jm") or {}
+                        if jm_side:
+                            texts.append(prometheus_text_from_snapshot(
+                                jm_side, labels={"job": j["id"]}))
                 except Exception:
                     pass
             # one TYPE line per family, samples grouped — naive
@@ -192,6 +207,35 @@ class _Handler(BaseHTTPRequestHandler):
                     v = m.value()
                     out[k] = v if isinstance(v, (int, float, dict)) else str(v)
                 return self._json(200, out)
+            if parts[2] == "checkpoints":
+                from flink_tpu.metrics.checkpoint_stats import (
+                    empty_checkpoints_payload,
+                )
+
+                stats = getattr(client, "checkpoint_stats", None)
+                if len(parts) == 3:
+                    return self._json(200, _jsonable(
+                        stats.payload() if stats is not None
+                        else empty_checkpoints_payload()))
+                if len(parts) == 4:
+                    if not parts[3].isdigit():
+                        return self._json(
+                            400, {"error": "checkpoint id must be an integer"})
+                    rec = stats.checkpoint(int(parts[3])) if stats else None
+                    if rec is None:
+                        return self._json(404, {
+                            "error": f"no retained stats for checkpoint "
+                                     f"{parts[3]}"})
+                    return self._json(200, _jsonable(rec))
+            if parts[2] == "exceptions" and len(parts) == 3:
+                from flink_tpu.metrics.checkpoint_stats import (
+                    empty_exceptions_payload,
+                )
+
+                hist = getattr(client, "exceptions", None)
+                return self._json(200, _jsonable(
+                    hist.payload() if hist is not None
+                    else empty_exceptions_payload()))
             if parts[2] == "state" and len(parts) == 4:
                 # queryable state (S13): /jobs/<id>/state/<uid>?key=K
                 from urllib.parse import parse_qs, urlparse
@@ -275,6 +319,18 @@ class _Handler(BaseHTTPRequestHandler):
                     and parts[4] == "backpressure":
                 return self._json(200, _jsonable(
                     self.jm.job_backpressure(job_id)))
+            if parts[2] == "checkpoints" and len(parts) == 3:
+                return self._json(200, _jsonable(
+                    self.jm.job_checkpoints(job_id)))
+            if parts[2] == "checkpoints" and len(parts) == 4:
+                if not parts[3].isdigit():
+                    return self._json(
+                        400, {"error": "checkpoint id must be an integer"})
+                return self._json(200, _jsonable(
+                    self.jm.job_checkpoint(job_id, int(parts[3]))))
+            if parts[2] == "exceptions" and len(parts) == 3:
+                return self._json(200, _jsonable(
+                    self.jm.job_exceptions(job_id)))
         except Exception as e:  # noqa: BLE001 — JM lookup failures -> 404
             return self._json(404, {"error": repr(e)})
         return self._json(404, {"error": f"no route {self.path}"})

@@ -21,6 +21,8 @@ DASHBOARD_HTML = """<!DOCTYPE html>
  header { padding: 14px 22px; border-bottom: 1px solid var(--line);
           display: flex; gap: 18px; align-items: baseline; }
  h1 { font-size: 1.05rem; margin: 0; }
+ h3 { font-size: 0.82rem; margin: 14px 0 4px; color: var(--dim);
+      font-weight: normal; text-transform: uppercase; letter-spacing: 1px; }
  #overview { color: var(--dim); }
  header a { color: var(--info); text-decoration: none; margin-left: 10px; }
  main { padding: 18px 22px; }
@@ -73,6 +75,48 @@ function kv(obj) {
 }
 
 const bpClass = (r) => r > 0.5 ? "FAILED" : (r > 0.1 ? "CANCELED" : "RUNNING");
+const cpClass = (s) => s === "COMPLETED" ? "RUNNING"
+  : (s === "FAILED" ? "FAILED" : "CREATED");
+
+function checkpointSection(cps) {
+  // checkpoint & recovery observability: lifetime counts, last restore,
+  // and the bounded per-checkpoint history ring (/jobs/:id/checkpoints)
+  if (!cps || !cps.counts || !(cps.counts.total || cps.counts.failed)) return "";
+  const rows = (cps.history || []).slice(0, 8).map(c => `<tr>
+    <td>${esc(c.id)}</td>
+    <td class="${cpClass(c.status)}">${esc(c.status)}${c.is_savepoint ? " (sp)" : ""}</td>
+    <td>${fmt(c.end_to_end_duration_ms)}</td>
+    <td>${fmt(c.sync_duration_ms)} / ${fmt(c.async_duration_ms)}</td>
+    <td>${fmt(c.state_size_bytes)}</td>
+    <td>${esc((c.failure_cause ?? "").slice(0, 60))}</td></tr>`);
+  const r = cps.latest?.restored;
+  return "<h3>checkpoints</h3>" + kv({
+    "completed": fmt(cps.counts.completed),
+    "failed": fmt(cps.counts.failed),
+    "in progress": fmt(cps.counts.in_progress),
+    "last restore": r == null ? "-" :
+      `chk ${r.checkpoint_id ?? "?"} in ${fmt(r.restore_duration_ms)}ms`,
+  }) + (rows.length ? `<table><thead><tr><th>id</th><th>status</th>
+    <th>e2e ms</th><th>sync/async ms</th><th>bytes</th><th>failure</th></tr>
+    </thead><tbody>${rows.join("")}</tbody></table>` : "");
+}
+
+function exceptionSection(exc) {
+  // bounded exception history + recovery timeline (/jobs/:id/exceptions)
+  if (!exc || !(exc.entries ?? []).length) return "";
+  const entries = exc.entries.slice(0, 6).map(e => esc(
+    `#${e.restart_number} ${new Date(e.timestamp_ms).toISOString()} ` +
+    `[${e.task ?? "?"}${e.task_manager ? " @ " + e.task_manager : ""}] ` +
+    e.exception)).join("<br>");
+  const recs = (exc.recoveries ?? []).slice(0, 4).map(r => esc(
+    `restart #${r.restart_number}: rewound to chk ${r.restored_checkpoint_id ?? "none"}, ` +
+    `restore ${fmt(r.restore_duration_ms)}ms, downtime ${fmt(r.downtime_ms)}ms` +
+    (r.steps_replayed != null ? `, ${r.steps_replayed} steps replayed` : "") +
+    (r.events_replayed != null ? `, ${fmt(r.events_replayed)} events replayed` : "")
+  )).join("<br>");
+  return `<h3>exceptions</h3><div class="spans">${entries}</div>` +
+    (recs ? `<div class="spans">${recs}</div>` : "");
+}
 
 function operatorTable(metrics) {
   // per-operator observability: latency-marker percentiles, device time,
@@ -101,9 +145,11 @@ function operatorTable(metrics) {
 }
 
 async function detailRow(id) {
-  const [info, metrics, traces] = await Promise.all([
+  const [info, metrics, traces, cps, exc] = await Promise.all([
     j(`/jobs/${id}`), j(`/jobs/${id}/metrics`),
     j(`/jobs/${id}/traces`).catch(() => ({resourceSpans: []})),
+    j(`/jobs/${id}/checkpoints`).catch(() => null),
+    j(`/jobs/${id}/exceptions`).catch(() => null),
   ]);
   const spans = (traces.resourceSpans[0]?.scopeSpans[0]?.spans ?? []);
   const spanRows = spans.slice(-12).reverse().map(s => {
@@ -122,11 +168,16 @@ async function detailRow(id) {
     .filter(([k]) => k.includes(`exchange.numBytes${dir}PerSecond`))
     .reduce((a, [, v]) => a + (Number(v) || 0), 0);
   const exchOut = exch("Out"), exchIn = exch("In");
+  const idle = metrics["job.idleTimeRatio"] ?? 0;
   return kv({
     "records/s": fmt(metrics["job.numRecordsInPerSecond"]),
     "busy ratio": fmt(metrics["job.busyTimeRatio"], 2),
-    "idle ratio": fmt(metrics["job.idleTimeRatio"], 2),
+    // idle-subtask indicator: a (sub)task coasting at >=95% idle is
+    // starved — skewed keys or a slow upstream, not healthy headroom
+    "idle ratio": idle >= 0.95
+      ? `<span class="CANCELED">${fmt(idle, 2)} idle</span>` : fmt(idle, 2),
     "backpressured": `<span class="${bpClass(bp)}">${fmt(bp, 2)}</span>`,
+    "wm skew ms": fmt(metrics["job.watermarkSkewMs"]),
     "step p50 ms": fmt(latency.p50), "step p99 ms": fmt(latency.p99),
     "device ms total": fmt(metrics["job.deviceTimeMsTotal"]),
     "exchange out B/s": fmt(exchOut), "exchange in B/s": fmt(exchIn),
@@ -134,6 +185,7 @@ async function detailRow(id) {
         ([k]) => k.endsWith("numLateRecordsDropped"))?.[1]),
     "error": esc(info.error ?? "none"),
   }) + operatorTable(metrics)
+    + checkpointSection(cps) + exceptionSection(exc)
     + (spanRows ? `<div class="spans">${spanRows}</div>` : "");
 }
 

@@ -94,6 +94,39 @@ def test_jax_stays_out_of_the_control_plane():
     assert not bad, "\n".join(bad)
 
 
+def _all_imports(path: pathlib.Path):
+    """EVERY import in the file, function bodies included — for rules where
+    even a lazy import is a layering violation."""
+    found = []
+    for node in ast.walk(ast.parse(path.read_text())):
+        if isinstance(node, ast.Import):
+            found.extend((a.name, node.lineno) for a in node.names)
+        elif isinstance(node, ast.ImportFrom) and node.module:
+            found.append((node.module, node.lineno))
+    return found
+
+
+def test_checkpoint_layer_never_imports_the_runtime():
+    """flink_tpu/checkpoint/ must not import flink_tpu.runtime — anywhere,
+    lazy imports included. Checkpoint/failure/recovery statistics flow
+    OUTWARD: the coordinator reports into trackers the runtime hands it
+    (metrics/checkpoint_stats.py stats + state_bytes_fn callbacks), it
+    never reaches into the scheduler or executor. A runtime import here
+    would invert the dependency and let coordinator changes drag in the
+    whole cluster stack (and, on TPU hosts, risk backend init from a
+    checkpoint utility)."""
+    bad = []
+    for f in sorted((PKG / "checkpoint").rglob("*.py")):
+        for imp, line in _all_imports(f):
+            if imp == "flink_tpu.runtime" or imp.startswith("flink_tpu.runtime."):
+                bad.append(
+                    f"{f.relative_to(PKG.parent)}:{line} imports {imp} "
+                    "(checkpoint layer must stay below the runtime; pass "
+                    "data outward via callbacks/trackers instead)"
+                )
+    assert not bad, "\n".join(bad)
+
+
 def _pickle_load_sites(path: pathlib.Path):
     """Every way raw deserialization can be spelled, anywhere in the file
     (function bodies included — unlike _module_level_imports this must see

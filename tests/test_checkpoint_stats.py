@@ -1,0 +1,503 @@
+"""Checkpoint, failure & recovery observability (control-plane half of the
+observability plane): CheckpointStatsTracker records/counters/gauges,
+exception history + recovery timeline, the coordinator's phase spans and
+failed-persist handling, the JM watermark-skew aggregate, and the e2e
+MiniCluster acceptance path over REST + Prometheus."""
+
+import json
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from flink_tpu.api.datastream import StreamExecutionEnvironment
+from flink_tpu.api.windowing.assigners import TumblingEventTimeWindows
+from flink_tpu.checkpoint.coordinator import CheckpointCoordinator
+from flink_tpu.checkpoint.storage import MemoryCheckpointStorage
+from flink_tpu.config import (
+    CheckpointingOptions,
+    Configuration,
+    ExecutionOptions,
+    ObservabilityOptions,
+    RestartOptions,
+)
+from flink_tpu.connectors.sink import CollectSink
+from flink_tpu.connectors.source import Batch, DataGeneratorSource
+from flink_tpu.core.watermarks import WatermarkStrategy
+from flink_tpu.metrics.checkpoint_stats import (
+    CheckpointStatsTracker,
+    ExceptionHistory,
+    operator_bytes_from_snapshot,
+    root_cause_chain,
+    snapshot_bytes_estimate,
+)
+from flink_tpu.metrics.registry import MetricRegistry
+from flink_tpu.metrics.traces import InMemoryTraceReporter, TraceRegistry
+from flink_tpu.runtime.minicluster import JobStatus, MiniCluster
+from flink_tpu.runtime.rest import RestServer
+from flink_tpu.utils.arrays import obj_array
+
+
+class _FakeClock:
+    def __init__(self, t=100.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+
+# ---------------------------------------------------------------------------
+# tracker: records, ring, counters, gauges
+# ---------------------------------------------------------------------------
+
+def test_tracker_ring_is_bounded_and_newest_first():
+    t = CheckpointStatsTracker(history_size=3)
+    for cid in range(1, 6):
+        t.report_pending(cid)
+        t.report_completed(cid, state_size_bytes=cid * 10)
+    p = t.payload()
+    assert p["counts"]["completed"] == 5           # lifetime, not ring-bounded
+    assert [c["id"] for c in p["history"]] == [5, 4, 3]
+    assert t.checkpoint(1) is None                 # evicted
+    assert t.checkpoint(5)["state_size_bytes"] == 50
+    g = t.gauge_values()
+    assert g["numberOfCompletedCheckpoints"] == 5
+    assert g["lastCheckpointSize"] == 50
+    assert g["numberOfInProgressCheckpoints"] == 0
+
+
+def test_tracker_ack_latency_failure_and_restore():
+    clock = _FakeClock(100.0)
+    t = CheckpointStatsTracker(history_size=8, clock=clock)
+    t.report_pending(1)
+    clock.t = 100.5
+    t.report_ack(1, "shard-0", state_size_bytes=400)
+    clock.t = 101.0
+    t.report_ack(1, "shard-1", state_size_bytes=600)
+    clock.t = 101.5
+    t.report_completed(1, async_duration_ms=3.0)
+    rec = t.checkpoint(1)
+    assert rec["status"] == "COMPLETED"
+    assert rec["tasks"]["shard-0"]["ack_latency_ms"] == pytest.approx(500.0)
+    assert rec["tasks"]["shard-1"]["ack_latency_ms"] == pytest.approx(1000.0)
+    # no explicit size: completed record sums the per-task snapshots
+    assert rec["state_size_bytes"] == 1000
+    assert rec["end_to_end_duration_ms"] == pytest.approx(1500.0)
+
+    t.report_pending(2)
+    t.report_failed(2, "persist exploded")
+    assert t.checkpoint(2)["status"] == "FAILED"
+    assert t.checkpoint(2)["failure_cause"] == "persist exploded"
+    # a late decline must never un-complete a completed checkpoint
+    t.report_failed(1, "stale decline")
+    assert t.checkpoint(1)["status"] == "COMPLETED"
+    assert t.gauge_values()["numberOfFailedCheckpoints"] == 1
+
+    clock.t = 110.0
+    t.report_restore(1, 42.0)
+    g = t.gauge_values()
+    assert g["lastCheckpointRestoreTimestamp"] == pytest.approx(110_000.0)
+    assert t.payload()["latest"]["restored"]["checkpoint_id"] == 1
+    assert t.payload()["latest"]["restored"]["restore_duration_ms"] == 42.0
+
+
+def test_tracker_straggler_completion_cannot_resurrect_failed_record():
+    """A job failure flips pending records to FAILED; a delayed ack that
+    then completes the set must not flip the record back to COMPLETED and
+    double-count it in both tallies."""
+    t = CheckpointStatsTracker()
+    t.report_pending(1)
+    t.report_failed(1, "job failure: tm lost")
+    t.report_ack(1, "shard-1", 100)
+    t.report_completed(1, state_size_bytes=999)
+    assert t.checkpoint(1)["status"] == "FAILED"
+    g = t.gauge_values()
+    assert g["numberOfFailedCheckpoints"] == 1
+    assert g["numberOfCompletedCheckpoints"] == 0
+    assert t.payload()["counts"]["total"] == 1
+
+
+def test_tracker_retriggered_id_does_not_duplicate_ring_slot():
+    t = CheckpointStatsTracker(history_size=4)
+    t.report_pending(1)
+    t.report_failed(1, "first try died")
+    t.report_pending(1)                     # coordinator re-uses the id
+    t.report_completed(1)
+    assert [c["id"] for c in t.payload()["history"]] == [1]
+    assert t.checkpoint(1)["status"] == "COMPLETED"
+
+
+def test_tracker_gauges_register_on_metric_group():
+    reg = MetricRegistry()
+    t = CheckpointStatsTracker()
+    t.register_metrics(reg.group("job"))
+    t.report_pending(1)
+    t.report_completed(1, state_size_bytes=123)
+    metrics = reg.all_metrics()
+    assert metrics["job.numberOfCompletedCheckpoints"].value() == 1
+    assert metrics["job.lastCheckpointSize"].value() == 123
+
+
+# ---------------------------------------------------------------------------
+# exception history + recovery timeline
+# ---------------------------------------------------------------------------
+
+def test_exception_history_chain_attribution_and_bounds():
+    h = ExceptionHistory(size=2)
+    try:
+        try:
+            raise ValueError("root cause")
+        except ValueError as inner:
+            raise RuntimeError("wrapper") from inner
+    except RuntimeError as e:
+        h.record_failure(repr(e), task="window-1", task_manager="tm-a",
+                         restart_number=0, exception=e)
+    entry = h.latest()
+    assert entry["task"] == "window-1" and entry["task_manager"] == "tm-a"
+    assert entry["root_cause_chain"] == [
+        "RuntimeError: wrapper", "ValueError: root cause"]
+    for n in range(1, 4):
+        h.record_failure(f"f{n}", restart_number=n)
+    p = h.payload()
+    assert len(p["entries"]) == 2                  # bounded
+    assert p["root_exception"] == "f3"             # newest first
+    assert p["entries"][0]["restart_number"] == 3
+
+
+def test_recovery_timeline_downtime_and_replay_depth():
+    clock = _FakeClock(50.0)
+    h = ExceptionHistory(size=4, clock=clock)
+    h.begin_recovery(1, cause="boom", steps_at_failure=17,
+                     events_at_failure=1700)
+    # open recovery is visible (downtime still unknown)
+    assert h.payload()["recoveries"][0]["downtime_ms"] is None
+    clock.t = 52.0
+    h.complete_recovery(restored_checkpoint_id=3, restore_duration_ms=80.0,
+                        restored_step=12)
+    rec = h.payload()["recoveries"][0]
+    assert rec["downtime_ms"] == pytest.approx(2000.0)
+    assert rec["restored_checkpoint_id"] == 3
+    assert rec["steps_replayed"] == 5              # 17 at failure, rewound to 12
+    g = h.gauge_values()
+    assert g["numRestarts"] == 1
+    assert g["lastRestartDowntimeMs"] == pytest.approx(2000.0)
+    assert g["lastCheckpointRestoreDurationMs"] == 80.0
+    # completing again without an open record is a no-op
+    h.complete_recovery(restored_checkpoint_id=9)
+    assert len(h.payload()["recoveries"]) == 1
+
+
+def test_root_cause_chain_handles_cycles():
+    a = ValueError("a")
+    b = RuntimeError("b")
+    a.__cause__ = b
+    b.__cause__ = a          # pathological cycle must not loop forever
+    assert root_cause_chain(a) == ["ValueError: a", "RuntimeError: b"]
+
+
+# ---------------------------------------------------------------------------
+# sizing helpers
+# ---------------------------------------------------------------------------
+
+def test_snapshot_bytes_estimate_counts_array_buffers():
+    snap = {
+        "operator": {"acc": np.zeros((8, 16), dtype=np.float32),
+                     "count": np.zeros(64, dtype=np.int32)},
+        "step": 3,
+        "blob": b"\x00" * 100,
+    }
+    est = snapshot_bytes_estimate(snap)
+    assert est >= 8 * 16 * 4 + 64 * 4 + 100
+
+
+def test_operator_bytes_from_snapshot_sums_shards():
+    per_op: dict = {}
+    operator_bytes_from_snapshot(
+        {"job.operator.win.stateBytes": 100, "job.busyTimeRatio": 0.5,
+         "job.operator.win.stateKeyCount": 7}, into=per_op)
+    operator_bytes_from_snapshot(
+        {"job.operator.win.stateBytes": 50,
+         "job.operator.agg.stateBytes": 25}, into=per_op)
+    assert per_op == {"win": 150, "agg": 25}
+
+
+# ---------------------------------------------------------------------------
+# coordinator: phase spans + failed persist/capture
+# ---------------------------------------------------------------------------
+
+class _ExplodingStorage(MemoryCheckpointStorage):
+    def save(self, checkpoint_id, data):
+        raise OSError("disk full")
+
+
+def _coordinator(storage, stats):
+    traces = TraceRegistry(trace_id="ab" * 16)
+    rep = InMemoryTraceReporter()
+    traces.add_reporter(rep)
+    coord = CheckpointCoordinator(storage, interval_ms=1, traces=traces,
+                                  stats=stats)
+    return coord, rep
+
+
+def test_coordinator_reports_phases_and_sizes_on_success():
+    stats = CheckpointStatsTracker()
+    coord, rep = _coordinator(MemoryCheckpointStorage(), stats)
+    coord.state_bytes_fn = lambda: {"win": 2048}
+    cid = coord.trigger(lambda: {"state": np.arange(100)})
+    rec = stats.checkpoint(cid)
+    assert rec["status"] == "COMPLETED"
+    assert rec["sync_duration_ms"] is not None
+    assert rec["async_duration_ms"] is not None
+    assert rec["state_size_bytes"] > 0             # pickled artifact size
+    assert rec["operators"] == {"win": 2048}
+    names = [s.name for s in rep.spans]
+    assert names == ["CheckpointCapture", "CheckpointPersist", "Checkpoint"]
+    assert all(s.trace_id == "ab" * 16 for s in rep.spans)
+    assert rep.spans[-1].attributes["status"] == "COMPLETED"
+
+
+def test_coordinator_failed_persist_ends_spans_records_failed_and_reraises():
+    stats = CheckpointStatsTracker()
+    coord, rep = _coordinator(_ExplodingStorage(), stats)
+    with pytest.raises(OSError, match="disk full"):
+        coord.trigger(lambda: {"state": 1})
+    # tracker: FAILED with the cause; no completion counted
+    assert stats.gauge_values()["numberOfFailedCheckpoints"] == 1
+    assert stats.gauge_values()["numberOfCompletedCheckpoints"] == 0
+    rec = stats.checkpoint(1)
+    assert rec["status"] == "FAILED" and "disk full" in rec["failure_cause"]
+    # no span leaked open: capture closed clean, persist + root closed FAILED
+    by_name = {s.name: s for s in rep.spans}
+    assert set(by_name) == {"CheckpointCapture", "CheckpointPersist",
+                            "Checkpoint"}
+    assert by_name["CheckpointPersist"].attributes["status"] == "FAILED"
+    assert by_name["Checkpoint"].attributes["status"] == "FAILED"
+    assert "disk full" in by_name["Checkpoint"].attributes["failureCause"]
+
+
+def test_coordinator_failed_capture_records_failed_and_reraises():
+    stats = CheckpointStatsTracker()
+    coord, rep = _coordinator(MemoryCheckpointStorage(), stats)
+
+    def bad_capture():
+        raise RuntimeError("capture raced a teardown")
+
+    with pytest.raises(RuntimeError, match="teardown"):
+        coord.trigger(bad_capture)
+    assert stats.checkpoint(1)["status"] == "FAILED"
+    by_name = {s.name: s for s in rep.spans}
+    assert set(by_name) == {"CheckpointCapture", "Checkpoint"}
+    assert by_name["CheckpointCapture"].attributes["status"] == "FAILED"
+
+
+# ---------------------------------------------------------------------------
+# JM aggregate: watermark skew
+# ---------------------------------------------------------------------------
+
+def test_aggregate_shard_metrics_watermark_skew():
+    from flink_tpu.runtime.cluster import aggregate_shard_metrics
+
+    agg = aggregate_shard_metrics({
+        0: {"job.operator.win.currentWatermark": 1000,
+            "job.numRecordsInPerSecond": 10.0},
+        1: {"job.operator.win.currentWatermark": 400,
+            "job.numRecordsInPerSecond": 20.0},
+    })
+    assert agg["job.operator.win.currentWatermark"] == 400   # MIN rule intact
+    assert agg["job.watermarkSkewMs"] == 600
+    assert agg["job.numRecordsInPerSecond"] == 30.0
+    # single shard: skew is zero, not absent (the gauge stays scrapeable)
+    agg1 = aggregate_shard_metrics(
+        {0: {"job.operator.win.currentWatermark": 1000}})
+    assert agg1["job.watermarkSkewMs"] == 0
+
+
+def test_aggregate_watermark_skew_ignores_min_watermark_sentinel():
+    """A subtask that has not seen a watermark yet sits at the
+    MIN_WATERMARK sentinel (-(1<<63)); differencing against it would
+    export ~9.2e18 ms of garbage skew. Sentinels are excluded — skew is
+    over subtasks that HAVE a watermark, 0 when fewer than two do."""
+    from flink_tpu.core.time import MIN_WATERMARK
+    from flink_tpu.runtime.cluster import aggregate_shard_metrics
+
+    agg = aggregate_shard_metrics({
+        0: {"job.operator.win.currentWatermark": 1000},
+        1: {"job.operator.win.currentWatermark": MIN_WATERMARK},
+        2: {"job.operator.win.currentWatermark": 400},
+    })
+    assert agg["job.watermarkSkewMs"] == 600
+    # only one real watermark: no pair to difference -> 0, never the sentinel
+    agg2 = aggregate_shard_metrics({
+        0: {"job.operator.win.currentWatermark": 1000},
+        1: {"job.operator.win.currentWatermark": MIN_WATERMARK},
+    })
+    assert agg2["job.watermarkSkewMs"] == 0
+
+
+def test_jm_persist_failure_marks_checkpoint_failed(tmp_path):
+    """Distributed parity with the coordinator's _abort: a persist that
+    raises after the pending entry was popped must flip the stats record
+    to FAILED itself — _fail_job's pending sweep can no longer reach it."""
+    from flink_tpu.runtime.cluster import JobManagerEndpoint, _JobState
+    from flink_tpu.runtime.rpc import RpcService
+
+    svc = RpcService()
+    jm = JobManagerEndpoint(svc, checkpoint_dir=str(tmp_path / "chk"))
+    try:
+        job = _JobState("j1", "bk", 1, "spec")
+        job.attempt = 1
+        jm._jobs["j1"] = job
+        job.pending[5] = {}
+        job.pending_target[5] = 10
+        job.stats.report_pending(5)
+
+        def boom(cid, data):
+            raise OSError("disk full")
+
+        jm._storage.save = boom
+        with pytest.raises(OSError, match="disk full"):
+            jm.ack_checkpoint("j1", 1, 0, 5, {"x": np.arange(4)})
+        rec = job.stats.checkpoint(5)
+        assert rec["status"] == "FAILED" and "disk full" in rec["failure_cause"]
+        assert job.stats.gauge_values()["numberOfInProgressCheckpoints"] == 0
+    finally:
+        jm.stop()
+        svc.stop()
+
+
+# ---------------------------------------------------------------------------
+# e2e acceptance: induced failure + restart, served over REST + Prometheus
+# ---------------------------------------------------------------------------
+
+def test_e2e_minicluster_failure_recovery_observability(tmp_path):
+    cluster = MiniCluster()
+    server = RestServer(cluster).start()
+
+    config = Configuration()
+    config.set(CheckpointingOptions.INTERVAL_MS, 1)      # checkpoint per step
+    config.set(CheckpointingOptions.DIRECTORY, str(tmp_path / "chk"))
+    config.set(ExecutionOptions.BATCH_SIZE, 100)
+    config.set(RestartOptions.INITIAL_BACKOFF_MS, 1)
+    config.set(ObservabilityOptions.CHECKPOINT_HISTORY_SIZE, 6)
+
+    state = {"failed": False}
+
+    def maybe_fail(x):
+        if not state["failed"] and x[2] >= 12_000:
+            state["failed"] = True
+            raise RuntimeError("injected failure")
+        return x
+
+    def gen(idx: np.ndarray) -> Batch:
+        values = [(int(i % 7), 1.0, int(i * 10)) for i in idx]
+        return Batch(obj_array(values), (idx * 10).astype(np.int64))
+
+    env = StreamExecutionEnvironment(config)
+    stream = env.from_source(
+        DataGeneratorSource(gen, count=2000, num_splits=8),
+        watermark_strategy=WatermarkStrategy.for_monotonous_timestamps(),
+    )
+    (stream.map(maybe_fail)
+           .key_by(lambda x: x[0])
+           .window(TumblingEventTimeWindows.of(1000)).count()
+           .sink_to(CollectSink()))
+    client = env.execute_async("cp-observability")
+    cluster.jobs.setdefault(client.job_id, client)
+
+    def get(path):
+        with urllib.request.urlopen(f"{server.url}{path}", timeout=10) as r:
+            return json.loads(r.read())
+
+    try:
+        assert client.wait(60) == JobStatus.FINISHED
+        assert client.num_restarts == 1
+
+        # -- /checkpoints: >=1 COMPLETED with nonzero duration and size ----
+        cps = get(f"/jobs/{client.job_id}/checkpoints")
+        assert cps["counts"]["completed"] >= 1
+        completed = [c for c in cps["history"] if c["status"] == "COMPLETED"]
+        assert completed
+        c0 = completed[0]
+        assert c0["end_to_end_duration_ms"] > 0
+        assert c0["sync_duration_ms"] > 0 and c0["async_duration_ms"] > 0
+        assert c0["state_size_bytes"] > 0
+        assert cps["summary"]["state_size_bytes"]["max"] > 0
+        assert len(cps["history"]) <= 6            # configured ring size
+        # per-checkpoint drill-down serves the same record
+        one = get(f"/jobs/{client.job_id}/checkpoints/{c0['id']}")
+        assert one["id"] == c0["id"] and one["status"] == "COMPLETED"
+
+        # -- /exceptions: the induced failure, attributed, with the chain --
+        exc = get(f"/jobs/{client.job_id}/exceptions")
+        assert exc["root_exception"] and "injected failure" in exc["root_exception"]
+        entry = exc["entries"][0]
+        assert entry["task"]                        # operator/job attribution
+        assert any("injected failure" in c for c in entry["root_cause_chain"])
+        assert entry["restart_number"] == 0
+
+        # -- recovery timeline: rewound checkpoint + nonzero durations -----
+        rec = exc["recoveries"][0]
+        assert rec["restored_checkpoint_id"] is not None
+        assert rec["restore_duration_ms"] > 0
+        assert rec["downtime_ms"] > 0
+        assert rec["events_replayed"] is not None
+
+        # latest.restored mirrors the rewind for the checkpoints view
+        assert cps["latest"]["restored"]["checkpoint_id"] == \
+            rec["restored_checkpoint_id"]
+
+        # -- Prometheus: the standard checkpoint gauges are scrapeable -----
+        with urllib.request.urlopen(f"{server.url}/metrics", timeout=10) as r:
+            prom = r.read().decode()
+        for family in ("job_numberOfCompletedCheckpoints",
+                       "job_numberOfFailedCheckpoints",
+                       "job_lastCheckpointDuration",
+                       "job_lastCheckpointSize",
+                       "job_lastCheckpointRestoreTimestamp",
+                       "job_numRestarts",
+                       "job_lastRestartDowntimeMs"):
+            assert f"# TYPE {family} gauge" in prom, family
+        # values, not just families: at least one completed checkpoint and
+        # the restore timestamp is a real wall clock
+        line = next(l for l in prom.splitlines()
+                    if l.startswith("job_numberOfCompletedCheckpoints{"))
+        assert float(line.rsplit(" ", 1)[1]) >= 1
+    finally:
+        server.stop()
+
+
+def test_checkpoints_route_without_checkpointing_is_empty_not_404():
+    cluster = MiniCluster()
+    server = RestServer(cluster).start()
+
+    def gen(idx: np.ndarray) -> Batch:
+        return Batch(obj_array([int(i) for i in idx]),
+                     (idx * 10).astype(np.int64))
+
+    env = StreamExecutionEnvironment(Configuration())
+    env.from_source(
+        DataGeneratorSource(gen, count=64),
+        watermark_strategy=WatermarkStrategy.for_monotonous_timestamps(),
+    ).map(lambda x: x).sink_to(CollectSink())
+    client = env.execute_async("no-cp")
+    cluster.jobs.setdefault(client.job_id, client)
+    try:
+        assert client.wait(30) == JobStatus.FINISHED
+        with urllib.request.urlopen(
+                f"{server.url}/jobs/{client.job_id}/checkpoints",
+                timeout=10) as r:
+            body = json.loads(r.read())
+        assert body["counts"]["completed"] == 0 and body["history"] == []
+        with urllib.request.urlopen(
+                f"{server.url}/jobs/{client.job_id}/exceptions",
+                timeout=10) as r:
+            body = json.loads(r.read())
+        assert body["entries"] == [] and body["root_exception"] is None
+        # unknown checkpoint id: 404 with a JSON error, not a crash
+        with pytest.raises(urllib.error.HTTPError) as err:
+            urllib.request.urlopen(
+                f"{server.url}/jobs/{client.job_id}/checkpoints/7", timeout=10)
+        assert err.value.code == 404
+    finally:
+        server.stop()

@@ -801,6 +801,44 @@ def test_cluster_checkpoint_failover_exactly_once(tmp_path):
     got = _collect(client.job_result(job_id))
     assert got == _expected(_make_spec(n_steps=40, batch=30), 2)
 
+    # ---- control-plane observability of the same run (ISSUE 4) ----------
+    # checkpoint stats: >=1 COMPLETED record with per-task acks + real sizes
+    cps = client.job_checkpoints(job_id)
+    assert cps["counts"]["completed"] >= 1
+    completed = [c for c in cps["history"] if c["status"] == "COMPLETED"]
+    assert completed, cps
+    c0 = completed[0]
+    assert c0["end_to_end_duration_ms"] > 0
+    assert c0["state_size_bytes"] > 0              # persisted artifact size
+    assert len(c0["tasks"]) == 2                   # both shards acked
+    assert all(a["ack_latency_ms"] >= 0 and a["state_size_bytes"] > 0
+               for a in c0["tasks"].values())
+    assert client.job_checkpoint(job_id, c0["id"])["id"] == c0["id"]
+
+    # exception history: the TM loss, attributed to the dead TaskManager
+    exc = client.job_exceptions(job_id)
+    assert exc["entries"], exc
+    # the kill surfaces either as te2's heartbeat timeout (attributed to
+    # te2) or as a channel failure reported by the surviving shard on te1 —
+    # either way the entry must name a REAL TaskManager of the job
+    assert any(e["task_manager"] in (te1.tm_id, te2.tm_id)
+               for e in exc["entries"]), exc
+    assert "lost" in exc["root_exception"] or "shard" in exc["root_exception"]
+
+    # recovery timeline: rewound checkpoint id + nonzero restore/downtime
+    assert exc["recoveries"], exc
+    rec = exc["recoveries"][-1]                    # oldest = the TM-loss one
+    assert rec["restored_checkpoint_id"] is not None
+    assert rec["restore_duration_ms"] > 0
+    assert rec["downtime_ms"] > 0
+    assert rec["steps_replayed"] is not None and rec["steps_replayed"] >= 0
+
+    # JM-side gauges ride job_metrics for /metrics exposition
+    jm_metrics = client.job_metrics(job_id)
+    assert jm_metrics["jm"]["job.numberOfCompletedCheckpoints"] >= 1
+    assert jm_metrics["jm"]["job.numRestarts"] >= 1
+    assert jm_metrics["job"]["job.numberOfCompletedCheckpoints"] >= 1
+
     te1.stop()
     te3.stop()
     jm.heartbeats.stop()

@@ -180,8 +180,11 @@ def test_rest_traces_otlp(cluster_server, tmp_path):
     doc = json.loads(body)
     spans = doc["resourceSpans"][0]["scopeSpans"][0]["spans"]
     assert spans, "expected checkpoint spans"
-    s0 = spans[0]
-    assert s0["name"] == "checkpointing.Checkpoint"
+    names = {s["name"] for s in spans}
+    # lifecycle root + the capture/persist phase spans it brackets
+    assert {"checkpointing.Checkpoint", "checkpointing.CheckpointCapture",
+            "checkpointing.CheckpointPersist"} <= names
+    s0 = next(s for s in spans if s["name"] == "checkpointing.Checkpoint")
     assert len(s0["traceId"]) == 32
     attrs = {a["key"]: a["value"] for a in s0["attributes"]}
     assert "checkpointId" in attrs

@@ -456,6 +456,78 @@ def test_rest_bearer_token_derived_from_cluster_secret():
         server.stop()
 
 
+def test_rest_checkpoint_and_exception_routes_enforce_bearer():
+    """The checkpoint/failure observability routes added by the control-
+    plane observability PR sit behind the same bearer gate as every other
+    route: 401 without the token, 200 with it (and a well-formed payload)."""
+    import json
+    import urllib.error
+    import urllib.request
+
+    from flink_tpu.runtime.minicluster import MiniCluster
+    from flink_tpu.runtime.rest import RestServer
+
+    cfg = Configuration()
+    cfg.set(SecurityOptions.TRANSPORT_SECRET, "cp-rest-secret")
+    cfg.set(SecurityOptions.REST_AUTH_ENABLED, True)
+    cluster = MiniCluster()
+    server = RestServer(cluster, config=cfg).start()
+    token = rest_bearer_token(SecurityConfig.with_secret("cp-rest-secret"))
+
+    # a real job so the routes serve populated-or-empty payloads, not 404s
+    from flink_tpu.api.datastream import StreamExecutionEnvironment
+    from flink_tpu.connectors.sink import CollectSink
+    from flink_tpu.connectors.source import Batch, DataGeneratorSource
+    from flink_tpu.core.watermarks import WatermarkStrategy
+    from flink_tpu.utils.arrays import obj_array
+
+    def gen(idx):
+        return Batch(obj_array([int(i) for i in idx]),
+                     (idx * 10).astype("int64"))
+
+    env = StreamExecutionEnvironment(Configuration())
+    env.from_source(
+        DataGeneratorSource(gen, count=64),
+        watermark_strategy=WatermarkStrategy.for_monotonous_timestamps(),
+    ).map(lambda x: x).sink_to(CollectSink())
+    client = env.execute_async("rest-auth-cp")
+    cluster.jobs.setdefault(client.job_id, client)
+    client.wait(30)
+
+    try:
+        for route in (f"/jobs/{client.job_id}/checkpoints",
+                      f"/jobs/{client.job_id}/checkpoints/1",
+                      f"/jobs/{client.job_id}/exceptions"):
+            with pytest.raises(urllib.error.HTTPError) as exc:
+                urllib.request.urlopen(f"{server.url}{route}", timeout=10)
+            assert exc.value.code == 401, route
+
+        req = urllib.request.Request(
+            f"{server.url}/jobs/{client.job_id}/checkpoints")
+        req.add_header("Authorization", f"Bearer {token}")
+        with urllib.request.urlopen(req, timeout=10) as r:
+            body = json.loads(r.read())
+        assert set(body) >= {"counts", "summary", "latest", "history"}
+
+        req = urllib.request.Request(
+            f"{server.url}/jobs/{client.job_id}/exceptions")
+        req.add_header("Authorization", f"Bearer {token}")
+        with urllib.request.urlopen(req, timeout=10) as r:
+            body = json.loads(r.read())
+        assert set(body) >= {"root_exception", "entries", "recoveries"}
+
+        # /checkpoints/:cid with the token: 404 (no retained record — the
+        # job ran without checkpointing), NOT 401
+        req = urllib.request.Request(
+            f"{server.url}/jobs/{client.job_id}/checkpoints/1")
+        req.add_header("Authorization", f"Bearer {token}")
+        with pytest.raises(urllib.error.HTTPError) as exc:
+            urllib.request.urlopen(req, timeout=10)
+        assert exc.value.code == 404
+    finally:
+        server.stop()
+
+
 # ---------------------------------------------------------------------------
 # TLS layering (security.ssl.internal.*)
 # ---------------------------------------------------------------------------
