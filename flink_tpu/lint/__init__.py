@@ -1,0 +1,40 @@
+"""flink_tpu.lint — ArchUnit-style static analysis for the runtime.
+
+The reference project enforces its architectural invariants with
+flink-architecture-tests: ArchUnit rules over the compiled classes, plus
+frozen violation stores that let known debt live on explicitly while new
+violations fail CI. This package is the same capability for flink_tpu,
+built on the Python AST:
+
+- ``index``     — parse every module once into a shared :class:`ModuleIndex`
+- ``rule``      — :class:`Rule` base class, :class:`Violation`, the registry
+- ``locks``     — per-class lock model (lock attrs, guarded regions,
+                  nested acquisitions) consumed by the concurrency rules
+- ``rules_concurrency`` / ``rules_device`` / ``rules_wire`` /
+  ``rules_architecture`` — the three rule families (CONC/DEV/WIRE+ARCH+DOC)
+- ``baseline``  — frozen-violation store; every entry carries a written
+                  justification or the engine refuses it
+- ``engine``    — runs the registry over an index, applies the baseline
+- ``cli``       — ``python -m flink_tpu.lint`` with text/JSON/SARIF output
+
+Rules are small classes over the shared index; violations carry
+``file:line`` + rule id + fix hint, so CI output is directly actionable.
+"""
+
+from flink_tpu.lint.baseline import Baseline, BaselineEntry
+from flink_tpu.lint.engine import LintReport, run_lint
+from flink_tpu.lint.index import ModuleIndex, ModuleInfo
+from flink_tpu.lint.rule import Rule, Violation, all_rules, get_rule
+
+__all__ = [
+    "Baseline",
+    "BaselineEntry",
+    "LintReport",
+    "ModuleIndex",
+    "ModuleInfo",
+    "Rule",
+    "Violation",
+    "all_rules",
+    "get_rule",
+    "run_lint",
+]

@@ -1,0 +1,149 @@
+"""Architecture & completeness rule family (ARCH/DOC).
+
+The original tests/test_architecture.py checks, re-homed as registry
+rules (the reference keeps the same rules in flink-architecture-tests as
+ArchUnit layer definitions with frozen stores):
+
+- ARCH001 layer-dag — foundation layers must not import upward at module
+  level (lazy, function-scoped imports are the sanctioned escape hatch).
+- ARCH002 checkpoint-below-runtime — flink_tpu/checkpoint must not import
+  flink_tpu.runtime anywhere, lazy imports included.
+- DOC001 config-docs-complete — every declared ConfigOption key must
+  appear in docs/configuration.md.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterator, List, Tuple
+
+from flink_tpu.lint.index import ModuleIndex
+from flink_tpu.lint.rule import Rule, Violation, register  # noqa: F401 — Violation used in annotations
+
+#: layer dir -> package-relative module prefixes it must NOT import at
+#: module level ("{pkg}" is substituted with the indexed package name)
+LAYER_FORBIDDEN: Dict[str, List[str]] = {
+    "core": ["{pkg}.runtime", "{pkg}.api", "{pkg}.table", "{pkg}.cep",
+             "{pkg}.ops", "{pkg}.state"],
+    "utils": ["{pkg}.runtime", "{pkg}.api", "{pkg}.table", "{pkg}.cep"],
+    "ops": ["{pkg}.runtime", "{pkg}.api", "{pkg}.table", "{pkg}.cep"],
+    "state": ["{pkg}.api", "{pkg}.table", "{pkg}.cep"],
+    "graph": ["{pkg}.table", "{pkg}.cep", "{pkg}.runtime"],
+    "api": ["{pkg}.table", "{pkg}.runtime"],
+}
+
+
+@register
+class LayerDagRule(Rule):
+    id = "ARCH001"
+    name = "layer-dag"
+    family = "architecture"
+    rationale = (
+        "The layer DAG — core/utils at the bottom, ops above them, "
+        "state/graph next, api on top, runtime/table/cep reachable only "
+        "lazily — keeps `import flink_tpu.api` from dragging in the whole "
+        "runtime (and a TPU backend) at import time. Function-scoped "
+        "imports are the sanctioned escape hatch, playing the role of "
+        "ArchUnit's frozen store but enforced structurally: execution "
+        "entry points import the executor when called."
+    )
+    hint = ("import lazily inside the function that needs it, or move the "
+            "code to the layer it actually belongs to")
+
+    def check(self, index: ModuleIndex) -> Iterator[Violation]:
+        for layer, banned_tpl in LAYER_FORBIDDEN.items():
+            banned = [b.format(pkg=index.package) for b in banned_tpl]
+            for mod in index.in_subtree(layer):
+                for imp, line in index.module_level_imports(mod):
+                    for b in banned:
+                        if imp == b or imp.startswith(b + "."):
+                            yield self.violation(
+                                mod, line,
+                                (f"layer {layer!r} imports {imp} at module "
+                                 f"level (must not depend on {b})"),
+                                symbol=f"{layer}->{imp}")
+
+
+@register
+class CheckpointBelowRuntimeRule(Rule):
+    id = "ARCH002"
+    name = "checkpoint-below-runtime"
+    family = "architecture"
+    rationale = (
+        "flink_tpu/checkpoint must not import flink_tpu.runtime — "
+        "anywhere, lazy imports included. Checkpoint/failure/recovery "
+        "statistics flow OUTWARD: the coordinator reports into trackers "
+        "the runtime hands it (metrics/checkpoint_stats.py stats + "
+        "state_bytes_fn callbacks); it never reaches into the scheduler "
+        "or executor. A runtime import here inverts the dependency and "
+        "lets coordinator changes drag in the whole cluster stack (and, "
+        "on TPU hosts, risk backend init from a checkpoint utility)."
+    )
+    hint = "pass data outward via callbacks/trackers instead"
+
+    def check(self, index: ModuleIndex) -> Iterator[Violation]:
+        banned = f"{index.package}.runtime"
+        for mod in index.in_subtree("checkpoint"):
+            seen: Dict[str, int] = {}
+            for imp, line in index.all_imports(mod):
+                if imp == banned or imp.startswith(banned + "."):
+                    base = f"import:{imp}"
+                    n = seen[base] = seen.get(base, 0) + 1
+                    yield self.violation(
+                        mod, line,
+                        (f"checkpoint layer imports {imp} (must stay below "
+                         f"the runtime, lazy imports included)"),
+                        symbol=base if n == 1 else f"{base}#{n}")
+
+
+def _declared_config_keys(index: ModuleIndex) -> List[Tuple[str, int, str]]:
+    """(key, line, holder_scope) for every ConfigOptions.key("...") call in
+    the package's config.py — the AST-level equivalent of
+    docs.generate.collect_options, so the rule also runs on fixture
+    packages that are never importable."""
+    mod = index.get("config.py")
+    if mod is None:
+        return []
+    out: List[Tuple[str, int, str]] = []
+    for node in ast.walk(mod.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        fn = node.func
+        if isinstance(fn, ast.Attribute) and fn.attr == "key" and \
+                isinstance(fn.value, ast.Name) and \
+                fn.value.id == "ConfigOptions" and node.args and \
+                isinstance(node.args[0], ast.Constant) and \
+                isinstance(node.args[0].value, str):
+            out.append((node.args[0].value, node.lineno, "config.py"))
+    return out
+
+
+@register
+class ConfigDocsCompleteRule(Rule):
+    id = "DOC001"
+    name = "config-docs-complete"
+    family = "architecture"
+    rationale = (
+        "Every ConfigOption declared in config.py must appear in "
+        "docs/configuration.md (regenerate with `python -m "
+        "flink_tpu.docs.generate`). The reference gates its docs the same "
+        "way (ConfigOptionsDocsCompletenessITCase): an undocumented "
+        "option fails CI before it ships, so the generated reference can "
+        "be trusted to be the full surface."
+    )
+    hint = "run `python -m flink_tpu.docs.generate` and commit the result"
+
+    def check(self, index: ModuleIndex) -> Iterator[Violation]:
+        keys = _declared_config_keys(index)
+        if not keys:
+            return
+        doc_path = index.project_root / "docs" / "configuration.md"
+        doc = doc_path.read_text() if doc_path.exists() else ""
+        mod = index.get("config.py")
+        for key, line, _holder in keys:
+            if f"`{key}`" not in doc:
+                yield self.violation(
+                    mod, line,
+                    f"config option `{key}` missing from "
+                    f"docs/configuration.md",
+                    symbol=f"option:{key}")

@@ -759,7 +759,8 @@ class JobManagerEndpoint(RpcEndpoint):
             time.sleep(self.restart_delay)
             self.run_in_main_thread(self._try_schedule, job)
 
-        threading.Thread(target=delayed, daemon=True).start()
+        threading.Thread(target=delayed, daemon=True,
+                         name=f"restart-delay-{job.job_id[:6]}").start()
 
     # ---- task callbacks ---------------------------------------------------
     def _release_job_local_state(self, job: _JobState) -> None:
@@ -775,7 +776,8 @@ class JobManagerEndpoint(RpcEndpoint):
 
         # off the JM main thread: the TM handler is one-directional, but a
         # dead TM's connect timeout must not stall scheduling
-        threading.Thread(target=_release, daemon=True).start()
+        threading.Thread(target=_release, daemon=True,
+                         name=f"release-state-{job.job_id[:6]}").start()
 
     def task_finished(self, job_id: str, attempt: int, shard: int, results: list) -> None:
         job = self._jobs.get(job_id)
@@ -1124,7 +1126,8 @@ class _ShardTask:
             except Exception:
                 pass
 
-        threading.Thread(target=_decline, daemon=True).start()
+        threading.Thread(target=_decline, daemon=True,
+                         name=f"cp-decline-{self.job_id[:6]}-s{self.shard}").start()
 
     def _resolve_local_restore(self) -> None:
         """Local recovery (S11): restore from the TM-local copy of the

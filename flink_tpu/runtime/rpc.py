@@ -325,5 +325,6 @@ class RpcGateway:
             except BaseException as e:  # noqa: BLE001
                 f.set_exception(e)
 
-        threading.Thread(target=run, daemon=True).start()
+        threading.Thread(target=run, daemon=True,
+                         name=f"rpc-async-{self._endpoint}.{method}").start()
         return f

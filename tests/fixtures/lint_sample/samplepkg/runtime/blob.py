@@ -1,0 +1,7 @@
+"""Deliberately-violating fixture: bare pickle on a network plane (WIRE001)."""
+
+import pickle
+
+
+def decode(payload: bytes):
+    return pickle.loads(payload)

@@ -1,0 +1,7 @@
+"""Deliberately-violating fixture: unnamed thread (CONC004)."""
+
+import threading
+
+
+def spawn(fn):
+    threading.Thread(target=fn).start()

@@ -1,234 +1,64 @@
-"""Architecture tests: layering rules over module imports.
+"""Architecture tests — thin wrapper over the flink_tpu.lint registry.
 
-Reference capability: flink-architecture-tests (ArchUnit rules freezing
-layering and API discipline, e.g. ApiAnnotationRules.java / ConnectorRules
-with checked-in violation stores). The analogue here parses each module's
-AST and asserts the layer DAG:
+The rules that used to live here as ad-hoc AST functions (layering DAG,
+jax-free control plane, checkpoint layering, pickle bans, dataplane
+serialization freedom, config-docs completeness) are now registry rules
+in ``flink_tpu/lint/`` — the ArchUnit-style analyzer framework with a
+frozen-violation baseline (ISSUE-5). This module generates **one test per
+registered rule**, so the rules live in exactly one place and a new rule
+is gated here automatically; `tests/test_lint.py` covers the engine
+itself (CLI, formats, baseline lifecycle).
 
-    core, utils          — foundation: import nothing above themselves
-    ops                  — device kernels: no runtime/api/table/cep deps
-    state, graph         — no api/table/cep deps
-    api                  — builds plans; may reach runtime only lazily
-                           (inside functions), never at module import time
-
-Lazy (function-scoped) imports are the sanctioned escape hatch — the same
-role ArchUnit's violation store plays, but enforced structurally: execution
-entry points import the executor when called, so importing the API layer
-can never drag in the whole runtime.
+A failure here prints the same actionable ``file:line [RULE] message``
+output as ``python -m flink_tpu.lint`` (or ``bin/lint``); fix the
+violation or baseline it WITH a written justification in
+``lint_baseline.json``.
 """
 
-import ast
 import pathlib
 
+import pytest
+
 import flink_tpu
+from flink_tpu.lint import Baseline, ModuleIndex, all_rules
 
 PKG = pathlib.Path(flink_tpu.__file__).parent
+BASELINE_PATH = PKG.parent / "lint_baseline.json"
 
-# layer -> module prefixes it must NOT import at module level
-FORBIDDEN = {
-    "core": ["flink_tpu.runtime", "flink_tpu.api", "flink_tpu.table",
-             "flink_tpu.cep", "flink_tpu.ops", "flink_tpu.state"],
-    "utils": ["flink_tpu.runtime", "flink_tpu.api", "flink_tpu.table",
-              "flink_tpu.cep"],
-    "ops": ["flink_tpu.runtime", "flink_tpu.api", "flink_tpu.table",
-            "flink_tpu.cep"],
-    "state": ["flink_tpu.api", "flink_tpu.table", "flink_tpu.cep"],
-    "graph": ["flink_tpu.table", "flink_tpu.cep", "flink_tpu.runtime"],
-    "api": ["flink_tpu.table", "flink_tpu.runtime"],
-}
+_cache = {}
 
 
-def _module_level_imports(path: pathlib.Path):
-    """Imports executed at import time: module body + class bodies, but NOT
-    function bodies (lazy imports are the sanctioned layering escape)."""
-    tree = ast.parse(path.read_text())
-    found = []
-
-    def walk(node):
-        for child in ast.iter_child_nodes(node):
-            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
-                continue
-            if isinstance(child, ast.Import):
-                found.extend(a.name for a in child.names)
-            elif isinstance(child, ast.ImportFrom) and child.module:
-                found.append(child.module)
-            else:
-                walk(child)
-
-    walk(tree)
-    return found
+def _shared_index() -> ModuleIndex:
+    """One parse of the package for all per-rule tests."""
+    if "index" not in _cache:
+        _cache["index"] = ModuleIndex(PKG)
+    return _cache["index"]
 
 
-def test_layering_rules():
-    violations = []
-    for layer, banned in FORBIDDEN.items():
-        layer_dir = PKG / layer
-        files = list(layer_dir.rglob("*.py")) if layer_dir.is_dir() else []
-        assert files, f"layer {layer!r} has no modules?"
-        for f in files:
-            for imp in _module_level_imports(f):
-                for b in banned:
-                    if imp == b or imp.startswith(b + "."):
-                        violations.append(
-                            f"{f.relative_to(PKG.parent)} imports {imp} "
-                            f"(layer {layer!r} must not depend on {b})"
-                        )
-    assert not violations, "\n".join(violations)
+def _baseline() -> Baseline:
+    # a fresh Baseline per rule-test: `match` marks entries live, and tests
+    # must not share that state across rules
+    return Baseline.load(BASELINE_PATH) if BASELINE_PATH.exists() else \
+        Baseline()
 
 
-def test_jax_stays_out_of_the_control_plane():
-    """The cluster control plane (JM/TM endpoints, RPC, blob, heartbeats,
-    HA) must not import jax at module level: an oracle-path worker process
-    must never initialize a TPU backend just by starting up (backend init
-    claims the chip; see _make_operator's device-path-only import)."""
-    control = ["runtime/cluster.py", "runtime/rpc.py", "runtime/blob.py",
-               "runtime/heartbeat.py", "runtime/ha.py",
-               "runtime/ha_kubernetes.py", "runtime/rest.py",
-               "runtime/dataplane.py",
-               "security/framing.py", "security/transport.py"]
-    bad = []
-    for rel in control:
-        for imp in _module_level_imports(PKG / rel):
-            if imp == "jax" or imp.startswith("jax."):
-                bad.append(f"{rel} imports {imp} at module level")
-    assert not bad, "\n".join(bad)
+@pytest.mark.parametrize("rule", all_rules(), ids=lambda r: r.id)
+def test_rule(rule):
+    index = _shared_index()
+    baseline = _baseline()
+    active = []
+    for violation in rule.check(index):
+        entry = baseline.match(violation)
+        if entry is None or not entry.justified:
+            active.append(violation)
+    assert not active, (
+        f"[{rule.id} {rule.name}] {rule.rationale}\n\n"
+        + "\n".join(v.render() for v in active)
+    )
 
 
-def _all_imports(path: pathlib.Path):
-    """EVERY import in the file, function bodies included — for rules where
-    even a lazy import is a layering violation."""
-    found = []
-    for node in ast.walk(ast.parse(path.read_text())):
-        if isinstance(node, ast.Import):
-            found.extend((a.name, node.lineno) for a in node.names)
-        elif isinstance(node, ast.ImportFrom) and node.module:
-            found.append((node.module, node.lineno))
-    return found
-
-
-def test_checkpoint_layer_never_imports_the_runtime():
-    """flink_tpu/checkpoint/ must not import flink_tpu.runtime — anywhere,
-    lazy imports included. Checkpoint/failure/recovery statistics flow
-    OUTWARD: the coordinator reports into trackers the runtime hands it
-    (metrics/checkpoint_stats.py stats + state_bytes_fn callbacks), it
-    never reaches into the scheduler or executor. A runtime import here
-    would invert the dependency and let coordinator changes drag in the
-    whole cluster stack (and, on TPU hosts, risk backend init from a
-    checkpoint utility)."""
-    bad = []
-    for f in sorted((PKG / "checkpoint").rglob("*.py")):
-        for imp, line in _all_imports(f):
-            if imp == "flink_tpu.runtime" or imp.startswith("flink_tpu.runtime."):
-                bad.append(
-                    f"{f.relative_to(PKG.parent)}:{line} imports {imp} "
-                    "(checkpoint layer must stay below the runtime; pass "
-                    "data outward via callbacks/trackers instead)"
-                )
-    assert not bad, "\n".join(bad)
-
-
-def _pickle_load_sites(path: pathlib.Path):
-    """Every way raw deserialization can be spelled, anywhere in the file
-    (function bodies included — unlike _module_level_imports this must see
-    lazy code paths too): `pickle.loads/load(...)`, `pickle.Unpickler`
-    references, and `from pickle import loads/load/Unpickler` (which would
-    make later bare-name calls invisible to attribute matching — the
-    import itself is the violation)."""
-    tree = ast.parse(path.read_text())
-    found = []
-    for node in ast.walk(tree):
-        if isinstance(node, ast.ImportFrom) and node.module in (
-                "pickle", "cloudpickle"):
-            for a in node.names:
-                if a.name in ("loads", "load", "Unpickler", "*"):
-                    found.append(
-                        (node.module, f"import {a.name}", node.lineno))
-        elif isinstance(node, ast.Attribute) and isinstance(node.value, ast.Name) \
-                and node.value.id in ("pickle", "cloudpickle"):
-            if node.attr in ("loads", "load", "Unpickler"):
-                found.append((node.value.id, node.attr, node.lineno))
-    return found
-
-
-def test_every_config_option_is_documented():
-    """Every ConfigOption declared in flink_tpu/config.py must appear in
-    docs/configuration.md (regenerate with `python -m
-    flink_tpu.docs.generate`). The reference gates its docs the same way
-    (ConfigOptionsDocsCompletenessITCase): an undocumented option fails CI
-    before it ships, so the generated reference can be trusted to be the
-    full surface."""
-    from flink_tpu.docs.generate import collect_options
-
-    doc = (PKG.parent / "docs" / "configuration.md").read_text()
-    missing = [
-        opt.key
-        for _cls, _attr, opt in collect_options()
-        if f"`{opt.key}`" not in doc
+def test_no_parse_failures():
+    assert not _shared_index().parse_failures, [
+        f"{f.rel}:{f.line}: {f.error}"
+        for f in _shared_index().parse_failures
     ]
-    assert not missing, (
-        "config options missing from docs/configuration.md (run `python -m "
-        f"flink_tpu.docs.generate`): {missing}"
-    )
-
-
-def test_dataplane_data_path_is_serialization_free():
-    """runtime/dataplane.py may not serialize batch payloads itself — no
-    pickle/cloudpickle import, no `dumps(`/`loads(` call anywhere in the
-    module. Batch bytes cross the process boundary only through
-    flink_tpu.security: the zero-copy binary columnar wire
-    (security/wire.py via transport.send_data_frame/recv_msg) or the
-    legacy restricted-pickle codec (transport.send_obj/recv_obj). This
-    pins the ISSUE-3 zero-copy property: a convenience `dumps(batch)`
-    creeping back into the data path reintroduces the full-copy
-    serialization tax (and, on the receive side, a deserialize-before-MAC
-    hazard) that the binary wire exists to remove."""
-    path = PKG / "runtime" / "dataplane.py"
-    tree = ast.parse(path.read_text())
-    bad = []
-    for node in ast.walk(tree):
-        if isinstance(node, ast.Import):
-            for a in node.names:
-                if a.name in ("pickle", "cloudpickle"):
-                    bad.append(f"line {node.lineno}: import {a.name}")
-        elif isinstance(node, ast.ImportFrom):
-            if node.module in ("pickle", "cloudpickle"):
-                bad.append(f"line {node.lineno}: from {node.module} import ...")
-            elif node.module and any(
-                    a.name in ("dumps", "loads", "dump", "load")
-                    for a in node.names):
-                bad.append(
-                    f"line {node.lineno}: from {node.module} imports a "
-                    "serializer name"
-                )
-        elif isinstance(node, ast.Call):
-            fn = node.func
-            name = fn.id if isinstance(fn, ast.Name) else (
-                fn.attr if isinstance(fn, ast.Attribute) else None)
-            if name in ("dumps", "loads", "dump", "load"):
-                bad.append(f"line {node.lineno}: call to {name}(...)")
-    assert not bad, (
-        "runtime/dataplane.py serializes on the data path — route batches "
-        "through security.transport/security.wire instead:\n" + "\n".join(bad)
-    )
-
-
-def test_no_bare_pickle_loads_on_network_planes():
-    """Everything under flink_tpu/runtime/ and flink_tpu/fs/ handles bytes
-    that can originate from a socket (RPC frames, exchange batches, blob
-    payloads, object-store reads), so NO module there may deserialize with
-    pickle directly — loads/load calls, Unpickler subclassing, and
-    `from pickle import loads` are all banned; deserialization goes through
-    flink_tpu/security (restricted_loads after MAC verification;
-    trusted_loads for post-auth job specs). This lint keeps the ISSUE-1
-    fix from regressing: a new raw-pickle path on a network plane fails CI
-    before it fails an incident review."""
-    bad = []
-    for layer in ("runtime", "fs"):
-        for f in sorted((PKG / layer).rglob("*.py")):
-            for mod, what, line in _pickle_load_sites(f):
-                bad.append(
-                    f"{f.relative_to(PKG.parent)}:{line} uses {mod}.{what} "
-                    "— route it through flink_tpu.security.framing "
-                    "(restricted_loads/trusted_loads)"
-                )
-    assert not bad, "\n".join(bad)

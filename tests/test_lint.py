@@ -1,0 +1,301 @@
+"""Tier-1 gate for the flink_tpu.lint analyzer (ISSUE-5).
+
+Three layers:
+
+1. **The gate** — the full engine over the real ``flink_tpu`` package
+   against the checked-in ``lint_baseline.json`` must be clean (exit 0),
+   every baseline entry justified and live. This is what keeps future
+   PRs' invariants enforced by CI rather than reviewer memory.
+2. **Engine mechanics** — CLI exit codes (0/1/2), output formats (text /
+   JSON / SARIF-against-golden), ``--write-baseline`` seeding.
+3. **Baseline lifecycle round-trip** — add → suppress → remove → fail
+   (a stale entry is an error, so fixed debt must leave the ledger).
+"""
+
+import json
+import pathlib
+import shutil
+import textwrap
+
+import flink_tpu
+from flink_tpu.lint import Baseline, all_rules, run_lint
+from flink_tpu.lint.cli import main as lint_main
+from flink_tpu.lint.engine import (
+    EXIT_BASELINE_ERROR,
+    EXIT_CLEAN,
+    EXIT_VIOLATIONS,
+)
+
+PKG = pathlib.Path(flink_tpu.__file__).parent
+REPO = PKG.parent
+BASELINE = REPO / "lint_baseline.json"
+FIXTURES = pathlib.Path(__file__).parent / "fixtures"
+SAMPLE_PKG = FIXTURES / "lint_sample" / "samplepkg"
+
+
+# ---------------------------------------------------------------------------
+# 1. the gate
+# ---------------------------------------------------------------------------
+
+def test_flink_tpu_is_lint_clean_against_the_checked_in_baseline():
+    baseline = Baseline.load(BASELINE)
+    report = run_lint(PKG, baseline=baseline)
+    rendered = "\n".join(v.render() for v in report.violations)
+    assert not report.violations, (
+        "new lint violations (fix them, or baseline WITH a written "
+        f"justification in {BASELINE.name}):\n{rendered}"
+    )
+    assert not report.baseline_errors, "\n".join(report.baseline_errors)
+    assert report.exit_code == EXIT_CLEAN
+    assert report.modules_scanned > 100     # really scanned the package
+
+
+def test_every_baseline_entry_is_justified():
+    baseline = Baseline.load(BASELINE)
+    unjustified = [e.fingerprint for e in baseline.entries if not e.justified]
+    assert not unjustified, (
+        f"baseline entries without a written justification: {unjustified}"
+    )
+
+
+def test_cli_gate_matches_engine():
+    assert lint_main([str(PKG), "--baseline", str(BASELINE)]) == EXIT_CLEAN
+
+
+def test_path_scoped_rules_are_not_vacuous():
+    """Every path the rules are configured against must exist in the real
+    package — otherwise a rename silently disables the rule and it passes
+    forever without checking anything (the old test_layering_rules had
+    this guard; the registry migration must not lose it)."""
+    from flink_tpu.lint import ModuleIndex
+    from flink_tpu.lint.rules_architecture import LAYER_FORBIDDEN
+    from flink_tpu.lint.rules_device import CONTROL_PLANE
+    from flink_tpu.lint.rules_wire import SerializationFreeDataplaneRule
+
+    index = ModuleIndex(PKG)
+    for layer in LAYER_FORBIDDEN:
+        assert any(index.in_subtree(layer)), (
+            f"layer {layer!r} has no modules — LAYER_FORBIDDEN is stale "
+            f"and ARCH001 is vacuous for it")
+    for rel in CONTROL_PLANE:
+        assert index.get(rel) is not None, (
+            f"control-plane module {rel} missing — CONTROL_PLANE is stale "
+            f"and DEV003 is vacuous for it")
+    assert index.get(SerializationFreeDataplaneRule.DATAPLANE) is not None
+    assert any(index.in_subtree("checkpoint")), (
+        "checkpoint/ has no modules — ARCH002 is vacuous")
+    assert index.get("config.py") is not None, "DOC001 is vacuous"
+
+
+# ---------------------------------------------------------------------------
+# 2. engine mechanics
+# ---------------------------------------------------------------------------
+
+def _write_violating_pkg(tmp_path) -> pathlib.Path:
+    root = tmp_path / "vpkg"
+    root.mkdir()
+    (root / "__init__.py").touch()
+    (root / "w.py").write_text(textwrap.dedent("""
+        import threading
+
+        def spawn(fn):
+            threading.Thread(target=fn).start()
+    """))
+    return root
+
+
+def test_cli_exit_1_on_violations(tmp_path, capsys):
+    root = _write_violating_pkg(tmp_path)
+    rc = lint_main([str(root), "--no-baseline"])
+    out = capsys.readouterr().out
+    assert rc == EXIT_VIOLATIONS
+    assert "CONC004" in out and "vpkg/w.py:5" in out
+
+
+def test_cli_exit_0_on_clean_package(tmp_path):
+    root = tmp_path / "cleanpkg"
+    root.mkdir()
+    (root / "__init__.py").touch()
+    (root / "ok.py").write_text("X = 1\n")
+    assert lint_main([str(root), "--no-baseline"]) == EXIT_CLEAN
+
+
+def test_cli_json_format(tmp_path, capsys):
+    root = _write_violating_pkg(tmp_path)
+    rc = lint_main([str(root), "--no-baseline", "--format", "json"])
+    doc = json.loads(capsys.readouterr().out)
+    assert rc == EXIT_VIOLATIONS
+    assert doc["exit_code"] == EXIT_VIOLATIONS
+    assert [v["rule"] for v in doc["violations"]] == ["CONC004"]
+    assert doc["violations"][0]["fingerprint"].startswith("CONC004::")
+
+
+def test_cli_rule_filter_and_list(tmp_path, capsys):
+    root = _write_violating_pkg(tmp_path)
+    # a filter that excludes the only violation reports clean
+    assert lint_main([str(root), "--no-baseline",
+                      "--rule", "WIRE001"]) == EXIT_CLEAN
+    capsys.readouterr()
+    assert lint_main(["--list-rules"]) == EXIT_CLEAN
+    out = capsys.readouterr().out
+    for rule in all_rules():
+        assert rule.id in out
+
+
+def test_sarif_output_matches_golden(capsys):
+    rc = lint_main([str(SAMPLE_PKG), "--no-baseline",
+                    "--rule", "CONC004", "--rule", "WIRE001",
+                    "--format", "sarif"])
+    out = capsys.readouterr().out
+    assert rc == EXIT_VIOLATIONS
+    golden = (FIXTURES / "lint_expected.sarif").read_text()
+    assert json.loads(out) == json.loads(golden), (
+        "SARIF output drifted from tests/fixtures/lint_expected.sarif — "
+        "if the change is intentional, regenerate the golden with:\n"
+        "  python -m flink_tpu.lint tests/fixtures/lint_sample/samplepkg "
+        "--no-baseline --rule CONC004 --rule WIRE001 --format sarif "
+        "> tests/fixtures/lint_expected.sarif"
+    )
+    doc = json.loads(out)
+    results = doc["runs"][0]["results"]
+    assert {r["ruleId"] for r in results} == {"CONC004", "WIRE001"}
+    uris = {r["locations"][0]["physicalLocation"]["artifactLocation"]["uri"]
+            for r in results}
+    assert uris == {"samplepkg/worker.py", "samplepkg/runtime/blob.py"}
+
+
+# ---------------------------------------------------------------------------
+# 3. baseline lifecycle: add -> suppress -> remove -> fail
+# ---------------------------------------------------------------------------
+
+def test_baseline_round_trip(tmp_path):
+    root = _write_violating_pkg(tmp_path)
+    bl_path = tmp_path / "lint_baseline.json"
+
+    # (0) violation fails the run
+    report = run_lint(root)
+    assert report.exit_code == EXIT_VIOLATIONS
+    violation = report.violations[0]
+
+    # (1) add WITHOUT justification: suppressed but the run errors (exit 2)
+    baseline = Baseline(path=bl_path)
+    baseline.add(violation)                      # seeds a TODO justification
+    baseline.save()
+    report = run_lint(root, baseline=Baseline.load(bl_path))
+    assert report.exit_code == EXIT_BASELINE_ERROR
+    assert any("justification" in e for e in report.baseline_errors)
+
+    # (2) write the justification: suppressed cleanly (exit 0)
+    baseline = Baseline.load(bl_path)
+    baseline.entries[0].justification = (
+        "fixture thread is short-lived and joined by the test harness")
+    baseline.save()
+    report = run_lint(root, baseline=Baseline.load(bl_path))
+    assert report.exit_code == EXIT_CLEAN
+    assert len(report.suppressed) == 1
+
+    # (3) fix the code: the entry goes stale and the run fails again
+    (root / "w.py").write_text(textwrap.dedent("""
+        import threading
+
+        def spawn(fn):
+            threading.Thread(target=fn, daemon=True, name="fix-w").start()
+    """))
+    report = run_lint(root, baseline=Baseline.load(bl_path))
+    assert report.exit_code == EXIT_BASELINE_ERROR
+    assert any("stale" in e for e in report.baseline_errors)
+
+    # (4) remove the stale entry: clean again
+    baseline = Baseline.load(bl_path)
+    baseline.entries = []
+    baseline.save()
+    report = run_lint(root, baseline=Baseline.load(bl_path))
+    assert report.exit_code == EXIT_CLEAN
+
+
+def test_write_baseline_rejects_no_baseline_combo(tmp_path, capsys):
+    """--no-baseline --write-baseline would rebuild the file from empty
+    and destroy every human-written justification — refused outright."""
+    root = _write_violating_pkg(tmp_path)
+    assert lint_main([str(root), "--no-baseline",
+                      "--write-baseline"]) == EXIT_BASELINE_ERROR
+    assert "mutually exclusive" in capsys.readouterr().err
+
+
+def test_write_baseline_merges_into_existing(tmp_path, capsys):
+    """--write-baseline must preserve already-justified entries."""
+    root = _write_violating_pkg(tmp_path)
+    (root / "w2.py").write_text(textwrap.dedent("""
+        import threading
+
+        def spawn2(fn):
+            threading.Thread(target=fn).start()
+    """))
+    bl_path = tmp_path / "lint_baseline.json"
+    report = run_lint(root)
+    assert len(report.violations) == 2
+    baseline = Baseline(path=bl_path)
+    first = next(v for v in report.violations if v.path.endswith("w.py"))
+    baseline.add(first, justification="human-written reason")
+    baseline.save()
+
+    assert lint_main([str(root), "--baseline", str(bl_path),
+                      "--write-baseline"]) == EXIT_CLEAN
+    capsys.readouterr()
+    doc = json.loads(bl_path.read_text())
+    justs = sorted(e["justification"] for e in doc["entries"])
+    assert len(doc["entries"]) == 2
+    assert justs[0].startswith("TODO")           # the newly-frozen one
+    assert justs[1] == "human-written reason"    # preserved, not clobbered
+
+
+def test_write_baseline_cli_flow(tmp_path, capsys):
+    root = _write_violating_pkg(tmp_path)
+    bl_path = tmp_path / "lint_baseline.json"
+
+    # seeding writes TODO entries and exits 0 (the freeze itself succeeds)
+    assert lint_main([str(root), "--baseline", str(bl_path),
+                      "--write-baseline"]) == EXIT_CLEAN
+    capsys.readouterr()
+    doc = json.loads(bl_path.read_text())
+    assert len(doc["entries"]) == 1
+    assert doc["entries"][0]["justification"].startswith("TODO")
+
+    # ...but the engine refuses the TODO until a human justifies it
+    assert lint_main([str(root), "--baseline",
+                      str(bl_path)]) == EXIT_BASELINE_ERROR
+
+    baseline = Baseline.load(bl_path)
+    baseline.entries[0].justification = "documented fixture debt"
+    baseline.save()
+    assert lint_main([str(root), "--baseline", str(bl_path)]) == EXIT_CLEAN
+
+
+def test_fingerprints_survive_line_churn(tmp_path):
+    """Baseline matching is line-independent: prepending code must not
+    orphan the entry."""
+    root = _write_violating_pkg(tmp_path)
+    report = run_lint(root)
+    fp_before = report.violations[0].fingerprint
+    line_before = report.violations[0].line
+    src = (root / "w.py").read_text()
+    (root / "w.py").write_text("# a comment\nY = 2\n" + src)
+    report = run_lint(root)
+    assert report.violations[0].fingerprint == fp_before
+    assert report.violations[0].line == line_before + 2  # line moved; fp did not
+
+
+def test_rule_filter_skips_stale_check_for_other_rules(tmp_path):
+    """A --rule filtered run must not call every other rule's baseline
+    entries stale."""
+    root = _write_violating_pkg(tmp_path)
+    bl_path = tmp_path / "lint_baseline.json"
+    baseline = Baseline(path=bl_path)
+    report = run_lint(root)
+    baseline.add(report.violations[0], justification="documented debt")
+    baseline.save()
+    from flink_tpu.lint import get_rule
+
+    report = run_lint(root, rules=[get_rule("WIRE001")],
+                      baseline=Baseline.load(bl_path))
+    assert report.exit_code == EXIT_CLEAN
