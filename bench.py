@@ -1098,6 +1098,198 @@ def checkpoint_microbench(events: int = 100_000, reps: int = 2) -> dict:
     }
 
 
+class _ScenarioWindows:
+    """Tumbling assigner with an amortized per-record service cost that
+    releases the GIL (bulk sleeps), so extra shard threads genuinely add
+    capacity: the saturation the autoscaler must detect is real, and the
+    recovery it buys is measurable, even inside one bench process."""
+
+    def __init__(self, size_ms, cost_s, bulk=150):
+        from flink_tpu.api.windowing.assigners import TumblingEventTimeWindows
+
+        self._inner = TumblingEventTimeWindows.of(size_ms)
+        self.cost_s = cost_s
+        self.bulk = bulk
+        self._n = 0
+
+    def __getattr__(self, name):
+        if name.startswith("_"):      # never proxy dunders/privates: the
+            raise AttributeError(name)  # unpickle path probes them before
+        return getattr(self._inner, name)  # _inner exists
+
+    def assign_windows(self, element, timestamp):
+        self._n += 1
+        if self._n % self.bulk == 0:
+            time.sleep(self.cost_s * self.bulk)
+        return self._inner.assign_windows(element, timestamp)
+
+
+class _ScenarioSource:
+    """Arrival-paced 2x load-step source (picklable): profile[s] records in
+    step s across shards, sliced per shard; step s blocks until its
+    scheduled arrival (re-anchored per attempt, so replay stays paced)."""
+
+    def __init__(self, profile, interval_s):
+        self.profile = list(profile)
+        self.interval_s = interval_s
+
+    def __call__(self, shard, num_shards):
+        outer = self
+
+        class _Paced(list):
+            def __init__(self):
+                super().__init__(range(len(outer.profile)))
+                self._anchor = None
+
+            def __getitem__(self, s):
+                now = time.monotonic()
+                if self._anchor is None:
+                    self._anchor = (now, s)
+                due = self._anchor[0] + (s - self._anchor[1]) * outer.interval_s
+                if due > now:
+                    time.sleep(due - now)
+                rng = np.random.default_rng(4000 + s)
+                n = outer.profile[s]
+                keys = rng.integers(0, 64, n).astype(np.int64)
+                vals = np.ones(n, dtype=np.float64)
+                ts = (s * 1000 + rng.integers(0, 1000, n)).astype(np.int64)
+                sl = slice(shard, None, num_shards)
+                return keys[sl], vals[sl], ts[sl], s * 1000 + 500
+
+        return _Paced()
+
+
+def autoscaler_scenario(pre_steps: int = 30, high_steps: int = 100,
+                        interval_s: float = 0.062,
+                        cost_s: float = 0.0002) -> dict:
+    """Adaptation-speed microbench (ROADMAP item 2 gate): an arrival-paced
+    keyed job at ~0.65 utilization takes a 2x load step that saturates
+    parallelism 1; the autoscaler must scale up by checkpoint rewind +
+    key-group remap. Emits autoscaler.{rescales, time_to_adapt_s,
+    throughput_ratio_post_step} so adaptation speed is tracked per PR
+    (time_to_adapt = load step crossing the wire -> rescaled attempt
+    RUNNING; throughput ratio = the coordinator's settled post-rescale
+    rate over the pre-step offered rate)."""
+    from flink_tpu.config import AutoscalerOptions, Configuration
+    from flink_tpu.runtime.cluster import (
+        DistributedJobSpec,
+        JobManagerEndpoint,
+        TaskExecutorEndpoint,
+    )
+    from flink_tpu.runtime.rpc import RpcService
+
+    import tempfile
+
+    pre, high = 162, 324
+    profile = [pre] * pre_steps + [high] * high_steps
+    pre_rate = pre / interval_s
+    cfg = (Configuration()
+           .set(AutoscalerOptions.ENABLED, True)
+           .set(AutoscalerOptions.POLICY, "threshold")
+           .set(AutoscalerOptions.MAX_PARALLELISM, 2)
+           .set(AutoscalerOptions.INTERVAL_MS, 200)
+           .set(AutoscalerOptions.SIGNAL_WINDOW, 6)
+           .set(AutoscalerOptions.STABILIZATION_INTERVAL_MS, 1500)
+           .set(AutoscalerOptions.SCALE_UP_THRESHOLD, 0.9)
+           # up-adaptation only: the e2e suite covers scale-down, and a
+           # noisy low reading mid-scenario would pollute the timing
+           .set(AutoscalerOptions.SCALE_DOWN_THRESHOLD, 0.05))
+    spec = DistributedJobSpec(
+        name="autoscaler-scenario",
+        source_factory=_ScenarioSource(profile, interval_s),
+        assigner=_ScenarioWindows(2000, cost_s),
+        aggregate="sum",
+        max_parallelism=16,
+    )
+    chk = tempfile.mkdtemp(prefix="flink-tpu-asbench-")
+    svc_jm, svc_tm = RpcService(), RpcService()
+    jm = JobManagerEndpoint(
+        svc_jm, checkpoint_dir=chk, checkpoint_interval=0.3,
+        heartbeat_interval=0.2, heartbeat_timeout=15.0,
+        autoscaler_config=cfg,
+    )
+    te = TaskExecutorEndpoint(svc_tm, slots=2, shipping_interval_ms=200)
+    te.connect(svc_jm.address)
+    client = svc_jm.gateway(svc_jm.address, "jobmanager")
+    try:
+        job_id = client.submit_job(spec.to_bytes(), 1)
+        # nominal arrival time of the 2x step (the source's pacing anchor
+        # is its first batch, within startup jitter of submit)
+        t_step = time.monotonic() + pre_steps * interval_s
+        t_adapted = None
+        deadline = time.monotonic() + 180
+        status = {}
+        while time.monotonic() < deadline:
+            status = client.job_status(job_id)
+            if (t_adapted is None and status["rescales"] >= 1
+                    and status["status"] == "RUNNING"):
+                t_adapted = time.monotonic()
+            if status["status"] in ("FINISHED", "FAILED"):
+                break
+            time.sleep(0.1)
+        auto = client.job_autoscaler(job_id)
+        settled = [d for d in auto["decisions"]
+                   if d["action"] == "scale-up" and d["outcome"] == "executed"
+                   and d.get("throughput_after")]
+        # decision log is newest-first: [0] is the LATEST settled scale-up
+        post_tput = settled[0]["throughput_after"] if settled else 0.0
+        return {
+            "status": status.get("status"),
+            "rescales": int(status.get("rescales", 0)),
+            "time_to_adapt_s": (round(max(t_adapted - t_step, 0.0), 3)
+                                if t_adapted is not None else None),
+            "throughput_ratio_post_step": round(post_tput / pre_rate, 3),
+            "last_rescale_duration_ms": round(
+                float(auto.get("last_rescale_duration_ms") or 0.0), 3),
+            "pre_rate_records_per_s": pre_rate,
+        }
+    finally:
+        te.stop()
+        jm.heartbeats.stop()
+        svc_jm.stop()
+        svc_tm.stop()
+        import shutil
+
+        shutil.rmtree(chk, ignore_errors=True)
+
+
+def child_autoscaler() -> None:
+    """Autoscaler-scenario child: CPU-pinned like child_checkpoint (the
+    oracle path never needs a device, and the parent must never lose the
+    TPU relay to a control-plane bench)."""
+    _emit({"event": "start", "device": "cpu-autoscaler", "pid": os.getpid()})
+    try:
+        import jax
+        from jax._src import xla_bridge as _xb
+
+        jax.config.update("jax_platforms", "cpu")
+        _xb._backend_factories.pop("axon", None)
+        _xb._topology_factories.pop("axon", None)
+    except Exception:
+        pass
+    _emit({"event": "result", "result": autoscaler_scenario()})
+
+
+def run_autoscaler_scenario_child(timeout_s: float = 240.0) -> dict:
+    """Run the autoscaler scenario in a JAX_PLATFORMS=cpu subprocess and
+    return its result event (or an error dict — the headline must survive)."""
+    try:
+        r = subprocess.run(
+            [sys.executable, os.path.abspath(__file__), "--child",
+             "autoscaler", "0", "0", "0"],
+            stdout=subprocess.PIPE, stderr=subprocess.DEVNULL, text=True,
+            timeout=timeout_s, env={**os.environ, "JAX_PLATFORMS": "cpu"},
+        )
+        for line in reversed(r.stdout.splitlines()):
+            if line.startswith("{"):
+                obj = json.loads(line)
+                if obj.get("event") == "result":
+                    return obj["result"]
+        return {"error": "no result event from autoscaler child"}
+    except Exception as e:  # noqa: BLE001
+        return {"error": repr(e)[:300]}
+
+
 def child_checkpoint() -> None:
     """Checkpoint-microbench child: CPU-pinned like child_cpu (the relay is
     single-client — a jax backend probe from the parent would wedge the TPU
@@ -1161,6 +1353,12 @@ def parent_main() -> None:
     checkpoint = run_checkpoint_microbench_child()
     _emit({"event": "checkpoint_microbench", "result": checkpoint})
 
+    # elastic-autoscaler adaptation speed: host-only 2x load-step scenario
+    # in its own CPU-pinned child, so the trajectory tracks how fast the
+    # scheduler turns a saturation signal into a completed rescale
+    autoscaler = run_autoscaler_scenario_child()
+    _emit({"event": "autoscaler_scenario", "result": autoscaler})
+
     def consider(res, rank):
         nonlocal best, best_rank
         if res is None:
@@ -1176,6 +1374,7 @@ def parent_main() -> None:
             printed.set()
             best["dataplane"] = dataplane
             best["checkpoint"] = checkpoint
+            best["autoscaler"] = autoscaler
             print(json.dumps(best), flush=True)
             for c in _CHILDREN:
                 # never orphan a TPU child: it would keep the single-client
@@ -1264,6 +1463,8 @@ def main() -> None:
             child_tpu(T, 1 << int(sys.argv[4]), spans)
         elif label == "checkpoint":
             child_checkpoint()
+        elif label == "autoscaler":
+            child_autoscaler()
         else:
             child_cpu(T, 1 << int(sys.argv[4]), spans)
     else:

@@ -421,6 +421,110 @@ class ObservabilityOptions:
     )
 
 
+class AutoscalerOptions:
+    """The elastic autoscaler (flink_tpu/scheduler/ — the AdaptiveScheduler
+    analogue): a JM-side reactive controller that watches the
+    observability-plane gauges (busy/backpressure ratios, pool usage,
+    watermark skew, checkpoint durations), decides scale-up/down per the
+    configured policy, and rescales live jobs by rewinding to the latest
+    completed checkpoint and remapping key-groups onto the new slot set.
+    Off by default — rescaling costs a checkpoint rewind + replay."""
+
+    ENABLED = (
+        ConfigOptions.key("autoscaler.enabled").bool_type().default_value(False)
+    ).with_description(
+        "Enable reactive autoscaling. On the distributed JobManager the "
+        "controller watches TM-shipped metric snapshots and executes "
+        "policy-driven rescales (keyed single-vertex jobs only; staged "
+        "pipelines and device-operator snapshots cannot re-shard). On a "
+        "MiniCluster the controller runs observe-only: decisions appear in "
+        "/jobs/:id/autoscaler but are never executed."
+    )
+    MIN_PARALLELISM = (
+        ConfigOptions.key("autoscaler.min-parallelism").int_type().default_value(1)
+    ).with_description(
+        "Lower bound the autoscaler may scale a job down to."
+    )
+    MAX_PARALLELISM = (
+        ConfigOptions.key("autoscaler.max-parallelism").int_type().default_value(0)
+    ).with_description(
+        "Upper bound the autoscaler may scale a job up to; 0 (default) "
+        "bounds only by available slots and the job's own max-parallelism "
+        "(key-group count)."
+    )
+    STABILIZATION_INTERVAL_MS = (
+        ConfigOptions.key("autoscaler.stabilization-interval-ms")
+        .duration_ms_type().default_value(30_000)
+    ).with_description(
+        "Quiet period after a job starts or a rescale completes before the "
+        "next decision may execute: signals from a warming attempt (replay, "
+        "cold caches, fresh counters) must not immediately trigger another "
+        "rescale."
+    )
+    POLICY = (
+        ConfigOptions.key("autoscaler.policy").string_type().default_value("threshold")
+    ).with_description(
+        "Decision engine: 'threshold' doubles/halves parallelism on the "
+        "utilization thresholds; 'learning' wraps the threshold rule with a "
+        "bounded history of past rescale outcomes and damps decisions that "
+        "previously failed to improve throughput (the Adaptive Parallelism "
+        "Tuning blueprint, PAPERS.md)."
+    )
+    INTERVAL_MS = (
+        ConfigOptions.key("autoscaler.interval-ms")
+        .duration_ms_type().default_value(1000)
+    ).with_description(
+        "How often the controller samples the job's aggregated gauges into "
+        "the signal window and evaluates the policy."
+    )
+    SIGNAL_WINDOW = (
+        ConfigOptions.key("autoscaler.signal-window").int_type().default_value(6)
+    ).with_description(
+        "Samples per vertex the signal aggregator averages over before the "
+        "policy sees them — one noisy tick must not rescale a job. The "
+        "3-sample decision warm-up and outcome-settling bars clamp to this "
+        "window when it is smaller."
+    )
+    SCALE_UP_THRESHOLD = (
+        ConfigOptions.key("autoscaler.utilization.scale-up-threshold")
+        .float_type().default_value(0.85)
+    ).with_description(
+        "Windowed utilization (busy + backpressured fraction) at or above "
+        "which the threshold policy scales up."
+    )
+    SCALE_DOWN_THRESHOLD = (
+        ConfigOptions.key("autoscaler.utilization.scale-down-threshold")
+        .float_type().default_value(0.3)
+    ).with_description(
+        "Windowed utilization at or below which the threshold policy "
+        "scales down."
+    )
+    DECISION_HISTORY_SIZE = (
+        ConfigOptions.key("autoscaler.decision-history.size")
+        .int_type().default_value(32)
+    ).with_description(
+        "Decision-log entries retained per job (signals seen, action, "
+        "target, outcome, rescale duration), served at "
+        "/jobs/:id/autoscaler."
+    )
+    LEARNING_MIN_GAIN = (
+        ConfigOptions.key("autoscaler.learning.min-gain")
+        .float_type().default_value(1.1)
+    ).with_description(
+        "Throughput gain a past scale-up must have achieved (scale-down: "
+        "1/min-gain retention) for the learning policy to repeat the same "
+        "transition without damping."
+    )
+    LEARNING_PATIENCE = (
+        ConfigOptions.key("autoscaler.learning.patience")
+        .int_type().default_value(4)
+    ).with_description(
+        "Number of triggers the learning policy suppresses a previously "
+        "unhelpful transition for before retrying it (load may have "
+        "changed shape since the bad outcome)."
+    )
+
+
 class SecurityOptions:
     """Transport security (reference: SecurityOptions + security.ssl.internal.*).
 

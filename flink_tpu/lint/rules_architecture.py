@@ -24,12 +24,21 @@ from flink_tpu.lint.rule import Rule, Violation, register  # noqa: F401 — Viol
 #: module level ("{pkg}" is substituted with the indexed package name)
 LAYER_FORBIDDEN: Dict[str, List[str]] = {
     "core": ["{pkg}.runtime", "{pkg}.api", "{pkg}.table", "{pkg}.cep",
-             "{pkg}.ops", "{pkg}.state"],
-    "utils": ["{pkg}.runtime", "{pkg}.api", "{pkg}.table", "{pkg}.cep"],
-    "ops": ["{pkg}.runtime", "{pkg}.api", "{pkg}.table", "{pkg}.cep"],
-    "state": ["{pkg}.api", "{pkg}.table", "{pkg}.cep"],
+             "{pkg}.ops", "{pkg}.state", "{pkg}.scheduler"],
+    "utils": ["{pkg}.runtime", "{pkg}.api", "{pkg}.table", "{pkg}.cep",
+              "{pkg}.scheduler"],
+    "ops": ["{pkg}.runtime", "{pkg}.api", "{pkg}.table", "{pkg}.cep",
+            "{pkg}.scheduler"],
+    "state": ["{pkg}.api", "{pkg}.table", "{pkg}.cep", "{pkg}.scheduler"],
     "graph": ["{pkg}.table", "{pkg}.cep", "{pkg}.runtime"],
     "api": ["{pkg}.table", "{pkg}.runtime"],
+    # the autoscaler consumes metric-snapshot/state/config shapes and is
+    # driven by the runtime through injected callables — it may import
+    # metrics/state/config, never the runtime (or anything above it); and
+    # the layers it consumes must not import it back
+    "metrics": ["{pkg}.runtime", "{pkg}.api", "{pkg}.table", "{pkg}.cep",
+                "{pkg}.scheduler"],
+    "scheduler": ["{pkg}.runtime", "{pkg}.api", "{pkg}.table", "{pkg}.cep"],
 }
 
 

@@ -404,10 +404,16 @@ class ExceptionHistory:
     # -- recovery timeline -------------------------------------------------
     def begin_recovery(self, restart_number: int, *, cause: str,
                        steps_at_failure: Optional[int] = None,
-                       events_at_failure: Optional[int] = None) -> None:
+                       events_at_failure: Optional[int] = None,
+                       kind: str = "restart") -> None:
+        """`kind` distinguishes failure-driven restarts from deliberate
+        autoscaler rescales — both rewind to a checkpoint and redeploy, so
+        both ride this timeline (and both count toward numRestarts, as the
+        reference's reactive mode does)."""
         with self._lock:
             self._num_restarts += 1
             self._open_recovery = {
+                "kind": str(kind),
                 "restart_number": int(restart_number),
                 "failed_at_ms": self._clock() * 1000.0,
                 "cause": str(cause),

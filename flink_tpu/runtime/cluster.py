@@ -34,6 +34,7 @@ import pickle
 import threading
 import time
 import uuid
+from collections import deque
 from dataclasses import dataclass, field
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
@@ -67,6 +68,7 @@ from flink_tpu.runtime.rpc import (
     trace_context,
 )
 from flink_tpu.security.framing import trusted_loads
+from flink_tpu.state import key_groups
 
 
 # ---------------------------------------------------------------------------
@@ -139,32 +141,24 @@ class GraphJobSpec(_PickledSpec):
 def merge_shard_snapshots(handles: Dict[int, dict]) -> dict:
     """Fold per-shard snapshots into one logical-state snapshot for
     rescaling: heap tables union by key group (disjoint by construction,
-    the StateAssignmentOperation analogue), timers concatenate, the
-    collect-sink results concatenate. Each new shard restores from this and
-    filters to its own KeyGroupRange (state/heap.py restore)."""
-    merged_op: dict = {"state": {}, "timers": {"event": [], "proc": [], "watermark": None}}
+    the StateAssignmentOperation analogue — state/key_groups.py holds the
+    shared remap primitives), timers concatenate, the collect-sink results
+    concatenate. Each new shard restores from this and filters to its own
+    KeyGroupRange (state/heap.py restore; timers via
+    filter_timers_for_range)."""
+    ok, why = key_groups.reshardable(handles)
+    if not ok:
+        raise ValueError(why)
+    shards = sorted(handles)
+    ops = [handles[s]["operator"] for s in shards]
+    merged_op = {
+        "state": key_groups.merge_keyed_state(
+            [op.get("state", {}) for op in ops]),
+        "timers": key_groups.merge_timers([op.get("timers") for op in ops]),
+    }
     results: list = []
-    for shard in sorted(handles):
-        snap = handles[shard]
-        op = snap["operator"]
-        if "columnar" in op or "cnt" in op:
-            raise ValueError(
-                "device-operator snapshots re-shard by key group inside the "
-                "sharded device state, not via heap-table merge; rescaling "
-                "device jobs is not supported yet"
-            )
-        for name, table in op.get("state", {}).items():
-            dst = merged_op["state"].setdefault(name, {})
-            for kg, entries in table.items():
-                dst.setdefault(kg, {}).update(entries)
-        t = op.get("timers")
-        if t is not None:
-            merged_op["timers"]["event"].extend(t.get("event", []))
-            merged_op["timers"]["proc"].extend(t.get("proc", []))
-            wm = t.get("watermark")
-            cur = merged_op["timers"]["watermark"]
-            merged_op["timers"]["watermark"] = wm if cur is None else min(cur, wm)
-        results.extend(snap.get("results", []))
+    for s in shards:
+        results.extend(handles[s].get("results", []))
     step = handles[min(handles)]["step"]
     return {"operator": merged_op, "results": results, "step": step, "merged": True}
 
@@ -175,6 +169,11 @@ class _JobState:
     blob_key: str
     parallelism: int
     spec_name: str
+    # rescale eligibility, captured at submit: keyed DistributedJobSpec
+    # jobs re-shard by key group up to the spec's key-group count; graph
+    # jobs snapshot whole runtimes and cannot change task count
+    keyed: bool = True
+    spec_max_parallelism: int = 128
     status: str = "CREATED"            # CREATED/RUNNING/RESTARTING/FINISHED/FAILED/CANCELED
     requested_parallelism: int = 0
     attempt: int = 0
@@ -206,6 +205,12 @@ class _JobState:
     # single overwritten failure string (sizes set by the JM at submit)
     stats: CheckpointStatsTracker = field(default_factory=CheckpointStatsTracker)
     exceptions: ExceptionHistory = field(default_factory=ExceptionHistory)
+    # elastic autoscaling (scheduler/): deliberate rescale bookkeeping —
+    # lifetime count, last redeploy duration, and the perf_counter stamp of
+    # an in-flight rescale (cleared when the new attempt reaches RUNNING)
+    num_rescales: int = 0
+    last_rescale_duration_ms: float = 0.0
+    rescale_started: Optional[float] = None
 
     @property
     def failure(self) -> Optional[str]:
@@ -300,6 +305,7 @@ class JobManagerEndpoint(RpcEndpoint):
         auto_records_per_task: int = 1 << 20,
         checkpoint_history_size: int = 10,
         exception_history_size: int = 16,
+        autoscaler_config=None,
     ):
         super().__init__(name="jobmanager")
         self.rpc = rpc
@@ -330,6 +336,49 @@ class JobManagerEndpoint(RpcEndpoint):
         self._stopped = threading.Event()
         threading.Thread(target=self._schedule_loop, daemon=True,
                          name="schedule-retry").start()
+        # elastic autoscaler (scheduler/ — AdaptiveScheduler analogue): a
+        # controller thread samples each RUNNING job's aggregated gauges
+        # into the signal windows and executes policy-driven rescales via
+        # _rescale_job; `autoscaler_config` is a Configuration carrying the
+        # autoscaler.* group (None or enabled=false leaves it off)
+        self.autoscaler = None
+        self._autoscaler_interval = 1.0
+        if autoscaler_config is not None:
+            from flink_tpu.config import AutoscalerOptions
+            from flink_tpu.scheduler import AutoscalerCoordinator
+
+            if autoscaler_config.get(AutoscalerOptions.ENABLED):
+                self.autoscaler = AutoscalerCoordinator.from_config(
+                    autoscaler_config, rescale_executor=self._rescale_job)
+                self._autoscaler_interval = autoscaler_config.get(
+                    AutoscalerOptions.INTERVAL_MS) / 1000.0
+                threading.Thread(target=self._autoscaler_loop, daemon=True,
+                                 name="autoscaler").start()
+
+    def _autoscaler_loop(self) -> None:
+        while not self._stopped.wait(self._autoscaler_interval):
+            try:
+                self.run_in_main_thread(self._autoscale_tick).result(timeout=30)
+            except Exception:
+                pass
+
+    def _autoscale_tick(self) -> None:
+        """One controller evaluation (JM main thread — the coordinator's
+        rescale executor mutates job state inline, like every other
+        scheduling mutation). Only keyed single-vertex jobs are eligible:
+        staged pipelines snapshot per-stage runtimes, not key-group state."""
+        for job_id, job in list(self._jobs.items()):
+            if job.status != "RUNNING" or job.stages != 1 or not job.keyed:
+                continue
+            metrics, per_shard, _ = self._aggregated_job_metrics(job)
+            if not per_shard:
+                continue
+            self.autoscaler.observe(
+                job_id, job.parallelism, metrics,
+                # slots the job could occupy, capped by its key-group count
+                max_slots=min(len(self._free_slots()) + job.parallelism,
+                              job.spec_max_parallelism),
+            )
 
     def _schedule_loop(self) -> None:
         while not self._stopped.wait(max(self.restart_delay, 0.2)):
@@ -361,18 +410,24 @@ class JobManagerEndpoint(RpcEndpoint):
                      metrics: Optional[dict] = None,
                      spans: Optional[list] = None) -> bool:
         self.heartbeats.receive_heartbeat(tm_id)
+        # keys are (job_id, shard, attempt) — the attempt guard keeps an
+        # in-flight heartbeat snapshotted before a rescale's cancel from
+        # re-landing AFTER the redeploy cleared job.steps/metric_snapshots
+        # (a dead higher shard would otherwise pollute the aggregates and
+        # the autoscaler's signal windows for the whole new attempt);
+        # 2-tuple keys (older TMs) are accepted unguarded
         if steps:
-            for (job_id, shard), step in steps.items():
+            for (job_id, shard, *att), step in steps.items():
                 job = self._jobs.get(job_id)
-                if job is not None:
+                if job is not None and (not att or att[0] == job.attempt):
                     job.steps[shard] = step
         if metrics:
             # TM-shipped metric snapshots (authenticated RPC plane): latest
             # snapshot per shard wins — the JM serves aggregates, history
             # lives in whatever scrapes /metrics
-            for (job_id, shard), snap in metrics.items():
+            for (job_id, shard, *att), snap in metrics.items():
                 job = self._jobs.get(job_id)
-                if job is not None:
+                if job is not None and (not att or att[0] == job.attempt):
                     job.metric_snapshots[shard] = snap
         if spans:
             for sd in spans:
@@ -436,6 +491,8 @@ class JobManagerEndpoint(RpcEndpoint):
         job_id = uuid.uuid4().hex[:16]
         job = _JobState(
             job_id, blob_key, parallelism, spec.name,
+            keyed=not isinstance(spec, GraphJobSpec),
+            spec_max_parallelism=getattr(spec, "max_parallelism", 128),
             requested_parallelism=parallelism, stages=stages,
             source_stages=source_stages, trace_id=job_trace_id(job_id),
             stats=CheckpointStatsTracker(
@@ -494,6 +551,7 @@ class JobManagerEndpoint(RpcEndpoint):
             "savepoints": list(job.completed_savepoints),
             "savepoints_failed": list(job.failed_savepoints),
             "failure": job.failure, "restarts": job.restarts,
+            "rescales": job.num_rescales,
             "checkpoints": [c[0] for c in job.completed],
             "trace_id": job.trace_id,
         }
@@ -505,19 +563,33 @@ class JobManagerEndpoint(RpcEndpoint):
             for job_id, job in self._jobs.items()
         ]
 
-    def job_metrics(self, job_id: str) -> dict:
-        """Aggregated + per-shard metric view of the TM-shipped snapshots,
-        plus the JM-side control-plane gauges (`jm`): checkpoint stats and
-        restart/downtime — these live on the coordinator, not on any TM, so
-        they ride as their own labeled snapshot in /metrics."""
-        job = self._jobs[job_id]
-        per_shard = {int(s): dict(snap) for s, snap in job.metric_snapshots.items()}
+    def _aggregated_job_metrics(self, job: "_JobState"
+                                ) -> "tuple[dict, dict, dict]":
+        """One fold of the TM-shipped per-shard snapshots plus the JM-side
+        control-plane gauges: checkpoint stats, restart/downtime, and
+        rescale counters live on the coordinator, not on any TM. Both
+        /jobs/:id/metrics and the autoscaler tick read THIS recipe — the
+        signal extractor needs e.g. job.lastCheckpointDuration as its
+        rescale-cost proxy, and a fold maintained twice would let the
+        autoscaler's view silently diverge from what /metrics reports."""
+        per_shard = {int(s): dict(snap)
+                     for s, snap in job.metric_snapshots.items()}
         agg = aggregate_shard_metrics(per_shard)
         jm_gauges = job.stats.gauge_values(prefix="job.")
         jm_gauges.update(job.exceptions.gauge_values(prefix="job."))
+        jm_gauges["job.numRescales"] = job.num_rescales
+        jm_gauges["job.lastRescaleDurationMs"] = job.last_rescale_duration_ms
         if "job.watermarkSkewMs" in agg:
             jm_gauges["job.watermarkSkewMs"] = agg["job.watermarkSkewMs"]
         agg.update(jm_gauges)
+        return agg, per_shard, jm_gauges
+
+    def job_metrics(self, job_id: str) -> dict:
+        """Aggregated + per-shard metric view of the TM-shipped snapshots,
+        plus the JM-side control-plane gauges (`jm`), which ride as their
+        own labeled snapshot in /metrics."""
+        job = self._jobs[job_id]
+        agg, per_shard, jm_gauges = self._aggregated_job_metrics(job)
         return {
             "job": agg,
             "per_shard": per_shard,
@@ -600,10 +672,99 @@ class JobManagerEndpoint(RpcEndpoint):
         job.status = "CANCELED"
         self._release_job_local_state(job)
 
+    # ---- elastic rescaling (scheduler/ executor half) ---------------------
+    def rescale_job(self, job_id: str, parallelism: int,
+                    reason: str = "manual") -> dict:
+        """RPC: deliberate live rescale to `parallelism` — the operator- or
+        policy-triggered generalization of the rescale-down-on-TM-loss
+        path. Returns {"accepted": bool, "detail": str}."""
+        accepted, detail = self._rescale_job(job_id, int(parallelism), reason)
+        return {"accepted": accepted, "detail": detail}
+
+    def _rescale_job(self, job_id: str, target: int,
+                     reason: str) -> Tuple[bool, str]:
+        """Rescale executor: rewind to the latest completed checkpoint and
+        remap key-groups onto the new slot set (both directions). The
+        mechanics reuse the failover path — cancel the attempt, mark the
+        job RESCALING, let _try_schedule merge + re-shard the snapshot —
+        so a rescale gets the same recovery-timeline entry (kind
+        'rescale'), restore accounting, and exactly-once replay semantics
+        as a restart, without consuming the restart-attempts budget."""
+        job = self._jobs.get(job_id)
+        if job is None:
+            return False, f"unknown job {job_id}"
+        if job.status != "RUNNING":
+            return False, f"job is {job.status}, not RUNNING"
+        if job.stages != 1 or not job.keyed:
+            return False, ("only keyed jobs can rescale: staged/graph "
+                           "pipelines snapshot whole runtimes, not "
+                           "key-group state")
+        if not job.completed:
+            return False, "no completed checkpoint to rewind to"
+        if target < 1:
+            return False, f"parallelism must be positive, got {target}"
+        if target > job.spec_max_parallelism:
+            return False, (f"target {target} exceeds the job's "
+                           f"max-parallelism (key-group count) "
+                           f"{job.spec_max_parallelism}")
+        if target == job.parallelism:
+            return False, f"already at parallelism {target}"
+        capacity = len(self._free_slots()) + job.parallelism
+        if target > capacity:
+            return False, f"{target} slots needed, {capacity} available"
+        _cp_id, handles, _step = job.completed[-1]
+        if set(handles) != set(range(target)):
+            ok, why = key_groups.reshardable(handles)
+            if not ok:
+                return False, why
+        old = job.parallelism
+        job.num_rescales += 1
+        job.rescale_started = time.perf_counter()
+        # in-flight checkpoints belong to the attempt being cancelled: the
+        # attempt guard rejects their remaining acks and checkpoint ids are
+        # never reused, so without this sweep (the _fail_job analogue) the
+        # stats records would sit IN_PROGRESS forever in /jobs/:id/checkpoints
+        for cp_id in list(job.pending):
+            job.stats.report_failed(
+                cp_id, f"superseded by rescale {old}->{target}")
+        self._cancel_tasks(job)
+        job.parallelism = target
+        job.status = "RESCALING"
+        # the rescale rides the recovery timeline (it IS a rewind+redeploy)
+        # tagged kind='rescale'; numRestarts counts it, as the reference's
+        # reactive mode does, but restart_attempts is not consumed
+        job.exceptions.begin_recovery(
+            job.restarts, kind="rescale",
+            cause=f"rescale {old}->{target}: {reason}",
+            steps_at_failure=max(job.steps.values(), default=0))
+        self._job_span(job, "autoscaler", "JobRescale", time.time() * 1000.0,
+                       fromParallelism=old, toParallelism=target,
+                       reason=reason[:200])
+        self._try_schedule(job)
+        return True, f"rescaling {old}->{target}"
+
+    def job_autoscaler(self, job_id: str) -> dict:
+        """Autoscaler view (/jobs/:id/autoscaler): decision log + rescale
+        counters. Manual rescale_job calls count in num_rescales even with
+        no coordinator attached."""
+        from flink_tpu.scheduler import empty_autoscaler_payload
+
+        job = self._jobs[job_id]
+        if self.autoscaler is not None:
+            payload = self.autoscaler.payload(
+                job_id, num_rescales=job.num_rescales,
+                last_rescale_duration_ms=job.last_rescale_duration_ms)
+        else:
+            payload = empty_autoscaler_payload()
+            payload.update(num_rescales=job.num_rescales,
+                           last_rescale_duration_ms=job.last_rescale_duration_ms)
+        payload["parallelism"] = job.parallelism
+        return payload
+
     # ---- scheduling (M4-lite: deploy when slots cover parallelism) -------
     def _try_schedule_all(self) -> None:
         for job in self._jobs.values():
-            if job.status in ("CREATED", "RESTARTING"):
+            if job.status in ("CREATED", "RESTARTING", "RESCALING"):
                 self._try_schedule(job)
 
     def _free_slots(self) -> List[str]:
@@ -624,7 +785,7 @@ class JobManagerEndpoint(RpcEndpoint):
         return slots
 
     def _try_schedule(self, job: _JobState) -> None:
-        if job.status not in ("CREATED", "RESTARTING"):
+        if job.status not in ("CREATED", "RESTARTING", "RESCALING"):
             return  # already scheduled (e.g. a TM registration raced the
             # delayed-restart thread) or terminal
         slots = self._free_slots()
@@ -662,11 +823,11 @@ class JobManagerEndpoint(RpcEndpoint):
                         return
                     merged = None
                 if merged is not None:
-                    restore = {
-                        shard: (merged if shard == 0
-                                else {**merged, "results": []})
-                        for shard in range(job.parallelism)
-                    }
+                    # pre-split per shard: shipping the whole merged state
+                    # to every shard would serialize ~parallelism copies
+                    # of the job state over the deploy RPCs
+                    restore = key_groups.split_merged_snapshot(
+                        merged, job.spec_max_parallelism, job.parallelism)
                     local_cp = None  # re-sharded state has no local copy
         job.attempt += 1
         job.assignment = {shard: slots[shard] for shard in range(job.parallelism)}
@@ -675,6 +836,10 @@ class JobManagerEndpoint(RpcEndpoint):
         }
         job.finished = {}
         job.steps = {}
+        # drop the dead attempt's shipped snapshots: after a rescale-down a
+        # stale higher-shard snapshot would keep inflating the aggregates
+        # (and the autoscaler's signals) forever
+        job.metric_snapshots.clear()
         job.pending.clear()
         job.pending_target.clear()
         # in-flight savepoints belong to the dead attempt: report them as
@@ -701,7 +866,12 @@ class JobManagerEndpoint(RpcEndpoint):
                 )
             except Exception:
                 # undetected-dead worker: evict it, cancel the partial
-                # attempt, go back to WaitingForResources
+                # attempt, go back to WaitingForResources. If this deploy
+                # was a deliberate rescale it has degraded into a plain
+                # restart (which may land at a different parallelism):
+                # the later redeploy must not stamp a rescale completion
+                # for a shape change that never took effect
+                job.rescale_started = None
                 self._tms.pop(tm_id, None)
                 self.heartbeats.unmonitor(tm_id)
                 self._cancel_tasks(job)
@@ -722,6 +892,20 @@ class JobManagerEndpoint(RpcEndpoint):
             restore_duration_ms=restore_ms,
             restored_step=restore_step,
         )
+        if job.rescale_started is not None:
+            # deliberate rescale complete: stamp decision-to-RUNNING
+            # duration (lastRescaleDurationMs) and restart the autoscaler's
+            # stabilization window from completion time
+            job.last_rescale_duration_ms = (
+                time.perf_counter() - job.rescale_started) * 1000.0
+            job.rescale_started = None
+            if self.autoscaler is not None:
+                # target disambiguates: a manual rescale_job RPC also
+                # lands here, and its duration must not stamp a pending
+                # coordinator decision for a different parallelism
+                self.autoscaler.rescale_completed(
+                    job.job_id, job.last_rescale_duration_ms,
+                    target=job.parallelism)
 
     def _cancel_tasks(self, job: _JobState) -> None:
         for tm_id in set(job.assignment.values()):
@@ -781,7 +965,11 @@ class JobManagerEndpoint(RpcEndpoint):
 
     def task_finished(self, job_id: str, attempt: int, shard: int, results: list) -> None:
         job = self._jobs.get(job_id)
-        if job is None or attempt != job.attempt:
+        if job is None or attempt != job.attempt or job.status != "RUNNING":
+            # the attempt guard misses a cancelled-but-racing task of the
+            # CURRENT attempt (rescale/restart cancels first, bumps the
+            # attempt only at redeploy) — a finish landing then must not
+            # flip a RESCALING/RESTARTING job to FINISHED
             return
         job.finished[shard] = results
         # abort in-flight checkpoints this shard never snapshotted: a
@@ -1451,8 +1639,10 @@ class _ShardTask:
         # task-scope observability for the keyed hot path: throughput,
         # busy/idle/backPressured ratios (busy = partition/send + operator
         # sections; credit waits measured at the senders are subtracted;
-        # channel-merge polling is idle), plus the window operator's HBM
-        # footprint / key cardinality gauges
+        # cross-shard channel-merge polling is idle — the self-partition
+        # never waits; checkpoint snapshot/ack time counts as neither, so
+        # utilization tracks offered load, not checkpoint cost), plus the
+        # window operator's HBM footprint / key cardinality gauges
         job_group = self.registry.group("job")
         records_in = job_group.counter("numRecordsIn")
         io = TaskIOMetrics()
@@ -1472,21 +1662,14 @@ class _ShardTask:
             if self.restore.get("merged"):
                 # rescaled restore: keep only timers whose key falls in this
                 # shard's key-group range (state filters itself by range)
-                from flink_tpu.core.keygroups import assign_to_key_group
-
                 kg_range = key_group_range_for_operator(
                     self.spec.max_parallelism, P, self.shard
                 )
-                t = op_snap["timers"]
                 op_snap = {
                     "state": op_snap["state"],
-                    "timers": {
-                        "event": [e for e in t["event"] if kg_range.contains(
-                            assign_to_key_group(e[1], self.spec.max_parallelism))],
-                        "proc": [e for e in t["proc"] if kg_range.contains(
-                            assign_to_key_group(e[1], self.spec.max_parallelism))],
-                        "watermark": t["watermark"],
-                    },
+                    "timers": key_groups.filter_timers_for_range(
+                        op_snap["timers"], kg_range,
+                        self.spec.max_parallelism),
                 }
             op.restore(op_snap)
             # the collect-sink is stateful: outputs emitted before the
@@ -1494,15 +1677,24 @@ class _ShardTask:
             # the failed attempt are discarded and re-fired on replay)
             results.extend(self.restore.get("results", []))
 
-        # output channels to every shard (incl. self, for uniformity)
+        # output channels to every OTHER shard; the self-partition takes a
+        # local fast path (a plain deque — producer and consumer are this
+        # same thread, strictly send-then-poll per step). Riding the
+        # loopback socket instead costs an encode/MAC/decode round trip
+        # through the exchange thread per step, and under CPU saturation
+        # that transit wait reads as idle — capping a saturated p=1 job's
+        # utilization far below 1.0 and blinding the autoscaler.
         from flink_tpu.config import ExchangeOptions
         from flink_tpu.metrics.exchange import register_channel_metrics
 
         wire_fmt = (cfg.get(ExchangeOptions.WIRE_FORMAT) if cfg is not None
                     else ExchangeOptions.WIRE_FORMAT.default)
         exch_metrics_group = self.registry.group("job", "exchange")
+        self_parts: deque = deque()
         outs: Dict[int, OutputChannel] = {}
         for dst in range(P):
+            if dst == self.shard:
+                continue
             outs[dst] = OutputChannel(
                 self.peers[dst], f"{self.job_id}/a{self.attempt}/{self.shard}->{dst}",
                 security=self.te.exchange.security, wire_format=wire_fmt,
@@ -1511,7 +1703,8 @@ class _ShardTask:
                 lambda ch=outs[dst]: ch.backpressured_s)
             register_channel_metrics(exch_metrics_group, str(dst),
                                      outbound=outs[dst])
-        ins = {src: self.te.exchange.channel(self._channel_id(src)) for src in range(P)}
+        ins = {src: self.te.exchange.channel(self._channel_id(src))
+               for src in range(P) if src != self.shard}
         for src, ch in ins.items():
             job_group.gauge(f"exchange.inPoolUsage.{src}", ch.occupancy)
             register_channel_metrics(exch_metrics_group, str(src), inbound=ch)
@@ -1521,6 +1714,11 @@ class _ShardTask:
         try:
             while not self.cancelled.is_set():
                 # ---- step-aligned checkpoint barrier -----------------------
+                # (snapshot/ack/persist time deliberately sits OUTSIDE the
+                # busy accounting: utilization must track offered load, not
+                # checkpoint cost — a result-heavy job checkpointing often
+                # would otherwise read busy while idle and mislead the
+                # autoscaler in both directions)
                 with self._cp_lock:
                     due = [r for r in self._cp_requests if r[1] <= step]
                     self._cp_requests = [r for r in self._cp_requests if r[1] > step]
@@ -1556,7 +1754,11 @@ class _ShardTask:
                 owner = (kgs.astype(np.int64) * P) // self.spec.max_parallelism
                 for dst in range(P):
                     m = owner == dst
-                    outs[dst].send((keys[m], vals[m], ts[m], int(wm), step))
+                    part = (keys[m], vals[m], ts[m], int(wm), step)
+                    if dst == self.shard:
+                        self_parts.append(part)
+                    else:
+                        outs[dst].send(part)
                 busy_dt = time.perf_counter() - busy_t0
 
                 # ---- merge one batch per input channel (min watermark) -----
@@ -1566,16 +1768,20 @@ class _ShardTask:
                 parts = []
                 wms = []
                 for src in range(P):
-                    got = None
-                    while True:  # short waits so cancellation stays responsive
-                        try:
-                            got = ins[src].poll(timeout=0.5)
-                            break
-                        except TimeoutError:
-                            if self.cancelled.is_set():
-                                return
-                    if got is None:
-                        raise RuntimeError(f"channel from shard {src} ended early")
+                    if src == self.shard:
+                        got = self_parts.popleft()   # sent above, same thread
+                    else:
+                        got = None
+                        while True:  # short waits so cancellation stays responsive
+                            try:
+                                got = ins[src].poll(timeout=0.5)
+                                break
+                            except TimeoutError:
+                                if self.cancelled.is_set():
+                                    return
+                        if got is None:
+                            raise RuntimeError(
+                                f"channel from shard {src} ended early")
                     k, v, t, w, s = got
                     assert s == step, f"step skew: got {s} expected {step}"
                     parts.append((k, v, t))
@@ -1662,6 +1868,7 @@ class TaskExecutorEndpoint(RpcEndpoint):
         self._blob: Optional[BlobCache] = None
         rpc.register(self)
         self._hb_thread: Optional[threading.Thread] = None
+        self._hb_stop = threading.Event()
 
     def connect(self, jm_address: str) -> None:
         gw = self.rpc.gateway(jm_address, "jobmanager")
@@ -1674,11 +1881,20 @@ class TaskExecutorEndpoint(RpcEndpoint):
             self._hb_thread.start()
 
     def _hb_loop(self) -> None:
-        while True:
-            time.sleep(0.5)
+        # beat at least every 0.5s (liveness), faster when the shipping
+        # interval asks for fresher metric/step snapshots — a sub-500ms
+        # observability.shipping.interval-ms was previously unreachable,
+        # which left the autoscaler's signal windows up to one full beat
+        # stale and starved fast-stepping jobs of checkpoint-target margin
+        beat_s = min(0.5, max(self.shipping_interval_ms, 50) / 1000.0)
+        # wait() not sleep(): a stopped endpoint's thread must exit — a
+        # leaked loop keeps dialing the dead JM at up to 5 Hz forever
+        # (real TM processes run until killed, but in-process tests stack
+        # dozens of endpoints per run)
+        while not self._hb_stop.wait(beat_s):
             try:
                 steps = {
-                    (t.job_id, t.shard): t.current_step
+                    (t.job_id, t.shard, t.attempt): t.current_step
                     for t in self._tasks.values()
                     if not t.cancelled.is_set()
                 }
@@ -1696,7 +1912,7 @@ class TaskExecutorEndpoint(RpcEndpoint):
                             continue
                         snap = metrics_snapshot(t.registry.all_metrics())
                         if snap:
-                            metrics[(t.job_id, t.shard)] = snap
+                            metrics[(t.job_id, t.shard, t.attempt)] = snap
                         sp = t.drain_spans()
                         if sp:
                             spans.extend(sp)
@@ -1774,6 +1990,7 @@ class TaskExecutorEndpoint(RpcEndpoint):
         return True
 
     def stop(self) -> None:
+        self._hb_stop.set()
         for task in self._tasks.values():
             task.cancelled.set()
         self.exchange.stop()
@@ -1867,6 +2084,8 @@ def main(argv: Optional[List[str]] = None) -> None:
                     ObservabilityOptions.CHECKPOINT_HISTORY_SIZE),
                 exception_history_size=conf.get(
                     ObservabilityOptions.EXCEPTION_HISTORY_SIZE),
+                # autoscaler.* group (scheduler/): enabled=false is inert
+                autoscaler_config=conf,
             )
         JobManagerEndpoint(
             svc,

@@ -241,6 +241,25 @@ class MiniCluster:
         client.exceptions = ExceptionHistory(
             size=config.get(ObservabilityOptions.EXCEPTION_HISTORY_SIZE))
         client.exceptions.register_metrics(job_group)
+        # elastic autoscaler, observe-only: an in-process job runs as ONE
+        # task, so there is nothing to rescale — but the same signal
+        # windows + policy run against the job's own registry and the
+        # decision log serves at /jobs/:id/autoscaler, so a pipeline can
+        # be profiled for scaling behavior before cluster deployment
+        from flink_tpu.config import AutoscalerOptions
+
+        if config.get(AutoscalerOptions.ENABLED):
+            from flink_tpu.metrics.registry import metrics_snapshot
+            from flink_tpu.scheduler import AutoscalerCoordinator
+
+            client.autoscaler = AutoscalerCoordinator.from_config(config)
+            # observe-only mode never rescales, so these read a constant
+            # 0 — registered anyway so the gauge surface matches the
+            # distributed JM and dashboards scrape one shape
+            job_group.gauge("numRescales", lambda: 0)
+            job_group.gauge("lastRescaleDurationMs", lambda: 0.0)
+            client._autoscaler_metrics = (
+                lambda c=client: metrics_snapshot(c.metrics.all_metrics()))
         coordinator = (
             CheckpointCoordinator(
                 storage,
@@ -300,6 +319,12 @@ class MiniCluster:
 
                 def cancel_check():
                     client.records_in = runtime.records_in  # progress gauge
+                    auto = getattr(client, "autoscaler", None)
+                    if auto is not None:
+                        # throttled: maybe_observe snapshots the registry
+                        # only when an autoscaler.interval-ms tick is due
+                        auto.maybe_observe(client.job_id, 1,
+                                           client._autoscaler_metrics)
                     return client._cancel.is_set()
 
                 runtime.run(

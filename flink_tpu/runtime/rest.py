@@ -23,6 +23,9 @@ view). Endpoints:
                               → one retained checkpoint's record
   GET  /jobs/<id>/exceptions  → bounded exception history + recovery
                                 timeline (JobExceptionsHandler analogue)
+  GET  /jobs/<id>/autoscaler  → autoscaler decision log + rescale counters
+                                (scheduler/ — signals seen, action taken,
+                                outcome, rescale durations)
   GET  /metrics               → Prometheus text exposition (all jobs)
   POST /jars/run              → {"module": "/path/script.py", "entry": "main"}
                                 application-mode submission: the script builds
@@ -236,6 +239,17 @@ class _Handler(BaseHTTPRequestHandler):
                 return self._json(200, _jsonable(
                     hist.payload() if hist is not None
                     else empty_exceptions_payload()))
+            if parts[2] == "autoscaler" and len(parts) == 3:
+                # decision log + rescale counters (scheduler/); MiniCluster
+                # jobs run observe-only, so decisions carry outcome
+                # 'observe-only' and parallelism is the single in-process task
+                from flink_tpu.scheduler import empty_autoscaler_payload
+
+                auto = getattr(client, "autoscaler", None)
+                payload = (auto.payload(client.job_id) if auto is not None
+                           else empty_autoscaler_payload())
+                payload.setdefault("parallelism", 1)
+                return self._json(200, _jsonable(payload))
             if parts[2] == "state" and len(parts) == 4:
                 # queryable state (S13): /jobs/<id>/state/<uid>?key=K
                 from urllib.parse import parse_qs, urlparse
@@ -331,6 +345,9 @@ class _Handler(BaseHTTPRequestHandler):
             if parts[2] == "exceptions" and len(parts) == 3:
                 return self._json(200, _jsonable(
                     self.jm.job_exceptions(job_id)))
+            if parts[2] == "autoscaler" and len(parts) == 3:
+                return self._json(200, _jsonable(
+                    self.jm.job_autoscaler(job_id)))
         except Exception as e:  # noqa: BLE001 — JM lookup failures -> 404
             return self._json(404, {"error": repr(e)})
         return self._json(404, {"error": f"no route {self.path}"})

@@ -34,7 +34,7 @@ DASHBOARD_HTML = """<!DOCTYPE html>
  tr.job:hover { background: var(--panel); }
  .RUNNING { color: var(--ok); } .FINISHED { color: var(--info); }
  .FAILED { color: var(--bad); }
- .CANCELED, .RESTARTING, .CREATED { color: var(--warn); }
+ .CANCELED, .RESTARTING, .RESCALING, .CREATED { color: var(--warn); }
  .detail { background: var(--panel); }
  .detail td { padding: 12px 16px; }
  .kv { display: grid; grid-template-columns: repeat(auto-fill, minmax(210px, 1fr));
@@ -109,13 +109,39 @@ function exceptionSection(exc) {
     `[${e.task ?? "?"}${e.task_manager ? " @ " + e.task_manager : ""}] ` +
     e.exception)).join("<br>");
   const recs = (exc.recoveries ?? []).slice(0, 4).map(r => esc(
-    `restart #${r.restart_number}: rewound to chk ${r.restored_checkpoint_id ?? "none"}, ` +
+    `${r.kind === "rescale" ? "rescale" : "restart"} #${r.restart_number}: ` +
+    `rewound to chk ${r.restored_checkpoint_id ?? "none"}, ` +
     `restore ${fmt(r.restore_duration_ms)}ms, downtime ${fmt(r.downtime_ms)}ms` +
     (r.steps_replayed != null ? `, ${r.steps_replayed} steps replayed` : "") +
     (r.events_replayed != null ? `, ${fmt(r.events_replayed)} events replayed` : "")
   )).join("<br>");
   return `<h3>exceptions</h3><div class="spans">${entries}</div>` +
     (recs ? `<div class="spans">${recs}</div>` : "");
+}
+
+function autoscalerSection(a) {
+  // elastic autoscaler (/jobs/:id/autoscaler): parallelism, rescale
+  // counters and the bounded decision log (signals seen -> action ->
+  // outcome); hidden for jobs with no autoscaler and no decisions
+  if (!a || (!a.enabled && !(a.decisions ?? []).length && !a.num_rescales))
+    return "";
+  const actClass = (d) => d.outcome === "executed" ? "RUNNING"
+    : (String(d.outcome).startsWith("rejected") ? "FAILED" : "CREATED");
+  const rows = (a.decisions ?? []).slice(0, 8).map(d => `<tr>
+    <td>${new Date(d.timestamp_ms).toISOString().slice(11, 19)}</td>
+    <td>${esc(d.action)} ${d.parallelism}&rarr;${d.target}</td>
+    <td>${fmt(d.signals?.utilization, 2)}</td>
+    <td class="${actClass(d)}">${esc(d.outcome)}</td>
+    <td>${fmt(d.duration_ms)}</td>
+    <td>${esc(String(d.reason).slice(0, 70))}</td></tr>`);
+  return "<h3>autoscaler</h3>" + kv({
+    "policy": esc(a.policy ?? "off"),
+    "parallelism": fmt(a.parallelism),
+    "rescales": fmt(a.num_rescales),
+    "last rescale ms": fmt(a.last_rescale_duration_ms),
+  }) + (rows.length ? `<table><thead><tr><th>at</th><th>action</th>
+    <th>util</th><th>outcome</th><th>rescale ms</th><th>reason</th></tr>
+    </thead><tbody>${rows.join("")}</tbody></table>` : "");
 }
 
 function operatorTable(metrics) {
@@ -145,11 +171,12 @@ function operatorTable(metrics) {
 }
 
 async function detailRow(id) {
-  const [info, metrics, traces, cps, exc] = await Promise.all([
+  const [info, metrics, traces, cps, exc, auto] = await Promise.all([
     j(`/jobs/${id}`), j(`/jobs/${id}/metrics`),
     j(`/jobs/${id}/traces`).catch(() => ({resourceSpans: []})),
     j(`/jobs/${id}/checkpoints`).catch(() => null),
     j(`/jobs/${id}/exceptions`).catch(() => null),
+    j(`/jobs/${id}/autoscaler`).catch(() => null),
   ]);
   const spans = (traces.resourceSpans[0]?.scopeSpans[0]?.spans ?? []);
   const spanRows = spans.slice(-12).reverse().map(s => {
@@ -185,6 +212,7 @@ async function detailRow(id) {
         ([k]) => k.endsWith("numLateRecordsDropped"))?.[1]),
     "error": esc(info.error ?? "none"),
   }) + operatorTable(metrics)
+    + autoscalerSection(auto)
     + checkpointSection(cps) + exceptionSection(exc)
     + (spanRows ? `<div class="spans">${spanRows}</div>` : "");
 }

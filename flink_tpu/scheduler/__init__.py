@@ -1,0 +1,52 @@
+"""Elastic autoscaling: the AdaptiveScheduler analogue.
+
+A JM-side reactive controller that closes the loop from the
+observability plane back into scheduling (ROADMAP item 2 — SURVEY §2.7
+"Rescaling"): `signals` turns the JM-aggregated gauges into windowed
+per-vertex utilization estimates, `policy` decides scale-up/down
+(threshold rule, or the learning rule that damps rescales which
+previously failed to help — PAPERS.md "Learning from the Past"), and
+`autoscaler.AutoscalerCoordinator` drives the loop and keeps the
+decision log served at /jobs/:id/autoscaler.
+
+The rescale itself — rewind to the latest completed checkpoint, remap
+key-groups onto the new slot set (state/key_groups.py), redeploy — is
+executed by the runtime through an injected callable; this package
+imports metrics/state/config shapes only, never the runtime.
+"""
+
+from flink_tpu.scheduler.autoscaler import (
+    AutoscalerCoordinator,
+    empty_autoscaler_payload,
+)
+from flink_tpu.scheduler.policy import (
+    LearningPolicy,
+    RescaleOutcome,
+    ScalingDecision,
+    ScalingPolicy,
+    ThresholdPolicy,
+    build_policy,
+)
+from flink_tpu.scheduler.signals import (
+    SignalAggregator,
+    SignalEstimate,
+    SignalSample,
+    SignalWindow,
+    extract_signals,
+)
+
+__all__ = [
+    "AutoscalerCoordinator",
+    "empty_autoscaler_payload",
+    "LearningPolicy",
+    "RescaleOutcome",
+    "ScalingDecision",
+    "ScalingPolicy",
+    "ThresholdPolicy",
+    "build_policy",
+    "SignalAggregator",
+    "SignalEstimate",
+    "SignalSample",
+    "SignalWindow",
+    "extract_signals",
+]

@@ -1,0 +1,173 @@
+"""Signal aggregation for the adaptive scheduler.
+
+The observability plane already measures everything a scaler needs — the
+run loops feed busy/idle/backPressured accounting (metrics/task_io.py),
+the exchange rings expose inPoolUsage, the JM aggregates watermark skew
+and checkpoint durations. This module turns those JM-aggregated gauges
+into per-vertex *windowed* utilization estimates the policy engine can
+act on: instantaneous ratios are too noisy to rescale a job over (one
+slow sample must not double a cluster), so each signal is averaged over a
+bounded window of samples and decisions see the window, not the tick.
+
+Layering: scheduler sits above metrics/state/config and below runtime —
+this module consumes plain metric-snapshot dicts (whatever
+`aggregate_shard_metrics` / `metrics_snapshot` produced) and never
+imports the runtime.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from collections import deque
+from typing import Deque, Dict, Optional
+
+
+@dataclasses.dataclass(frozen=True)
+class SignalSample:
+    """One reading of the scaling-relevant gauges for one vertex/job.
+
+    busy/backpressured/idle prefer the windowed `*TimeMsPerSecond` gauges
+    (recent state, sampled every observability.sampling.interval-ms) and
+    fall back to the lifetime ratios for snapshots that lack them."""
+
+    timestamp: float                     # seconds (coordinator clock)
+    busy: float = 0.0                    # fraction of recent wall time busy
+    backpressured: float = 0.0
+    idle: float = 0.0
+    in_pool_usage: float = 0.0           # mean exchange ring occupancy
+    watermark_skew_ms: float = 0.0
+    checkpoint_duration_ms: float = 0.0  # last completed checkpoint e2e
+    records_in: float = 0.0              # cumulative counter (resets on redeploy)
+
+    @property
+    def utilization(self) -> float:
+        """Busy + backpressured fraction: the share of wall time this
+        vertex either worked or was blocked by downstream backlog — both
+        argue for more parallelism, idle argues for less."""
+        return min(self.busy + self.backpressured, 1.0)
+
+
+def _ratio(metrics: Dict[str, float], leaf: str) -> float:
+    """Windowed ms-per-second gauge as a fraction, else the lifetime ratio.
+
+    A PRESENT windowed gauge is authoritative even at 0.0 — a fully idle
+    vertex legitimately reads busy 0, and falling back to a stale lifetime
+    ratio there would invert the signal (a long-busy job going idle would
+    keep reading ~0.9 and could never scale down). The warm-up guards
+    (stabilization + min_samples) cover the instant after a deploy when
+    the gauge exists but has not sampled yet."""
+    rate = metrics.get(f"job.{leaf}TimeMsPerSecond")
+    if isinstance(rate, (int, float)):
+        return min(max(float(rate), 0.0) / 1000.0, 1.0)
+    return float(metrics.get(f"job.{leaf}TimeRatio", 0.0) or 0.0)
+
+
+def extract_signals(metrics: Dict[str, object],
+                    now: Optional[float] = None) -> SignalSample:
+    """Pull the scaling signals out of a metric snapshot (JM-aggregated
+    per-job dict, or a MiniCluster registry snapshot — same key space)."""
+    pool = [float(v) for k, v in metrics.items()
+            if "inPoolUsage" in k and isinstance(v, (int, float))]
+    return SignalSample(
+        timestamp=time.monotonic() if now is None else now,
+        busy=_ratio(metrics, "busy"),
+        backpressured=_ratio(metrics, "backPressured"),
+        idle=_ratio(metrics, "idle"),
+        in_pool_usage=sum(pool) / len(pool) if pool else 0.0,
+        watermark_skew_ms=float(metrics.get("job.watermarkSkewMs", 0.0) or 0.0),
+        checkpoint_duration_ms=float(
+            metrics.get("job.lastCheckpointDuration", 0.0) or 0.0),
+        records_in=float(metrics.get("job.numRecordsIn", 0.0) or 0.0),
+    )
+
+
+@dataclasses.dataclass(frozen=True)
+class SignalEstimate:
+    """Windowed view the policy decides on."""
+
+    utilization: float
+    busy: float
+    backpressured: float
+    idle: float
+    in_pool_usage: float
+    watermark_skew_ms: float
+    checkpoint_duration_ms: float
+    throughput_per_s: float      # records/s over the window
+    samples: int                 # window fill — policies gate on warm-up
+    # max single-sample utilization in the window: scale-down wants the
+    # WHOLE window idle, not a mean dragged down by a few stalled ticks
+    peak_utilization: float = 0.0
+
+    def as_dict(self) -> Dict[str, float]:
+        return dataclasses.asdict(self)
+
+
+class SignalWindow:
+    """Bounded window of samples for one vertex with mean estimates.
+
+    The records_in counter resets when an attempt redeploys (fresh task
+    registries); a backwards step clears the window so a rescale never
+    reports negative throughput or mixes attempts."""
+
+    def __init__(self, size: int = 6):
+        self.size = max(int(size), 1)
+        self._samples: Deque[SignalSample] = deque(maxlen=self.size)
+
+    def observe(self, sample: SignalSample) -> SignalEstimate:
+        if self._samples and sample.records_in < self._samples[-1].records_in:
+            self._samples.clear()    # counter reset: new attempt
+        self._samples.append(sample)
+        return self.estimate()
+
+    def clear(self) -> None:
+        self._samples.clear()
+
+    def estimate(self) -> SignalEstimate:
+        n = len(self._samples)
+        if n == 0:
+            return SignalEstimate(0, 0, 0, 0, 0, 0, 0, 0.0, 0)
+
+        def mean(attr: str) -> float:
+            return sum(getattr(s, attr) for s in self._samples) / n
+
+        first, last = self._samples[0], self._samples[-1]
+        dt = max(last.timestamp - first.timestamp, 1e-9)
+        tput = ((last.records_in - first.records_in) / dt) if n >= 2 else 0.0
+        return SignalEstimate(
+            utilization=mean("utilization"),
+            peak_utilization=max(s.utilization for s in self._samples),
+            busy=mean("busy"),
+            backpressured=mean("backpressured"),
+            idle=mean("idle"),
+            in_pool_usage=mean("in_pool_usage"),
+            watermark_skew_ms=mean("watermark_skew_ms"),
+            checkpoint_duration_ms=last.checkpoint_duration_ms,
+            throughput_per_s=max(tput, 0.0),
+            samples=n,
+        )
+
+
+class SignalAggregator:
+    """Per-vertex signal windows (today: one vertex per keyed job; the
+    per-vertex shape is what a multi-vertex graph scaler will key on)."""
+
+    def __init__(self, window: int = 6):
+        self.window = window
+        self._vertices: Dict[str, SignalWindow] = {}
+
+    def observe(self, vertex: str, metrics: Dict[str, object],
+                now: Optional[float] = None) -> SignalEstimate:
+        win = self._vertices.get(vertex)
+        if win is None:
+            win = self._vertices[vertex] = SignalWindow(self.window)
+        return win.observe(extract_signals(metrics, now))
+
+    def reset(self, vertex: str) -> None:
+        win = self._vertices.get(vertex)
+        if win is not None:
+            win.clear()
+
+    def estimate(self, vertex: str) -> SignalEstimate:
+        win = self._vertices.get(vertex)
+        return win.estimate() if win is not None else SignalWindow().estimate()

@@ -1,1 +1,2 @@
-"""Keyed state: descriptors, heap backend (oracle/CPU), columnar device backend."""
+"""Keyed state: descriptors, heap backend (oracle/CPU), columnar device
+backend, and the key-group remap used for rescaling (key_groups.py)."""

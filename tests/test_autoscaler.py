@@ -1,0 +1,897 @@
+"""Elastic autoscaler (flink_tpu/scheduler/): signals, policies,
+coordinator, the JM rescale executor, and the load-spike acceptance e2e.
+
+The e2e models the ROADMAP item-2 scenario: an arrival-paced keyed job
+whose per-record service cost releases the GIL (bulk sleeps), so adding
+task threads genuinely raises capacity even in one test process. A 2x
+traffic step saturates parallelism 1 (capacity ~1.4x the pre-step rate,
+below the required 1.5x), the autoscaler scales up by checkpoint rewind +
+key-group remap, throughput recovers to 2x, and a later load drop scales
+back down — with exactly-once results against a fixed-parallelism oracle.
+"""
+
+import time
+
+import numpy as np
+import pytest
+
+from flink_tpu.api.windowing.assigners import TumblingEventTimeWindows
+from flink_tpu.config import AutoscalerOptions, Configuration
+from flink_tpu.scheduler import (
+    AutoscalerCoordinator,
+    LearningPolicy,
+    SignalWindow,
+    ThresholdPolicy,
+    build_policy,
+    extract_signals,
+)
+from flink_tpu.scheduler.signals import SignalSample
+
+
+# ---------------------------------------------------------------------------
+# 1. signals
+# ---------------------------------------------------------------------------
+
+def _sample(t, busy=0.5, bp=0.0, records=0.0):
+    return SignalSample(timestamp=t, busy=busy, backpressured=bp,
+                        records_in=records)
+
+
+def test_signal_window_means_and_throughput():
+    win = SignalWindow(size=4)
+    for i in range(4):
+        est = win.observe(_sample(float(i), busy=0.2 * (i + 1),
+                                  records=1000.0 * i))
+    assert est.samples == 4
+    assert est.utilization == pytest.approx((0.2 + 0.4 + 0.6 + 0.8) / 4)
+    # 3000 records over 3 seconds
+    assert est.throughput_per_s == pytest.approx(1000.0)
+    # bounded: a 5th sample evicts the 1st
+    est = win.observe(_sample(4.0, busy=1.0, records=4000.0))
+    assert est.samples == 4
+    assert est.utilization == pytest.approx((0.4 + 0.6 + 0.8 + 1.0) / 4)
+
+
+def test_signal_window_clears_on_counter_reset():
+    """A records_in counter going backwards means a fresh attempt deployed
+    (rescale/failover): the window must not mix attempts or report
+    negative throughput."""
+    win = SignalWindow(size=4)
+    for i in range(3):
+        win.observe(_sample(float(i), records=5000.0 + 1000 * i))
+    est = win.observe(_sample(3.0, records=100.0))   # reset
+    assert est.samples == 1
+    assert est.throughput_per_s == 0.0
+
+
+def test_extract_signals_prefers_windowed_rates():
+    est = extract_signals({
+        "job.busyTimeMsPerSecond": 700.0,
+        "job.busyTimeRatio": 0.1,          # lifetime: stale, must lose
+        "job.backPressuredTimeMsPerSecond": 100.0,
+        "job.numRecordsIn": 42.0,
+        "job.exchange.inPoolUsage.0": 0.5,
+        "job.exchange.inPoolUsage.1": 1.0,
+        "job.watermarkSkewMs": 123.0,
+    }, now=0.0)
+    assert est.busy == pytest.approx(0.7)
+    assert est.backpressured == pytest.approx(0.1)
+    assert est.utilization == pytest.approx(0.8)
+    assert est.in_pool_usage == pytest.approx(0.75)
+    assert est.watermark_skew_ms == 123.0
+    # falls back to the lifetime ratio when the rate gauge is absent
+    est = extract_signals({"job.busyTimeRatio": 0.33}, now=0.0)
+    assert est.busy == pytest.approx(0.33)
+    # a PRESENT windowed gauge is authoritative even at zero: a fully
+    # idle vertex must not inherit its stale lifetime ratio (that would
+    # read ~0.9 busy on an idle job and block every scale-down)
+    est = extract_signals({"job.busyTimeMsPerSecond": 0.0,
+                           "job.busyTimeRatio": 0.9}, now=0.0)
+    assert est.busy == 0.0
+
+
+# ---------------------------------------------------------------------------
+# 2. policies
+# ---------------------------------------------------------------------------
+
+def _estimate(util, samples=5, tput=1000.0):
+    win = SignalWindow(size=samples)
+    for i in range(samples):
+        win.observe(_sample(float(i), busy=util, records=tput * i))
+    return win.estimate()
+
+
+def test_threshold_policy_scales_up_down_and_clamps():
+    p = ThresholdPolicy(scale_up_threshold=0.8, scale_down_threshold=0.3)
+    up = p.decide(_estimate(0.9), 2, 1, 8)
+    assert (up.action, up.target) == ("scale-up", 4)
+    down = p.decide(_estimate(0.1), 4, 1, 8)
+    assert (down.action, down.target) == ("scale-down", 2)
+    assert p.decide(_estimate(0.5), 2, 1, 8).action == "none"
+    # clamping: at max, at min
+    assert p.decide(_estimate(0.9), 8, 1, 8).action == "none"
+    assert p.decide(_estimate(0.1), 1, 1, 8).action == "none"
+    # max below doubling still makes progress
+    assert p.decide(_estimate(0.9), 2, 1, 3).target == 3
+    # cold window: no decision before min_samples
+    assert p.decide(_estimate(0.9, samples=1), 2, 1, 8).action == "none"
+
+
+def test_threshold_policy_scale_down_vetoed_by_window_peak():
+    """A transient stall must not halve a busy job: scale-down requires
+    the WHOLE window idle — a mean dragged below the threshold by a few
+    stalled ticks around a genuinely busy one is load jitter, and acting
+    on it churns rescales (each one a checkpoint rewind + replay)."""
+    pol = ThresholdPolicy(scale_down_threshold=0.3, min_samples=1)
+    win = SignalWindow(size=4)
+    for i, u in enumerate([0.9, 0.0, 0.0, 0.0]):     # mean 0.225, peak 0.9
+        est = win.observe(_sample(float(i), busy=u, records=100.0 * i))
+    d = pol.decide(est, 4, 1, 8)
+    assert d.action == "none" and "peak" in d.reason
+    # a genuinely idle window still scales down
+    win = SignalWindow(size=4)
+    for i in range(4):
+        est = win.observe(_sample(float(i), busy=0.1, records=100.0 * i))
+    assert pol.decide(est, 4, 1, 8).action == "scale-down"
+
+
+def test_learning_policy_damps_unhelpful_rescales_with_patience():
+    """The Adaptive Parallelism Tuning blueprint: a scale-up that bought
+    nothing is suppressed for `patience` triggers, then retried; a good
+    outcome clears the damping immediately."""
+    p = LearningPolicy(ThresholdPolicy(scale_up_threshold=0.8), patience=3,
+                       min_gain=1.1)
+    hot = _estimate(0.95)
+    assert p.decide(hot, 2, 1, 8).action == "scale-up"
+    # past 2->4 rescale observed no gain
+    p.record_outcome("scale-up", 2, 4, 1000.0, 1005.0)
+    for i in range(3):
+        d = p.decide(hot, 2, 1, 8)
+        assert d.action == "none" and "damped" in d.reason, (i, d)
+    # patience exhausted: retried
+    retry = p.decide(hot, 2, 1, 8)
+    assert retry.action == "scale-up" and "retry" in retry.reason
+    # a GOOD outcome clears the grudge for subsequent decisions
+    p.record_outcome("scale-up", 2, 4, 1000.0, 1800.0)
+    assert p.decide(hot, 2, 1, 8).action == "scale-up"
+    # a different from-parallelism is never damped by the 2->4 history
+    p.record_outcome("scale-up", 2, 4, 1000.0, 1001.0)
+    assert p.decide(hot, 4, 1, 16).action == "scale-up"
+
+
+def test_learning_policy_damps_scale_down_that_lost_throughput():
+    p = LearningPolicy(ThresholdPolicy(scale_down_threshold=0.3),
+                       patience=2, min_gain=1.25)
+    cold = _estimate(0.05)
+    assert p.decide(cold, 4, 1, 8).action == "scale-down"
+    # past 4->2 halved throughput (below the 1/1.25 = 0.8 retention bar)
+    p.record_outcome("scale-down", 4, 2, 1000.0, 500.0)
+    assert p.decide(cold, 4, 1, 8).action == "none"
+    # a scale-down that RETAINED throughput is not damped
+    p2 = LearningPolicy(ThresholdPolicy(scale_down_threshold=0.3),
+                        patience=2, min_gain=1.25)
+    p2.record_outcome("scale-down", 4, 2, 1000.0, 990.0)
+    assert p2.decide(cold, 4, 1, 8).action == "scale-down"
+
+
+def test_build_policy_factory():
+    assert build_policy("threshold").name == "threshold"
+    assert build_policy("learning").name == "learning"
+    with pytest.raises(ValueError):
+        build_policy("oracle")
+
+
+# ---------------------------------------------------------------------------
+# 3. coordinator
+# ---------------------------------------------------------------------------
+
+def _coordinator(executor=None, **kw):
+    clock = [0.0]
+    kw.setdefault("stabilization_interval_ms", 0)
+    coord = AutoscalerCoordinator(
+        ThresholdPolicy(scale_up_threshold=0.8, scale_down_threshold=0.2,
+                        min_samples=1),
+        rescale_executor=executor, clock=lambda: clock[0], **kw)
+    return coord, clock
+
+
+def _busy_metrics(ratio, records):
+    return {"job.busyTimeMsPerSecond": ratio * 1000.0,
+            "job.numRecordsIn": records}
+
+
+def test_coordinator_executes_and_logs_decisions():
+    calls = []
+
+    def executor(job_id, target, reason):
+        calls.append((job_id, target))
+        return True, "ok"
+
+    coord, clock = _coordinator(executor, stabilization_interval_ms=1500)
+    d = None
+    for i in range(3):
+        clock[0] = float(i)
+        d = coord.observe("j1", 2, _busy_metrics(0.95, 1000.0 * i),
+                          max_slots=8) or d
+    # first ticks sit inside the initial stabilization window; the t=2
+    # tick executes once, then post-rescale stabilization holds
+    assert calls == [("j1", 4)]
+    assert d is not None and d.action == "scale-up"
+    payload = coord.payload("j1", num_rescales=len(calls))
+    assert payload["enabled"] and payload["policy"] == "threshold"
+    assert payload["num_rescales"] == 1    # caller-supplied: the executor
+    entry = payload["decisions"][0]        # (JM) owns the counters
+    assert entry["action"] == "scale-up" and entry["outcome"] == "executed"
+    assert entry["signals"]["utilization"] > 0.9
+    executed = [d for d in payload["decisions"] if d["outcome"] == "executed"]
+    assert len(executed) == 1
+
+
+def test_coordinator_stabilization_window_blocks_decisions():
+    calls = []
+    coord, clock = _coordinator(
+        lambda j, t, r: (calls.append(t) or True, "ok"),
+        stabilization_interval_ms=10_000)
+    # window fills during stabilization but nothing executes
+    for i in range(5):
+        clock[0] = float(i)
+        assert coord.observe("j1", 2, _busy_metrics(0.95, 100.0 * i)) is None
+    assert calls == []
+    clock[0] = 11.0
+    coord.observe("j1", 2, _busy_metrics(0.95, 600.0))
+    assert calls == [4]
+    # after the executed rescale: quiet again until completion + interval
+    clock[0] = 12.0
+    assert coord.observe("j1", 4, _busy_metrics(0.95, 50.0)) is None
+    coord.rescale_completed("j1", 250.0)
+    assert coord.payload("j1")["decisions"][0]["duration_ms"] == 250.0
+
+
+def test_coordinator_rejected_execution_is_logged():
+    coord, clock = _coordinator(lambda j, t, r: (False, "no checkpoint"))
+    clock[0] = 1.0
+    d = coord.observe("j1", 1, _busy_metrics(0.95, 10.0), max_slots=4)
+    assert d is not None and d.action == "scale-up"
+    entry = coord.payload("j1")["decisions"][0]
+    assert entry["outcome"] == "rejected: no checkpoint"
+    assert coord.payload("j1")["num_rescales"] == 0
+
+
+def test_coordinator_decision_log_is_bounded():
+    # window of 1: each tick's estimate is that sample, so alternating
+    # utilization yields alternating (non-coalescable) up/down proposals
+    coord, clock = _coordinator(None, decision_log_size=2, signal_window=1)
+    for i in range(6):
+        clock[0] = float(i)
+        coord.observe("j1", 2, _busy_metrics(0.95 if i % 2 else 0.05,
+                                             10.0 * i), max_slots=8)
+    decisions = coord.payload("j1")["decisions"]
+    assert len(decisions) == 2
+    assert all(d["outcome"] == "observe-only" for d in decisions)
+    assert {d["action"] for d in decisions} == {"scale-up", "scale-down"}
+
+
+def test_coordinator_coalesces_repeated_identical_decisions():
+    """A decision the executor keeps refusing (or observe-only mode)
+    refires every tick by design; identical repeats must coalesce in
+    place — not churn real rescale history out of the bounded log."""
+    coord, clock = _coordinator(lambda j, t, r: (False, "no checkpoint"),
+                                decision_log_size=4)
+    for i in range(5):
+        clock[0] = float(i)
+        coord.observe("j1", 1, _busy_metrics(0.95, 10.0 * i), max_slots=4)
+    decisions = coord.payload("j1")["decisions"]
+    assert len(decisions) == 1
+    assert decisions[0]["repeats"] == 5
+    assert decisions[0]["outcome"] == "rejected: no checkpoint"
+    assert decisions[0]["timestamp_ms"] >= 0
+
+
+def test_coordinator_discards_pending_outcome_on_foreign_parallelism():
+    """A failover that lands the job somewhere OTHER than the rescale's
+    target mid-stabilization must not record that deployment's throughput
+    as the rescale's outcome — it would poison the learning history."""
+    policy = LearningPolicy(
+        ThresholdPolicy(scale_up_threshold=0.8, min_samples=1),
+        min_gain=1.2)
+    clock = [0.0]
+    coord = AutoscalerCoordinator(
+        policy, stabilization_interval_ms=1000,
+        rescale_executor=lambda j, t, r: (True, "ok"),
+        clock=lambda: clock[0])
+    coord.observe("j1", 2, _busy_metrics(0.95, 0.0), max_slots=8)
+    clock[0] = 2.0
+    assert coord.observe("j1", 2, _busy_metrics(0.95, 200.0),
+                         max_slots=8).action == "scale-up"   # 2 -> 4
+    coord.rescale_completed("j1", 50.0)
+    # a TM loss drops the job to p=1 (not the rescale's target 4)
+    for i in range(4):
+        clock[0] = 4.0 + i
+        coord.observe("j1", 1, _busy_metrics(0.5, 100.0 * i))
+    assert len(policy.history) == 0
+    entry = coord.payload("j1")["decisions"][0]
+    assert entry["throughput_after"] is None
+
+
+def test_coordinator_discards_pending_outcome_when_rescale_never_lands():
+    """A deploy failure can restart the job at its ORIGINAL parallelism —
+    no shape change, so the window-reset guard never fires. The rescale's
+    pending outcome must still be discarded: recording old-parallelism
+    throughput as the scale-up's gain (~1.0) would damp a genuinely
+    needed rescale."""
+    policy = LearningPolicy(
+        ThresholdPolicy(scale_up_threshold=0.8, min_samples=1),
+        min_gain=1.2)
+    clock = [0.0]
+    coord = AutoscalerCoordinator(
+        policy, stabilization_interval_ms=1000,
+        rescale_executor=lambda j, t, r: (True, "ok"),
+        clock=lambda: clock[0])
+    coord.observe("j1", 1, _busy_metrics(0.95, 0.0), max_slots=8)
+    clock[0] = 2.0
+    assert coord.observe("j1", 1, _busy_metrics(0.95, 200.0),
+                         max_slots=8).action == "scale-up"    # 1 -> 2
+    # the deploy failed and the adaptive restart landed back at p=1
+    for i in range(4):
+        clock[0] = 4.0 + i
+        coord.observe("j1", 1, _busy_metrics(0.95, 100.0 * i), max_slots=8)
+    assert len(policy.history) == 0
+    executed = [d for d in coord.payload("j1")["decisions"]
+                if d["outcome"] == "executed"]
+    assert executed and all(d["throughput_after"] is None for d in executed)
+
+
+def test_coordinator_outcome_feeds_learning_policy():
+    policy = LearningPolicy(
+        ThresholdPolicy(scale_up_threshold=0.8, min_samples=1),
+        min_gain=1.2, patience=10)
+    clock = [0.0]
+    coord = AutoscalerCoordinator(
+        policy, stabilization_interval_ms=1000,
+        rescale_executor=lambda j, t, r: (True, "ok"),
+        clock=lambda: clock[0])
+    coord.observe("j1", 2, _busy_metrics(0.95, 0.0), max_slots=8)
+    clock[0] = 2.0        # decision-time window: 100 records/s
+    assert coord.observe("j1", 2, _busy_metrics(0.95, 200.0),
+                         max_slots=8).action == "scale-up"
+    coord.rescale_completed("j1", 100.0)
+    # post-stabilization samples at the SAME 100 rec/s: gain ~1.0
+    for i in range(4):
+        clock[0] = 4.0 + i
+        coord.observe("j1", 4, _busy_metrics(0.5, 100.0 * i))
+    assert len(policy.history) == 1
+    assert policy.history[0].gain == pytest.approx(1.0)
+    assert policy.history[0].from_parallelism == 2
+    entry = coord.payload("j1")["decisions"][0]
+    assert entry["throughput_after"] is not None
+    # the unhelpful outcome now damps the next 2->N scale-up
+    clock[0] = 20.0
+    coord2_decision = policy.decide(_estimate(0.95), 2, 1, 8)
+    assert coord2_decision.action == "none" and "damped" in coord2_decision.reason
+
+
+def test_maybe_observe_throttles_by_interval():
+    seen = []
+    coord, clock = _coordinator(None, interval_ms=1000)
+    for t in (0.0, 0.1, 0.5, 1.1, 1.2, 2.2):
+        clock[0] = t
+        coord.maybe_observe("j1", 1,
+                            lambda: seen.append(1) or _busy_metrics(0.5, 0.0))
+    assert len(seen) == 3          # t=0.0, 1.1, 2.2
+
+
+def test_from_config_builds_policy_and_bounds():
+    cfg = (Configuration()
+           .set(AutoscalerOptions.POLICY, "learning")
+           .set(AutoscalerOptions.MIN_PARALLELISM, 2)
+           .set(AutoscalerOptions.MAX_PARALLELISM, 6)
+           .set(AutoscalerOptions.STABILIZATION_INTERVAL_MS, 5000))
+    coord = AutoscalerCoordinator.from_config(cfg)
+    assert isinstance(coord.policy, LearningPolicy)
+    assert (coord.min_parallelism, coord.max_parallelism) == (2, 6)
+    assert coord.stabilization_s == 5.0
+    p = coord.payload("nope")
+    assert p["policy"] == "learning" and p["decisions"] == []
+
+
+def test_from_config_small_signal_window_still_decides_and_settles():
+    """autoscaler.signal-window below the default 3-sample warm-up must
+    clamp the warm-up (and the outcome-settling bar) to the window, not
+    leave the policy 'warming up (2/3 samples)' forever — a silently
+    inert autoscaler."""
+    cfg = (Configuration()
+           .set(AutoscalerOptions.SIGNAL_WINDOW, 2)
+           .set(AutoscalerOptions.STABILIZATION_INTERVAL_MS, 0)
+           .set(AutoscalerOptions.SCALE_UP_THRESHOLD, 0.8))
+    calls = []
+    clock = [0.0]
+    coord = AutoscalerCoordinator.from_config(
+        cfg, rescale_executor=lambda j, t, r: (calls.append(t) or True, "ok"),
+        clock=lambda: clock[0])
+    for i in range(2):
+        clock[0] = float(i)
+        coord.observe("j1", 1, _busy_metrics(0.95, 100.0 * i), max_slots=4)
+    assert calls == [2], "window of 2 never cleared the hardcoded warm-up"
+    # the settling bar clamps too: the outcome lands once the 2-sample
+    # window refills after the post-rescale arm
+    for i in range(4):
+        clock[0] = 3.0 + i
+        coord.observe("j1", 2, _busy_metrics(0.5, 100.0 * i))
+    entry = [d for d in coord.payload("j1")["decisions"]
+             if d["outcome"] == "executed"][0]
+    assert entry["throughput_after"] == pytest.approx(100.0)
+
+
+def test_back_to_back_rescales_both_settle_outcomes():
+    """A job that stays saturated after a scale-up must not execute the
+    next rescale until the first one's outcome has settled — otherwise
+    every pending measurement in the chain is overwritten and the
+    learning history stays empty under sustained load."""
+    policy = LearningPolicy(
+        ThresholdPolicy(scale_up_threshold=0.8, min_samples=1),
+        min_gain=1.2, patience=10)
+    clock = [0.0]
+    calls = []
+    coord = AutoscalerCoordinator(
+        policy, stabilization_interval_ms=1000,
+        rescale_executor=lambda j, t, r: (calls.append(t) or True, "ok"),
+        clock=lambda: clock[0])
+    coord.observe("j1", 1, _busy_metrics(0.95, 0.0), max_slots=8)
+    clock[0] = 2.0
+    assert coord.observe("j1", 1, _busy_metrics(0.95, 200.0),
+                         max_slots=8).action == "scale-up"      # 1 -> 2
+    assert calls == [2]
+    # still saturated at p=2: the 2->4 rescale waits for the 1->2
+    # outcome to settle, then fires in the same tick
+    for i in range(4):
+        clock[0] = 4.0 + i
+        coord.observe("j1", 2, _busy_metrics(0.95, 300.0 * i), max_slots=8)
+    assert calls == [2, 4]
+    assert len(policy.history) == 1
+    assert policy.history[0].from_parallelism == 1
+    assert policy.history[0].gain == pytest.approx(3.0)   # 100 -> 300 rec/s
+    first_up = [d for d in coord.payload("j1")["decisions"]
+                if d["outcome"] == "executed" and d["parallelism"] == 1][0]
+    assert first_up["throughput_after"] == pytest.approx(300.0)
+
+
+# ---------------------------------------------------------------------------
+# 4. distributed rescale executor (manual path)
+# ---------------------------------------------------------------------------
+
+class _MeteredTumblingWindows(TumblingEventTimeWindows):
+    """Tumbling assigner with an amortized per-record service cost that
+    releases the GIL (one bulk sleep per `bulk` records — assign_windows
+    runs exactly once per record, unlike the reduce fn which skips each
+    window's first record). More task threads therefore mean genuinely
+    more capacity even inside one test process. cost_s=0 is a plain
+    assigner (oracle/expected runs). The bulk granule is coarse (~30 ms)
+    so sandbox sleep overshoot stays a small RELATIVE error — measured
+    utilization must track the nominal service cost, not timer jitter."""
+
+    def __init__(self, size_ms, cost_s=0.0, bulk=150):
+        super().__init__(size_ms)
+        self.cost_s = cost_s
+        self.bulk = bulk
+        self._n = 0
+
+    def assign_windows(self, element, timestamp):
+        if self.cost_s:
+            self._n += 1
+            if self._n % self.bulk == 0:
+                time.sleep(self.cost_s * self.bulk)
+        return super().assign_windows(element, timestamp)
+
+
+class _PacedLoadBatches:
+    """Partition-invariant load profile, arrival-paced (picklable).
+
+    profile[s] = records in step s ACROSS shards; each shard takes its
+    keys[shard::num_shards] slice of the deterministically generated step
+    batch, so the per-step union is identical at any parallelism. With
+    `interval_s` set, step s blocks until its scheduled arrival time
+    (anchored at this attempt's first access, so replay after a rescale
+    resumes paced rather than bursting). 64 keys split the 16 key groups
+    evenly, so traffic balances 50/50 across two shards."""
+
+    def __init__(self, profile, interval_s, shard, num_shards, n_keys=64):
+        self.profile = list(profile)
+        self.interval_s = interval_s
+        self.shard = shard
+        self.num_shards = num_shards
+        self.n_keys = n_keys
+        self._anchor = None
+
+    def __len__(self):
+        return len(self.profile)
+
+    def __getitem__(self, s):
+        if self.interval_s:
+            now = time.monotonic()
+            if self._anchor is None:
+                self._anchor = (now, s)
+            due = self._anchor[0] + (s - self._anchor[1]) * self.interval_s
+            if due > now:
+                time.sleep(due - now)
+        rng = np.random.default_rng(9000 + s)      # per-STEP determinism
+        n = self.profile[s]
+        keys = np.asarray([f"k{v}" for v in rng.integers(0, self.n_keys, n)],
+                          dtype=object)
+        vals = np.ones(n, dtype=np.float64)
+        ts = (s * 1000 + rng.integers(0, 1000, n)).astype(np.int64)
+        sl = slice(self.shard, None, self.num_shards)
+        return keys[sl], vals[sl], ts[sl], s * 1000 + 500
+
+
+class _LoadFactory:
+    def __init__(self, profile, interval_s):
+        self.profile = list(profile)
+        self.interval_s = interval_s
+
+    def __call__(self, shard, num_shards):
+        return _PacedLoadBatches(self.profile, self.interval_s, shard,
+                                 num_shards)
+
+
+def _load_spec(profile, interval_s, cost_s=0.0):
+    from flink_tpu.runtime.cluster import DistributedJobSpec
+
+    return DistributedJobSpec(
+        name="load-step",
+        source_factory=_LoadFactory(profile, interval_s),
+        assigner=_MeteredTumblingWindows(2000, cost_s=cost_s),
+        aggregate="sum",
+        max_parallelism=16,
+    )
+
+
+def _expected_results(profile):
+    """Fixed-parallelism oracle run of the same profile (unpaced, costless)."""
+    from flink_tpu.ops.aggregators import resolve
+    from flink_tpu.runtime.oracle_window_operator import OracleWindowOperator
+
+    op = OracleWindowOperator(TumblingEventTimeWindows.of(2000),
+                              resolve("sum").python_equivalent(),
+                              max_parallelism=16)
+    batches = _PacedLoadBatches(profile, 0.0, 0, 1)
+    for s in range(len(batches)):
+        keys, vals, ts, wm = batches[s]
+        for i in range(len(keys)):
+            op.process_record(keys[i], float(vals[i]), int(ts[i]))
+        op.process_watermark(wm)
+    op.process_watermark((1 << 63) - 1)
+    return {(k, w.start): r for k, w, r, _ in op.drain_output()}
+
+
+def _collect(result):
+    return {(k, w[0]): r for k, w, r, _ in result}
+
+
+def _wait(predicate, timeout, interval=0.2, desc="condition"):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        got = predicate()
+        if got:
+            return got
+        time.sleep(interval)
+    raise AssertionError(f"timed out after {timeout}s waiting for {desc}")
+
+
+def test_manual_rescale_up_preserves_results(tmp_path):
+    """The rescale executor alone (no policy): deliberate 2->3 then 3->2
+    rescales of a RUNNING keyed job rewind to the latest checkpoint,
+    remap key-groups onto the new slot set, and results stay exact. Each
+    rescale shows up in num_rescales, lastRescaleDurationMs, and the
+    recovery timeline as kind='rescale' with a nonzero restore duration —
+    without consuming the restart-attempts budget. Also pins the rescale
+    hygiene sweeps: stale-attempt heartbeats are dropped, in-flight
+    checkpoints fail instead of pending forever."""
+    from flink_tpu.runtime.cluster import (
+        JobManagerEndpoint,
+        TaskExecutorEndpoint,
+    )
+    from flink_tpu.runtime.rpc import RpcService
+
+    profile = [120] * 50
+    spec = _load_spec(profile, interval_s=0.08)
+    svc_jm, svc_tm = RpcService(), RpcService()
+    jm = JobManagerEndpoint(
+        svc_jm, checkpoint_dir=str(tmp_path / "chk"),
+        heartbeat_interval=0.2, heartbeat_timeout=10.0,
+    )
+    te = TaskExecutorEndpoint(svc_tm, slots=3, shipping_interval_ms=200)
+    te.connect(svc_jm.address)
+    client = svc_jm.gateway(svc_jm.address, "jobmanager")
+    job_id = client.submit_job(spec.to_bytes(), 2)
+    try:
+        # rejected pre-checkpoint: nothing to rewind to
+        r = client.rescale_job(job_id, 3)
+        assert not r["accepted"] and "checkpoint" in r["detail"]
+
+        _wait(lambda: client.trigger_checkpoint(job_id)
+              and client.job_status(job_id)["checkpoints"],
+              30, desc="first completed checkpoint")
+        r = client.rescale_job(job_id, 3, "test scale-up")
+        assert r["accepted"], r
+        # same-parallelism and over-capacity targets are refused
+        _wait(lambda: client.job_status(job_id)["status"] in
+              ("RUNNING", "FINISHED"), 30, desc="rescale redeploy")
+        assert not client.rescale_job(job_id, 3)["accepted"]
+        assert not client.rescale_job(job_id, 9)["accepted"]
+        # beyond the spec's key-group count: refused regardless of slots
+        r = client.rescale_job(job_id, 17)
+        assert not r["accepted"] and "max-parallelism" in r["detail"]
+
+        # a late heartbeat carrying the CANCELLED attempt's snapshots is
+        # dropped by the attempt guard (it would otherwise re-land after
+        # the redeploy's clear and pollute the new attempt's aggregates —
+        # and the autoscaler's signal windows — forever); 2-tuple legacy
+        # keys from older TMs stay accepted
+        job = jm._jobs[job_id]
+        stale = job.attempt - 1
+        jm.heartbeat_tm(te.tm_id, steps={(job_id, 7, stale): 999},
+                        metrics={(job_id, 7, stale):
+                                 {"job.numRecordsIn": 1e9}})
+        assert 7 not in job.steps and 7 not in job.metric_snapshots
+        jm.heartbeat_tm(te.tm_id, metrics={(job_id, 7): {"probe": 1.0}})
+        assert 7 in job.metric_snapshots
+        del job.metric_snapshots[7]
+
+        # a checkpoint in flight when a rescale lands can never complete
+        # (the attempt guard rejects the dead attempt's acks, checkpoint
+        # ids are not reused): its stats record is swept to FAILED, like
+        # the _fail_job path, instead of sitting IN_PROGRESS forever
+        cp2 = _wait(lambda: client.trigger_checkpoint(job_id), 30,
+                    desc="checkpoint trigger on the rescaled attempt")
+        r = client.rescale_job(job_id, 2, "down while checkpoint pending")
+        assert r["accepted"], r
+        rec = client.job_checkpoint(job_id, cp2)
+        assert rec["status"] == "FAILED", rec
+        assert "superseded by rescale 3->2" in rec["failure_cause"]
+        assert client.job_checkpoints(job_id)["counts"]["in_progress"] == 0
+
+        st = _wait(lambda: (lambda s: s if s["status"] == "FINISHED" else None)(
+            client.job_status(job_id)), 90, desc="job finish")
+        assert st["restarts"] == 0          # budget untouched
+        assert st["rescales"] == 2
+        assert st["parallelism"] == 2
+
+        auto = client.job_autoscaler(job_id)
+        assert auto["num_rescales"] == 2
+        assert auto["last_rescale_duration_ms"] > 0
+        assert auto["enabled"] is False      # no policy attached
+        recs = client.job_exceptions(job_id)["recoveries"]
+        rescales = [r for r in recs if r.get("kind") == "rescale"]
+        assert len(rescales) == 2
+        assert all(r["restore_duration_ms"] > 0 for r in rescales)
+        assert "rescale 3->2" in rescales[0]["cause"]   # newest first
+        assert "rescale 2->3" in rescales[1]["cause"]
+        metrics = client.job_metrics(job_id)
+        assert metrics["jm"]["job.numRescales"] == 2
+        assert metrics["jm"]["job.lastRescaleDurationMs"] > 0
+
+        assert _collect(client.job_result(job_id)) == _expected_results(profile)
+    finally:
+        te.stop()
+        jm.heartbeats.stop()
+        svc_jm.stop()
+        svc_tm.stop()
+
+
+# ---------------------------------------------------------------------------
+# 5. load-spike acceptance e2e (ROADMAP item 2)
+# ---------------------------------------------------------------------------
+
+# arrival interval and amortized per-record service cost: at cost 0.2 ms a
+# single shard saturates near interval/cost ~ 280 records/step (with
+# per-record overhead), so the 2x step (324) saturates p=1 while p=2 keeps
+# ~0.55 nominal utilization per shard — enough headroom that sandbox timer
+# overshoot cannot saturate p=2 and pile an arrival backlog into the low
+# phase (a drained backlog reads as busy and masks the scale-down signal)
+_INTERVAL_S = 0.062
+_COST_S = 0.0002
+_PRE, _HIGH, _LOW = 162, 324, 40
+_PROFILE = [_PRE] * 40 + [_HIGH] * 120 + [_LOW] * 90
+
+
+def test_autoscaler_load_spike_scales_up_then_down(tmp_path):
+    """Acceptance: under autoscaler.enabled, a 2x traffic step saturates
+    the single shard and triggers a scale-up; measured throughput after
+    adaptation recovers to >= 1.5x the pre-step rate; the later load drop
+    triggers a scale-down; every rescale appears in the decision log AND
+    the recovery timeline with nonzero restore duration; final results are
+    exactly-once against a fixed-parallelism oracle."""
+    from flink_tpu.runtime.cluster import (
+        JobManagerEndpoint,
+        TaskExecutorEndpoint,
+    )
+    from flink_tpu.runtime.rpc import RpcService
+
+    pre_rate = _PRE / _INTERVAL_S                     # records/s offered
+    cfg = (Configuration()
+           .set(AutoscalerOptions.ENABLED, True)
+           .set(AutoscalerOptions.POLICY, "threshold")
+           .set(AutoscalerOptions.MIN_PARALLELISM, 1)
+           .set(AutoscalerOptions.MAX_PARALLELISM, 4)
+           .set(AutoscalerOptions.INTERVAL_MS, 200)
+           .set(AutoscalerOptions.SIGNAL_WINDOW, 6)
+           .set(AutoscalerOptions.STABILIZATION_INTERVAL_MS, 1500)
+           # thresholds sit far from every steady-state reading: the pre
+           # phase reads ~0.6 busy, p=1 saturation ~0.87+ (the self-channel
+           # fast path keeps the shuffle transit out of idle; checkpoint
+           # cost is excluded from busy), p=2 high ~0.55-0.7, the low
+           # phase ~0.1-0.3 — sandbox timer jitter inflates sleeps, so
+           # each phase needs real margin to its deciding threshold
+           .set(AutoscalerOptions.SCALE_UP_THRESHOLD, 0.85)
+           .set(AutoscalerOptions.SCALE_DOWN_THRESHOLD, 0.45))
+    spec = _load_spec(_PROFILE, _INTERVAL_S, cost_s=_COST_S)
+    svc_jm, svc_tm = RpcService(), RpcService()
+    jm = JobManagerEndpoint(
+        svc_jm, checkpoint_dir=str(tmp_path / "chk"),
+        checkpoint_interval=0.3, heartbeat_interval=0.2,
+        heartbeat_timeout=15.0, autoscaler_config=cfg,
+    )
+    te = TaskExecutorEndpoint(svc_tm, slots=4, shipping_interval_ms=200)
+    te.connect(svc_jm.address)
+    client = svc_jm.gateway(svc_jm.address, "jobmanager")
+    job_id = client.submit_job(spec.to_bytes(), 1)
+    try:
+        # measure the DELIVERED pre-step rate on the same wall clock the
+        # coordinator uses: under sandbox contention every paced sleep
+        # stretches, so the nominal offered rate can overstate what this
+        # run could ever deliver — the 1.5x recovery bar below is honest
+        # only against min(nominal, delivered). Count-bounded endpoints
+        # keep the window inside the pre phase at any slowdown.
+        def _records_in():
+            agg = client.job_metrics(job_id).get("job") or {}
+            return float(agg.get("job.numRecordsIn", 0.0))
+
+        c0 = _wait(lambda: (lambda c: c >= 2 * _PRE and c)(_records_in()), 30,
+                   interval=0.05, desc="pre-phase traffic")
+        t0 = time.monotonic()
+        c1 = _wait(lambda: (lambda c: c >= c0 + 25 * _PRE and c)(_records_in()),
+                   60, interval=0.05, desc="pre-phase measurement window")
+        measured_pre = (c1 - c0) / (time.monotonic() - t0)
+
+        # the 2x step must trigger a policy scale-up within a bounded window
+        _wait(lambda: client.job_status(job_id)["rescales"] >= 1, 30,
+              interval=0.1, desc="policy-driven scale-up")
+        st = client.job_status(job_id)
+        assert st["parallelism"] >= 2, st
+        auto = client.job_autoscaler(job_id)
+        ups = [d for d in auto["decisions"]
+               if d["action"] == "scale-up" and d["outcome"] == "executed"]
+        assert ups, auto["decisions"]
+        # the decision was driven by genuine saturation: the windowed
+        # utilization the policy saw cleared its configured threshold
+        assert ups[-1]["signals"]["utilization"] >= 0.85
+        # the tick's metric view carries the JM-side checkpoint gauges: an
+        # executed rescale required a completed checkpoint, so the signals
+        # it decided on must show its duration (0 would mean the signal
+        # extractor never saw job.lastCheckpointDuration on this path)
+        assert ups[-1]["signals"]["checkpoint_duration_ms"] > 0
+
+        # measure the delivered post-rescale rate over a ~20-step window
+        # on the same clock as the pre measurement, while the high phase
+        # is still offering. The redeploy reset the records counter (and
+        # any further rescale resets it again), so the anchor re-arms on
+        # a backwards step and the window is wholly within one attempt.
+        anchor = [None]
+
+        def _high_rate():
+            c = _records_in()
+            if anchor[0] is None or c < anchor[0][1]:
+                anchor[0] = (time.monotonic(), c)
+                return None
+            if c >= anchor[0][1] + 20 * _HIGH:
+                return (c - anchor[0][1]) / (time.monotonic() - anchor[0][0])
+            return None
+
+        measured_high = _wait(_high_rate, 60, interval=0.05,
+                              desc="post-rescale measurement window")
+
+        # the load drop scales back down
+        _wait(lambda: client.job_status(job_id)["rescales"] >= 2
+              or client.job_status(job_id)["status"] == "FINISHED", 45,
+              interval=0.1, desc="scale-down after load drop")
+        st = _wait(lambda: (lambda s: s if s["status"] == "FINISHED" else None)(
+            client.job_status(job_id)), 90, desc="job finish")
+        assert st["rescales"] >= 2, st
+        assert st["parallelism"] == 1, st
+        assert st["restarts"] == 0, st      # no failures, only rescales
+
+        auto = client.job_autoscaler(job_id)
+        downs = [d for d in auto["decisions"]
+                 if d["action"] == "scale-down" and d["outcome"] == "executed"]
+        assert downs, auto["decisions"]
+        assert downs[-1]["signals"]["utilization"] <= 0.45
+        assert auto["num_rescales"] == st["rescales"]
+        assert auto["last_rescale_duration_ms"] > 0
+        executed = [d for d in auto["decisions"] if d["outcome"] == "executed"]
+        assert all(d["duration_ms"] > 0 for d in executed)
+
+        # throughput after adaptation recovered to >= 1.5x the pre-step
+        # rate — p=1 capacity (~1.4x) cannot reach this, only the rescale
+        # can. The bar is the slower of the nominal offered rate and the
+        # rate this run actually delivered pre-step (both capacity and
+        # offered rate stretch by the same factor under contention, so
+        # the saturation story and the 1.5x margin are preserved); the
+        # measurement is the in-test 20-step window above — wide enough
+        # that one shipping stall cannot under-read a healthy rescale.
+        assert measured_pre > 0
+        baseline = min(pre_rate, measured_pre)
+        assert measured_high >= 1.5 * baseline, (
+            measured_high, pre_rate, measured_pre,
+            client.job_status(job_id), client.job_autoscaler(job_id))
+        # the coordinator's own (shorter) post-stabilization measurement
+        # also settled and closed the loop into the learning policy.
+        # Payload entries are copies, so re-read the settled log.
+        ups = [d for d in auto["decisions"]
+               if d["action"] == "scale-up" and d["outcome"] == "executed"]
+        settled = [d for d in ups if d["throughput_after"] is not None]
+        assert settled, f"scale-up outcome never settled: {auto['decisions']}"
+        assert settled[-1]["throughput_after"] > 0
+
+        # every rescale in the recovery timeline, restore durations nonzero
+        recs = client.job_exceptions(job_id)["recoveries"]
+        rescales = [r for r in recs if r.get("kind") == "rescale"]
+        assert len(rescales) == st["rescales"]
+        assert all(r["restore_duration_ms"] > 0 for r in rescales)
+        assert all(r["restored_checkpoint_id"] is not None for r in rescales)
+
+        # exactly-once: no dropped or duplicated outputs vs the oracle
+        assert _collect(client.job_result(job_id)) == _expected_results(_PROFILE)
+    finally:
+        te.stop()
+        jm.heartbeats.stop()
+        svc_jm.stop()
+        svc_tm.stop()
+
+
+# ---------------------------------------------------------------------------
+# 6. MiniCluster: observe-only autoscaler
+# ---------------------------------------------------------------------------
+
+def test_minicluster_autoscaler_observe_only():
+    """An in-process job runs as one task, so the coordinator attaches in
+    observe-only mode: decisions are logged (outcome 'observe-only'),
+    gauges register, nothing rescales."""
+    from flink_tpu.api.datastream import StreamExecutionEnvironment
+    from flink_tpu.config import ExecutionOptions
+    from flink_tpu.connectors.sink import CollectSink
+    from flink_tpu.connectors.source import Batch, DataGeneratorSource
+    from flink_tpu.core.watermarks import WatermarkStrategy
+    from flink_tpu.runtime.minicluster import MiniCluster
+    from flink_tpu.utils.arrays import obj_array
+
+    def gen(idx):
+        return Batch(obj_array([int(i) & 7 for i in idx]),
+                     (idx * 10).astype("int64"))
+
+    cfg = (Configuration()
+           .set(ExecutionOptions.BATCH_SIZE, 1024)
+           .set(AutoscalerOptions.ENABLED, True)
+           .set(AutoscalerOptions.INTERVAL_MS, 10)
+           .set(AutoscalerOptions.STABILIZATION_INTERVAL_MS, 0)
+           # threshold 0 => every warm window proposes a scale-up, so the
+           # observe-only log fills deterministically
+           .set(AutoscalerOptions.SCALE_UP_THRESHOLD, 0.0))
+    env = StreamExecutionEnvironment(cfg)
+    env.from_source(
+        DataGeneratorSource(gen, count=100_000),
+        watermark_strategy=WatermarkStrategy.for_monotonous_timestamps(),
+    ).map(lambda x: x).sink_to(CollectSink())
+    client = env.execute_async("observe-only")
+    MiniCluster.get_shared().jobs.setdefault(client.job_id, client)
+    client.wait(60)
+
+    auto = getattr(client, "autoscaler", None)
+    assert auto is not None
+    payload = auto.payload(client.job_id)
+    assert payload["enabled"] and payload["num_rescales"] == 0
+    assert payload["decisions"], "no decisions logged"
+    assert all(d["outcome"] == "observe-only" for d in payload["decisions"])
+    assert all(d["action"] == "scale-up" for d in payload["decisions"])
+    # gauges registered on the job registry
+    metrics = {k: m.value() for k, m in client.metrics.all_metrics().items()}
+    assert metrics.get("job.numRescales") == 0

@@ -109,8 +109,15 @@ def test_leader_election_failover(tmp_path):
     time.sleep(0.3)
     assert not b.is_leader  # lease held and renewed
     a.stop(release=True)
+
+    # b flips is_leader before its lease record hits the file, so wait on
+    # the published record rather than asserting it right after takeover.
+    def b_published():
+        rec = b.current_leader()
+        return b.is_leader and rec is not None and rec["leader"] == "b"
+
     deadline = time.time() + 3
-    while not b.is_leader and time.time() < deadline:
+    while not b_published() and time.time() < deadline:
         time.sleep(0.05)
     assert b.is_leader
     assert b.current_leader()["leader"] == "b"
@@ -884,10 +891,40 @@ def _partition_invariant_spec(n_steps=30, batch=60, n_keys=9):
     )
 
 
+class _GatedList(list):
+    """Source batches lightly paced, BLOCKING at `gate_step` until the
+    `resume` flag file appears — the test, not the clock, decides when
+    the stream may end (picklable; the gate survives restarts because
+    every attempt re-runs the factory)."""
+
+    def __init__(self, items, gate_step, resume_flag, delay=0.08):
+        super().__init__(items)
+        self.gate_step = gate_step
+        self.resume_flag = resume_flag
+        self.delay = delay
+
+    def __getitem__(self, i):
+        time.sleep(self.delay)
+        if i >= self.gate_step:
+            deadline = time.time() + 120
+            while not os.path.exists(self.resume_flag):
+                if time.time() > deadline:
+                    raise RuntimeError("resume flag never appeared")
+                time.sleep(0.05)
+        return super().__getitem__(i)
+
+
 def test_cluster_rescales_down_after_tm_loss(tmp_path):
     """Lose a TM with no replacement: the adaptive scheduler restarts the
     job at parallelism 1 from the checkpoint, re-sharding state by
-    key-group; results stay exact."""
+    key-group; results stay exact.
+
+    Deterministic by construction (this was checkpoint-timing flaky under
+    suite load): the source GATES at a step past the checkpoint window and
+    only the test's resume flag lets the stream end, so the job cannot
+    race to FINISHED before the heartbeat timeout notices the dead TM —
+    every transition below is a condition wait, not a sleep budget."""
+    resume = str(tmp_path / "resume")
     svc_jm = RpcService()
     jm = JobManagerEndpoint(
         svc_jm, checkpoint_dir=str(tmp_path / "chk"),
@@ -897,36 +934,53 @@ def test_cluster_rescales_down_after_tm_loss(tmp_path):
     spec = _partition_invariant_spec()
     orig_factory = spec.source_factory
 
-    def slow_factory(shard, num_shards):
-        return _SlowList(orig_factory(shard, num_shards), delay=0.1)
+    def gated_factory(shard, num_shards, _orig=orig_factory, _resume=resume):
+        return _GatedList(_orig(shard, num_shards), gate_step=20,
+                          resume_flag=_resume)
 
-    spec.source_factory = slow_factory
+    spec.source_factory = gated_factory
 
     svc1, svc2 = RpcService(), RpcService()
-    te1 = TaskExecutorEndpoint(svc1, slots=1)
+    # sub-500ms shipping tightens the heartbeat beat, so the JM's view of
+    # step progress is fresh enough for the checkpoint target margin
+    te1 = TaskExecutorEndpoint(svc1, slots=1, shipping_interval_ms=200)
     te1.connect(svc_jm.address)
-    te2 = TaskExecutorEndpoint(svc2, slots=1)
+    te2 = TaskExecutorEndpoint(svc2, slots=1, shipping_interval_ms=200)
     te2.connect(svc_jm.address)
     client = svc_jm.gateway(svc_jm.address, "jobmanager")
     job_id = client.submit_job(spec.to_bytes(), 2)
 
-    deadline = time.time() + 30
-    while time.time() < deadline:
-        if client.trigger_checkpoint(job_id) and client.job_status(job_id)["checkpoints"]:
-            break
-        time.sleep(0.3)
-    assert client.job_status(job_id)["checkpoints"]
+    def wait_for(predicate, timeout, desc):
+        deadline = time.time() + timeout
+        while time.time() < deadline:
+            got = predicate()
+            if got:
+                return got
+            time.sleep(0.2)
+        raise AssertionError(
+            f"timed out waiting for {desc}: {client.job_status(job_id)}")
+
+    wait_for(lambda: client.trigger_checkpoint(job_id)
+             and client.job_status(job_id)["checkpoints"],
+             60, "a completed checkpoint")
     te2.stop()
     svc2.stop()        # no replacement: must downscale to te1 alone
 
-    deadline = time.time() + 90
-    while time.time() < deadline:
-        st = client.job_status(job_id)
-        if st["status"] in ("FINISHED", "FAILED"):
-            break
-        time.sleep(0.3)
+    # the gate holds the stream open, so the ONLY way forward is the
+    # heartbeat timeout -> fail -> adaptive reschedule at parallelism 1
+    wait_for(lambda: (lambda s: s["restarts"] >= 1
+                      and s["status"] == "RUNNING"
+                      and s["parallelism"] == 1)(client.job_status(job_id)),
+             60, "adaptive rescale-down to the surviving TM")
+
+    (tmp_path / "resume").touch()      # release the stream end
+    st = wait_for(lambda: (lambda s: s if s["status"] in
+                           ("FINISHED", "FAILED") else None)(
+                               client.job_status(job_id)),
+                  90, "job completion")
     assert st["status"] == "FINISHED", st
     assert st["restarts"] >= 1
+    assert st["parallelism"] == 1
     got = _collect(client.job_result(job_id))
     want = _expected(_partition_invariant_spec(), 1)
     assert got == want

@@ -77,6 +77,17 @@ def test_path_scoped_rules_are_not_vacuous():
         assert any(index.in_subtree(layer)), (
             f"layer {layer!r} has no modules — LAYER_FORBIDDEN is stale "
             f"and ARCH001 is vacuous for it")
+    # the scheduler layer must stay REGISTERED, not merely existent: a
+    # deleted dict entry would leave scheduler/ free to grow runtime
+    # imports with every test still green
+    assert "scheduler" in LAYER_FORBIDDEN, (
+        "scheduler layer unregistered from ARCH001 — the autoscaler may "
+        "not import the runtime (rescales flow through injected callables)")
+    assert any("runtime" in b for b in LAYER_FORBIDDEN["scheduler"]), (
+        "scheduler layer no longer forbids runtime imports")
+    assert "metrics" in LAYER_FORBIDDEN and any(
+        "scheduler" in b for b in LAYER_FORBIDDEN["metrics"]), (
+        "metrics layer no longer forbids importing the scheduler")
     for rel in CONTROL_PLANE:
         assert index.get(rel) is not None, (
             f"control-plane module {rel} missing — CONTROL_PLANE is stale "

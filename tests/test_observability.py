@@ -500,16 +500,22 @@ def test_tm_ships_metrics_and_spans_to_jm_with_matching_trace_ids(tmp_path):
             f"no checkpoint completed mid-run (savepoint requested: "
             f"{sp_requested}, failed: {status['savepoints_failed']})")
 
-        # TM-shipped metric snapshots reach the JM (last heartbeat may lag)
+        # TM-shipped metric snapshots reach the JM (last heartbeat may lag).
+        # snap and agg must come from ONE job_metrics response: the JM folds
+        # the aggregate from the same snapshot store at serve time, but a
+        # final post-FINISH ship landing between two separate calls makes
+        # them disagree.
         deadline = time.time() + 10
-        per_shard = {}
+        metrics = {"per_shard": {}}
         spans = []
         while time.time() < deadline:
-            per_shard = client.job_metrics(job_id)["per_shard"]
+            metrics = client.job_metrics(job_id)
             spans = client.job_spans(job_id)
-            if per_shard and any(s["name"] == "CheckpointAck" for s in spans):
+            if metrics["per_shard"] and any(
+                    s["name"] == "CheckpointAck" for s in spans):
                 break
             time.sleep(0.2)
+        per_shard = metrics["per_shard"]
         assert per_shard, "TM never shipped a metric snapshot"
         snap = per_shard[0]
         assert snap["job.numRecordsIn"] > 0
@@ -517,7 +523,7 @@ def test_tm_ships_metrics_and_spans_to_jm_with_matching_trace_ids(tmp_path):
         # the keyed hot path carries real task IO ratios, so the
         # backpressure view below isn't trivially zero
         assert 0 < snap["job.busyTimeRatio"] <= 1.0
-        agg = client.job_metrics(job_id)["job"]
+        agg = metrics["job"]
         assert agg["job.numRecordsIn"] == snap["job.numRecordsIn"]
 
         # spans from BOTH processes, all on the derived trace id

@@ -1,0 +1,212 @@
+"""Property tests for the key-group remap (flink_tpu/state/key_groups.py).
+
+The invariant rescaling rests on: for ANY (max_parallelism, old_p, new_p)
+pair, every key group is owned by exactly one subtask before and after
+the remap — no state lost, none duplicated. These tests sweep the
+parameter space instead of picking one config, because the off-by-one
+surface of ceil/floor range math is exactly where a hand-picked example
+stays green while a boundary pair corrupts state.
+"""
+
+import numpy as np
+import pytest
+
+from flink_tpu.core.keygroups import assign_to_key_group
+from flink_tpu.state.key_groups import (
+    filter_timers_for_range,
+    merge_keyed_state,
+    merge_timers,
+    owner_of_key_group,
+    ranges_for_parallelism,
+    reshardable,
+    split_merged_snapshot,
+    verify_partition,
+)
+
+MAXES = (1, 2, 3, 7, 16, 127, 128)
+
+
+def _parallelisms(max_p):
+    """All legal parallelisms up to 17, plus the extremes."""
+    return sorted(p for p in ({1, 2, 3, max(max_p // 2, 1), max_p} |
+                              set(range(1, min(max_p, 17) + 1)))
+                  if 1 <= p <= max_p)
+
+
+@pytest.mark.parametrize("max_p", MAXES)
+def test_every_key_group_owned_by_exactly_one_subtask(max_p):
+    for p in _parallelisms(max_p):
+        verify_partition(max_p, p)
+
+
+@pytest.mark.parametrize("max_p", (7, 16, 128))
+def test_owner_agrees_with_range_membership(max_p):
+    for p in _parallelisms(max_p):
+        ranges = ranges_for_parallelism(max_p, p)
+        for kg in range(max_p):
+            idx = owner_of_key_group(max_p, p, kg)
+            assert ranges[idx].contains(kg)
+            assert sum(r.contains(kg) for r in ranges) == 1
+
+
+def _shard_state(max_p, old_p, shard, n_keys=200):
+    """Heap-table snapshot fragment for one shard: {name: {kg: {key: v}}}
+    holding exactly the keys whose key group the shard owns."""
+    tables = {"window-contents": {}, "timers-aux": {}}
+    rng = ranges_for_parallelism(max_p, old_p)[shard]
+    for k in range(n_keys):
+        kg = assign_to_key_group(k, max_p)
+        if not rng.contains(kg):
+            continue
+        tables["window-contents"].setdefault(kg, {})[k] = k * 10
+        if k % 3 == 0:
+            tables["timers-aux"].setdefault(kg, {})[k] = -k
+    return tables
+
+
+@pytest.mark.parametrize("old_p,new_p", [
+    (1, 2), (2, 1), (2, 3), (3, 2), (2, 4), (4, 2), (5, 7), (7, 5),
+    (1, 16), (16, 1), (3, 16), (16, 3),
+])
+def test_merge_then_refilter_loses_and_duplicates_nothing(old_p, new_p):
+    """The rescale round trip: per-shard tables at old_p merge into one
+    logical view; each new_p subtask keeps the key groups in its range;
+    the union equals the original and the pieces are pairwise disjoint."""
+    max_p = 16
+    per_shard = [_shard_state(max_p, old_p, s) for s in range(old_p)]
+    merged = merge_keyed_state(per_shard)
+
+    # the merged view holds every (name, kg, key) exactly once
+    original = {}
+    for tables in per_shard:
+        for name, table in tables.items():
+            for kg, entries in table.items():
+                for k, v in entries.items():
+                    assert (name, kg, k) not in original
+                    original[(name, kg, k)] = v
+    flat_merged = {
+        (name, kg, k): v
+        for name, table in merged.items()
+        for kg, entries in table.items()
+        for k, v in entries.items()
+    }
+    assert flat_merged == original
+
+    # re-split to new_p: every entry lands in exactly one new subtask
+    new_ranges = ranges_for_parallelism(max_p, new_p)
+    seen = {}
+    for idx, rng in enumerate(new_ranges):
+        for name, table in merged.items():
+            for kg, entries in table.items():
+                if not rng.contains(kg):
+                    continue
+                for k, v in entries.items():
+                    assert (name, kg, k) not in seen, (
+                        f"{(name, kg, k)} owned by both subtask "
+                        f"{seen[(name, kg, k)]} and {idx}")
+                    seen[(name, kg, k)] = idx
+    assert set(seen) == set(original)
+
+
+@pytest.mark.parametrize("old_p,new_p", [(2, 3), (3, 1), (1, 4), (4, 4)])
+def test_timer_merge_and_filter_round_trip(old_p, new_p):
+    """Timers (time, key) concatenate on merge and re-split by the key's
+    key group: each timer survives in exactly one new subtask; the merged
+    watermark is the min over shards."""
+    max_p = 16
+    rng = np.random.default_rng(7)
+    ranges_old = ranges_for_parallelism(max_p, old_p)
+    per_shard = []
+    all_timers = set()
+    for s in range(old_p):
+        ev, pr = [], []
+        for k in sorted(set(rng.integers(0, 500, 40).tolist())):
+            kg = assign_to_key_group(int(k), max_p)
+            if not ranges_old[s].contains(kg):
+                continue
+            ev.append((int(k) * 7, int(k)))
+            pr.append((int(k) * 11, int(k)))
+            all_timers.add(int(k))
+        per_shard.append({"event": ev, "proc": pr, "watermark": 1000 + s})
+    merged = merge_timers(per_shard)
+    assert merged["watermark"] == 1000
+    assert len(merged["event"]) == len(all_timers)
+
+    claimed = {}
+    for idx, r in enumerate(ranges_for_parallelism(max_p, new_p)):
+        mine = filter_timers_for_range(merged, r, max_p)
+        assert mine["watermark"] == 1000
+        for _t, k in mine["event"]:
+            assert k not in claimed, f"timer key {k} in subtasks {claimed[k]} and {idx}"
+            claimed[k] = idx
+        # proc timers filter identically
+        assert {k for _t, k in mine["proc"]} == \
+               {k for _t, k in mine["event"]}
+    assert set(claimed) == all_timers
+
+
+@pytest.mark.parametrize("old_p,new_p", [
+    (1, 2), (2, 1), (2, 3), (3, 2), (1, 16), (16, 1), (5, 7),
+])
+def test_split_merged_snapshot_partitions_state_exactly(old_p, new_p):
+    """The JM-side pre-split (each new shard ships only its own slice):
+    the shards' state and timers must union back to the merged view with
+    no entry lost or duplicated; results ride with shard 0 only; the
+    step and merged markers survive on every slice."""
+    max_p = 16
+    per_shard = [_shard_state(max_p, old_p, s) for s in range(old_p)]
+    merged_state = merge_keyed_state(per_shard)
+    timers = merge_timers([
+        {"event": [(k * 7, k) for kg in tables["window-contents"]
+                   for k in tables["window-contents"][kg]],
+         "proc": [], "watermark": 500 + s}
+        for s, tables in enumerate(per_shard)
+    ])
+    merged = {"operator": {"state": merged_state, "timers": timers},
+              "results": [("a", 1), ("b", 2)], "step": 9, "merged": True}
+    split = split_merged_snapshot(merged, max_p, new_p)
+    assert set(split) == set(range(new_p))
+
+    seen_state, seen_timers = {}, {}
+    for shard, snap in split.items():
+        assert snap["step"] == 9 and snap["merged"] is True
+        assert snap["results"] == (merged["results"] if shard == 0 else [])
+        assert snap["operator"]["timers"]["watermark"] == 500
+        for name, table in snap["operator"]["state"].items():
+            for kg, entries in table.items():
+                for k, v in entries.items():
+                    assert (name, kg, k) not in seen_state
+                    seen_state[(name, kg, k)] = (shard, v)
+        for _t, k in snap["operator"]["timers"]["event"]:
+            assert k not in seen_timers
+            seen_timers[k] = shard
+    flat_merged = {
+        (name, kg, k): v
+        for name, table in merged_state.items()
+        for kg, entries in table.items()
+        for k, v in entries.items()
+    }
+    assert {key: v for key, (_s, v) in seen_state.items()} == flat_merged
+    assert set(seen_timers) == {k for _t, k in timers["event"]}
+
+
+def test_merge_timers_tolerates_missing_and_none_watermarks():
+    merged = merge_timers([
+        None,
+        {"event": [(1, 5)], "proc": [], "watermark": None},
+        {"event": [], "proc": [(2, 6)], "watermark": 42},
+    ])
+    assert merged["watermark"] == 42
+    assert merged["event"] == [(1, 5)] and merged["proc"] == [(2, 6)]
+
+
+def test_reshardable_rejects_device_operator_snapshots():
+    ok, why = reshardable({0: {"operator": {"state": {}, "timers": {}}}})
+    assert ok and why == ""
+    for marker in ("columnar", "cnt"):
+        ok, why = reshardable({
+            0: {"operator": {"state": {}}},
+            1: {"operator": {marker: object()}},
+        })
+        assert not ok
+        assert "device" in why
