@@ -110,15 +110,25 @@ class DataStream:
         return DataStream(self.env, Transformation(kind, name, [self.transform], config))
 
     # -- record-local ops --------------------------------------------------
-    def map(self, fn: Callable, name: str = "map", vectorized: bool = False) -> "DataStream":
+    def map(self, fn: Callable, name: str = "map", vectorized: bool = False,
+            traceable: bool = False) -> "DataStream":
         """Per-record transform. With vectorized=True, fn receives the whole
         value column (numpy array) and must return an equal-length column —
         the chain then executes as array ops instead of a Python loop (the
         TPU-native form of operator chaining: the reference fuses chained
         operators into direct calls, StreamingJobGraphGenerator.java:1730;
-        here a chain fuses into columnar kernels)."""
+        here a chain fuses into columnar kernels).
+
+        traceable=True (implies vectorized) additionally declares fn to be a
+        pure jax-traceable column function (array ufunc ops only, no data-
+        dependent shapes or host calls): the chain then qualifies for
+        whole-graph fusion, compiling together with a downstream keyed
+        window aggregate into ONE jitted device program (docs/fusion.md)."""
         fn = fn.map if hasattr(fn, "map") else fn
-        return self._derive("map", name, {"fn": fn, "vectorized": vectorized})
+        return self._derive("map", name, {
+            "fn": fn, "vectorized": vectorized or traceable,
+            "traceable": traceable,
+        })
 
     def map_batch(self, fn: Callable, name: str = "map_batch") -> "DataStream":
         """1:1 transform over the whole step batch at once (list -> list of
@@ -127,10 +137,16 @@ class DataStream:
         return DataStream(self.env, t)
 
     def map_with_timestamp(self, fn: Callable, name: str = "map_ts",
-                           vectorized: bool = False) -> "DataStream":
+                           vectorized: bool = False,
+                           traceable: bool = False) -> "DataStream":
         """map over (value, event_timestamp_ms) pairs. Vectorized form:
-        fn(values_column, timestamps_column) -> values_column."""
-        return self._derive("map_ts", name, {"fn": fn, "vectorized": vectorized})
+        fn(values_column, timestamps_column) -> values_column. traceable=True
+        declares a jax-traceable column fn eligible for whole-graph fusion
+        (see map())."""
+        return self._derive("map_ts", name, {
+            "fn": fn, "vectorized": vectorized or traceable,
+            "traceable": traceable,
+        })
 
     def flat_map(self, fn: Callable, name: str = "flat_map",
                  vectorized: bool = False) -> "DataStream":
@@ -140,11 +156,16 @@ class DataStream:
         fn = fn.flat_map if hasattr(fn, "flat_map") else fn
         return self._derive("flat_map", name, {"fn": fn, "vectorized": vectorized})
 
-    def filter(self, fn: Callable, name: str = "filter", vectorized: bool = False) -> "DataStream":
+    def filter(self, fn: Callable, name: str = "filter",
+               vectorized: bool = False, traceable: bool = False) -> "DataStream":
         """Predicate filter. Vectorized form: fn(values_column) returns a
-        boolean mask over the column."""
+        boolean mask over the column. traceable=True declares a
+        jax-traceable mask fn eligible for whole-graph fusion (see map())."""
         fn = fn.filter if hasattr(fn, "filter") else fn
-        return self._derive("filter", name, {"fn": fn, "vectorized": vectorized})
+        return self._derive("filter", name, {
+            "fn": fn, "vectorized": vectorized or traceable,
+            "traceable": traceable,
+        })
 
     def async_map(
         self,
@@ -280,13 +301,21 @@ class DataStream:
         return IterativeStream(self.env, t)
 
     def key_by(self, key_selector: Callable, name: str = "key_by",
-               vectorized: bool = False) -> "KeyedStream":
+               vectorized: bool = False, traceable: bool = False) -> "KeyedStream":
         """Partition by key. Vectorized form: key_selector(values_column)
-        returns the whole key column — keeps the hot ingest path columnar."""
+        returns the whole key column — keeps the hot ingest path columnar.
+
+        traceable=True (implies vectorized) declares the selector to be a
+        pure jax-traceable column function returning NON-NEGATIVE INTEGER
+        keys below `execution.state.key-capacity`: the key column is then
+        computed on device and a downstream eligible window aggregate fuses
+        with this step's chain into one device program (docs/fusion.md)."""
+        vectorized = vectorized or traceable
         sel = as_key_selector(key_selector) if not vectorized else key_selector
         t = Transformation(
             "key_by", name, [self.transform],
-            {"key_selector": sel, "vectorized": vectorized},
+            {"key_selector": sel, "vectorized": vectorized,
+             "traceable": traceable},
         )
         return KeyedStream(self.env, t)
 
@@ -544,7 +573,8 @@ class WindowedStream:
         return self
 
     def _agg_transform(self, aggregate, value_fn, window_fn, name,
-                       value_vectorized: bool = False) -> DataStream:
+                       value_vectorized: bool = False,
+                       value_traceable: bool = False) -> DataStream:
         t = Transformation(
             "window_aggregate",
             name,
@@ -553,7 +583,8 @@ class WindowedStream:
                 "assigner": self._assigner,
                 "aggregate": aggregate,
                 "value_fn": value_fn,
-                "value_vectorized": value_vectorized,
+                "value_vectorized": value_vectorized or value_traceable,
+                "value_traceable": value_traceable,
                 "window_fn": window_fn,
                 "trigger": self._trigger,
                 "evictor": self._evictor,
@@ -561,6 +592,7 @@ class WindowedStream:
                 "side_output_late": self._side_output_late,
                 "key_selector": self._keyed.key_selector,
                 "key_vectorized": self._keyed.transform.config.get("vectorized", False),
+                "key_traceable": self._keyed.transform.config.get("traceable", False),
             },
         )
         return DataStream(self._keyed.env, t)
@@ -572,13 +604,17 @@ class WindowedStream:
         window_fn=None,
         name: str = "window_aggregate",
         value_vectorized: bool = False,
+        value_traceable: bool = False,
     ) -> DataStream:
         """`aggregate` is a builtin name ('sum'/'count'/'min'/'max'/'mean'),
         a DeviceAggregator (device path), or an AggregateFunction (oracle).
         `value_fn` extracts the numeric column for device aggregation; with
-        value_vectorized=True it maps the whole values column at once."""
+        value_vectorized=True it maps the whole values column at once, and
+        value_traceable=True additionally declares it jax-traceable so the
+        extraction runs inside the fused device program (docs/fusion.md)."""
         return self._agg_transform(aggregate, value_fn, window_fn, name,
-                                   value_vectorized=value_vectorized)
+                                   value_vectorized=value_vectorized,
+                                   value_traceable=value_traceable)
 
     def reduce(self, fn: Callable, name: str = "window_reduce") -> DataStream:
         from flink_tpu.api.functions import ReduceAggregate

@@ -237,6 +237,18 @@ class ExecutionOptions:
         "out-of-orderness stays below the session gap — set to false to force "
         "the per-record oracle for streams with larger disorder."
     )
+    CHAIN_FUSION = (
+        ConfigOptions.key("execution.chain.device-fusion").bool_type().default_value(True)
+    ).with_description(
+        "Compile eligible operator chains (traceable map/filter/map_ts "
+        "prologue + traceable keyBy/value extraction + device-eligible "
+        "event-time window aggregate) into ONE jitted multi-step device "
+        "program with device-resident intermediates (whole-graph fusion, "
+        "docs/fusion.md). Requires execution.window.fused; UDFs must be "
+        "declared traceable=True at the API. Off, or for any ineligible "
+        "chain, execution keeps the per-step ChainRunner + window operator "
+        "path with identical results."
+    )
     SUPERBATCH_STEPS = (
         ConfigOptions.key("execution.window.superbatch-steps").int_type().default_value(32)
     ).with_description(

@@ -1,0 +1,171 @@
+"""Whole-graph fusion planner: classify chain steps as device-fusable.
+
+The reference's StreamingJobGraphGenerator chains record-local operators
+into one task so records flow by direct method calls instead of network
+hops (StreamingJobGraphGenerator.java:1730 isChainable). The TPU-native
+form goes one level further: an eligible chain — vectorized, jax-traceable
+map/filter/map_ts prologue feeding a device-eligible keyed window
+aggregate — compiles into ONE jitted multi-step device program
+(`lax.scan` over T batches) with device-resident intermediates. The host
+never materializes the post-transform columns, the key column, or the
+value column: filter + projection + key/value extraction + window ingest +
+fire + purge are a single XLA program per superbatch.
+
+This module is the *planner* only: it walks a planned StepGraph and
+decides, per keyed window step, whether the step (and the pure chain step
+feeding it, if any) can take the fused device path. The decision is
+returned as a `DeviceChainPlan` that the executor threads into a
+`DeviceChainRunner` (runtime/executor.py); everything ineligible keeps
+today's ChainRunner / WindowStepRunner path with unchanged semantics.
+
+Layering: this module lives in `graph` and may import `ops`/`core`,
+never `runtime` (ARCH001) — the plan is pure data about transformations.
+
+Eligibility ("On the Semantic Overlap of Operators in Stream Processing
+Engines" grounds which record-local operators collapse safely):
+
+- the window terminal resolves to a DeviceAggregator whose fields all
+  scatter-combine (add/min/max), on a sliceable event-time assigner, with
+  no custom trigger/evictor/window function, zero allowed lateness and no
+  late-data side output — the same bar as the fused superscan operator;
+- the key selector (and value_fn, if any) is declared `traceable=True` at
+  the API: a pure function of the value column using only jax-traceable
+  array ops, returning non-negative int keys below the configured key
+  capacity;
+- every transform of the upstream chain (if one feeds the window step) is
+  map/filter/map_ts declared `traceable=True`; flat_map changes
+  cardinality dynamically and always falls back;
+- the chain step feeds only this window step (a second consumer needs the
+  host-side columns, so fusing would corrupt its input) and shares its
+  slot-sharing group (a group boundary is a stage boundary).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Optional, Set, Tuple
+
+from flink_tpu.graph.transformation import Step, StepGraph, Transformation
+from flink_tpu.ops.aggregators import resolve
+
+#: chain kinds with a traced device form; flat_map is excluded (dynamic
+#: cardinality has no static-shape trace), map_batch is host-only by design
+TRACEABLE_CHAIN_KINDS = {"map", "filter", "map_ts"}
+
+
+@dataclasses.dataclass
+class DeviceChainPlan:
+    """One fused device chain: the traced prologue transformations (in
+    application order, possibly empty), the window terminal, and the input
+    edges the fused runner consumes (the absorbed chain step's inputs, or
+    the window step's own when nothing was absorbed)."""
+
+    transforms: List[Transformation]
+    terminal: Transformation
+    inputs: List            # (producer, ordinal, tag) edges, executor wiring
+    absorbed: Optional[Step] = None   # the chain step folded into the program
+
+    @property
+    def name(self) -> str:
+        parts = [t.name for t in self.transforms] + [self.terminal.name]
+        return " => ".join(parts)
+
+
+def window_is_device_fusable(t: Transformation) -> bool:
+    """Does this window_aggregate terminal qualify for the traced path?"""
+    if t.kind != "window_aggregate":
+        return False
+    cfg = t.config
+    if not cfg.get("key_traceable"):
+        return False
+    agg = resolve(cfg.get("aggregate"))
+    if agg is None or any(f.scatter not in ("add", "min", "max") for f in agg.fields):
+        return False
+    assigner = cfg.get("assigner")
+    if assigner is None or assigner.slice_ms is None or not assigner.is_event_time:
+        return False
+    if cfg.get("trigger") is not None or cfg.get("evictor") is not None:
+        return False
+    if cfg.get("window_fn") is not None:
+        return False
+    if cfg.get("allowed_lateness", 0) != 0 or cfg.get("side_output_late"):
+        return False
+    if cfg.get("value_fn") is not None and not cfg.get("value_traceable"):
+        return False
+    return True
+
+
+def chain_is_traceable(chain: List[Transformation]) -> bool:
+    """Every transform of a pure chain step has a traced device form."""
+    return all(
+        t.kind in TRACEABLE_CHAIN_KINDS and t.config.get("traceable")
+        for t in chain
+    )
+
+
+def _step_consumers(graph: StepGraph) -> Dict[int, int]:
+    """id(step) -> number of consuming edges across the graph (main-channel
+    and side-channel alike: any second consumer pins the step on host)."""
+    counts: Dict[int, int] = {}
+    for s in graph.steps:
+        for edge in s.inputs:
+            ent = edge[0]
+            if isinstance(ent, Step):
+                counts[id(ent)] = counts.get(id(ent), 0) + 1
+    return counts
+
+
+def plan_device_chains(
+    graph: StepGraph,
+) -> Tuple[Dict[int, DeviceChainPlan], Set[int]]:
+    """Walk the StepGraph; return ({id(window_step): plan}, absorbed_ids).
+
+    Steps in `absorbed_ids` (pure chain steps whose whole body was folded
+    into a fused program) must not get a runner of their own; the window
+    step's runner consumes the absorbed step's input edges instead."""
+    plans: Dict[int, DeviceChainPlan] = {}
+    absorbed: Set[int] = set()
+    consumers = _step_consumers(graph)
+
+    for step in graph.steps:
+        t = step.terminal
+        if t is None or not window_is_device_fusable(t):
+            continue
+        if step.partitioning != "key_group" or len(step.inputs) != 1:
+            continue
+        producer, _ordinal, tag = step.inputs[0][0], step.inputs[0][1], (
+            step.inputs[0][2] if len(step.inputs[0]) > 2 else None)
+        if tag is not None:
+            # a side-output channel feeds this window: the producer's side
+            # rows are host objects; keep the host path
+            continue
+        if (
+            isinstance(producer, Step)
+            and producer.terminal is None
+            and chain_is_traceable(producer.chain)
+            and consumers.get(id(producer), 0) == 1
+            and producer.slot_group == step.slot_group
+            and len(producer.inputs) == 1
+        ):
+            plans[id(step)] = DeviceChainPlan(
+                transforms=list(producer.chain),
+                terminal=t,
+                inputs=list(producer.inputs),
+                absorbed=producer,
+            )
+            absorbed.add(id(producer))
+        else:
+            # no absorbable chain: fuse key/value extraction + window alone
+            plans[id(step)] = DeviceChainPlan(
+                transforms=[], terminal=t, inputs=list(step.inputs),
+            )
+    return plans, absorbed
+
+
+def describe(plans: Dict[int, DeviceChainPlan]) -> str:
+    """Human-readable plan summary (mirrors StepGraph.describe)."""
+    return "\n".join(
+        f"device-chain[{i}]: {p.name}"
+        + (f" (absorbs {p.absorbed.name})" if p.absorbed is not None else "")
+        for i, p in enumerate(plans.values())
+    )

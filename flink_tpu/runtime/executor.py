@@ -42,7 +42,7 @@ from flink_tpu.runtime.timers import InternalTimerService
 from flink_tpu.metrics.registry import MetricRegistry
 from flink_tpu.metrics.task_io import DeviceTimer, TaskIOMetrics
 from flink_tpu.state.heap import HeapKeyedStateBackend, value_state
-from flink_tpu.utils.arrays import obj_array
+from flink_tpu.utils.arrays import as_device_column, canonical_column, obj_array
 from flink_tpu.core.keygroups import KeyGroupRange
 
 
@@ -188,6 +188,31 @@ class StepRunner:
         pass
 
 
+def _fused_chunk(batch_size: int) -> int:
+    """Superscan ingest chunk for a configured batch size: the next power
+    of two, clamped to [256, 4096] — one policy for the classic fused
+    window runner and the fused device chain, so the two paths can never
+    silently drift to different dispatch geometries."""
+    return min(4096, max(256, 1 << (max(batch_size, 1) - 1).bit_length()))
+
+
+def _columnarize_records(vals, where: str):
+    """Record-mode (object column) → numeric column, for UDFs declared
+    traceable=True: the declared contract is a numeric column function, so
+    the host fallback paths must feed them the same representation the
+    fused device path stages (CHAIN_FUSION is a perf switch, never a
+    semantics switch). Raises if the records do not columnarize."""
+    arr = np.asarray(vals.tolist() if isinstance(vals, np.ndarray)
+                     else list(vals))
+    if arr.dtype == object:
+        raise TypeError(
+            f"{where} is declared traceable=True and requires numeric "
+            "record columns; these records do not columnarize — drop "
+            "traceable=True to run per-record instead"
+        )
+    return arr
+
+
 class ChainRunner(StepRunner):
     """Fused stateless chain: map/filter/flat_map applied per batch
     (OperatorChain ChainingOutput analogue, StreamingJobGraphGenerator.java:1730).
@@ -203,11 +228,16 @@ class ChainRunner(StepRunner):
         self.transforms = transforms
 
     @staticmethod
-    def _to_column(vals) -> np.ndarray:
+    def _to_column(vals, columnar: bool = False) -> np.ndarray:
+        """Normalize a transform's output. `columnar=True` marks the output
+        of a vectorized/traceable fn, which is by contract a whole column —
+        numeric arrays of ANY rank pass through (a traceable UDF written
+        with jnp ops returns a jax array; objectifying its rows would
+        silently de-columnarize the fusion-off fallback path)."""
         if isinstance(vals, np.ndarray):
             return vals
         arr = np.asarray(vals)
-        if arr.dtype.kind in "OUSifub" and arr.ndim == 1:
+        if arr.dtype.kind in "OUSifub" and (arr.ndim == 1 or columnar):
             return arr
         return obj_array(list(vals))
 
@@ -219,14 +249,24 @@ class ChainRunner(StepRunner):
                 return
             fn = t.config["fn"]
             vec = t.config.get("vectorized", False)
+            if t.config.get("traceable"):
+                if getattr(vals, "dtype", None) == object:
+                    # fusion-off / mixed-chain fallback of a traceable UDF
+                    # fed by a record-mode segment: same columnarization
+                    # the fused device path performs at ingest
+                    vals = _columnarize_records(vals, f"{t.kind} '{t.name}'")
+                # canonical-dtype contract: the fused path computes on
+                # canonical columns, so the fallback must too (same checked
+                # cast — identical inputs, identical results)
+                vals = canonical_column(vals, f"{t.kind} '{t.name}'")
             if t.kind == "map":
                 if vec:
-                    vals = self._to_column(fn(vals))
+                    vals = self._to_column(fn(vals), columnar=True)
                 else:
                     vals = obj_array([fn(v) for v in vals])
             elif t.kind == "map_ts":
                 if vec:
-                    vals = self._to_column(fn(vals, ts))
+                    vals = self._to_column(fn(vals, ts), columnar=True)
                 else:
                     vals = obj_array([fn(v, int(x)) for v, x in zip(vals, ts)])
             elif t.kind == "filter":
@@ -241,12 +281,21 @@ class ChainRunner(StepRunner):
             elif t.kind == "map_batch":
                 # whole-batch transform (amortized device dispatch: model
                 # inference, vectorized UDFs)
-                vals = self._to_column(fn(list(vals) if not vec else vals))
-                assert len(vals) == len(ts), "map_batch must be 1:1"
+                vals = self._to_column(fn(list(vals) if not vec else vals),
+                                       columnar=vec)
+                if len(vals) != len(ts):
+                    # a hard error, not an assert: asserts vanish under
+                    # `python -O`, and a 1:N map_batch would silently
+                    # corrupt timestamp alignment for everything downstream
+                    raise ValueError(
+                        f"map_batch '{t.name}' returned {len(vals)} values "
+                        f"for {len(ts)} input records; map_batch must be "
+                        "1:1 (use flat_map for 1:N transforms)"
+                    )
             elif t.kind == "flat_map":
                 if vec:
                     out, src_idx = fn(vals)
-                    vals = self._to_column(out)
+                    vals = self._to_column(out, columnar=True)
                     ts = ts[np.asarray(src_idx, dtype=np.int64)]
                 else:
                     new_vals, new_ts = [], []
@@ -345,6 +394,7 @@ class WindowStepRunner(StepRunner):
         aggregate = cfg["aggregate"]
         self.key_selector = cfg["key_selector"]
         self.key_vectorized = cfg.get("key_vectorized", False)
+        self.key_traceable = cfg.get("key_traceable", False)
         self.value_fn = cfg.get("value_fn") or (lambda v: v)
         self.value_vectorized = cfg.get("value_vectorized", False) and cfg.get("value_fn")
         self.window_fn = cfg.get("window_fn")
@@ -436,7 +486,7 @@ class WindowStepRunner(StepRunner):
                 # not pay for the configured maximum up front
                 key_capacity=min(1 << 10, config.get(ExecutionOptions.KEY_CAPACITY)),
                 superbatch_steps=config.get(ExecutionOptions.SUPERBATCH_STEPS),
-                chunk=min(4096, max(256, 1 << (max(batch_size, 1) - 1).bit_length())),
+                chunk=_fused_chunk(batch_size),
                 columnar_output=config.get(ExecutionOptions.COLUMNAR_OUTPUT),
             )
             self.device = True
@@ -447,6 +497,7 @@ class WindowStepRunner(StepRunner):
                 allowed_lateness=cfg["allowed_lateness"],
                 key_capacity=config.get(ExecutionOptions.KEY_CAPACITY),
                 emit_late_to_side_output=cfg["side_output_late"],
+                columnar_output=config.get(ExecutionOptions.COLUMNAR_OUTPUT),
             )
             self.device = True
         else:
@@ -477,6 +528,14 @@ class WindowStepRunner(StepRunner):
         )
 
     def on_batch(self, values: np.ndarray, timestamps: np.ndarray) -> None:
+        if self.key_traceable and len(timestamps):
+            # fusion-off fallback of a traceable program: columnarize
+            # record-mode sources and cast to the canonical dtype exactly
+            # like the fused ingest would, so CHAIN_FUSION stays a perf
+            # switch, never a semantics switch
+            if getattr(values, "dtype", None) == object:
+                values = _columnarize_records(values, "key_by selector")
+            values = canonical_column(values, "key_by selector input")
         if self.device:
             if self.key_vectorized:
                 keys = np.asarray(self.key_selector(values))
@@ -507,14 +566,18 @@ class WindowStepRunner(StepRunner):
                 # PT windows: assignment & timers use wall clock, not event ts
                 now = int(time.time() * 1000)
                 timestamps = np.full(len(values), now, dtype=np.int64)
-            # vectorized selectors see a one-row column per record here
+            # vectorized selectors see a one-row column per record here;
+            # np.asarray on the result keeps jnp-written (traceable) fns
+            # usable — a bare jax scalar is unhashable as an oracle key
             key_of = (
-                (lambda v: self.key_selector(np.asarray(v)[None, ...])[0])
+                (lambda v: np.asarray(
+                    self.key_selector(np.asarray(v)[None, ...]))[0])
                 if self.key_vectorized
                 else self.key_selector
             )
             val_of = (
-                (lambda v: self.value_fn(np.asarray(v)[None, ...])[0])
+                (lambda v: np.asarray(
+                    self.value_fn(np.asarray(v)[None, ...]))[0])
                 if self.value_vectorized
                 else self.value_fn
             )
@@ -610,6 +673,86 @@ class WindowStepRunner(StepRunner):
 
     def restore(self, snap: dict) -> None:
         self.op.restore(snap["operator"])
+
+
+class DeviceChainRunner(WindowStepRunner):
+    """Whole-graph fusion runner (graph/fusion.py): one runner for a fused
+    device chain — the traceable map/filter/map_ts prologue, key/value
+    extraction, and the windowed aggregation compile into ONE jitted
+    multi-step device program (`lax.scan` over T batches) with
+    device-resident intermediates. Raw source columns are the only thing
+    the host stages; the post-transform columns, key column and value
+    column never materialize host-side.
+
+    This is the reference's operator chaining taken to its TPU-native
+    conclusion (StreamingJobGraphGenerator chains operators into direct
+    calls; XLA chains them into one program). Inherits the watermark
+    clamping, drain, metrics, and snapshot surfaces of WindowStepRunner —
+    only construction and ingest differ."""
+
+    def __init__(self, step: Step, plan, config: Configuration):
+        from flink_tpu.runtime.fused_window_operator import FusedWindowOperator
+        from flink_tpu.runtime.fused_window_pipeline import TracedPrologue
+
+        t = plan.terminal
+        cfg = t.config
+        prologue = TracedPrologue(
+            transforms=tuple(
+                (tr.kind, tr.config["fn"]) for tr in plan.transforms),
+            key_fn=cfg["key_selector"],
+            value_fn=cfg.get("value_fn"),
+        )
+        batch_size = config.get(ExecutionOptions.BATCH_SIZE)
+        self.op = FusedWindowOperator(
+            cfg["assigner"],
+            cfg["aggregate"],
+            # dense device keying cannot grow mid-dispatch: capacity is the
+            # configured bound, and an out-of-range traced key raises at
+            # resolve (never silently aliases another key's row)
+            key_capacity=config.get(ExecutionOptions.KEY_CAPACITY),
+            superbatch_steps=config.get(ExecutionOptions.SUPERBATCH_STEPS),
+            chunk=_fused_chunk(batch_size),
+            columnar_output=config.get(ExecutionOptions.COLUMNAR_OUTPUT),
+            prologue=prologue,
+        )
+        self.device = True
+        self.window_fn = None
+        self.processing_time = False
+        self.uid = t.uid
+        self._drain_resolves_device = True
+        self.device_timer = (
+            DeviceTimer()
+            if config.get(ObservabilityOptions.DEVICE_TIMING_ENABLED)
+            else None
+        )
+        self._warned_object_columns = False
+
+    def on_batch(self, values: np.ndarray, timestamps: np.ndarray) -> None:
+        if len(timestamps) == 0:
+            return   # idle poll / watermark-only step: nothing to stage
+        vals = values
+        if getattr(vals, "dtype", None) == object or not isinstance(vals, np.ndarray):
+            # record-mode source: one columnarization pass per batch. A
+            # columnar source (numeric ndarray batches) or the binary wire
+            # (frombuffer views, runtime/stages.py) skips this entirely.
+            if not self._warned_object_columns:
+                self._warned_object_columns = True
+                import warnings
+
+                warnings.warn(
+                    "fused device chain fed by a record-mode source: paying "
+                    "a per-batch columnarization pass; switch the source to "
+                    "columnar numeric batches to feed the device directly",
+                    RuntimeWarning,
+                )
+            vals = _columnarize_records(vals, "fused device chain")
+        else:
+            vals = as_device_column(vals)
+        if self.device_timer is not None:
+            with self.device_timer.section():
+                self.op.process_raw_batch(vals, timestamps)
+        else:
+            self.op.process_raw_batch(vals, timestamps)
 
 
 class KeyedReduceRunner(StepRunner):
@@ -1188,11 +1331,28 @@ def _make_runner(step: Step, config: Configuration) -> StepRunner:
 def build_runners(graph: StepGraph, config: Configuration):
     """Build the runner DAG: one runner per step, fan-out edges wired by
     input ordinal. Returns (runners in topo order, source feed map
-    {source_transformation_id: [(entry_runner, ordinal)]})."""
+    {source_transformation_id: [(entry_runner, ordinal)]}).
+
+    Whole-graph fusion (graph/fusion.py) happens here: eligible window
+    steps get a DeviceChainRunner that absorbs the pure traceable chain
+    step feeding them — the absorbed step gets no runner, and the fused
+    runner consumes the absorbed step's input edges directly."""
+    from flink_tpu.graph.fusion import plan_device_chains
+
+    plans, absorbed = {}, set()
+    if config.get(ExecutionOptions.CHAIN_FUSION) and \
+            config.get(ExecutionOptions.FUSED_WINDOWS):
+        plans, absorbed = plan_device_chains(graph)
+
     runner_of: Dict[int, StepRunner] = {}
     runners: List[StepRunner] = []
     for step in graph.steps:
-        r = _make_runner(step, config)
+        if id(step) in absorbed:
+            continue
+        if id(step) in plans:
+            r = DeviceChainRunner(step, plans[id(step)], config)
+        else:
+            r = _make_runner(step, config)
         if len(step.inputs) > 1:
             r.num_inputs = len(step.inputs)
         runner_of[id(step)] = r
@@ -1200,8 +1360,12 @@ def build_runners(graph: StepGraph, config: Configuration):
 
     feeds: Dict[int, List] = {}
     for step in graph.steps:
+        if id(step) in absorbed:
+            continue
         r = runner_of[id(step)]
-        for edge in step.inputs:
+        step_inputs = (plans[id(step)].inputs if id(step) in plans
+                       else step.inputs)
+        for edge in step_inputs:
             entity, ordinal = edge[0], edge[1]
             tag = edge[2] if len(edge) > 2 else None
             if isinstance(entity, Transformation):       # a source feeds this

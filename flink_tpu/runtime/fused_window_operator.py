@@ -59,6 +59,9 @@ class _Step:
     ts: np.ndarray
     wm: int
     n_fires: int
+    # slice ids of ts, when the normalizer already computed them (staging
+    # reuses them instead of re-dividing the whole timestamp column)
+    s_abs: Optional[np.ndarray] = None
 
 
 class StepNormalizer:
@@ -68,8 +71,16 @@ class StepNormalizer:
     frontier math); divergence would be a planner error, so the pipeline's
     own checks stay on as assertions."""
 
-    def __init__(self, pipe: FusedWindowPipeline):
+    def __init__(self, pipe: FusedWindowPipeline, raw_payload: bool = False):
         self.p = pipe
+        # payload column type: dense int32 key ids (classic), or the raw
+        # record columns of a traced chain (whole-graph fusion) — the
+        # normalizer only ever row-indexes the payload, so the frontier
+        # math is identical; the cast is the single dtype-touching point
+        self._cast = (
+            (lambda a: np.asarray(a)) if raw_payload
+            else (lambda a: np.asarray(a, np.int32))
+        )
         self.wm = MIN_WATERMARK
         self.fire_cursor: Optional[int] = None
         self.max_seen: Optional[int] = None
@@ -219,7 +230,7 @@ class StepNormalizer:
             keep = s_abs >= self._min_live_slice(self.wm)  # late records: the
             # pipeline drops/counts them itself; they must not affect splits
         if not keep.any():
-            out.append(_Step(np.asarray(kid, np.int32), vals, np.asarray(ts, np.int64),
+            out.append(_Step(self._cast(kid), vals, np.asarray(ts, np.int64),
                              self.wm, 0))
             return
 
@@ -254,22 +265,34 @@ class StepNormalizer:
             if not keep.any():
                 # only late rows survived the hold-back filter: ship them as
                 # a zero-fire step (the pipeline drops+counts them itself)
-                out.append(_Step(np.asarray(kid, np.int32), vals,
+                out.append(_Step(self._cast(kid), vals,
                                  np.asarray(ts, np.int64), self.wm, 0))
                 return
 
         # slice-span splitting: sub-steps each touching < nsb distinct slices
         smin = int(s_abs[keep].min())
-        group = np.where(keep, (s_abs - smin) // p.NSB, 0)
-        for gval in np.unique(group):
-            sel = group == gval
-            out.append(_Step(
-                np.asarray(kid)[sel].astype(np.int32),
-                None if vals is None else np.asarray(vals)[sel],
-                np.asarray(ts)[sel].astype(np.int64),
-                self.wm, 0,
-            ))
         smax = int(s_abs[keep].max())
+        if smax - smin < p.NSB and bool(keep.all()):
+            # hot path (in-order stream, batch within one slice block):
+            # single step, NO column copy and no group sort — on the fused
+            # chain path this forwards the raw source column untouched
+            out.append(_Step(
+                self._cast(kid),
+                None if vals is None else np.asarray(vals),
+                np.asarray(ts, np.int64),
+                self.wm, 0,
+                s_abs=s_abs,
+            ))
+        else:
+            group = np.where(keep, (s_abs - smin) // p.NSB, 0)
+            for gval in np.unique(group):
+                sel = group == gval
+                out.append(_Step(
+                    self._cast(np.asarray(kid)[sel]),
+                    None if vals is None else np.asarray(vals)[sel],
+                    np.asarray(ts)[sel].astype(np.int64),
+                    self.wm, 0,
+                ))
         self.max_seen = smax if self.max_seen is None else max(self.max_seen, smax)
         self.min_used = smin if self.min_used is None else min(self.min_used, smin)
         cand = self.p._j_oldest(smin)
@@ -297,6 +320,11 @@ class StepNormalizer:
                 (k.tolist(), None if v is None else v.tolist(), t.tolist())
                 for k, v, t in self._future
             ],
+            # payload dtypes of the held columns: the raw-payload cast is
+            # dtype-free np.asarray, and a tolist() round-trip would promote
+            # float32 columns to float64 — tripping the fused pipeline's
+            # fixed-geometry check on the first post-restore dispatch
+            "future_kdt": [str(np.asarray(k).dtype) for k, _v, _t in self._future],
         }
 
     def restore(self, snap: dict) -> None:
@@ -305,11 +333,13 @@ class StepNormalizer:
         self.max_seen = snap["max_seen"]
         self.min_used = snap.get("min_used")
         self.purged_to = snap["purged_to"]
+        kdts = snap.get("future_kdt")  # absent in pre-fusion snapshots
         self._future = [
-            (np.asarray(k, np.int32),
+            (self._cast(k) if kdts is None
+             else np.asarray(k, np.dtype(kdts[i])),
              None if v is None else np.asarray(v, np.float32),
              np.asarray(t, np.int64))
-            for k, v, t in snap["future"]
+            for i, (k, v, t) in enumerate(snap["future"])
         ]
         self.num_future_held = sum(len(t) for _, _, t in self._future)
 
@@ -333,18 +363,25 @@ class FusedWindowOperator:
         out_rows: int = 256,
         chunk: int = 4096,
         columnar_output: bool = False,
+        prologue=None,
     ):
         self.agg = resolve(aggregate)
         if self.agg is None:
             raise ValueError(f"aggregate {aggregate!r} has no device form")
+        # whole-graph fusion (graph/fusion.py): with a TracedPrologue the
+        # pipeline compiles chain transforms + key/value extraction into the
+        # superscan itself; steps then carry RAW source columns and keying
+        # is dense-int on device (no host key dictionary on the hot path)
+        self.prologue = prologue
         self.pipe = FusedWindowPipeline(
             assigner, self.agg,
             key_capacity=key_capacity, num_slices=num_slices, nsb=nsb,
             fires_per_step=fires_per_step, out_rows=out_rows, chunk=chunk,
+            prologue=prologue,
         )
         self.T = superbatch_steps
-        self.keydict = KeyDictionary(dense_int_keys)
-        self.norm = StepNormalizer(self.pipe)
+        self.keydict = KeyDictionary(dense_int_keys or prologue is not None)
+        self.norm = StepNormalizer(self.pipe, raw_payload=prologue is not None)
         self._steps: List[_Step] = []
         self._inflight: Optional[tuple] = None  # (DeferredEmissions, wm)
         self.output: List[Tuple[Any, Any, Any, int]] = []
@@ -363,6 +400,11 @@ class FusedWindowOperator:
 
     def process_batch(self, keys: np.ndarray, values: np.ndarray,
                       timestamps: np.ndarray) -> None:
+        if self.prologue is not None:
+            raise RuntimeError(
+                "this operator runs a traced chain prologue; feed it raw "
+                "columns via process_raw_batch"
+            )
         if len(timestamps) == 0:
             return
         ids, required = self.keydict.lookup_or_insert(np.asarray(keys))
@@ -371,6 +413,18 @@ class FusedWindowOperator:
         self._steps.extend(
             self.norm.push(ids.astype(np.int32), vals,
                            np.asarray(timestamps, np.int64))
+        )
+        self._maybe_dispatch()
+
+    def process_raw_batch(self, values: np.ndarray,
+                          timestamps: np.ndarray) -> None:
+        """Whole-graph fusion ingest: raw source columns, untouched by any
+        host transform — the traced prologue (chain + key/value extraction)
+        runs inside the compiled dispatch."""
+        if len(timestamps) == 0:
+            return
+        self._steps.extend(
+            self.norm.push(values, None, np.asarray(timestamps, np.int64))
         )
         self._maybe_dispatch()
 
@@ -436,9 +490,13 @@ class FusedWindowOperator:
         return group
 
     def _dispatch(self, group: List[_Step]) -> None:
-        batches = [(s.kid, s.vals, s.ts) for s in group]
         wms = [s.wm for s in group]
-        d = self.pipe.process_superbatch(batches, wms, defer=True)
+        if self.prologue is not None:
+            d = self.pipe.process_superbatch_raw(
+                [(s.kid, s.ts, s.s_abs) for s in group], wms, defer=True)
+        else:
+            d = self.pipe.process_superbatch(
+                [(s.kid, s.vals, s.ts) for s in group], wms, defer=True)
         self._resolve_inflight()
         self._inflight = (d, group[-1].wm)
 
@@ -453,6 +511,27 @@ class FusedWindowOperator:
             self.emitted_watermark = wm
 
     def _emit(self, window, counts, fields) -> None:
+        if self.prologue is not None:
+            # dense device keying: the emitted key IS the id the traced
+            # selector produced — every capacity row may be live
+            counts = np.asarray(counts)
+            live = np.flatnonzero(counts > 0)
+            if live.size == 0:
+                return
+            fdict: Dict[str, Any] = {
+                f.name: (counts if f.source == ONE
+                         else np.asarray(fields[f.name]))
+                for f in self.agg.fields
+            }
+            result = np.asarray(self.agg.extract(fdict))
+            ts = window.max_timestamp()
+            if self.columnar_output:
+                self.output.append(
+                    (None, window, (window, live, result[live]), ts))
+                return
+            for i in live:
+                self.output.append((int(i), window, result[i].item(), ts))
+            return
         counts = np.asarray(counts)[: len(self.keydict)]
         live = np.flatnonzero(counts > 0)
         if live.size == 0:
@@ -484,6 +563,12 @@ class FusedWindowOperator:
         """Point lookup (queryable state): {abs_slice: {field..., count}}
         for one key, folding device ring cells, buffered steps, and
         held-back future records into one consistent view."""
+        if self.prologue is not None:
+            raise RuntimeError(
+                "queryable state is unavailable on the fused chain path: "
+                "buffered steps hold raw pre-transform columns, so a "
+                "consistent per-key view would need the traced UDFs on host"
+            )
         kid = self.keydict.lookup(key)
         if kid is None:
             return {}

@@ -39,6 +39,7 @@ import numpy as np
 from flink_tpu.api.windowing.assigners import WindowAssigner
 from flink_tpu.core.time import MIN_WATERMARK, TimeWindow
 from flink_tpu.ops.aggregators import DeviceAggregator, ONE, VALUE, resolve
+from flink_tpu.utils.arrays import canonical_column
 
 
 def _ceil_div(a: int, b: int) -> int:
@@ -52,22 +53,65 @@ class _PlannedFire:
     step: int         # step within the dispatch
 
 
+@dataclasses.dataclass(frozen=True)
+class TracedPrologue:
+    """The traced pre-stage of a fused device chain (whole-graph fusion,
+    graph/fusion.py): chain transforms applied to the raw value column
+    INSIDE the compiled superscan, then key/value extraction. All callables
+    must be pure jax-traceable column functions; `key_fn` must return
+    non-negative int keys < the pipeline's key capacity (checked against a
+    max-key reduction carried through the scan and raised at resolve time —
+    an out-of-range key must never silently alias another key's row)."""
+
+    transforms: Tuple[Tuple[str, Any], ...]   # ('map'|'filter'|'map_ts', fn)
+    key_fn: Any
+    value_fn: Optional[Any] = None            # None: the column IS the value
+
+    @property
+    def needs_ts(self) -> bool:
+        return any(kind == "map_ts" for kind, _fn in self.transforms)
+
+
+#: compiled chained-superscan executables, shared across pipeline instances
+#: (FIFO-bounded; entries keep the user fns alive, which is what makes
+#: identity-keyed caching safe)
+_CHAINED_CACHE: Dict[tuple, Any] = {}
+_CHAINED_CACHE_MAX = 128
+
+
 class DeferredEmissions:
     """Handle for fires of one dispatch; the device->host copy runs async."""
 
-    def __init__(self, pipe: "FusedWindowPipeline", fires, count_out, outs):
+    def __init__(self, pipe: "FusedWindowPipeline", fires, count_out, outs,
+                 key_bounds=None, key_capacity: Optional[int] = None):
         self._pipe = pipe
         self._fires = fires
         self._count_out = count_out
         self._outs = outs
+        self._key_bounds = key_bounds    # int32[2]: [max_seen, min_seen]
+        self._key_capacity = key_capacity
         try:
             count_out.copy_to_host_async()
             for v in outs.values():
                 v.copy_to_host_async()
+            if key_bounds is not None:
+                key_bounds.copy_to_host_async()
         except AttributeError:
             pass
 
     def resolve(self):
+        if self._key_bounds is not None:
+            hi, lo = (int(v) for v in np.asarray(self._key_bounds))
+            if hi >= self._key_capacity or lo < 0:
+                raise ValueError(
+                    f"traced key selector produced keys in [{lo}, {hi}] "
+                    f"outside [0, {self._key_capacity}): the fused device "
+                    "chain uses dense integer keys and cannot grow capacity "
+                    "mid-dispatch. Raise 'execution.state.key-capacity' "
+                    "above the largest key the selector can emit (and keep "
+                    "keys non-negative), or drop traceable=True on key_by "
+                    "to use the host key dictionary."
+                )
         count_np = np.asarray(self._count_out)
         outs_np = {k: np.asarray(v) for k, v in self._outs.items()}
         return [
@@ -194,6 +238,7 @@ class FusedWindowPipeline:
         backend: str = "auto",        # 'auto' | 'xla' | 'pallas'
         pallas_interpret: bool = False,
         plan_only: bool = False,      # host planner/cursors only, no device state
+        prologue: Optional[TracedPrologue] = None,
     ):
         agg = resolve(aggregate)
         if agg is None:
@@ -213,8 +258,20 @@ class FusedWindowPipeline:
         self.R = out_rows
         self.chunk = chunk
         self.exact_sums = exact_sums
+        self.prologue = prologue
+        if prologue is not None:
+            # the traced chain prologue runs inside the XLA superscan; the
+            # pallas kernel consumes prebuilt idx streams and has no
+            # prologue slot (on TPU the XLA superscan still runs on device)
+            backend = "xla"
         self.backend = backend
         self.pallas_interpret = pallas_interpret
+        # traced-chain state: fixed raw-column geometry (the compiled chain
+        # executables live in the module-level _CHAINED_CACHE, keyed on the
+        # prologue + aggregate + geometry, so a re-built pipeline for the
+        # same program re-uses the jitted program instead of recompiling)
+        self._raw_shape: Optional[tuple] = None
+        self._raw_dtype = None
         self._pallas: Optional[bool] = None   # decided at first dispatch
         self._kernel_layout = False           # states in pallas slice-major form
 
@@ -591,6 +648,278 @@ class FusedWindowPipeline:
         return plan, smin_abs
 
     # ------------------------------------------------------------------
+    # traced-chain path (whole-graph fusion): the chain prologue runs
+    # INSIDE the compiled superscan — raw source columns go to the device,
+    # filter/projection/key/value extraction never materialize on host
+    # ------------------------------------------------------------------
+    def stage_superbatch_raw(self, steps, watermarks):
+        """Host planning + device staging for one traced-chain dispatch.
+
+        steps: [(raw_column [n, ...], timestamps int64 [n][, slice_ids])] —
+        raw source values BEFORE any chain transform (slice_ids optional:
+        the normalizer's precomputed `_slice_of(ts)`). The host plans
+        fires/purges from
+        the timestamps alone (the chain never changes timestamps, and a
+        filter only removes records, so timestamp-derived slice bounds stay
+        valid upper bounds; windows planned over filtered-out slices fire
+        empty rows, which emission drops). Late records are masked to
+        srel -1 here (and counted), so the traced program never sees them
+        as live."""
+        import jax
+
+        if self.prologue is None:
+            raise RuntimeError("stage_superbatch_raw requires a prologue")
+        T = len(steps)
+        B = max(max((len(step[1]) for step in steps), default=0), 1)
+        # staged width quantized to power-of-two multiples of the chunk:
+        # ragged last batches and watermark-only tail groups land on a few
+        # bounded shapes (log2 many) instead of compiling a fresh (T, B)
+        # executable per width, while tiny tails keep tiny staging buffers
+        # — pad rows are srel -1 and never touch state
+        B = self.chunk * (1 << max(0, -(-B // self.chunk) - 1).bit_length())
+
+        for raw, ts, *_rest in steps:
+            if not len(ts):
+                continue
+            arr = np.asarray(raw)
+            if self._raw_shape is None:
+                if arr.dtype == object:
+                    raise TypeError(
+                        "the fused device chain needs numeric record "
+                        "columns; this source yields Python objects — use a "
+                        "columnar source (numeric ndarray batches) or drop "
+                        "traceable=True to stay on the host chain"
+                    )
+                self._raw_shape, self._raw_dtype = arr.shape[1:], arr.dtype
+            elif arr.shape[1:] != self._raw_shape or arr.dtype != self._raw_dtype:
+                raise ValueError(
+                    f"record column geometry changed mid-stream: "
+                    f"{arr.dtype}{list(arr.shape[1:])} after "
+                    f"{self._raw_dtype}{list(self._raw_shape)} — the fused "
+                    "chain executable is shaped on a fixed column layout"
+                )
+        raw_shape, raw_dtype = self._raw_shape, self._raw_dtype
+        if raw_shape is None:
+            # all-empty superbatch before any data: a scalar placeholder
+            # column for THIS dispatch only — pinning it on the instance
+            # would make the first real batch afterwards (e.g. a watermark
+            # arriving right after restore) read as a mid-stream geometry
+            # change and crash a healthy job
+            raw_shape, raw_dtype = (), np.dtype(np.float32)
+
+        # np.empty, not zeros: pad rows are srel -1 — every traced consumer
+        # masks on that before touching raw/ts, so the 16MB+ staging memset
+        # per dispatch would be pure waste. Buffers are allocated in jax's
+        # CANONICAL dtype (x64-off: float64→float32, int64→int32): device_put
+        # of a non-canonical array re-casts the whole buffer host-side every
+        # dispatch — a full extra copy, and the garbage pad bytes overflow
+        # the narrowing float cast (RuntimeWarning). Real rows cast at fill.
+        from jax import dtypes as _jdt
+        raw_h = np.empty((T, B) + raw_shape,
+                         dtype=_jdt.canonicalize_dtype(raw_dtype))
+        srel_h = np.full((T, B), -1, dtype=np.int32)
+        ts_h = (np.empty((T, B), dtype=_jdt.canonicalize_dtype(np.int64))
+                if self.prologue.needs_ts else None)
+        smin_pos = np.zeros(T, dtype=np.int32)
+        fire_pos = np.zeros((T, self.F), dtype=np.int32)
+        fire_valid = np.zeros((T, self.F), dtype=np.int32)
+        fire_row = np.zeros((T, self.F), dtype=np.int32)
+        purge_mask = np.ones((T, self.S), dtype=np.int32)
+        fires: List[_PlannedFire] = []
+
+        cur = _PlanCursor(self)
+        for t, step in enumerate(steps):
+            raw, ts = step[0], step[1]
+            pre_s_abs = step[2] if len(step) > 2 else None
+            n = len(ts)
+            if n:
+                ts_arr = np.asarray(ts, dtype=np.int64)
+                s_abs = (pre_s_abs if pre_s_abs is not None
+                         else self._slice_of(ts_arr))
+                keep = np.ones(n, dtype=bool)
+                if cur.wm > MIN_WATERMARK:
+                    keep = s_abs >= self._min_live_slice(cur.wm)
+                    self.num_late_records_dropped += int(n - keep.sum())
+                if keep.any():
+                    live = s_abs[keep]
+                    smin = int(live.min())
+                    cur.observe(smin, int(live.max()))
+                    srel_h[t, :n] = np.where(keep, s_abs - smin, -1).astype(np.int32)
+                    smin_pos[t] = smin % self.S
+                # checked canonical cast: an int64/float64 source column
+                # narrowing into the staging dtype must not silently wrap
+                # (same contract as the timestamp guard below); the host
+                # fallback casts through the same helper, so both paths
+                # compute on identical canonical inputs
+                raw_h[t, :n] = canonical_column(
+                    raw, "fused chain record column")
+                if ts_h is not None:
+                    if ts_h.dtype.itemsize < 8 and (
+                        int(ts_arr.max()) > np.iinfo(ts_h.dtype).max
+                        or int(ts_arr.min()) < np.iinfo(ts_h.dtype).min
+                    ):
+                        raise TypeError(
+                            "traceable map_with_timestamp under the fused "
+                            "chain stages timestamps in the backend's "
+                            f"canonical {ts_h.dtype} (jax x64 is disabled) "
+                            "and these event timestamps do not fit — they "
+                            "would silently wrap inside the traced UDF. "
+                            "Rebase event time near zero, enable jax x64, "
+                            "or drop traceable=True to run the host chain."
+                        )
+                    ts_h[t, :n] = ts_arr
+            cur.advance(t, watermarks[t], fire_pos, fire_valid, fire_row,
+                        purge_mask, fires)
+        cur.commit()
+
+        plan = (
+            jax.device_put(smin_pos),
+            jax.device_put(fire_pos),
+            jax.device_put(fire_valid),
+            jax.device_put(fire_row),
+            jax.device_put(purge_mask),
+            fires,
+        )
+        ts_d = jax.device_put(ts_h) if ts_h is not None else None
+        return jax.device_put(raw_h), jax.device_put(srel_h), ts_d, plan
+
+    def process_superbatch_raw(self, steps, watermarks, *,
+                               staged: Optional[tuple] = None,
+                               defer: bool = False):
+        """Run T traced-chain steps in one dispatch (the prologue-bearing
+        sibling of process_superbatch; same defer contract)."""
+        import jax.numpy as jnp
+
+        if staged is None and all(len(step[1]) == 0 for step in steps):
+            # watermark-only dispatch: with zero rows the prologue is
+            # irrelevant, so run the classic (prologue-free) fire/purge
+            # program over the same device state — tracing the chained
+            # program would apply the user's column fns to a placeholder
+            # scalar column (crashing any 2-D selector), and this also
+            # covers the restore-then-watermark ordering where the record
+            # geometry is still unknown but restored state must fire
+            empty = [(np.empty(0, np.int32), None, np.empty(0, np.int64))
+                     for _ in steps]
+            return self.process_superbatch(empty, watermarks, defer=defer)
+        if staged is None:
+            staged = self.stage_superbatch_raw(steps, watermarks)
+        raw_d, srel_d, ts_d, plan = staged
+        (smin_pos, fire_pos, fire_valid, fire_row, purge_mask, fires) = plan
+        T, B = srel_d.shape
+
+        self._to_canonical()
+        run = self._chained_superscan(T, B)
+        outs0 = {
+            f.name: jnp.zeros((self.R, self.K), jnp.dtype(f.dtype))
+            for f in self._value_fields
+        }
+        count_out0 = jnp.zeros((self.R, self.K), jnp.int32)
+        xs = (raw_d, srel_d)
+        if self.prologue.needs_ts:
+            xs = xs + (ts_d,)
+        xs = xs + (smin_pos, fire_pos, fire_valid, fire_row, purge_mask)
+        self._state, self._count, outs, count_out, key_bounds = run(
+            self._state, self._count, outs0, count_out0, *xs)
+
+        used = -(-max(len(fires), 1) // 16) * 16
+        if used < self.R:
+            count_out = _slice_rows(count_out, used)
+            outs = {k: _slice_rows(v, used) for k, v in outs.items()}
+        deferred = DeferredEmissions(self, fires, count_out, outs,
+                                     key_bounds=key_bounds,
+                                     key_capacity=self.K)
+        return deferred if defer else deferred.resolve()
+
+    def _chained_superscan(self, T: int, B: int):
+        # module-level memo: the key holds STRONG references to the user
+        # fns (via the frozen TracedPrologue), so identity-hashed entries
+        # can never collide with a recycled id; builtin DeviceAggregators
+        # are memoized singletons, custom ones identity-hash conservatively
+        key = (self.prologue, self.agg, self.K, self.S, self.NSB, self.F,
+               self.R, self.spw, self.chunk, self.exact_sums, T, B)
+        fn = _CHAINED_CACHE.get(key)
+        if fn is None:
+            while len(_CHAINED_CACHE) >= _CHAINED_CACHE_MAX:
+                _CHAINED_CACHE.pop(next(iter(_CHAINED_CACHE)))
+            fn = _CHAINED_CACHE[key] = self._build_chained_superscan(T, B)
+        return fn
+
+    def _build_chained_superscan(self, T: int, B: int):
+        """Compile prologue + T-step superscan into one program. On CPU
+        backends ingest uses direct scatter-adds ([K, S] is cache-resident
+        and the MXU one-hot matmuls that win on TPU lose badly on a scalar
+        core); on TPU the matmul-histogram ingest is kept."""
+        import jax
+        import jax.numpy as jnp
+
+        pro = self.prologue
+        ingest = "matmul" if jax.default_backend() == "tpu" else "scatter"
+        step = make_superscan_step(
+            self.agg, self.K, self.S, self.NSB, self.F, self.R,
+            self.spw, self.chunk, self.exact_sums, ingest=ingest,
+        )
+        K, NSB = self.K, self.NSB
+        needs_vals = self._needs_vals
+        needs_ts = pro.needs_ts
+        transforms = tuple(pro.transforms)
+        key_fn, value_fn = pro.key_fn, pro.value_fn
+
+        def body(carry, args):
+            inner, key_bounds = carry
+            if needs_ts:
+                raw, srel, ts = args[0], args[1], args[2]
+                rest = args[3:]
+            else:
+                raw, srel = args[0], args[1]
+                ts = None
+                rest = args[2:]
+            col = raw
+            mask = srel >= 0
+            for kind, fn in transforms:
+                if kind == "map":
+                    col = fn(col)
+                elif kind == "map_ts":
+                    col = fn(col, ts)
+                else:  # filter
+                    mask = mask & jnp.asarray(fn(col)).astype(bool)
+            keys = jnp.asarray(key_fn(col)).astype(jnp.int32)
+            live = mask & (keys >= 0) & (keys < K)
+            idx = jnp.where(live, keys * NSB + srel, jnp.int32(-1))
+            idx = idx.astype(jnp.int32)
+            if needs_vals:
+                vcol = value_fn(col) if value_fn is not None else col
+                # dead/pad rows hold uninitialized staging bytes that can
+                # decode as NaN/inf; zero them BEFORE ingest — the matmul
+                # histogram multiplies the zero one-hot by the raw value,
+                # and 0 * NaN = NaN would poison every sum in the chunk
+                # (the scatter path drops by index, but identical inputs
+                # keep both ingest forms bit-identical)
+                vals = jnp.where(
+                    live, jnp.asarray(vcol).astype(jnp.float32), 0.0)
+            else:
+                vals = jnp.zeros((1,), jnp.float32)
+            # key range observed over every SURVIVING record (pre range
+            # clamp): an out-of-range key is a hard error at resolve, never
+            # a silent drop or a silent alias of another key's row
+            key_bounds = jnp.stack([
+                jnp.maximum(key_bounds[0],
+                            jnp.max(jnp.where(mask, keys, jnp.int32(-1)))),
+                jnp.minimum(key_bounds[1],
+                            jnp.min(jnp.where(mask, keys, jnp.int32(0)))),
+            ])
+            inner, _ = step(inner, (idx, vals) + rest)
+            return (inner, key_bounds), None
+
+        def run(state, count, outs, count_out, *xs):
+            kb0 = jnp.asarray([-1, 0], jnp.int32)
+            (inner, key_bounds), _ = jax.lax.scan(
+                body, ((state, count, outs, count_out), kb0), xs)
+            state, count, outs, count_out = inner
+            return state, count, outs, count_out, key_bounds
+
+        return jax.jit(run)
+
+    # ------------------------------------------------------------------
     def snapshot(self) -> dict:
         self._require_state()
         self._to_canonical()  # snapshots use the [K, S] layout across backends
@@ -636,10 +965,19 @@ def _slice_rows(buf, n: int):
     return _row_slicer(n)(buf)
 
 
-def make_superscan_step(agg, K, S, NSB, F, R, SPW, chunk, exact):
+def make_superscan_step(agg, K, S, NSB, F, R, SPW, chunk, exact,
+                        ingest: str = "matmul"):
     """The per-step ingest/fire/purge body, shared by the single-chip
     superscan and the shard_map sharded superscan (each shard runs this on
-    its local key range)."""
+    its local key range).
+
+    `ingest` selects how add-combining fields land in the [K, S] ring:
+    'matmul' (default, unchanged) re-expresses the scatter as MXU one-hot
+    histograms — the TPU form; 'scatter' uses direct scatter-adds, which is
+    what wins on CPU backends (the [K, S] ring is cache-resident and the
+    dense one-hot contraction does K*NSB work per record on a scalar
+    core). Identical math either way: both are pure adds into the same
+    cells, counts exact in int32."""
     import jax
     import jax.numpy as jnp
 
@@ -657,70 +995,108 @@ def make_superscan_step(agg, K, S, NSB, F, R, SPW, chunk, exact):
         idx, vals, smin_pos, fire_pos, fire_valid, fire_row, purge_mask = args
 
         # ingest: MXU histograms over (key, rel-slice) segments for
-        # add-combining fields; min/max fields scatter-combine (no matmul
-        # form exists for order statistics — the scatter unit is the cost
-        # of supporting them on the fused path at all)
-        pc = matmul_hist.count_hist(idx, nseg, chunk=chunk).reshape(K, NSB)
+        # add-combining fields (or direct scatter-adds on CPU backends);
+        # min/max fields always scatter-combine (no matmul form exists for
+        # order statistics — the scatter unit is the cost of supporting
+        # them on the fused path at all)
+        kid = idx // NSB
+        srel = idx % NSB
+        col = (smin_pos + srel) % S
+        safe_kid = jnp.where(idx >= 0, kid, K)  # OOB rows drop
         cols = (smin_pos + jnp.arange(NSB, dtype=jnp.int32)) % S
-        count = count.at[:, cols].add(pc)
+        # CPU add-ingest form: XLA lowers a FLAT 1-D index scatter ~2x
+        # faster than the 2-D (kid, col) scatter, so adds go through a
+        # [K*NSB] staging histogram folded densely into the ring columns —
+        # gated on the dense fold (nseg per step) staying small next to
+        # the batch, so huge-K geometries keep the direct scatter
+        flat_adds = ingest != "matmul" and nseg <= 16 * idx.shape[0]
+        if ingest == "matmul":
+            pc = matmul_hist.count_hist(idx, nseg, chunk=chunk).reshape(K, NSB)
+            count = count.at[:, cols].add(pc)
+        elif flat_adds:
+            # dead rows carry idx -1, which jax would WRAP to the last
+            # segment (numpy negative indexing; mode="drop" only drops
+            # past-the-end) — remap them to nseg so the drop is real
+            safe_idx = jnp.where(idx >= 0, idx, nseg)
+            pc = jnp.zeros((nseg,), jnp.int32).at[safe_idx].add(
+                jnp.int32(1), mode="drop").reshape(K, NSB)
+            count = count.at[:, cols].add(pc)
+        else:
+            count = count.at[safe_kid, col].add(jnp.int32(1), mode="drop")
         new_state = {}
         for name, dt, scatter, ident in vfields:
             if scatter == "add":
-                ph = matmul_hist.weighted_hist(
-                    idx, vals, nseg, chunk=chunk, exact=exact
-                ).reshape(K, NSB)
-                new_state[name] = state[name].at[:, cols].add(ph.astype(dt))
+                if ingest == "matmul":
+                    ph = matmul_hist.weighted_hist(
+                        idx, vals, nseg, chunk=chunk, exact=exact
+                    ).reshape(K, NSB)
+                    new_state[name] = state[name].at[:, cols].add(ph.astype(dt))
+                elif flat_adds:
+                    ph = jnp.zeros((nseg,), dt).at[
+                        jnp.where(idx >= 0, idx, nseg)].add(
+                        vals.astype(dt), mode="drop").reshape(K, NSB)
+                    new_state[name] = state[name].at[:, cols].add(ph)
+                else:
+                    new_state[name] = state[name].at[safe_kid, col].add(
+                        vals.astype(dt), mode="drop")
             else:
-                kid = idx // NSB
-                srel = idx % NSB
-                col = (smin_pos + srel) % S
-                safe_kid = jnp.where(idx >= 0, kid, K)  # OOB rows drop
                 upd = getattr(state[name].at[safe_kid, col], scatter)
                 new_state[name] = upd(vals.astype(dt), mode="drop")
         state = new_state if vfields else state
 
-        # fire: combine the window's slice columns, write compact rows
+        # fire: combine the window's slice columns, write compact rows.
+        # The WHOLE fire body sits under the cond, gathers included: most
+        # steps fire nothing, and the K*SPW column gather+combine per fire
+        # slot is the dominant per-step fixed cost when computed eagerly
+        # (at K=8192, SPW=10, F=2 that is 20x the ingest work of an 8k
+        # batch) — identical results, the eager crow was discarded unless
+        # fire_valid was set anyway
+        _COMBINE = {"add": lambda a: a.sum(axis=1),
+                    "min": lambda a: a.min(axis=1),
+                    "max": lambda a: a.max(axis=1)}
+
         def write_fire(f, bufs):
-            outs, count_out = bufs
             pos = (fire_pos[f] + jnp.arange(SPW, dtype=jnp.int32)) % S
             row = jnp.clip(fire_row[f], 0, R - 1)
-            crow = count[:, pos].sum(axis=1)
-            count_out = jax.lax.cond(
-                fire_valid[f] > 0,
-                lambda b: jax.lax.dynamic_update_index_in_dim(b, crow, row, 0),
-                lambda b: b,
-                count_out,
-            )
-            _COMBINE = {"add": lambda a: a.sum(axis=1),
-                        "min": lambda a: a.min(axis=1),
-                        "max": lambda a: a.max(axis=1)}
-            new_outs = {}
-            for name, _dt, scatter, _ident in vfields:
-                vrow = _COMBINE[scatter](state[name][:, pos])
-                new_outs[name] = jax.lax.cond(
-                    fire_valid[f] > 0,
-                    lambda b, vr=vrow, r=row: jax.lax.dynamic_update_index_in_dim(b, vr, r, 0),
-                    lambda b: b,
-                    outs[name],
-                )
-            return (new_outs if vfields else outs), count_out
+
+            def do_fire(b):
+                outs, count_out = b
+                crow = count[:, pos].sum(axis=1)
+                count_out = jax.lax.dynamic_update_index_in_dim(
+                    count_out, crow, row, 0)
+                new_outs = {}
+                for name, _dt, scatter, _ident in vfields:
+                    vrow = _COMBINE[scatter](state[name][:, pos])
+                    new_outs[name] = jax.lax.dynamic_update_index_in_dim(
+                        outs[name], vrow, row, 0)
+                return (new_outs if vfields else outs), count_out
+
+            return jax.lax.cond(fire_valid[f] > 0, do_fire, lambda b: b, bufs)
 
         bufs = (outs, count_out)
         for f in range(F):
             bufs = write_fire(f, bufs)
         outs, count_out = bufs
 
-        # purge expired ring columns (reset to the field's identity)
-        count = count * purge_mask[None, :]
-        if vfields:
-            state = {
-                name: jnp.where(
-                    purge_mask[None, :] > 0,
-                    state[name],
-                    jnp.asarray(ident, dt),
-                )
-                for name, dt, _scatter, ident in vfields
-            }
+        # purge expired ring columns (reset to the field's identity); under
+        # a cond for the same reason — the S*K multiply/where is pure
+        # identity on the all-ones masks most steps carry
+        def do_purge(sc):
+            state, count = sc
+            count = count * purge_mask[None, :]
+            if vfields:
+                state = {
+                    name: jnp.where(
+                        purge_mask[None, :] > 0,
+                        state[name],
+                        jnp.asarray(ident, dt),
+                    )
+                    for name, dt, _scatter, ident in vfields
+                }
+            return state, count
+
+        state, count = jax.lax.cond(
+            jnp.any(purge_mask == 0), do_purge, lambda sc: sc, (state, count))
         return (state, count, outs, count_out), None
 
     return step

@@ -60,7 +60,7 @@ import numpy as np
 from flink_tpu.connectors.source import Batch, Source, SourceSplit, SplitEnumerator, SourceReader
 from flink_tpu.core.time import MIN_WATERMARK
 from flink_tpu.graph.transformation import Step, StepGraph, Transformation
-from flink_tpu.utils.arrays import obj_array
+from flink_tpu.utils.arrays import as_device_column, obj_array
 
 
 # ---------------------------------------------------------------------------
@@ -388,7 +388,12 @@ class _StageReader(SourceReader):
                     # HERE, on the run-loop thread between batches
                     self._aligner.on_barrier(self._gate, int(msg[1]))
                 return _EMPTY_BATCH
-            return Batch(values=msg[1],
+            # numeric columns forward device-ready: the binary wire decodes
+            # straight into contiguous np.frombuffer views, which pass
+            # through untouched and jax.device_put can stage without a host
+            # transform pass (whole-graph fusion ingest, docs/fusion.md);
+            # only a non-contiguous view pays one compaction here
+            return Batch(values=as_device_column(msg[1]),
                          timestamps=np.asarray(msg[2], dtype=np.int64))
         return None
 

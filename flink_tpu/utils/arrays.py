@@ -15,3 +15,56 @@ def obj_array(items: Sequence) -> np.ndarray:
     if len(items):
         arr[:] = list(items)
     return arr
+
+
+def canonical_column(arr, where: str = "column"):
+    """Cast a numeric column to jax's canonical dtype (x64-off: int64→int32,
+    float64→float32), refusing LOUDLY when the values don't survive the
+    narrowing. Traceable UDFs compute in canonical dtype on EVERY path —
+    the fused device program stages canonical buffers, and the host
+    fallback casts through here too — so fused-vs-fallback results stay
+    identical, and a value that would silently wrap (ints) or overflow to
+    inf (floats) is an error, never a silent semantics divergence."""
+    from jax import dtypes as _jdt
+
+    arr = np.asarray(arr)
+    dt = np.dtype(_jdt.canonicalize_dtype(arr.dtype))
+    if dt == arr.dtype:
+        return arr
+    if arr.size and np.issubdtype(arr.dtype, np.integer):
+        info = np.iinfo(dt)
+        lo, hi = int(arr.min()), int(arr.max())
+        if lo < info.min or hi > info.max:
+            raise TypeError(
+                f"{where}: values in [{lo}, {hi}] do not fit the backend's "
+                f"canonical {dt} (jax x64 is disabled) and would silently "
+                "wrap; re-encode the column, enable jax x64, or drop "
+                "traceable=True to keep the host chain"
+            )
+        return arr.astype(dt)
+    with np.errstate(over="ignore", invalid="ignore"):
+        out = arr.astype(dt)  # overflow checked explicitly below
+    if arr.size and np.issubdtype(arr.dtype, np.floating):
+        bad = ~np.isfinite(out) & np.isfinite(arr)
+        if bad.any():
+            raise TypeError(
+                f"{where}: {int(bad.sum())} value(s) overflow the backend's "
+                f"canonical {dt} (jax x64 is disabled); re-scale the column, "
+                "enable jax x64, or drop traceable=True to keep the host "
+                "chain"
+            )
+    return out
+
+
+def as_device_column(arr):
+    """Normalize a numeric column for device staging without copying when
+    avoidable: a contiguous numeric ndarray (including the read-only
+    `np.frombuffer` views the binary columnar wire decodes into) passes
+    through untouched — `jax.device_put` accepts read-only buffers — and
+    only a non-contiguous view pays one compaction copy. Object arrays are
+    returned unchanged (record-mode data; columnarized downstream)."""
+    if not isinstance(arr, np.ndarray) or arr.dtype == object:
+        return arr
+    if arr.flags.c_contiguous:
+        return arr
+    return np.ascontiguousarray(arr)

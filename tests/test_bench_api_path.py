@@ -1,0 +1,66 @@
+"""Bench smoke gate for the API-path scenario (whole-graph fusion).
+
+Runs the real `bench.api_path_microbench` at smoke scale and asserts the
+result JSON carries the keys every BENCH_*.json must now track — so a
+regression that silently reroutes the DataStream API back to the slow
+ChainRunner path (fused_selected False) or breaks result parity fails
+tier-1, not just a human eyeballing the next bench run. Throughput
+NUMBERS are deliberately not asserted (sandbox scheduler noise); the
+structural keys and the parity/fused-selection booleans are the gate.
+"""
+
+import importlib.util
+import pathlib
+
+import pytest
+
+_BENCH = pathlib.Path(__file__).resolve().parents[1] / "bench.py"
+
+
+@pytest.fixture(scope="module")
+def bench():
+    spec = importlib.util.spec_from_file_location("bench_smoke", _BENCH)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+@pytest.fixture(scope="module")
+def result(bench):
+    # smoke scale: small batch + few events keeps compile+run well under a
+    # minute on the CPU backend while exercising warmup, parity (all three
+    # paths), and the timed sweeps exactly as the real bench does
+    return bench.api_path_microbench(events=8192, batch=2048)
+
+
+def test_result_carries_the_tracked_keys(result):
+    for key in (
+        "api_path_tuples_per_sec",
+        "chain_runner_tuples_per_sec",
+        "scalar_api_tuples_per_sec",
+        "speedup_vs_chain_runner",
+        "speedup_vs_scalar_api",
+        "parity",
+        "fused_selected",
+    ):
+        assert key in result, f"bench result JSON lost {key!r}"
+    assert result["api_path_tuples_per_sec"] > 0
+
+
+def test_api_path_parity_is_exact(result):
+    assert result["parity"] is True, (
+        "fused vs chain vs per-record scalar results diverged — the API "
+        "path is emitting different windows than the oracle"
+    )
+
+
+def test_fused_runner_is_actually_selected(result):
+    assert result["fused_selected"] is True, (
+        "graph translation no longer routes the benchmark program to "
+        "DeviceChainRunner — parity would still hold on the slow path, "
+        "so this flag is the reroute gate"
+    )
+
+
+def test_windows_were_emitted(result):
+    assert result["windows_emitted"] > 0

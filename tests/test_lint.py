@@ -88,6 +88,16 @@ def test_path_scoped_rules_are_not_vacuous():
     assert "metrics" in LAYER_FORBIDDEN and any(
         "scheduler" in b for b in LAYER_FORBIDDEN["metrics"]), (
         "metrics layer no longer forbids importing the scheduler")
+    # the fusion planner must stay in graph/ under the graph layer's
+    # runtime ban: the DeviceChainPlan is pure data about transformations,
+    # and a planner that imports the runtime inverts the translation DAG
+    assert "graph" in LAYER_FORBIDDEN and any(
+        "runtime" in b for b in LAYER_FORBIDDEN["graph"]), (
+        "graph layer no longer forbids runtime imports — the fusion "
+        "planner (graph/fusion.py) must not reach into the executor")
+    assert index.get("graph/fusion.py") is not None, (
+        "graph/fusion.py missing — the whole-graph fusion planner moved "
+        "and ARCH001's graph-layer ban no longer covers it")
     for rel in CONTROL_PLANE:
         assert index.get(rel) is not None, (
             f"control-plane module {rel} missing — CONTROL_PLANE is stale "
