@@ -431,6 +431,98 @@ class ObservabilityOptions:
         "chain, restart number; restore duration, rewound checkpoint id, "
         "replay depth, downtime), served at /jobs/:id/exceptions."
     )
+    DEVICE_STATS_ENABLED = (
+        ConfigOptions.key("observability.device.enabled")
+        .bool_type().default_value(True)
+    ).with_description(
+        "Device-plane observability for device window operators: XLA "
+        "compile/recompile tracking with shape-signature cause attribution "
+        "(ring doubling, batch-geometry churn, dtype change), per-kernel "
+        "cost/roofline gauges (hbmUtilizationPct, flopsUtilizationPct), "
+        "per-phase ingest/fire/purge step counters threaded through the "
+        "superscan carry, and per-key-group load telemetry (keySkew, hot "
+        "keys). Served at /jobs/:id/device and shipped TM->JM on the "
+        "heartbeat. Per-batch host cost is O(1); the key-stats fold runs "
+        "on device on its own sampling interval."
+    )
+    DEVICE_RECOMPILE_HISTORY_SIZE = (
+        ConfigOptions.key("observability.device.recompile-history.size")
+        .int_type().default_value(32)
+    ).with_description(
+        "Compile events retained in the per-job recompile-event ring "
+        "(program, shape signature, cause, compile wall time). The "
+        "lifetime compile/recompile counters are unaffected by the ring "
+        "size."
+    )
+    DEVICE_RECOMPILE_STORM_THRESHOLD = (
+        ConfigOptions.key("observability.device.recompile-storm.threshold")
+        .int_type().default_value(4)
+    ).with_description(
+        "Recompiles within observability.device.recompile-storm.window-ms "
+        "that flip the recompileStorm warning gauge to 1 — a job re-jitting "
+        "at this rate is paying compile latency on the hot path (growing "
+        "key dictionary, churning batch geometry)."
+    )
+    DEVICE_RECOMPILE_STORM_WINDOW_MS = (
+        ConfigOptions.key("observability.device.recompile-storm.window-ms")
+        .duration_ms_type().default_value(60_000)
+    ).with_description(
+        "Sliding window over which recompiles are counted for the "
+        "recompileStorm warning gauge."
+    )
+    DEVICE_COST_ANALYSIS_ENABLED = (
+        ConfigOptions.key("observability.device.cost-analysis.enabled")
+        .bool_type().default_value(True)
+    ).with_description(
+        "Capture XLA cost analysis (FLOPs, bytes accessed) for each "
+        "compiled device program at compile time — the numerator of the "
+        "roofline gauges. Costs one extra trace (no compile) per program "
+        "signature; utilization gauges read 0 when disabled."
+    )
+    DEVICE_MEMORY_ANALYSIS_ENABLED = (
+        ConfigOptions.key("observability.device.memory-analysis.enabled")
+        .bool_type().default_value(False)
+    ).with_description(
+        "Additionally capture compiled-executable memory analysis (temp/"
+        "output/argument HBM bytes) per program signature. jax exposes "
+        "this only on AOT-compiled executables, so enabling it costs one "
+        "EXTRA compile per program signature — leave off on TPU jobs "
+        "whose superscan compiles take seconds; the cost-analysis roofline "
+        "does not need it."
+    )
+    DEVICE_KEY_STATS_INTERVAL_MS = (
+        ConfigOptions.key("observability.device.key-stats.interval-ms")
+        .duration_ms_type().default_value(1000)
+    ).with_description(
+        "How often the per-key-group load fold runs (one device "
+        "segment-sum over the resident window state + a tiny host "
+        "readback). Gauges (keySkew, activeKeys, keyGroupLoad histogram, "
+        "top-K hot keys) hold the latest fold between runs."
+    )
+    DEVICE_KEY_STATS_TOP_K = (
+        ConfigOptions.key("observability.device.key-stats.top-k")
+        .int_type().default_value(8)
+    ).with_description(
+        "Hot keys reported per operator by the key-stats fold (dense key "
+        "id + resident record count, hottest first)."
+    )
+    DEVICE_HBM_GBPS = (
+        ConfigOptions.key("observability.device.hbm-gbps")
+        .float_type().default_value(0.0)
+    ).with_description(
+        "HBM bandwidth (GB/s) used as the denominator of the "
+        "hbmUtilizationPct roofline gauge. 0 picks a per-platform default "
+        "(tpu/gpu/cpu); set it to the bench-measured hbm_gbps of the "
+        "actual part for calibrated utilization."
+    )
+    DEVICE_PEAK_TFLOPS = (
+        ConfigOptions.key("observability.device.peak-tflops")
+        .float_type().default_value(0.0)
+    ).with_description(
+        "Peak compute (TFLOP/s) used as the denominator of the "
+        "flopsUtilizationPct roofline gauge. 0 picks a per-platform "
+        "default."
+    )
 
 
 class AutoscalerOptions:

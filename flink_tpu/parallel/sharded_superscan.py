@@ -96,7 +96,39 @@ class ShardedFusedPipeline:
         self._value_fields = [f for f in self.agg.fields if f.source == VALUE]
         self._needs_vals = bool(self._value_fields)
         self._init_state()
-        self._fn_cache: Dict[Tuple[int, int], Any] = {}
+        self._fn_cache: Dict[Tuple, Any] = {}
+        # device-plane observability: an attached CompileTracker wraps the
+        # sharded dispatch; phase counters thread through the shared
+        # superscan step body (summed over shards at resolve, accumulated
+        # into the planner's phase_totals)
+        self.compile_tracker = None
+        self.phase_counters = False
+
+    # ------------------------------------------------------------------
+    def attach_device_stats(self, tracker, phase_counters: bool = True) -> None:
+        """Wire a CompileTracker (metrics/device_stats.py) around the
+        sharded dispatch. Call before the first dispatch: the phase flag
+        is part of the executable cache key."""
+        self.compile_tracker = tracker
+        self.phase_counters = bool(phase_counters)
+
+    @property
+    def phase_totals(self):
+        return self._planner.phase_totals
+
+    def key_loads(self):
+        """Global per-key record counts ([K]) for the key-stats fold —
+        one reshape + segment-sum over the sharded count ring."""
+        count = getattr(self, "_count", None)
+        if count is None:
+            return None
+        return count.reshape(self.K, self.S).sum(axis=1)
+
+    def key_stats_ready(self) -> bool:
+        return self._planner.max_seen_slice is not None
+
+    def state_row_bytes(self) -> int:
+        return self._planner.state_row_bytes()
 
     # ------------------------------------------------------------------
     def _shard_spec(self, *tail):
@@ -119,7 +151,8 @@ class ShardedFusedPipeline:
 
     # ------------------------------------------------------------------
     def _build(self, T: int, B: int):
-        key = (T, B)
+        phases = self.phase_counters
+        key = (T, B, phases)
         if key in self._fn_cache:
             return self._fn_cache[key]
 
@@ -132,7 +165,7 @@ class ShardedFusedPipeline:
             chunk //= 2
         step = make_superscan_step(
             self.agg, Kl, S, NSB, self.F, R, self._planner.spw, chunk,
-            self.exact,
+            self.exact, phase_counters=phases,
         )
         nf = len(self._value_fields)
 
@@ -177,18 +210,36 @@ class ShardedFusedPipeline:
                 for f in self._value_fields
             }
             count_out0 = jnp.zeros((R, Kl), jnp.int32)
-            (state, count, outs, count_out), _ = jax.lax.scan(
+            carry0 = (state, count, outs0, count_out0)
+            if phases:
+                carry0 = carry0 + (jnp.zeros((3,), jnp.int32),)
+            carry, _ = jax.lax.scan(
                 routed_step,
-                (state, count, outs0, count_out0),
+                carry0,
                 (idx, vals, smin_pos, fire_pos, fire_valid, fire_row,
                  purge_mask),
             )
+            if phases:
+                state, count, outs, count_out, pc = carry
+            else:
+                state, count, outs, count_out = carry
             names = [f.name for f in self._value_fields]
-            return (
+            out = (
                 count[None], tuple(state[nm][None] for nm in names),
                 count_out[None], tuple(outs[nm][None] for nm in names),
             )
+            if phases:
+                out = out + (pc[None],)   # [1, 3] per shard
+            return out
 
+        out_specs = (
+            P(axis, None, None),
+            (P(axis, None, None),) * nf,
+            P(axis, None, None),                      # count_out [n,R,Kl]
+            (P(axis, None, None),) * nf,
+        )
+        if phases:
+            out_specs = out_specs + (P(axis, None),)  # phase counters [n,3]
         sharded = shard_map(
             per_shard,
             mesh=self.mesh,
@@ -200,12 +251,7 @@ class ShardedFusedPipeline:
                 P(None), P(None, None), P(None, None), P(None, None),
                 P(None, None),                            # plan (replicated)
             ),
-            out_specs=(
-                P(axis, None, None),
-                (P(axis, None, None),) * nf,
-                P(axis, None, None),                      # count_out [n,R,Kl]
-                (P(axis, None, None),) * nf,
-            ),
+            out_specs=out_specs,
             check_vma=False,
         )
         fn = jax.jit(sharded)
@@ -254,11 +300,23 @@ class ShardedFusedPipeline:
         B = int(idx_d.shape[2])
         run = self._build(T, B)
         names = [f.name for f in self._value_fields]
-        count, states, count_out, field_outs = run(
-            self._count, tuple(self._state[nm] for nm in names),
-            idx_d, vals_d, smin_pos, fire_pos, fire_valid, fire_row,
-            purge_mask,
-        )
+        args = (self._count, tuple(self._state[nm] for nm in names),
+                idx_d, vals_d, smin_pos, fire_pos, fire_valid, fire_row,
+                purge_mask)
+        if self.compile_tracker is not None:
+            out = self.compile_tracker.call(
+                "sharded_superscan", run, args,
+                {"T": T, "B": B, "K": self.K, "S": self.S, "n": self.n,
+                 "dtype": "+".join(str(np.dtype(f.dtype))
+                                   for f in self._value_fields) or "count"})
+        else:
+            out = run(*args)
+        pc_total = None
+        if self.phase_counters:
+            count, states, count_out, field_outs, pc = out
+            pc_total = pc.sum(axis=0)   # fold the shard axis on device
+        else:
+            count, states, count_out, field_outs = out
         self._count = count
         self._state = dict(zip(names, states))
         # [n, R, K_local] -> [R, K] (contiguous key ranges)
@@ -267,7 +325,8 @@ class ShardedFusedPipeline:
             nm: jnp.transpose(o, (1, 0, 2)).reshape(self.R, self.K)
             for nm, o in zip(names, field_outs)
         }
-        deferred = DeferredEmissions(self._planner, fires, count_rows, out_rows)
+        deferred = DeferredEmissions(self._planner, fires, count_rows,
+                                     out_rows, phase_counts=pc_total)
         return deferred if defer else deferred.resolve()
 
     # ------------------------------------------------------------------

@@ -231,12 +231,18 @@ def _shard_combine(key: str) -> str:
     report progress a straggler shard has not made); everything else
     (counters, totals, and THROUGHPUT rates like numRecordsInPerSecond,
     which is work done) sums. Matches on the full key, not just the leaf:
-    per-channel gauges like exchange.inPoolUsage.<n> have a numeric leaf."""
+    per-channel gauges like exchange.inPoolUsage.<n> have a numeric leaf.
+    Device-plane additions: skew/storm/hot-key gauges take the MAX (the
+    job's skew is its worst shard — summing a per-shard ratio would be
+    meaningless and averaging would hide a single hot shard), roofline
+    utilization percentages average (each shard's own chip's fraction)."""
     leaf = key.rsplit(".", 1)[-1]
     if leaf.startswith("current"):
         return "min"
+    if leaf in ("keySkew", "recompileStorm", "hotKeyLoad"):
+        return "max"
     if "Ratio" in leaf or leaf.endswith("TimeMsPerSecond") \
-            or "inPoolUsage" in key:
+            or leaf.endswith("UtilizationPct") or "inPoolUsage" in key:
         return "mean"
     return "sum"
 
@@ -266,7 +272,9 @@ def aggregate_shard_metrics(per_shard: Dict[int, dict]) -> dict:
     wm_skews = []
     for key, vals in scalars.items():
         how = _shard_combine(key)
-        if how == "min":
+        if how == "max":
+            agg[key] = max(vals)
+        elif how == "min":
             agg[key] = min(vals)
             # job-level watermark skew: max-min currentWatermark across the
             # subtasks of one operator — how far the combined (MIN) watermark
@@ -759,6 +767,61 @@ class JobManagerEndpoint(RpcEndpoint):
             payload.update(num_rescales=job.num_rescales,
                            last_rescale_duration_ms=job.last_rescale_duration_ms)
         payload["parallelism"] = job.parallelism
+        return payload
+
+    def job_device(self, job_id: str) -> dict:
+        """Device-plane view (/jobs/:id/device) of a distributed job: the
+        job-level fold of the TM-shipped device gauges (compile counters
+        sum, storm/skew take the worst shard, roofline percentages
+        average) plus the 'device'-scope compile-event spans the TMs
+        shipped on the heartbeat — shape-compatible with the MiniCluster
+        payload so one dashboard panel reads both."""
+        from flink_tpu.metrics.device_stats import empty_device_payload
+
+        job = self._jobs[job_id]
+        agg, per_shard, _ = self._aggregated_job_metrics(job)
+
+        def _num(key, cast=float, default=0):
+            v = agg.get(key)
+            return cast(v) if isinstance(v, (int, float)) else default
+
+        events = []
+        for sd in job.spans:
+            if sd.get("scope") != "device":
+                continue
+            attrs = sd.get("attributes") or {}
+            events.append({
+                "program": attrs.get("program"),
+                "signature": attrs.get("signature"),
+                "cause": attrs.get("cause"),
+                "recompile": bool(attrs.get("recompile", False)),
+                "compile_count": attrs.get("compileCount"),
+                "duration_ms": attrs.get("durationMs"),
+                "wall_ts_ms": sd.get("end_ts_ms"),
+                "shard": attrs.get("shard"),
+            })
+        payload = empty_device_payload()
+        payload["compile"].update(
+            numCompiles=_num("job.device.numCompiles", int),
+            numRecompiles=_num("job.device.numRecompiles", int),
+            compileTimeMsTotal=_num("job.device.compileTimeMsTotal"),
+            recompileStorm=_num("job.device.recompileStorm", int),
+            events=events[-64:],
+        )
+        device_keys = {
+            k: v for k, v in agg.items()
+            if ".device." in k or k.rsplit(".", 1)[-1] in (
+                "keySkew", "activeKeys", "hotKeyLoad", "keyGroupLoad",
+                "keyGroupStateBytes", "hbmUtilizationPct",
+                "flopsUtilizationPct")
+        }
+        payload["metrics"] = device_keys
+        payload["per_shard"] = {
+            s: {k: v for k, v in snap.items()
+                if ".device." in k or "keySkew" in k}
+            for s, snap in per_shard.items()
+        }
+        payload["enabled"] = bool(device_keys or events)
         return payload
 
     # ---- scheduling (M4-lite: deploy when slots cover parallelism) -------
@@ -1655,6 +1718,62 @@ class _ShardTask:
                 op_group.gauge(gauge_name, fn)
         op_group.gauge("numLateRecordsDropped",
                        lambda: getattr(op, "num_late_records_dropped", 0))
+        # device-plane observability: compile tracking where the operator
+        # exposes the attach surface (fused/sharded paths), key-skew
+        # telemetry wherever per-key counts are device-resident. The
+        # gauges ship to the JM on the heartbeat snapshots (job.device.*,
+        # job.keySkew feeds scheduler/signals.py); compile events ride the
+        # span buffer as 'device'-scope spans.
+        key_stats = None
+        O = ObservabilityOptions
+
+        def _opt(option):
+            return cfg.get(option) if cfg is not None else option.default
+
+        if _opt(O.DEVICE_STATS_ENABLED):
+            attach = getattr(op, "attach_device_stats", None)
+            if attach is not None:
+                from flink_tpu.metrics.device_stats import CompileTracker
+
+                def _emit_compile_span(ev, task=self):
+                    task.record_span(
+                        "device", "XlaCompile",
+                        ev["wall_ts_ms"] - ev["duration_ms"],
+                        program=ev.get("program"),
+                        signature=ev.get("signature"),
+                        cause=ev.get("cause"),
+                        recompile=bool(ev.get("recompile", False)),
+                        compileCount=int(ev.get("compile_count", 1)),
+                        durationMs=float(ev.get("duration_ms", 0.0)),
+                    )
+
+                tracker = CompileTracker(
+                    history_size=_opt(O.DEVICE_RECOMPILE_HISTORY_SIZE),
+                    storm_threshold=_opt(O.DEVICE_RECOMPILE_STORM_THRESHOLD),
+                    storm_window_ms=_opt(O.DEVICE_RECOMPILE_STORM_WINDOW_MS),
+                    cost_analysis=_opt(O.DEVICE_COST_ANALYSIS_ENABLED),
+                    memory_analysis=_opt(O.DEVICE_MEMORY_ANALYSIS_ENABLED),
+                    on_event=_emit_compile_span,
+                )
+                attach(tracker)
+                tracker.register(self.registry.group("job", "device"))
+            loads_fn = getattr(op, "key_loads", None)
+            if loads_fn is not None:
+                from flink_tpu.metrics.key_stats import KeyStatsCollector
+
+                key_stats = KeyStatsCollector(
+                    loads_fn,
+                    num_key_groups=self.spec.max_parallelism,
+                    top_k=_opt(O.DEVICE_KEY_STATS_TOP_K),
+                    row_bytes_fn=getattr(op, "state_row_bytes", None),
+                    ready_fn=getattr(op, "key_stats_ready", None),
+                    interval_ms=_opt(O.DEVICE_KEY_STATS_INTERVAL_MS),
+                )
+                key_stats.register(op_group)
+                # the job-level gauge the autoscaler's signal extractor
+                # reads (absent on builds without device stats — the
+                # signal is OPTIONAL there, never implicit zero)
+                job_group.gauge("keySkew", key_stats.skew)
         results: list = []
         self._resolve_local_restore()
         if self.restore is not None:
@@ -1804,6 +1923,10 @@ class _ShardTask:
                 else:
                     for i in range(len(mk)):
                         op.process_record(mk[i], float(mv[i]), int(mt[i]))
+                if key_stats is not None:
+                    # one clock compare when not due; a due fold runs
+                    # BEFORE the watermark's purge sweep
+                    key_stats.maybe_collect()
                 if combined_wm > MIN_WATERMARK:
                     op.process_watermark(combined_wm)
                 results.extend(op.drain_output())

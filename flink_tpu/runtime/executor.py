@@ -526,6 +526,77 @@ class WindowStepRunner(StepRunner):
             if self.device and config.get(ObservabilityOptions.DEVICE_TIMING_ENABLED)
             else None
         )
+        self._init_device_stats(config)
+
+    def _init_device_stats(self, config: Configuration) -> None:
+        """Device-plane observability (metrics/device_stats.py + key_stats):
+        a CompileTracker wrapped around the operator's jit entry points
+        (operators without the attach surface — oracle, session, global —
+        simply skip it) and a throttled key-stats collector over the
+        operator's device-resident per-key counts. Gated like device
+        timing; per-batch host cost is one clock compare."""
+        self.device_stats = None
+        self.key_stats = None
+        self._roofline_peaks = None
+        if not (self.device
+                and config.get(ObservabilityOptions.DEVICE_STATS_ENABLED)):
+            return
+        from flink_tpu.metrics.device_stats import (
+            CompileTracker,
+            platform_peaks,
+        )
+
+        attach = getattr(self.op, "attach_device_stats", None)
+        if attach is not None:
+            tracker = CompileTracker(
+                history_size=config.get(
+                    ObservabilityOptions.DEVICE_RECOMPILE_HISTORY_SIZE),
+                storm_threshold=config.get(
+                    ObservabilityOptions.DEVICE_RECOMPILE_STORM_THRESHOLD),
+                storm_window_ms=config.get(
+                    ObservabilityOptions.DEVICE_RECOMPILE_STORM_WINDOW_MS),
+                cost_analysis=config.get(
+                    ObservabilityOptions.DEVICE_COST_ANALYSIS_ENABLED),
+                memory_analysis=config.get(
+                    ObservabilityOptions.DEVICE_MEMORY_ANALYSIS_ENABLED),
+            )
+            attach(tracker)
+            self.device_stats = tracker
+            self._roofline_peaks = platform_peaks(
+                config.get(ObservabilityOptions.DEVICE_HBM_GBPS),
+                config.get(ObservabilityOptions.DEVICE_PEAK_TFLOPS))
+        loads_fn = getattr(self.op, "key_loads", None)
+        if loads_fn is not None:
+            from flink_tpu.config import PipelineOptions as _PO
+            from flink_tpu.metrics.key_stats import KeyStatsCollector
+
+            self.key_stats = KeyStatsCollector(
+                loads_fn,
+                num_key_groups=config.get(_PO.MAX_PARALLELISM),
+                top_k=config.get(
+                    ObservabilityOptions.DEVICE_KEY_STATS_TOP_K),
+                row_bytes_fn=getattr(self.op, "state_row_bytes", None),
+                ready_fn=getattr(self.op, "key_stats_ready", None),
+                interval_ms=config.get(
+                    ObservabilityOptions.DEVICE_KEY_STATS_INTERVAL_MS),
+            )
+
+    def _device_stats_tick(self) -> None:
+        if self.key_stats is not None:
+            self.key_stats.maybe_collect()
+
+    def device_roofline(self) -> Dict[str, float]:
+        """hbmUtilizationPct / flopsUtilizationPct over the DeviceTimer's
+        measured device wall time (0.0 when either side is ungated)."""
+        from flink_tpu.metrics.device_stats import roofline_pct
+
+        tracker, timer = self.device_stats, self.device_timer
+        if tracker is None or timer is None or self._roofline_peaks is None:
+            return {"hbmUtilizationPct": 0.0, "flopsUtilizationPct": 0.0}
+        hbm, tflops = self._roofline_peaks
+        return roofline_pct(tracker.bytes_accessed_total(),
+                            tracker.flops_total(), timer.total_s,
+                            hbm, tflops)
 
     def on_batch(self, values: np.ndarray, timestamps: np.ndarray) -> None:
         if self.key_traceable and len(timestamps):
@@ -561,6 +632,7 @@ class WindowStepRunner(StepRunner):
                     self.op.process_batch(keys, nums, timestamps)
             else:
                 self.op.process_batch(keys, nums, timestamps)
+            self._device_stats_tick()
         else:
             if self.processing_time:
                 # PT windows: assignment & timers use wall clock, not event ts
@@ -588,6 +660,10 @@ class WindowStepRunner(StepRunner):
                 self._drain()
 
     def on_watermark(self, watermark: int) -> None:
+        if self.device and self.key_stats is not None:
+            # fold BEFORE the watermark's purge sweep so a due collection
+            # sees the state the advance is about to retire
+            self._device_stats_tick()
         if self.device_timer is not None:
             with self.device_timer.section():
                 self.op.process_watermark(watermark)
@@ -614,6 +690,7 @@ class WindowStepRunner(StepRunner):
     def on_processing_time(self, now_ms: int) -> None:
         # PT windows fire from the shared ProcessingTimeService tick, not
         # only when their own source produces a batch
+        self._device_stats_tick()
         if self.processing_time:
             self.op.advance_processing_time(now_ms)
             self._drain()
@@ -667,6 +744,23 @@ class WindowStepRunner(StepRunner):
         key_count = getattr(self.op, "state_key_count", None)
         if key_count is not None:
             group.gauge("stateKeyCount", key_count)
+        # device plane: compile counters, roofline, phase counters, key
+        # telemetry — all on the operator scope so laggard kernels are
+        # attributable per step
+        if self.device_stats is not None:
+            self.device_stats.register(group)
+            group.gauge("hbmUtilizationPct",
+                        lambda: self.device_roofline()["hbmUtilizationPct"])
+            group.gauge("flopsUtilizationPct",
+                        lambda: self.device_roofline()["flopsUtilizationPct"])
+            phases = getattr(self.op, "phase_totals", None)
+            if callable(phases):
+                group.gauge("phaseIngestRecords",
+                            lambda: phases()["ingestRecords"])
+                group.gauge("phaseFireSteps", lambda: phases()["fireSteps"])
+                group.gauge("phasePurgeSteps", lambda: phases()["purgeSteps"])
+        if self.key_stats is not None:
+            self.key_stats.register(group)
 
     def snapshot(self) -> dict:
         return {"operator": self.op.snapshot()}
@@ -725,6 +819,7 @@ class DeviceChainRunner(WindowStepRunner):
             if config.get(ObservabilityOptions.DEVICE_TIMING_ENABLED)
             else None
         )
+        self._init_device_stats(config)
         self._warned_object_columns = False
 
     def on_batch(self, values: np.ndarray, timestamps: np.ndarray) -> None:
@@ -753,6 +848,7 @@ class DeviceChainRunner(WindowStepRunner):
                 self.op.process_raw_batch(vals, timestamps)
         else:
             self.op.process_raw_batch(vals, timestamps)
+        self._device_stats_tick()
 
 
 class KeyedReduceRunner(StepRunner):
@@ -1477,9 +1573,11 @@ class JobRuntime:
                 self.generator.restore(snap["generator"])
 
     def __init__(self, graph: StepGraph, config: Configuration,
-                 registry: Optional[MetricRegistry] = None):
+                 registry: Optional[MetricRegistry] = None,
+                 traces=None):
         self.graph = graph
         self.config = config
+        self.traces = traces    # optional TraceRegistry for device spans
         self.runners, feeds = build_runners(graph, config)
         self.sources = [
             JobRuntime._SourceDriver(t, feeds.get(t.id, []))
@@ -1532,6 +1630,52 @@ class JobRuntime:
             r.device_timer.total_s * 1000.0
             for r in self.runners
             if getattr(r, "device_timer", None) is not None))
+        # device plane: job-level compile/roofline/skew gauges — these are
+        # the keys the TM heartbeat ships and the autoscaler's signal
+        # extractor reads (job.device.*, job.keySkew); compile events also
+        # ride the TraceRegistry as 'device'-scope spans when one is bound
+        trackers = [r.device_stats for r in self.runners
+                    if getattr(r, "device_stats", None) is not None]
+        collectors = [r.key_stats for r in self.runners
+                      if getattr(r, "key_stats", None) is not None]
+        if trackers:
+            dg = job_group.add_group("device")
+            dg.gauge("numCompiles",
+                     lambda: sum(t.num_compiles for t in trackers))
+            dg.gauge("numRecompiles",
+                     lambda: sum(t.num_recompiles for t in trackers))
+            dg.gauge("compileTimeMsTotal", lambda: round(
+                sum(t.compile_ms_total for t in trackers), 3))
+            dg.gauge("recompileStorm",
+                     lambda: max(t.recompile_storm() for t in trackers))
+            dg.gauge("hbmUtilizationPct", lambda: max(
+                (r.device_roofline()["hbmUtilizationPct"]
+                 for r in self.runners
+                 if getattr(r, "device_stats", None) is not None),
+                default=0.0))
+            dg.gauge("flopsUtilizationPct", lambda: max(
+                (r.device_roofline()["flopsUtilizationPct"]
+                 for r in self.runners
+                 if getattr(r, "device_stats", None) is not None),
+                default=0.0))
+        if collectors:
+            def _job_skew(cs=collectors):
+                skews = [s for s in (c.skew() for c in cs) if s is not None]
+                return max(skews) if skews else None
+
+            job_group.gauge("keySkew", _job_skew)
+        if traces is not None and trackers:
+            from flink_tpu.metrics.device_stats import compile_event_span
+
+            for t in trackers:
+                if t.on_event is None:
+                    t.on_event = (lambda ev, _tr=traces:
+                                  _tr.report(compile_event_span(ev)))
+        # profiler capture surface (observability.profiler.*): the REST
+        # /jobs/:id/device payload reports where captures landed — the
+        # per-attempt jax.profiler trace used to be write-only
+        self.profiler_captures = 0
+        self.last_profiler_capture_dir: Optional[str] = None
         self._marker_interval = config.get(ObservabilityOptions.MARKER_INTERVAL_MS)
         self._sampling_interval = config.get(ObservabilityOptions.SAMPLING_INTERVAL_MS)
 
@@ -1590,6 +1734,52 @@ class JobRuntime:
                 continue        # checkpoint's bookkeeping
         return out
 
+    def device_snapshot(self) -> Dict[str, Any]:
+        """The device-plane payload (/jobs/:id/device): merged compile
+        block, per-operator cost/roofline/phase/key telemetry, and the
+        profiler capture surface. Plain data, JSON-safe."""
+        from flink_tpu.metrics.device_stats import (
+            empty_device_payload,
+            merge_compile_payloads,
+        )
+
+        payload = empty_device_payload()
+        ops: Dict[str, Any] = {}
+        compile_payloads = []
+        for idx, r in enumerate(self.runners):
+            tracker = getattr(r, "device_stats", None)
+            ks = getattr(r, "key_stats", None)
+            timer = getattr(r, "device_timer", None)
+            if tracker is None and ks is None:
+                continue
+            entry: Dict[str, Any] = {}
+            if timer is not None:
+                entry["deviceTimeMsTotal"] = round(timer.total_s * 1000.0, 3)
+                entry["deviceDispatches"] = timer.dispatches
+            if tracker is not None:
+                cp = tracker.payload()
+                compile_payloads.append(cp)
+                entry["compile"] = cp
+                entry.update(r.device_roofline())
+            phases = getattr(getattr(r, "op", None), "phase_totals", None)
+            if callable(phases):
+                entry["phases"] = phases()
+            if ks is not None:
+                entry["keys"] = ks.payload()
+            ops[getattr(r, "uid", f"runner-{idx}")] = entry
+        payload["operators"] = ops
+        payload["compile"] = merge_compile_payloads(
+            compile_payloads,
+            history_size=self.config.get(
+                ObservabilityOptions.DEVICE_RECOMPILE_HISTORY_SIZE))
+        payload["enabled"] = bool(ops)
+        payload["profiler"] = {
+            "enabled": self.config.get(ObservabilityOptions.PROFILER_ENABLED),
+            "captures": self.profiler_captures,
+            "last_capture_dir": self.last_profiler_capture_dir,
+        }
+        return payload
+
     # -- the loop ---------------------------------------------------------
     def run(
         self,
@@ -1601,12 +1791,12 @@ class JobRuntime:
         if coordinator is not None:
             coordinator.register_on_complete(self.commit_sinks)
         profiling = False
+        profile_dir = self.config.get(ObservabilityOptions.PROFILER_DIR)
         if self.config.get(ObservabilityOptions.PROFILER_ENABLED):
             try:
                 import jax.profiler
 
-                jax.profiler.start_trace(
-                    self.config.get(ObservabilityOptions.PROFILER_DIR))
+                jax.profiler.start_trace(profile_dir)
                 profiling = True
             except Exception as e:  # noqa: BLE001 — observability never
                 import warnings      # fails the job
@@ -1622,6 +1812,10 @@ class JobRuntime:
                     import jax.profiler
 
                     jax.profiler.stop_trace()
+                    # the capture is no longer write-only: count it and
+                    # remember where it landed, for /jobs/:id/device
+                    self.profiler_captures += 1
+                    self.last_profiler_capture_dir = profile_dir
                 except Exception:
                     pass
 

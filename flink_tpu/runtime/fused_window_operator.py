@@ -605,6 +605,35 @@ class FusedWindowOperator:
     def num_late_records_dropped(self) -> int:
         return self.pipe.num_late_records_dropped
 
+    # -- device-plane observability ------------------------------------
+    def attach_device_stats(self, tracker, phase_counters: bool = True) -> None:
+        """Wire a CompileTracker (metrics/device_stats.py) around every
+        superscan dispatch and thread the ingest/fire/purge phase counters
+        through the compiled scan carry. Must be called before the first
+        batch — the phase flag is part of the executable cache key."""
+        self.pipe.attach_device_stats(tracker, phase_counters=phase_counters)
+
+    def phase_totals(self) -> Dict[str, int]:
+        """Cumulative per-phase superscan step counters (resolved
+        dispatches only): records ingested, fire slots executed, steps
+        that purged — where a laggard kernel's device time goes."""
+        t = self.pipe.phase_totals
+        return {"ingestRecords": int(t[0]), "fireSteps": int(t[1]),
+                "purgeSteps": int(t[2])}
+
+    def key_loads(self):
+        """Device-resident per-key record counts for the key-stats fold."""
+        return self.pipe.key_loads()
+
+    def key_stats_ready(self) -> bool:
+        """O(1) host probe: has any superbatch dispatch landed data in the
+        device ring yet? (Steps buffer host-side first — a key-stats fold
+        before the first dispatch would read an empty ring.)"""
+        return self.pipe.max_seen_slice is not None
+
+    def state_row_bytes(self) -> int:
+        return self.pipe.state_row_bytes()
+
     # -- observability gauges ------------------------------------------
     def state_bytes(self) -> int:
         """HBM footprint of the slice-ring arrays (0 until the pipeline's

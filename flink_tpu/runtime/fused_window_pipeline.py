@@ -83,23 +83,34 @@ class DeferredEmissions:
     """Handle for fires of one dispatch; the device->host copy runs async."""
 
     def __init__(self, pipe: "FusedWindowPipeline", fires, count_out, outs,
-                 key_bounds=None, key_capacity: Optional[int] = None):
+                 key_bounds=None, key_capacity: Optional[int] = None,
+                 phase_counts=None):
         self._pipe = pipe
         self._fires = fires
         self._count_out = count_out
         self._outs = outs
         self._key_bounds = key_bounds    # int32[2]: [max_seen, min_seen]
         self._key_capacity = key_capacity
+        # int32[3] per-phase step counters of this dispatch (device-plane
+        # observability); folded into the pipeline's totals at resolve so
+        # the readback rides the same async copy as the fire rows
+        self._phase_counts = phase_counts
         try:
             count_out.copy_to_host_async()
             for v in outs.values():
                 v.copy_to_host_async()
             if key_bounds is not None:
                 key_bounds.copy_to_host_async()
+            if phase_counts is not None:
+                phase_counts.copy_to_host_async()
         except AttributeError:
             pass
 
     def resolve(self):
+        if self._phase_counts is not None:
+            self._pipe.phase_totals += np.asarray(
+                self._phase_counts, dtype=np.int64)
+            self._phase_counts = None
         if self._key_bounds is not None:
             hi, lo = (int(v) for v in np.asarray(self._key_bounds))
             if hi >= self._key_capacity or lo < 0:
@@ -274,6 +285,15 @@ class FusedWindowPipeline:
         self._raw_dtype = None
         self._pallas: Optional[bool] = None   # decided at first dispatch
         self._kernel_layout = False           # states in pallas slice-major form
+        # device-plane observability (metrics/device_stats.py): an attached
+        # CompileTracker wraps every dispatch; phase_counters threads the
+        # ingest/fire/purge counters through the XLA superscan carry
+        # (accumulated into phase_totals at resolve). Both are wired by
+        # attach_device_stats BEFORE the first dispatch — phase_counters is
+        # part of the executable cache key.
+        self.compile_tracker = None
+        self.phase_counters = False
+        self.phase_totals = np.zeros(3, np.int64)  # [ingest, fire, purge]
 
         self.g = assigner.slice_ms
         self.sl = assigner.slide_slices
@@ -437,7 +457,60 @@ class FusedWindowPipeline:
         return _build_superscan(
             self.agg, self.K, self.S, self.NSB, self.F, self.R,
             self.spw, self.chunk, self.exact_sums, T, B,
+            phases=self.phase_counters,
         )
+
+    # ------------------------------------------------------------------
+    # device-plane observability (metrics/device_stats.py)
+    # ------------------------------------------------------------------
+    def attach_device_stats(self, tracker, phase_counters: bool = True) -> None:
+        """Attach a CompileTracker (and opt into the per-phase superscan
+        counters). Must run before the first dispatch: the phase flag is
+        part of the executable cache key."""
+        self.compile_tracker = tracker
+        self.phase_counters = bool(phase_counters)
+
+    def _signature(self, program_extra: Dict[str, Any]) -> Dict[str, Any]:
+        """Shape signature of the next dispatch — the key the tracker
+        diffs for recompile cause attribution (K change = ring doubling,
+        T/B change = batch-geometry churn, dtype change = dtype change)."""
+        sig: Dict[str, Any] = {
+            "K": self.K, "S": self.S, "NSB": self.NSB, "F": self.F,
+            "R": self.R,
+            "dtype": "+".join(str(np.dtype(f.dtype))
+                              for f in self._value_fields) or "count",
+        }
+        sig.update(program_extra)
+        return sig
+
+    def _tracked(self, program: str, fn, args: tuple, extra: Dict[str, Any]):
+        """Dispatch through the attached CompileTracker (or directly)."""
+        if self.compile_tracker is None:
+            return fn(*args)
+        return self.compile_tracker.call(
+            program, fn, args, self._signature(extra))
+
+    def key_loads(self):
+        """Device-resident per-key record counts ([K] int32): the input of
+        the key-stats fold (metrics/key_stats.py) — one segment-sum over
+        the count ring that is already in HBM. None before the first
+        dispatch materializes state (or on a plan-only planner)."""
+        count = getattr(self, "_count", None)
+        if count is None:
+            return None
+        if self._kernel_layout:
+            from flink_tpu.ops import pallas_superscan as ps
+
+            count = ps.from_kernel_layout(count, self.K, self.S)
+        return count.sum(axis=1)
+
+    def state_row_bytes(self) -> int:
+        """HBM bytes per key row (all slice cells of one key across count
+        + value fields) — the key-stats state-bytes histogram scale."""
+        n = 4 * self.S  # int32 count ring
+        for f in self._value_fields:
+            n += np.dtype(f.dtype).itemsize * self.S
+        return n
 
     # ------------------------------------------------------------------
     # host planner + dispatch
@@ -489,10 +562,12 @@ class FusedWindowPipeline:
             vals_flat = None
             if self._needs_vals:
                 vals_flat = vals_d if vals_d.ndim == 1 else vals_d.reshape(-1)
-            count_state, field_states, count_out, field_outs = run(
-                smin_pos, fire_pos, fire_valid, fire_row, purge_mask,
-                self._count, tuple(self._state[n] for n in names),
-                idx_flat, vals_flat,
+            count_state, field_states, count_out, field_outs = self._tracked(
+                "pallas_superscan", run,
+                (smin_pos, fire_pos, fire_valid, fire_row, purge_mask,
+                 self._count, tuple(self._state[n] for n in names),
+                 idx_flat, vals_flat),
+                {"T": T, "B": B},
             )
             self._count = count_state
             self._state = dict(zip(names, field_states))
@@ -516,10 +591,17 @@ class FusedWindowPipeline:
                 for f in self._value_fields
             }
             count_out0 = jnp.zeros((self.R, self.K), jnp.int32)
-            self._state, self._count, outs, count_out = run(
-                self._state, self._count, outs0, count_out0,
-                idx_d, vals_d, smin_pos, fire_pos, fire_valid, fire_row, purge_mask,
+            out = self._tracked(
+                "fused_superscan", run,
+                (self._state, self._count, outs0, count_out0,
+                 idx_d, vals_d, smin_pos, fire_pos, fire_valid, fire_row,
+                 purge_mask),
+                {"T": T, "B": B},
             )
+            if self.phase_counters:
+                self._state, self._count, outs, count_out, pc = out
+            else:
+                self._state, self._count, outs, count_out = out
 
         # read back only the rows actually fired (padded to a few stable
         # shapes so the slice executable is reused across dispatches)
@@ -528,7 +610,10 @@ class FusedWindowPipeline:
             count_out = _slice_rows(count_out, used)
             outs = {k: _slice_rows(v, used) for k, v in outs.items()}
 
-        deferred = DeferredEmissions(self, fires, count_out, outs)
+        deferred = DeferredEmissions(
+            self, fires, count_out, outs,
+            phase_counts=(pc if self.phase_counters and not self._use_pallas()
+                          else None))
         return deferred if defer else deferred.resolve()
 
     def stage_superbatch(self, batches, watermarks):
@@ -818,8 +903,16 @@ class FusedWindowPipeline:
         if self.prologue.needs_ts:
             xs = xs + (ts_d,)
         xs = xs + (smin_pos, fire_pos, fire_valid, fire_row, purge_mask)
-        self._state, self._count, outs, count_out, key_bounds = run(
-            self._state, self._count, outs0, count_out0, *xs)
+        out = self._tracked(
+            "fused_chained_superscan", run,
+            (self._state, self._count, outs0, count_out0) + xs,
+            {"T": T, "B": B, "raw_dtype": str(raw_d.dtype)},
+        )
+        pc = None
+        if self.phase_counters:
+            self._state, self._count, outs, count_out, key_bounds, pc = out
+        else:
+            self._state, self._count, outs, count_out, key_bounds = out
 
         used = -(-max(len(fires), 1) // 16) * 16
         if used < self.R:
@@ -827,7 +920,8 @@ class FusedWindowPipeline:
             outs = {k: _slice_rows(v, used) for k, v in outs.items()}
         deferred = DeferredEmissions(self, fires, count_out, outs,
                                      key_bounds=key_bounds,
-                                     key_capacity=self.K)
+                                     key_capacity=self.K,
+                                     phase_counts=pc)
         return deferred if defer else deferred.resolve()
 
     def _chained_superscan(self, T: int, B: int):
@@ -836,7 +930,8 @@ class FusedWindowPipeline:
         # can never collide with a recycled id; builtin DeviceAggregators
         # are memoized singletons, custom ones identity-hash conservatively
         key = (self.prologue, self.agg, self.K, self.S, self.NSB, self.F,
-               self.R, self.spw, self.chunk, self.exact_sums, T, B)
+               self.R, self.spw, self.chunk, self.exact_sums, T, B,
+               self.phase_counters)
         fn = _CHAINED_CACHE.get(key)
         if fn is None:
             while len(_CHAINED_CACHE) >= _CHAINED_CACHE_MAX:
@@ -854,9 +949,11 @@ class FusedWindowPipeline:
 
         pro = self.prologue
         ingest = "matmul" if jax.default_backend() == "tpu" else "scatter"
+        phases = self.phase_counters
         step = make_superscan_step(
             self.agg, self.K, self.S, self.NSB, self.F, self.R,
             self.spw, self.chunk, self.exact_sums, ingest=ingest,
+            phase_counters=phases,
         )
         K, NSB = self.K, self.NSB
         needs_vals = self._needs_vals
@@ -912,8 +1009,13 @@ class FusedWindowPipeline:
 
         def run(state, count, outs, count_out, *xs):
             kb0 = jnp.asarray([-1, 0], jnp.int32)
-            (inner, key_bounds), _ = jax.lax.scan(
-                body, ((state, count, outs, count_out), kb0), xs)
+            inner0 = (state, count, outs, count_out)
+            if phases:
+                inner0 = inner0 + (jnp.zeros((3,), jnp.int32),)
+            (inner, key_bounds), _ = jax.lax.scan(body, (inner0, kb0), xs)
+            if phases:
+                state, count, outs, count_out, pc = inner
+                return state, count, outs, count_out, key_bounds, pc
             state, count, outs, count_out = inner
             return state, count, outs, count_out, key_bounds
 
@@ -966,7 +1068,7 @@ def _slice_rows(buf, n: int):
 
 
 def make_superscan_step(agg, K, S, NSB, F, R, SPW, chunk, exact,
-                        ingest: str = "matmul"):
+                        ingest: str = "matmul", phase_counters: bool = False):
     """The per-step ingest/fire/purge body, shared by the single-chip
     superscan and the shard_map sharded superscan (each shard runs this on
     its local key range).
@@ -977,7 +1079,14 @@ def make_superscan_step(agg, K, S, NSB, F, R, SPW, chunk, exact,
     what wins on CPU backends (the [K, S] ring is cache-resident and the
     dense one-hot contraction does K*NSB work per record on a scalar
     core). Identical math either way: both are pure adds into the same
-    cells, counts exact in int32."""
+    cells, counts exact in int32.
+
+    `phase_counters` (device-plane observability) threads an int32[3]
+    counter through the carry — [records ingested, fire slots executed,
+    steps that purged] — so a dispatch's device time can be attributed to
+    the ingest/fire/purge phases without any extra host sync (the counts
+    ride the same async readback as the fire rows). The carry becomes a
+    5-tuple; callers opt in, so the default executable shape is unchanged."""
     import jax
     import jax.numpy as jnp
 
@@ -991,7 +1100,12 @@ def make_superscan_step(agg, K, S, NSB, F, R, SPW, chunk, exact,
     nseg = K * NSB
 
     def step(carry, args):
-        state, count, outs, count_out = carry
+        if phase_counters:
+            # `phase_c`, not `pc`: the ingest paths below use `pc` for
+            # their partial-count histograms
+            state, count, outs, count_out, phase_c = carry
+        else:
+            state, count, outs, count_out = carry
         idx, vals, smin_pos, fire_pos, fire_valid, fire_row, purge_mask = args
 
         # ingest: MXU histograms over (key, rel-slice) segments for
@@ -1095,20 +1209,50 @@ def make_superscan_step(agg, K, S, NSB, F, R, SPW, chunk, exact,
                 }
             return state, count
 
+        purged = jnp.any(purge_mask == 0)
         state, count = jax.lax.cond(
-            jnp.any(purge_mask == 0), do_purge, lambda sc: sc, (state, count))
+            purged, do_purge, lambda sc: sc, (state, count))
+        if phase_counters:
+            phase_c = phase_c + jnp.stack([
+                jnp.sum((idx >= 0).astype(jnp.int32)),
+                jnp.sum(fire_valid).astype(jnp.int32),
+                purged.astype(jnp.int32),
+            ])
+            return (state, count, outs, count_out, phase_c), None
         return (state, count, outs, count_out), None
 
     return step
 
 
 @functools.lru_cache(maxsize=None)
-def _build_superscan(agg, K, S, NSB, F, R, SPW, chunk, exact, T, B):
+def _build_superscan(agg, K, S, NSB, F, R, SPW, chunk, exact, T, B,
+                     phases: bool = False):
     """Compiled T-step superscan; module-level cache so every pipeline with
-    identical geometry (incl. warmup instances) shares one executable."""
+    identical geometry (incl. warmup instances) shares one executable.
+    With `phases` the program additionally returns the int32[3] per-phase
+    step counters threaded through the scan carry (device-plane
+    observability); the flag is part of the cache key, so gated jobs and
+    ungated jobs never share an executable shape."""
     import jax
+    import jax.numpy as jnp
 
-    step = make_superscan_step(agg, K, S, NSB, F, R, SPW, chunk, exact)
+    step = make_superscan_step(agg, K, S, NSB, F, R, SPW, chunk, exact,
+                               phase_counters=phases)
+
+    if phases:
+        @jax.jit
+        def run(state, count, outs, count_out, idx, vals, smin_pos,
+                fire_pos, fire_valid, fire_row, purge_mask):
+            carry0 = (state, count, outs, count_out,
+                      jnp.zeros((3,), jnp.int32))
+            (state, count, outs, count_out, pc), _ = jax.lax.scan(
+                step, carry0,
+                (idx, vals, smin_pos, fire_pos, fire_valid, fire_row,
+                 purge_mask),
+            )
+            return state, count, outs, count_out, pc
+
+        return run
 
     @jax.jit
     def run(state, count, outs, count_out, idx, vals, smin_pos, fire_pos, fire_valid, fire_row, purge_mask):

@@ -294,7 +294,8 @@ class MiniCluster:
             restore_ms = (time.perf_counter() - t_restore) * 1000.0
 
         while True:
-            runtime = JobRuntime(graph, config, registry=client.metrics)
+            runtime = JobRuntime(graph, config, registry=client.metrics,
+                                 traces=client.traces)
             client._runtime = runtime  # queryable-state surface (S13)
             if coordinator is not None:
                 # per-operator breakdown for completed checkpoint records

@@ -26,6 +26,12 @@ view). Endpoints:
   GET  /jobs/<id>/autoscaler  → autoscaler decision log + rescale counters
                                 (scheduler/ — signals seen, action taken,
                                 outcome, rescale durations)
+  GET  /jobs/<id>/device      → device-plane observability: compile/
+                                recompile counters + bounded event ring
+                                with cause attribution, per-operator
+                                roofline utilization and phase counters,
+                                key-skew telemetry, profiler capture
+                                surface (metrics/device_stats.py)
   GET  /metrics               → Prometheus text exposition (all jobs)
   POST /jars/run              → {"module": "/path/script.py", "entry": "main"}
                                 application-mode submission: the script builds
@@ -250,6 +256,17 @@ class _Handler(BaseHTTPRequestHandler):
                            else empty_autoscaler_payload())
                 payload.setdefault("parallelism", 1)
                 return self._json(200, _jsonable(payload))
+            if parts[2] == "device" and len(parts) == 3:
+                # device plane (metrics/device_stats.py): compile events,
+                # roofline/phase attribution, key skew, profiler captures
+                from flink_tpu.metrics.device_stats import (
+                    empty_device_payload,
+                )
+
+                rt = getattr(client, "_runtime", None)
+                return self._json(200, _jsonable(
+                    rt.device_snapshot() if rt is not None
+                    else empty_device_payload()))
             if parts[2] == "state" and len(parts) == 4:
                 # queryable state (S13): /jobs/<id>/state/<uid>?key=K
                 from urllib.parse import parse_qs, urlparse
@@ -348,6 +365,9 @@ class _Handler(BaseHTTPRequestHandler):
             if parts[2] == "autoscaler" and len(parts) == 3:
                 return self._json(200, _jsonable(
                     self.jm.job_autoscaler(job_id)))
+            if parts[2] == "device" and len(parts) == 3:
+                return self._json(200, _jsonable(
+                    self.jm.job_device(job_id)))
         except Exception as e:  # noqa: BLE001 — JM lookup failures -> 404
             return self._json(404, {"error": repr(e)})
         return self._json(404, {"error": f"no route {self.path}"})

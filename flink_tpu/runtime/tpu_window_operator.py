@@ -415,6 +415,27 @@ class TpuWindowOperator:
     def state_key_count(self) -> int:
         return len(self.state.keydict)
 
+    def key_loads(self):
+        """Device-resident per-key record counts ([K] int32) for the
+        key-stats fold (metrics/key_stats.py) — one segment-sum over the
+        count ring already in HBM."""
+        count = getattr(self.state, "count", None)
+        return None if count is None else count.sum(axis=1)
+
+    def key_stats_ready(self) -> bool:
+        """O(1) host probe: any slice ever written to the device ring."""
+        return self.state.frontiers.max_used is not None
+
+    def state_row_bytes(self) -> int:
+        """HBM bytes per key row across count + accumulator columns."""
+        import numpy as _np
+
+        n = 4 * self.state.S
+        for a in self.state.acc.values():
+            n += _np.dtype(getattr(a, "dtype", _np.float32)).itemsize \
+                * self.state.S
+        return n
+
     # ------------------------------------------------------------------
     # snapshot / restore
     # ------------------------------------------------------------------

@@ -144,6 +144,43 @@ function autoscalerSection(a) {
     </thead><tbody>${rows.join("")}</tbody></table>` : "");
 }
 
+function deviceSection(dev) {
+  // device plane (/jobs/:id/device): compile/recompile counters with the
+  // bounded cause-attributed event ring, per-operator roofline %, phase
+  // counters and key-skew telemetry; hidden when the plane is off
+  if (!dev || !dev.enabled) return "";
+  const c = dev.compile ?? {};
+  const storm = c.recompileStorm
+    ? '<span class="FAILED">STORM</span>' : "ok";
+  const evs = (c.events ?? []).slice(-8).reverse().map(e => esc(
+    `${e.program} [${e.cause}] ${fmt(e.duration_ms)}ms ` +
+    `${e.signature ?? ""}`)).join("<br>");
+  const ops = Object.entries(dev.operators ?? {}).map(([uid, o]) => `<tr>
+    <td>${esc(uid)}</td>
+    <td>${fmt(o.compile?.numCompiles)} / ${fmt(o.compile?.numRecompiles)}</td>
+    <td>${fmt(o.hbmUtilizationPct, 2)} / ${fmt(o.flopsUtilizationPct, 2)}</td>
+    <td>${fmt(o.phases?.ingestRecords)} / ${fmt(o.phases?.fireSteps)}
+        / ${fmt(o.phases?.purgeSteps)}</td>
+    <td>${fmt(o.keys?.keySkew, 2)}</td>
+    <td>${fmt(o.keys?.activeKeys)}</td>
+    <td>${esc((o.keys?.hotKeys ?? []).slice(0, 3)
+        .map(h => h[0] + ":" + fmt(h[1])).join(" "))}</td></tr>`);
+  const prof = dev.profiler ?? {};
+  return "<h3>device plane</h3>" + kv({
+    "compiles": fmt(c.numCompiles),
+    "recompiles": fmt(c.numRecompiles),
+    "compile ms": fmt(c.compileTimeMsTotal),
+    "recompile storm": storm,
+    "profiler captures": prof.enabled
+      ? `${fmt(prof.captures)} &rarr; ${esc(prof.last_capture_dir ?? "-")}`
+      : "off",
+  }) + (ops.length ? `<table><thead><tr><th>operator</th>
+    <th>compiles/re</th><th>hbm/flops %</th><th>ingest/fire/purge</th>
+    <th>key skew</th><th>active keys</th><th>hot keys</th></tr></thead>
+    <tbody>${ops.join("")}</tbody></table>` : "")
+    + (evs ? `<div class="spans">${evs}</div>` : "");
+}
+
 function operatorTable(metrics) {
   // per-operator observability: latency-marker percentiles, device time,
   // HBM state footprint — parsed from the job.operator.<uid>.* scope
@@ -171,12 +208,13 @@ function operatorTable(metrics) {
 }
 
 async function detailRow(id) {
-  const [info, metrics, traces, cps, exc, auto] = await Promise.all([
+  const [info, metrics, traces, cps, exc, auto, dev] = await Promise.all([
     j(`/jobs/${id}`), j(`/jobs/${id}/metrics`),
     j(`/jobs/${id}/traces`).catch(() => ({resourceSpans: []})),
     j(`/jobs/${id}/checkpoints`).catch(() => null),
     j(`/jobs/${id}/exceptions`).catch(() => null),
     j(`/jobs/${id}/autoscaler`).catch(() => null),
+    j(`/jobs/${id}/device`).catch(() => null),
   ]);
   const spans = (traces.resourceSpans[0]?.scopeSpans[0]?.spans ?? []);
   const spanRows = spans.slice(-12).reverse().map(s => {
@@ -212,6 +250,7 @@ async function detailRow(id) {
         ([k]) => k.endsWith("numLateRecordsDropped"))?.[1]),
     "error": esc(info.error ?? "none"),
   }) + operatorTable(metrics)
+    + deviceSection(dev)
     + autoscalerSection(auto)
     + checkpointSection(cps) + exceptionSection(exc)
     + (spanRows ? `<div class="spans">${spanRows}</div>` : "");

@@ -39,6 +39,13 @@ class SignalSample:
     watermark_skew_ms: float = 0.0
     checkpoint_duration_ms: float = 0.0  # last completed checkpoint e2e
     records_in: float = 0.0              # cumulative counter (resets on redeploy)
+    # device-plane signals (PR 8) — OPTIONAL, unlike the gauges above: a
+    # build/job without device stats simply lacks them, and treating the
+    # absence as 0.0 would feed "zero skew, zero utilization" into the
+    # learning policy and bias it toward jobs that merely lack the gauge.
+    # None means "not measured" and is EXCLUDED from window means.
+    key_skew: Optional[float] = None           # max/mean key-group load
+    device_utilization: Optional[float] = None  # worst roofline fraction 0..1
 
     @property
     def utilization(self) -> float:
@@ -63,12 +70,23 @@ def _ratio(metrics: Dict[str, float], leaf: str) -> float:
     return float(metrics.get(f"job.{leaf}TimeRatio", 0.0) or 0.0)
 
 
+def _optional(metrics: Dict[str, object], key: str,
+              scale: float = 1.0) -> Optional[float]:
+    """A gauge that is ABSENT stays None (excluded from window means) —
+    only a present numeric value is a measurement."""
+    v = metrics.get(key)
+    return float(v) * scale if isinstance(v, (int, float)) else None
+
+
 def extract_signals(metrics: Dict[str, object],
                     now: Optional[float] = None) -> SignalSample:
     """Pull the scaling signals out of a metric snapshot (JM-aggregated
     per-job dict, or a MiniCluster registry snapshot — same key space)."""
     pool = [float(v) for k, v in metrics.items()
             if "inPoolUsage" in k and isinstance(v, (int, float))]
+    hbm = _optional(metrics, "job.device.hbmUtilizationPct", 0.01)
+    flops = _optional(metrics, "job.device.flopsUtilizationPct", 0.01)
+    present = [u for u in (hbm, flops) if u is not None]
     return SignalSample(
         timestamp=time.monotonic() if now is None else now,
         busy=_ratio(metrics, "busy"),
@@ -79,6 +97,9 @@ def extract_signals(metrics: Dict[str, object],
         checkpoint_duration_ms=float(
             metrics.get("job.lastCheckpointDuration", 0.0) or 0.0),
         records_in=float(metrics.get("job.numRecordsIn", 0.0) or 0.0),
+        key_skew=_optional(metrics, "job.keySkew"),
+        # roofline fraction: the binding resource (worst of HBM/FLOPs)
+        device_utilization=max(present) if present else None,
     )
 
 
@@ -98,6 +119,12 @@ class SignalEstimate:
     # max single-sample utilization in the window: scale-down wants the
     # WHOLE window idle, not a mean dragged down by a few stalled ticks
     peak_utilization: float = 0.0
+    # device-plane estimates: mean over the samples that MEASURED them;
+    # None when no sample in the window carried the gauge (a build
+    # without device stats must not read as "zero skew / zero
+    # utilization" to the learning policy)
+    key_skew: Optional[float] = None
+    device_utilization: Optional[float] = None
 
     def as_dict(self) -> Dict[str, float]:
         return dataclasses.asdict(self)
@@ -131,6 +158,13 @@ class SignalWindow:
         def mean(attr: str) -> float:
             return sum(getattr(s, attr) for s in self._samples) / n
 
+        def optional_mean(attr: str) -> Optional[float]:
+            # mean over the samples that MEASURED the signal; None when
+            # none did — absence must never read as 0.0 downstream
+            vals = [getattr(s, attr) for s in self._samples
+                    if getattr(s, attr) is not None]
+            return sum(vals) / len(vals) if vals else None
+
         first, last = self._samples[0], self._samples[-1]
         dt = max(last.timestamp - first.timestamp, 1e-9)
         tput = ((last.records_in - first.records_in) / dt) if n >= 2 else 0.0
@@ -145,6 +179,8 @@ class SignalWindow:
             checkpoint_duration_ms=last.checkpoint_duration_ms,
             throughput_per_s=max(tput, 0.0),
             samples=n,
+            key_skew=optional_mean("key_skew"),
+            device_utilization=optional_mean("device_utilization"),
         )
 
 

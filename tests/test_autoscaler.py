@@ -90,6 +90,53 @@ def test_extract_signals_prefers_windowed_rates():
     assert est.busy == 0.0
 
 
+def test_device_signals_absent_read_none_not_zero():
+    """Satellite (f): a build/job without device stats simply LACKS the
+    skew/roofline gauges — reading the absence as 0.0 would feed "zero
+    skew, zero utilization" into the learning policy and bias it toward
+    jobs that merely lack the gauge. None means not measured."""
+    s = extract_signals({"job.busyTimeMsPerSecond": 500.0}, now=0.0)
+    assert s.key_skew is None
+    assert s.device_utilization is None
+    win = SignalWindow(size=4)
+    win.observe(s)
+    est = win.estimate()
+    assert est.key_skew is None
+    assert est.device_utilization is None
+    d = est.as_dict()
+    assert d["key_skew"] is None and d["device_utilization"] is None
+
+
+def test_device_signals_present_fold_over_measured_samples_only():
+    s_with = extract_signals({
+        "job.keySkew": 3.0,
+        "job.device.hbmUtilizationPct": 40.0,
+        "job.device.flopsUtilizationPct": 10.0,
+    }, now=0.0)
+    # device_utilization is the BINDING resource: worst roofline fraction
+    assert s_with.key_skew == 3.0
+    assert s_with.device_utilization == pytest.approx(0.40)
+    s_without = extract_signals({"job.busyTimeMsPerSecond": 100.0}, now=1.0)
+    win = SignalWindow(size=4)
+    win.observe(s_with)
+    win.observe(s_without)
+    win.observe(extract_signals({"job.keySkew": 5.0}, now=2.0))
+    est = win.estimate()
+    # mean over the samples that MEASURED the signal (3.0, 5.0), the
+    # unmeasured middle sample excluded — not (3+0+5)/3
+    assert est.key_skew == pytest.approx(4.0)
+    assert est.device_utilization == pytest.approx(0.40)
+
+
+def test_device_signal_zero_is_still_a_measurement():
+    """A PRESENT 0.0 gauge is a real reading (an idle device), distinct
+    from an absent gauge — it must participate in the mean."""
+    win = SignalWindow(size=4)
+    win.observe(extract_signals({"job.keySkew": 4.0}, now=0.0))
+    win.observe(extract_signals({"job.keySkew": 0.0}, now=1.0))
+    assert win.estimate().key_skew == pytest.approx(2.0)
+
+
 # ---------------------------------------------------------------------------
 # 2. policies
 # ---------------------------------------------------------------------------

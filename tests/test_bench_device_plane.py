@@ -1,0 +1,88 @@
+"""Bench smoke gate for the device-plane scenario (ISSUE-8).
+
+Runs the real `bench.device_plane_microbench` at smoke scale and asserts
+the result JSON carries the `device.*` keys every BENCH_*.json must now
+track — so a regression that silently stops counting compiles (the jit
+entry points losing their CompileTracker wrap), drops the recompile cause
+attribution, or zeroes the phase/key telemetry fails tier-1, not just a
+human eyeballing the next bench run. Throughput and overhead NUMBERS are
+deliberately not asserted (sandbox scheduler noise; the <= 2% overhead
+acceptance is judged on the real bench at full scale) — the structural
+keys, the nonzero compile count, and the attributed recompile causes are
+the gate (the PR-7 reroute-gate pattern).
+"""
+
+import importlib.util
+import pathlib
+
+import pytest
+
+_BENCH = pathlib.Path(__file__).resolve().parents[1] / "bench.py"
+
+
+@pytest.fixture(scope="module")
+def bench():
+    spec = importlib.util.spec_from_file_location("bench_device_smoke", _BENCH)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+@pytest.fixture(scope="module")
+def result(bench):
+    # smoke scale: distinctive key capacity + batch so the jitted
+    # executables are this run's own (a geometry another test already
+    # compiled would hide the compile events); one sweep keeps the gate
+    # well under a minute on the CPU backend
+    return bench.device_plane_microbench(events=49152, batch=2048,
+                                         num_keys=384, sweeps=1)
+
+
+def test_result_carries_the_tracked_device_keys(result):
+    for key in (
+        "tuples_per_sec_on",
+        "tuples_per_sec_off",
+        "overhead_pct",
+        "numCompiles",
+        "numRecompiles",
+        "compileTimeMsTotal",
+        "recompileStorm",
+        "recompile_causes",
+        "hbmUtilizationPct",
+        "flopsUtilizationPct",
+        "phases",
+        "keySkew",
+        "activeKeys",
+    ):
+        assert key in result, f"bench device block lost {key!r}"
+
+
+def test_compile_count_is_nonzero(result):
+    assert result["numCompiles"] > 0, (
+        "zero compiles observed — the superscan dispatch sites lost their "
+        "CompileTracker wrap, so the bench can no longer detect "
+        "recompile-thrashing regressions"
+    )
+    assert result["compileTimeMsTotal"] > 0
+
+
+def test_induced_recompile_is_cause_attributed(result):
+    assert "ring-doubling" in result["recompile_causes"], (
+        "the induced key-dictionary growth no longer surfaces as a "
+        "ring-doubling recompile in the event ring"
+    )
+
+
+def test_phase_counters_and_key_telemetry_populate(result):
+    phases = result["phases"]
+    # the traced filter keeps 1/3 of the stream; every survivor ingests
+    assert phases["ingestRecords"] > 0
+    assert phases["fireSteps"] > 0
+    assert result["keySkew"] is not None and result["keySkew"] >= 1.0
+    assert result["activeKeys"] > 0
+    assert result["hotKeys"]
+
+
+def test_throughput_measured_on_both_sides(result):
+    assert result["tuples_per_sec_on"] > 0
+    assert result["tuples_per_sec_off"] > 0

@@ -98,6 +98,18 @@ def test_path_scoped_rules_are_not_vacuous():
     assert index.get("graph/fusion.py") is not None, (
         "graph/fusion.py missing — the whole-graph fusion planner moved "
         "and ARCH001's graph-layer ban no longer covers it")
+    # the device-plane observability modules must stay in metrics/ under
+    # the metrics layer's runtime ban: compile/key telemetry flows OUTWARD
+    # (runtime callers hand in jitted fns and load columns), and a tracker
+    # that imported the runtime would invert the metrics DAG
+    for rel in ("metrics/device_stats.py", "metrics/key_stats.py"):
+        assert index.get(rel) is not None, (
+            f"{rel} missing — the device-plane observability core moved "
+            "and the metrics layer's runtime-import ban no longer covers "
+            "it")
+    assert any("runtime" in b for b in LAYER_FORBIDDEN["metrics"]), (
+        "metrics layer no longer forbids runtime imports — device_stats/"
+        "key_stats could silently grow executor dependencies")
     for rel in CONTROL_PLANE:
         assert index.get(rel) is not None, (
             f"control-plane module {rel} missing — CONTROL_PLANE is stale "

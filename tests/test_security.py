@@ -582,6 +582,137 @@ def test_rest_autoscaler_route_enforces_bearer():
         server.stop()
 
 
+def test_rest_device_route_enforces_bearer():
+    """Satellite (b): the /jobs/:id/device route (device-plane
+    observability) sits behind the same bearer gate on the MiniCluster
+    path: 401 without the token, 200 with it and the well-formed payload
+    (compile block + operators + profiler surface)."""
+    import json
+    import urllib.error
+    import urllib.request
+
+    from flink_tpu.runtime.minicluster import MiniCluster
+    from flink_tpu.runtime.rest import RestServer
+
+    cfg = Configuration()
+    cfg.set(SecurityOptions.TRANSPORT_SECRET, "dev-rest-secret")
+    cfg.set(SecurityOptions.REST_AUTH_ENABLED, True)
+    cluster = MiniCluster()
+    server = RestServer(cluster, config=cfg).start()
+    token = rest_bearer_token(SecurityConfig.with_secret("dev-rest-secret"))
+
+    from flink_tpu.api.datastream import StreamExecutionEnvironment
+    from flink_tpu.connectors.sink import CollectSink
+    from flink_tpu.connectors.source import Batch, DataGeneratorSource
+    from flink_tpu.core.watermarks import WatermarkStrategy
+    from flink_tpu.utils.arrays import obj_array
+
+    def gen(idx):
+        return Batch(obj_array([int(i) for i in idx]),
+                     (idx * 10).astype("int64"))
+
+    env = StreamExecutionEnvironment(Configuration())
+    env.from_source(
+        DataGeneratorSource(gen, count=64),
+        watermark_strategy=WatermarkStrategy.for_monotonous_timestamps(),
+    ).map(lambda x: x).sink_to(CollectSink())
+    client = env.execute_async("rest-auth-device")
+    cluster.jobs.setdefault(client.job_id, client)
+    client.wait(30)
+
+    try:
+        route = f"/jobs/{client.job_id}/device"
+        with pytest.raises(urllib.error.HTTPError) as exc:
+            urllib.request.urlopen(f"{server.url}{route}", timeout=10)
+        assert exc.value.code == 401
+
+        req = urllib.request.Request(f"{server.url}{route}")
+        req.add_header("Authorization", f"Bearer {token}")
+        with urllib.request.urlopen(req, timeout=10) as r:
+            body = json.loads(r.read())
+        assert set(body) >= {"enabled", "compile", "operators", "profiler"}
+        assert set(body["compile"]) >= {"numCompiles", "numRecompiles",
+                                        "events"}
+    finally:
+        server.stop()
+
+
+def test_rest_device_route_distributed_bridge_bearer(tmp_path):
+    """Satellite (b), jm_gateway-bridged path: /jobs/:id/device serves the
+    JobManagerEndpoint's device fold through the REST bridge — 401
+    without the bearer, 200 with it, and an authed unknown job is a 404,
+    not a 401 and not a hang."""
+    import json
+    import urllib.error
+    import urllib.request
+
+    from flink_tpu.api.windowing.assigners import TumblingEventTimeWindows
+    from flink_tpu.runtime.cluster import (
+        DistributedJobSpec,
+        JobManagerEndpoint,
+        TaskExecutorEndpoint,
+    )
+    from flink_tpu.runtime.minicluster import MiniCluster
+    from flink_tpu.runtime.rest import RestServer
+
+    def source_factory(shard, num_shards):
+        rng = np.random.default_rng(11 + shard)
+        return [((rng.integers(0, 4, 8)).astype(np.int64),
+                 np.ones(8, dtype=np.float64),
+                 (s * 1000 + rng.integers(0, 1000, 8)).astype(np.int64),
+                 s * 1000 + 500) for s in range(4)]
+
+    spec = DistributedJobSpec(
+        name="bridge-device", source_factory=source_factory,
+        assigner=TumblingEventTimeWindows.of(2000), aggregate="sum",
+        max_parallelism=16,
+    )
+    svc_jm, svc_tm = RpcService(), RpcService()
+    jm = JobManagerEndpoint(svc_jm, checkpoint_dir=str(tmp_path / "chk"))
+    te = TaskExecutorEndpoint(svc_tm, slots=1)
+    te.connect(svc_jm.address)
+    client = svc_jm.gateway(svc_jm.address, "jobmanager")
+    job_id = client.submit_job(spec.to_bytes(), 1)
+    deadline = time.time() + 60
+    while time.time() < deadline:
+        if client.job_status(job_id)["status"] in ("FINISHED", "FAILED"):
+            break
+        time.sleep(0.1)
+    assert client.job_status(job_id)["status"] == "FINISHED"
+
+    cfg = Configuration()
+    cfg.set(SecurityOptions.TRANSPORT_SECRET, "bridge-dev-secret")
+    cfg.set(SecurityOptions.REST_AUTH_ENABLED, True)
+    server = RestServer(MiniCluster(), config=cfg,
+                        jm_gateway=svc_jm.gateway(svc_jm.address,
+                                                  "jobmanager")).start()
+    token = rest_bearer_token(SecurityConfig.with_secret("bridge-dev-secret"))
+    try:
+        route = f"/jobs/{job_id}/device"
+        with pytest.raises(urllib.error.HTTPError) as exc:
+            urllib.request.urlopen(f"{server.url}{route}", timeout=10)
+        assert exc.value.code == 401
+
+        req = urllib.request.Request(f"{server.url}{route}")
+        req.add_header("Authorization", f"Bearer {token}")
+        with urllib.request.urlopen(req, timeout=10) as r:
+            body = json.loads(r.read())
+        assert set(body) >= {"enabled", "compile", "metrics", "per_shard"}
+
+        # authed unknown-job id: 404, not 401 and not a hang
+        req = urllib.request.Request(f"{server.url}/jobs/nope/device")
+        req.add_header("Authorization", f"Bearer {token}")
+        with pytest.raises(urllib.error.HTTPError) as exc:
+            urllib.request.urlopen(req, timeout=10)
+        assert exc.value.code == 404
+    finally:
+        server.stop()
+        te.stop()
+        jm.heartbeats.stop()
+        svc_jm.stop()
+        svc_tm.stop()
+
+
 def test_rest_autoscaler_route_distributed_bridge_bearer(tmp_path):
     """Same gate on the jm_gateway-bridged path: the route serves the
     JobManagerEndpoint's decision log through the REST bridge, 401 without
