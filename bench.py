@@ -1718,6 +1718,66 @@ def run_checkpoint_microbench_child(timeout_s: float = 300.0) -> dict:
         return {"error": repr(e)[:300]}
 
 
+def chaos_microbench(names: Optional[list] = None) -> dict:
+    """Resilience gate (ISSUE-10): run the chaos scenario matrix
+    (flink_tpu/chaos/scenarios.py — injected rpc flaps, dataplane blips,
+    torn checkpoints, storage brownouts, device dispatch errors, TM crash
+    mid-rescale, heartbeat partitions) and emit
+    chaos.{scenarios_passed, scenarios_total, parity, recovery_time_ms_p50}
+    so recovery behavior is tracked per PR exactly like throughput. Every
+    scenario asserts exactly-once parity vs an undisturbed oracle run and
+    the expected ExceptionHistory/recovery-timeline shape (injected
+    attribution included)."""
+    from flink_tpu.chaos import scenarios
+
+    result = scenarios.run_matrix(names)
+    # compact per-scenario view for the artifact (full detail on failure)
+    result["scenarios"] = [
+        {k: r[k] for k in ("name", "path", "passed", "parity", "restarts",
+                           "recovery_ms", "injected_fired", "attributed",
+                           "detail")}
+        for r in result["scenarios"]
+    ]
+    return result
+
+
+def child_chaos() -> None:
+    """Chaos-matrix child: CPU-pinned like child_checkpoint (scenarios run
+    in-process mini/distributed clusters; the parent must never lose the
+    TPU relay to a resilience drill)."""
+    _emit({"event": "start", "device": "cpu-chaos", "pid": os.getpid()})
+    try:
+        import jax
+        from jax._src import xla_bridge as _xb
+
+        jax.config.update("jax_platforms", "cpu")
+        _xb._backend_factories.pop("axon", None)
+        _xb._topology_factories.pop("axon", None)
+    except Exception:
+        pass
+    _emit({"event": "result", "result": chaos_microbench()})
+
+
+def run_chaos_microbench_child(timeout_s: float = 420.0) -> dict:
+    """Run the chaos matrix in a JAX_PLATFORMS=cpu subprocess and return
+    its result event (or an error dict — the headline must survive)."""
+    try:
+        r = subprocess.run(
+            [sys.executable, os.path.abspath(__file__), "--child",
+             "chaos", "0", "0", "0"],
+            stdout=subprocess.PIPE, stderr=subprocess.DEVNULL, text=True,
+            timeout=timeout_s, env={**os.environ, "JAX_PLATFORMS": "cpu"},
+        )
+        for line in reversed(r.stdout.splitlines()):
+            if line.startswith("{"):
+                obj = json.loads(line)
+                if obj.get("event") == "result":
+                    return obj["result"]
+        return {"error": "no result event from chaos child"}
+    except Exception as e:  # noqa: BLE001
+        return {"error": repr(e)[:300]}
+
+
 def parent_main() -> None:
     deadline = time.monotonic() + BUDGET_S - 15
     best = {
@@ -1762,6 +1822,12 @@ def parent_main() -> None:
     device_plane = run_device_plane_child()
     _emit({"event": "device_plane_microbench", "result": device_plane})
 
+    # chaos scenario matrix: injected compound faults against both
+    # execution paths, exactly-once parity vs undisturbed oracles —
+    # resilience tracked per-PR like throughput (CPU-pinned child)
+    chaos = run_chaos_microbench_child()
+    _emit({"event": "chaos_microbench", "result": chaos})
+
     def consider(res, rank):
         nonlocal best, best_rank
         if res is None:
@@ -1779,6 +1845,7 @@ def parent_main() -> None:
             best["checkpoint"] = checkpoint
             best["autoscaler"] = autoscaler
             best["api_path"] = api_path
+            best["chaos"] = chaos
             # device_plane, NOT "device": the top-level "device" key is the
             # backend marker ("tpu"/"cpu-jit") the bench driver parses —
             # clobbering it would misclassify the whole artifact
@@ -1884,6 +1951,8 @@ def main() -> None:
             child_api_path()
         elif label == "device-plane":
             child_device_plane()
+        elif label == "chaos":
+            child_chaos()
         else:
             child_cpu(T, 1 << int(sys.argv[4]), spans)
     else:

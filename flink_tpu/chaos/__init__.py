@@ -1,0 +1,29 @@
+"""flink_tpu.chaos — deterministic fault injection + the scenario gate.
+
+Two halves:
+
+- :mod:`flink_tpu.chaos.plan` (re-exported here): the seeded FaultPlan /
+  FaultRule model and the module-level HOOK the runtime's seams check.
+  Stdlib-only — security/, checkpoint/ and runtime/ all import it, so it
+  must sit below every layer it instruments.
+- :mod:`flink_tpu.chaos.scenarios` (import explicitly, NOT re-exported):
+  the named chaos scenario matrix (rpc-flap, dataplane-blip,
+  torn-checkpoint, ...) that runs real jobs under injected compound
+  faults and asserts exactly-once parity vs an undisturbed oracle. It
+  imports the runtime, so pulling it in here would drag the whole
+  runtime into every `import flink_tpu.security` — keep this package
+  __init__ leaf-light.
+
+See docs/robustness.md for the fault model and the scenario catalog.
+"""
+
+from flink_tpu.chaos.plan import (  # noqa: F401
+    INJECTED_MARKER,
+    FaultPlan,
+    FaultRule,
+    InjectedCrash,
+    InjectedFault,
+    active_plan,
+    install_plan,
+    uninstall_plan,
+)

@@ -1,0 +1,606 @@
+"""Chaos scenario matrix: real jobs under injected compound faults.
+
+Each named scenario runs a deterministic keyed-window job twice — once
+undisturbed (the oracle) and once with a seeded FaultPlan installed — and
+asserts (1) exactly-once result parity between the two runs, (2) the
+expected recovery shape (restarts / rescales / reconnects / tolerated
+checkpoint failures), and (3) that injected faults which caused failures
+are attributed ``injected: true`` in the PR-4 ExceptionHistory. The matrix
+covers BOTH execution paths: MiniCluster (torn-checkpoint,
+storage-brownout, device-dispatch-error) and the distributed JM+TM cluster
+(rpc-flap, dataplane-blip, tm-crash-during-rescale, heartbeat-partition).
+
+`bench.py chaos_microbench` runs :func:`run_matrix` and emits
+``chaos.{scenarios_passed, recovery_time_ms_p50, parity}`` into the bench
+artifact; ``tests/test_bench_chaos.py`` is the tier-1 smoke gate over the
+same matrix. See docs/robustness.md for the catalog and the config to
+reproduce each scenario locally.
+
+This module imports the runtime — import it explicitly
+(``flink_tpu.chaos.scenarios``), never from ``flink_tpu.chaos``'s
+package ``__init__`` (which must stay a stdlib-only leaf for the seams).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import shutil
+import statistics
+import tempfile
+import time
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from flink_tpu.chaos.plan import FaultPlan
+from flink_tpu.testing.harness import fault_injection
+
+
+# ---------------------------------------------------------------------------
+# shared workload: partition-consistent paced keyed source + oracle fold
+# ---------------------------------------------------------------------------
+
+class PacedKeyedSource:
+    """Partition-consistent arrival-paced source for the distributed path:
+    step s of shard i is the i-th slice of a seeded GLOBAL batch, so every
+    parallelism (and every rescale) sees the same record set per step —
+    replay after a checkpoint rewind stays exactly-once. `interval_s`
+    paces steps in wall time so control-plane events (checkpoints,
+    rescales, partitions) have room to land mid-job."""
+
+    def __init__(self, steps: int, batch: int, n_keys: int,
+                 interval_s: float, seed: int = 7):
+        self.steps = steps
+        self.batch = batch
+        self.n_keys = n_keys
+        self.interval_s = interval_s
+        self.seed = seed
+
+    def global_step(self, s: int):
+        rng = np.random.default_rng(self.seed * 100_003 + s)
+        keys = rng.integers(0, self.n_keys, self.batch).astype(np.int64)
+        vals = np.ones(self.batch, dtype=np.float64)
+        ts = (s * 500 + rng.integers(0, 500, self.batch)).astype(np.int64)
+        return keys, vals, ts, s * 500 + 250
+
+    def __call__(self, shard: int, num_shards: int):
+        outer = self
+
+        class _Paced(list):
+            def __init__(self):
+                super().__init__(range(outer.steps))
+                self._anchor = None
+
+            def __getitem__(self, s):
+                if outer.interval_s > 0:
+                    now = time.monotonic()
+                    if self._anchor is None:
+                        self._anchor = (now, s)
+                    due = self._anchor[0] + (s - self._anchor[1]) * outer.interval_s
+                    if due > now:
+                        time.sleep(due - now)
+                k, v, t, wm = outer.global_step(s)
+                sl = slice(shard, None, num_shards)
+                return k[sl], v[sl], t[sl], wm
+
+        return _Paced()
+
+
+def _dist_spec(source: PacedKeyedSource, name: str):
+    from flink_tpu.api.windowing.assigners import TumblingEventTimeWindows
+    from flink_tpu.runtime.cluster import DistributedJobSpec
+
+    return DistributedJobSpec(
+        name=name, source_factory=source,
+        assigner=TumblingEventTimeWindows.of(1000), aggregate="sum",
+        max_parallelism=16,
+    )
+
+
+def _dist_expected(source: PacedKeyedSource) -> Dict[Tuple[int, int], float]:
+    """Oracle: the global stream through one OracleWindowOperator."""
+    from flink_tpu.api.windowing.assigners import TumblingEventTimeWindows
+    from flink_tpu.core.time import MAX_WATERMARK
+    from flink_tpu.ops.aggregators import resolve
+    from flink_tpu.runtime.oracle_window_operator import OracleWindowOperator
+
+    op = OracleWindowOperator(
+        TumblingEventTimeWindows.of(1000),
+        resolve("sum").python_equivalent(), max_parallelism=16)
+    for s in range(source.steps):
+        keys, vals, ts, wm = source.global_step(s)
+        for i in range(len(keys)):
+            op.process_record(keys[i], float(vals[i]), int(ts[i]))
+        op.process_watermark(wm)
+    op.process_watermark(MAX_WATERMARK)
+    return {(int(k), int(w.start)): float(r)
+            for k, w, r, _ in op.drain_output()}
+
+
+def _collect_dist(result: Optional[list]) -> Dict[Tuple[int, int], float]:
+    return {(int(k), int(w[0])): float(r) for k, w, r, _ in (result or [])}
+
+
+@contextlib.contextmanager
+def _cluster(num_tms: int = 2, slots: int = 1,
+             tm_ids: Optional[List[str]] = None, **jm_kwargs):
+    from flink_tpu.runtime.cluster import (
+        JobManagerEndpoint,
+        TaskExecutorEndpoint,
+    )
+    from flink_tpu.runtime.rpc import RpcService
+
+    chk = tempfile.mkdtemp(prefix="flink-tpu-chaos-")
+    jm_defaults = dict(checkpoint_dir=chk, checkpoint_interval=0.2,
+                       heartbeat_interval=0.2, heartbeat_timeout=10.0,
+                       restart_delay=0.1)
+    jm_defaults.update(jm_kwargs)
+    svc_jm = RpcService()
+    jm = JobManagerEndpoint(svc_jm, **jm_defaults)
+    svcs = [svc_jm]
+    tes = []
+    for i in range(num_tms):
+        svc = RpcService()
+        svcs.append(svc)
+        te = TaskExecutorEndpoint(
+            svc, slots=slots, shipping_interval_ms=50,
+            tm_id=tm_ids[i] if tm_ids else None)
+        te.connect(svc_jm.address)
+        tes.append(te)
+    client = svc_jm.gateway(svc_jm.address, "jobmanager")
+    try:
+        yield client, jm, tes
+    finally:
+        for te in tes:
+            te.stop()
+        jm.stop()
+        for svc in svcs:
+            svc.stop()
+        shutil.rmtree(chk, ignore_errors=True)
+
+
+def _await_job(client, job_id: str, timeout_s: float = 90.0) -> dict:
+    deadline = time.monotonic() + timeout_s
+    st: dict = {}
+    while time.monotonic() < deadline:
+        st = client.job_status(job_id)
+        if st["status"] in ("FINISHED", "FAILED", "CANCELED"):
+            return st
+        time.sleep(0.05)
+    return st
+
+
+def _await(predicate: Callable[[], bool], timeout_s: float) -> bool:
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        if predicate():
+            return True
+        time.sleep(0.05)
+    return False
+
+
+# ---------------------------------------------------------------------------
+# shared workload: MiniCluster keyed tumbling count job
+# ---------------------------------------------------------------------------
+
+def _run_mini_count_job(name: str, *, records: int = 2600, batch: int = 200,
+                        chk_dir: Optional[str] = None, interval_ms: int = 1,
+                        tolerable: int = 0, max_retained: int = 50,
+                        fail_at_ts: Optional[int] = None,
+                        timeout_s: float = 120.0,
+                        extra_config: Optional[dict] = None):
+    """One keyed tumbling-count DataStream job on the in-process path.
+    Returns (client, sorted sink rows). `fail_at_ts` installs a one-shot
+    REAL failure (a map raising at an event-time threshold — deterministic
+    in event time, used to force the restart that exercises restore)."""
+    from flink_tpu.api.datastream import StreamExecutionEnvironment
+    from flink_tpu.api.windowing.assigners import TumblingEventTimeWindows
+    from flink_tpu.config import (
+        CheckpointingOptions,
+        Configuration,
+        ExecutionOptions,
+        RestartOptions,
+    )
+    from flink_tpu.connectors.sink import CollectSink
+    from flink_tpu.connectors.source import Batch, DataGeneratorSource
+    from flink_tpu.core.watermarks import WatermarkStrategy
+    from flink_tpu.utils.arrays import obj_array
+
+    config = Configuration()
+    config.set(ExecutionOptions.BATCH_SIZE, batch)
+    # distinctive ring capacity (the PR-8 bench-gate pattern): superscan
+    # executables are cached module-level by geometry, so sharing the
+    # device-stats tests' K=1024 shape would pre-compile THEIR geometry
+    # and hide the compile/recompile events those tests assert on
+    config.set(ExecutionOptions.KEY_CAPACITY, 768)
+    config.set(RestartOptions.INITIAL_BACKOFF_MS, 1)
+    if chk_dir is not None:
+        config.set(CheckpointingOptions.INTERVAL_MS, interval_ms)
+        config.set(CheckpointingOptions.DIRECTORY, chk_dir)
+        config.set(CheckpointingOptions.MAX_RETAINED, max_retained)
+        config.set(CheckpointingOptions.TOLERABLE_FAILED_CHECKPOINTS,
+                   tolerable)
+    for opt, val in (extra_config or {}).items():
+        config.set(opt, val)
+
+    state = {"failed": False}
+
+    def maybe_fail(x):
+        if fail_at_ts is not None and not state["failed"] \
+                and x[2] >= fail_at_ts:
+            state["failed"] = True
+            raise RuntimeError(f"forced failure at ts {x[2]}")
+        return x
+
+    def gen(idx: np.ndarray) -> Batch:
+        values = [(int(i % 7), 1.0, int(i * 10)) for i in idx]
+        return Batch(obj_array(values), (idx * 10).astype(np.int64))
+
+    env = StreamExecutionEnvironment(config)
+    stream = env.from_source(
+        DataGeneratorSource(gen, count=records, num_splits=8),
+        watermark_strategy=WatermarkStrategy.for_monotonous_timestamps(),
+    )
+    sink = CollectSink()
+    (stream.map(maybe_fail)
+           .key_by(lambda x: x[0])
+           .window(TumblingEventTimeWindows.of(1000)).count()
+           .sink_to(sink))
+    client = env.execute_async(name)
+    client.wait(timeout_s)
+    return client, sorted((int(k), int(n)) for k, n in sink.results)
+
+
+# ---------------------------------------------------------------------------
+# the scenarios
+# ---------------------------------------------------------------------------
+
+def _result(name: str, path: str, plan: Optional[FaultPlan],
+            problems: List[str], *, parity: Optional[bool] = None,
+            restarts: int = 0, recovery_ms: Optional[float] = None,
+            attributed: Optional[bool] = None) -> Dict[str, Any]:
+    return {
+        "name": name,
+        "path": path,
+        "passed": not problems,
+        "detail": "; ".join(problems),
+        "parity": bool(parity) if parity is not None else False,
+        "restarts": int(restarts),
+        "recovery_ms": recovery_ms,
+        "injected_fired": plan.total_fired if plan is not None else 0,
+        "attributed": attributed,
+    }
+
+
+def _check(problems: List[str], ok: bool, what: str) -> bool:
+    if not ok:
+        problems.append(what)
+    return ok
+
+
+def scenario_torn_checkpoint() -> Dict[str, Any]:
+    """Every checkpoint save from the 3rd onward writes a torn `_metadata`
+    (the artifact fsync-before-rename exists to prevent); a later real
+    failure forces a restore, which must SKIP the torn checkpoints and
+    rewind to the last complete one instead of crash-looping. Pre-chaos
+    runtime: the restart loop dies on a bare UnpicklingError and the job
+    hangs RESTARTING forever."""
+    problems: List[str] = []
+    _oracle_client, expected = _run_mini_count_job("torn-oracle")
+    chk = tempfile.mkdtemp(prefix="flink-tpu-torn-")
+    try:
+        with fault_injection(rules=[
+            {"scope": "storage", "fault": "torn", "match": "save",
+             "nth": 3, "max_fires": None},
+        ]) as plan:
+            client, results = _run_mini_count_job(
+                "torn-checkpoint", chk_dir=chk,
+                fail_at_ts=int(2600 * 10 * 0.7))
+    finally:
+        shutil.rmtree(chk, ignore_errors=True)
+    parity = results == expected
+    _check(problems, client.status().value == "FINISHED",
+           f"job ended {client.status().value}")
+    _check(problems, parity, "result parity broken")
+    _check(problems, client.num_restarts == 1,
+           f"expected 1 restart, saw {client.num_restarts}")
+    _check(problems, plan.total_fired >= 1, "no torn save was injected")
+    restored = (client.checkpoint_stats.last_restore or {}).get("checkpoint_id")
+    _check(problems, restored == 2,
+           f"restore did not skip the torn checkpoints (restored {restored}, "
+           "expected 2 — the last complete one)")
+    recs = client.exceptions.payload()["recoveries"]
+    recovery_ms = recs[0]["downtime_ms"] if recs else None
+    return _result("torn-checkpoint", "mini", plan, problems, parity=parity,
+                   restarts=client.num_restarts, recovery_ms=recovery_ms)
+
+
+def scenario_storage_brownout() -> Dict[str, Any]:
+    """Three consecutive checkpoint saves fail (storage brownout). With
+    execution.checkpointing.tolerable-failed-checkpoints=5 the job rides
+    it out: FAILED stats records (with injected attribution in the cause),
+    zero restarts, and the consecutive-failures gauge resets once storage
+    heals. Pre-chaos runtime: the first failed save restarts the job."""
+    problems: List[str] = []
+    _oracle_client, expected = _run_mini_count_job("brownout-oracle")
+    chk = tempfile.mkdtemp(prefix="flink-tpu-brownout-")
+    try:
+        with fault_injection(rules=[
+            {"scope": "storage", "fault": "error", "match": "save",
+             "nth": 2, "max_fires": 3},
+        ]) as plan:
+            client, results = _run_mini_count_job(
+                "storage-brownout", chk_dir=chk, tolerable=5)
+    finally:
+        shutil.rmtree(chk, ignore_errors=True)
+    parity = results == expected
+    _check(problems, client.status().value == "FINISHED",
+           f"job ended {client.status().value}")
+    _check(problems, parity, "result parity broken")
+    _check(problems, client.num_restarts == 0,
+           f"brownout was not tolerated: {client.num_restarts} restart(s)")
+    _check(problems, plan.total_fired == 3,
+           f"expected 3 injected save failures, fired {plan.total_fired}")
+    gauges = client.checkpoint_stats.gauge_values()
+    _check(problems, gauges["numberOfFailedCheckpoints"] >= 3,
+           "tolerated failures did not land FAILED stats records")
+    _check(problems, gauges["consecutiveFailedCheckpoints"] == 0,
+           "consecutive-failures gauge did not reset after storage healed")
+    _check(problems, gauges["numberOfCompletedCheckpoints"] >= 1,
+           "no checkpoint completed after the brownout")
+    failed = client.checkpoint_stats.payload()["latest"]["failed"]
+    attributed = bool(failed and "[chaos-injected" in
+                      (failed.get("failure_cause") or ""))
+    _check(problems, attributed,
+           "FAILED record lost the injected-fault attribution")
+    return _result("storage-brownout", "mini", plan, problems, parity=parity,
+                   restarts=client.num_restarts, attributed=attributed)
+
+
+def scenario_device_dispatch_error() -> Dict[str, Any]:
+    """One injected error at the device dispatch boundary (the 6th window
+    dispatch). The job must restart through the normal strategy, restore
+    from the latest checkpoint, finish with exact results — and the
+    ExceptionHistory entry must carry `injected: true` attribution."""
+    problems: List[str] = []
+    _oracle_client, expected = _run_mini_count_job("device-oracle")
+    chk = tempfile.mkdtemp(prefix="flink-tpu-device-")
+    try:
+        with fault_injection(rules=[
+            {"scope": "device", "fault": "error", "nth": 6},
+        ]) as plan:
+            client, results = _run_mini_count_job(
+                "device-dispatch-error", chk_dir=chk)
+    finally:
+        shutil.rmtree(chk, ignore_errors=True)
+    parity = results == expected
+    _check(problems, client.status().value == "FINISHED",
+           f"job ended {client.status().value}")
+    _check(problems, parity, "result parity broken")
+    _check(problems, client.num_restarts == 1,
+           f"expected 1 restart, saw {client.num_restarts}")
+    _check(problems, plan.total_fired == 1,
+           f"expected 1 injected dispatch error, fired {plan.total_fired}")
+    exc = client.exceptions.payload()
+    entry = exc["entries"][0] if exc["entries"] else {}
+    attributed = bool(entry.get("injected"))
+    _check(problems, attributed,
+           "injected dispatch error not attributed injected:true")
+    recs = exc["recoveries"]
+    recovery_ms = recs[0]["downtime_ms"] if recs else None
+    _check(problems, bool(recs) and recs[0]["restored_checkpoint_id"] is not None,
+           "recovery timeline missing the rewound checkpoint")
+    return _result("device-dispatch-error", "mini", plan, problems,
+                   parity=parity, restarts=client.num_restarts,
+                   recovery_ms=recovery_ms, attributed=attributed)
+
+
+def scenario_rpc_flap() -> Dict[str, Any]:
+    """Transient rpc-plane flap on idempotent control calls: the first two
+    checkpoint-ack attempts and two heartbeat shipments fail with
+    connection errors. The gateway retry (backoff + jitter + deadline)
+    absorbs all of it: zero restarts, checkpoints complete, exact results.
+    Pre-chaos runtime: the first failed ack kills the task and restarts
+    the whole job."""
+    problems: List[str] = []
+    source = PacedKeyedSource(steps=40, batch=40, n_keys=9, interval_s=0.08)
+    expected = _dist_expected(source)
+    with _cluster(num_tms=2) as (client, _jm, _tes):
+        with fault_injection(rules=[
+            {"scope": "rpc", "fault": "error",
+             "match": "jobmanager.ack_checkpoint", "nth": 1, "max_fires": 2},
+            {"scope": "rpc", "fault": "error",
+             "match": "jobmanager.heartbeat_tm", "nth": 6, "max_fires": 2},
+        ]) as plan:
+            job_id = client.submit_job(
+                _dist_spec(source, "rpc-flap").to_bytes(), 2)
+            st = _await_job(client, job_id)
+            parity = _collect_dist(
+                client.job_result(job_id) if st["status"] == "FINISHED"
+                else None) == expected
+            _check(problems, st["status"] == "FINISHED",
+                   f"job ended {st['status']}: {st.get('failure')}")
+            _check(problems, parity, "result parity broken")
+            _check(problems, st["restarts"] == 0,
+                   f"flap was not absorbed: {st['restarts']} restart(s)")
+            _check(problems, bool(st["checkpoints"]),
+                   "no checkpoint completed under the flap")
+            _check(problems, plan.total_fired >= 3,
+                   f"expected >=3 injected rpc faults, fired "
+                   f"{plan.total_fired}")
+            restarts = st["restarts"]
+    return _result("rpc-flap", "distributed", plan, problems, parity=parity,
+                   restarts=restarts)
+
+
+def scenario_dataplane_blip() -> Dict[str, Any]:
+    """One injected connection error on a keyed-exchange sender (shard 0 →
+    shard 1). The sender must reconnect inside the bounded window, verify
+    sequence continuity on the re-run open/credit negotiation, resend, and
+    the job completes with zero restarts. Pre-chaos runtime: the error
+    fails the task and restarts the job."""
+    problems: List[str] = []
+    source = PacedKeyedSource(steps=60, batch=40, n_keys=9, interval_s=0.02)
+    expected = _dist_expected(source)
+    with _cluster(num_tms=2) as (client, jm, _tes):
+        with fault_injection(rules=[
+            {"scope": "dataplane", "fault": "error", "match": "0->1",
+             "nth": 5, "max_fires": 1},
+        ]) as plan:
+            job_id = client.submit_job(
+                _dist_spec(source, "dataplane-blip").to_bytes(), 2)
+            st = _await_job(client, job_id)
+            parity = _collect_dist(
+                client.job_result(job_id) if st["status"] == "FINISHED"
+                else None) == expected
+            _check(problems, st["status"] == "FINISHED",
+                   f"job ended {st['status']}: {st.get('failure')}")
+            _check(problems, parity, "result parity broken")
+            _check(problems, st["restarts"] == 0,
+                   f"blip was not absorbed: {st['restarts']} restart(s)")
+            _check(problems, plan.total_fired == 1,
+                   f"expected 1 injected send error, fired "
+                   f"{plan.total_fired}")
+            metrics = client.job_metrics(job_id)["job"]
+            _check(problems,
+                   metrics.get("job.numDataplaneReconnects", 0) >= 1,
+                   "no dataplane reconnect was recorded")
+            restarts = st["restarts"]
+    return _result("dataplane-blip", "distributed", plan, problems,
+                   parity=parity, restarts=restarts)
+
+
+def scenario_tm_crash_during_rescale() -> Dict[str, Any]:
+    """A deliberate live rescale 1→2 whose deploy onto the second TM fails
+    as if the TM crashed mid-rescale. The rescale must degrade into a
+    plain restart that lands the job back at a healthy parallelism, with
+    exact results — and the degraded rescale must NOT stamp a completed
+    rescale duration (the PR-6 outcome hygiene the chaos plane verifies)."""
+    problems: List[str] = []
+    source = PacedKeyedSource(steps=140, batch=40, n_keys=9, interval_s=0.05)
+    expected = _dist_expected(source)
+    with _cluster(num_tms=2) as (client, jm, _tes):
+        with fault_injection(rules=[
+            {"scope": "rpc", "fault": "error",
+             "match": "taskexecutor.deploy_task", "nth": 3, "max_fires": 1},
+        ]) as plan:
+            job_id = client.submit_job(
+                _dist_spec(source, "tm-crash-rescale").to_bytes(), 1)
+            _check(problems,
+                   _await(lambda: bool(
+                       client.job_status(job_id)["checkpoints"]), 30.0),
+                   "no checkpoint completed before the rescale")
+            res = client.rescale_job(job_id, 2, "chaos-drill")
+            _check(problems, res["accepted"],
+                   f"rescale rejected: {res['detail']}")
+            st = _await_job(client, job_id)
+            parity = _collect_dist(
+                client.job_result(job_id) if st["status"] == "FINISHED"
+                else None) == expected
+            _check(problems, st["status"] == "FINISHED",
+                   f"job ended {st['status']}: {st.get('failure')}")
+            _check(problems, parity, "result parity broken")
+            _check(problems, st["rescales"] == 1,
+                   f"expected 1 rescale, saw {st['rescales']}")
+            _check(problems, plan.total_fired == 1,
+                   f"expected 1 injected deploy failure, fired "
+                   f"{plan.total_fired}")
+            auto = client.job_autoscaler(job_id)
+            _check(problems, float(auto["last_rescale_duration_ms"]) == 0.0,
+                   "degraded rescale stamped a completed-rescale duration "
+                   "(outcome hygiene broken)")
+            exc = client.job_exceptions(job_id)
+            kinds = [r["kind"] for r in exc["recoveries"]]
+            _check(problems, "rescale" in kinds,
+                   f"no rescale record in the recovery timeline: {kinds}")
+            recs = [r for r in exc["recoveries"] if r["kind"] == "rescale"]
+            recovery_ms = recs[0]["downtime_ms"] if recs else None
+            restarts = st["restarts"]
+    return _result("tm-crash-during-rescale", "distributed", plan, problems,
+                   parity=parity, restarts=restarts, recovery_ms=recovery_ms)
+
+
+def scenario_heartbeat_partition() -> Dict[str, Any]:
+    """A one-way partition between one TM and the JM (its heartbeats are
+    dropped for ~25 beats). The JM must declare the TM dead, fail over,
+    adaptively rescale the job down onto the surviving TM from the latest
+    checkpoint, and finish with exact results — with the TM loss
+    attributed to the partitioned TM in the exception history."""
+    problems: List[str] = []
+    source = PacedKeyedSource(steps=160, batch=40, n_keys=9, interval_s=0.05)
+    expected = _dist_expected(source)
+    with _cluster(num_tms=2, tm_ids=["tm-chaos-a", "tm-chaos-b"],
+                  heartbeat_timeout=1.2) as (client, jm, _tes):
+        with fault_injection(rules=[
+            {"scope": "heartbeat", "fault": "partition",
+             "match": "tm-chaos-b", "nth": 30, "max_fires": 35},
+        ]) as plan:
+            job_id = client.submit_job(
+                _dist_spec(source, "hb-partition").to_bytes(), 2)
+            st = _await_job(client, job_id, timeout_s=120.0)
+            parity = _collect_dist(
+                client.job_result(job_id) if st["status"] == "FINISHED"
+                else None) == expected
+            _check(problems, st["status"] == "FINISHED",
+                   f"job ended {st['status']}: {st.get('failure')}")
+            _check(problems, parity, "result parity broken")
+            _check(problems, st["restarts"] >= 1,
+                   "partition did not trigger failover")
+            _check(problems, plan.total_fired >= 5,
+                   f"too few heartbeats dropped ({plan.total_fired})")
+            exc = client.job_exceptions(job_id)
+            attributed_entries = [
+                e for e in exc["entries"]
+                if e.get("task_manager") == "tm-chaos-b"
+                and "heartbeat" in e["exception"]]
+            _check(problems, bool(attributed_entries),
+                   "TM loss not attributed to the partitioned TM")
+            recs = exc["recoveries"]
+            recovery_ms = (recs[0].get("downtime_ms") if recs else None)
+            _check(problems, bool(recs) and recs[-1]["downtime_ms"] is not None,
+                   "recovery timeline not closed after failover")
+            restarts = st["restarts"]
+    return _result("heartbeat-partition", "distributed", plan, problems,
+                   parity=parity, restarts=restarts, recovery_ms=recovery_ms)
+
+
+SCENARIOS: Dict[str, Callable[[], Dict[str, Any]]] = {
+    "torn-checkpoint": scenario_torn_checkpoint,
+    "storage-brownout": scenario_storage_brownout,
+    "device-dispatch-error": scenario_device_dispatch_error,
+    "rpc-flap": scenario_rpc_flap,
+    "dataplane-blip": scenario_dataplane_blip,
+    "tm-crash-during-rescale": scenario_tm_crash_during_rescale,
+    "heartbeat-partition": scenario_heartbeat_partition,
+}
+
+
+def run_scenario(name: str) -> Dict[str, Any]:
+    try:
+        return SCENARIOS[name]()
+    except Exception as e:  # noqa: BLE001 — a crashed scenario is a failure,
+        # not a crashed matrix: the remaining scenarios still run
+        from flink_tpu.chaos.plan import active_plan, uninstall_plan
+
+        if active_plan() is not None:   # fault_injection unwinds its own
+            uninstall_plan()            # install; this guards partial setup
+        return _result(name, "?", None, [f"scenario crashed: {e!r}"])
+
+
+def run_matrix(names: Optional[List[str]] = None) -> Dict[str, Any]:
+    """Run the (selected) scenario matrix and fold the bench summary:
+    scenarios_passed/total, overall parity, and the p50 of the observed
+    recovery times (fail → RUNNING downtime) across scenarios that
+    recovered."""
+    picked = names or list(SCENARIOS)
+    results = [run_scenario(n) for n in picked]
+    recoveries = [r["recovery_ms"] for r in results
+                  if r["recovery_ms"] is not None]
+    return {
+        "scenarios": results,
+        "scenarios_total": len(results),
+        "scenarios_passed": sum(1 for r in results if r["passed"]),
+        "parity": all(r["parity"] for r in results),
+        "recovery_time_ms_p50": (round(statistics.median(recoveries), 3)
+                                 if recoveries else None),
+    }

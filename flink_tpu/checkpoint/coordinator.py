@@ -22,7 +22,15 @@ from __future__ import annotations
 import time
 from typing import Callable, Dict, List, Optional, Tuple
 
-from flink_tpu.checkpoint.storage import CheckpointStorage
+from flink_tpu.chaos.plan import InjectedCrash
+from flink_tpu.checkpoint.storage import CheckpointStorage, CorruptCheckpointError
+
+
+class CheckpointFailuresExhaustedError(RuntimeError):
+    """More consecutive checkpoint failures than
+    execution.checkpointing.tolerable-failed-checkpoints allows — raised
+    from trigger() so the job's restart strategy takes over (the
+    CheckpointFailureManager escalation of the reference)."""
 
 
 class CheckpointCoordinator:
@@ -34,6 +42,7 @@ class CheckpointCoordinator:
         clock: Callable[[], float] = time.monotonic,
         traces=None,
         stats=None,
+        tolerable_failures: int = 0,
     ):
         self.storage = storage
         self.interval_s = interval_ms / 1000.0
@@ -42,6 +51,11 @@ class CheckpointCoordinator:
         self._last_trigger = clock()
         self._next_id = 1
         self.num_completed = 0
+        # execution.checkpointing.tolerable-failed-checkpoints: consecutive
+        # capture/persist failures absorbed (FAILED stats record, job keeps
+        # running) before trigger() escalates to the restart strategy
+        self.tolerable_failures = tolerable_failures
+        self._consecutive_failures = 0
         self._on_complete: List[Callable[[int], None]] = []
         self.traces = traces  # TraceRegistry; checkpoint lifecycle spans (O2)
         # CheckpointStatsTracker (metrics/checkpoint_stats.py): per-checkpoint
@@ -56,6 +70,14 @@ class CheckpointCoordinator:
     def register_on_complete(self, fn: Callable[[int], None]) -> None:
         self._on_complete.append(fn)
 
+    def reset_failure_streak(self) -> None:
+        """A new job attempt starts with its FULL failure tolerance: the
+        coordinator outlives restarts (MiniCluster constructs it once),
+        and carrying the exhausted streak over would make the very first
+        isolated failure of the restarted attempt escalate again —
+        hot-looping restarts until a checkpoint happens to complete."""
+        self._consecutive_failures = 0
+
     def set_next_id(self, next_id: int) -> None:
         self._next_id = max(self._next_id, next_id)
 
@@ -67,7 +89,12 @@ class CheckpointCoordinator:
             return None
         return self.trigger(capture_fn)
 
-    def trigger(self, capture_fn: Callable[[], dict]) -> int:
+    def trigger(self, capture_fn: Callable[[], dict]) -> Optional[int]:
+        """Returns the completed checkpoint id, or None when a failure was
+        TOLERATED (within tolerable_failures — the stats record is FAILED,
+        the job keeps running, the next interval retries with a fresh id).
+        Beyond tolerance the phase error is re-raised (chained into
+        CheckpointFailuresExhaustedError) for the restart strategy."""
         cid = self._next_id
         span = self.traces.span("checkpointing", "Checkpoint") if self.traces else None
         if self.stats is not None:
@@ -79,9 +106,8 @@ class CheckpointCoordinator:
         t_cap = self._clock()
         try:
             data = capture_fn()
-        except BaseException as e:  # noqa: BLE001 — record, close spans, re-raise
-            self._abort(cid, e, span, cap_span)
-            raise
+        except BaseException as e:  # noqa: BLE001 — record, close spans,
+            return self._failed(cid, e, span, cap_span)  # tolerate/raise
         sync_ms = (self._clock() - t_cap) * 1000.0
         if cap_span is not None:
             self.traces.report(cap_span.set_attribute("checkpointId", cid).end())
@@ -95,9 +121,9 @@ class CheckpointCoordinator:
         try:
             self.storage.save(cid, data)
         except BaseException as e:  # noqa: BLE001
-            self._abort(cid, e, span, persist_span)
-            raise
+            return self._failed(cid, e, span, persist_span)
         async_ms = (self._clock() - t_save) * 1000.0
+        self._consecutive_failures = 0   # tolerance counts CONSECUTIVE
         if persist_span is not None:
             self.traces.report(
                 persist_span.set_attribute("checkpointId", cid).end())
@@ -127,10 +153,32 @@ class CheckpointCoordinator:
                 .set_attribute("status", "COMPLETED").end())
         return cid
 
+    def _failed(self, cid: int, exc: BaseException, span, phase_span) -> None:
+        """A checkpoint phase raised: record FAILED, close the spans, then
+        either TOLERATE (within tolerable_failures: bump the failed id so
+        the retry never reuses it, restart the interval clock, return
+        None) or re-raise for the restart strategy. Non-Exception
+        BaseExceptions (KeyboardInterrupt, SystemExit) and InjectedCrash
+        are NEVER tolerated: tolerance is for storage faults, not for
+        interpreter shutdown or chaos process-death models."""
+        self._abort(cid, exc, span, phase_span)
+        if not isinstance(exc, Exception) or isinstance(exc, InjectedCrash):
+            raise exc
+        self._consecutive_failures += 1
+        if self._consecutive_failures <= self.tolerable_failures:
+            self._next_id = cid + 1
+            self._last_trigger = self._clock()   # no hot-loop retriggering
+            return None
+        if self.tolerable_failures > 0:
+            raise CheckpointFailuresExhaustedError(
+                f"checkpoint {cid} failed; {self._consecutive_failures} "
+                f"consecutive failures exceed tolerable-failed-checkpoints "
+                f"{self.tolerable_failures}") from exc
+        raise exc
+
     def _abort(self, cid: int, exc: BaseException, span, phase_span) -> None:
         """A checkpoint phase raised: flip the tracker record to FAILED and
-        close the open spans with the failure attribute (the caller
-        re-raises — failure handling belongs to the job's restart policy)."""
+        close the open spans with the failure attribute."""
         if self.stats is not None:
             self.stats.report_failed(cid, repr(exc))
         if phase_span is not None:
@@ -150,8 +198,15 @@ class CheckpointCoordinator:
             self.storage.discard(cid)
 
     def latest_snapshot(self) -> Optional[dict]:
-        latest = self.storage.latest()
-        if latest is None:
-            return None
-        _cid, handle = latest
-        return self.storage.load(handle)
+        """Newest LOADABLE snapshot: a torn/corrupt checkpoint artifact
+        (CorruptCheckpointError — e.g. truncated `_metadata` left by a
+        crash or disk fault) is SKIPPED and the rewind continues to the
+        previous complete checkpoint instead of crash-looping the restart
+        path on an unreadable file. None when nothing loadable remains
+        (the job replays from scratch — still exactly-once)."""
+        for _cid, handle in reversed(self.storage.list_checkpoints()):
+            try:
+                return self.storage.load(handle)
+            except CorruptCheckpointError:
+                continue
+        return None

@@ -3,9 +3,18 @@ runtime/state/filesystem/FsCheckpointStorageAccess.java:43 and the JM-heap
 MemoryBackendCheckpointStorageAccess).
 
 A checkpoint is one dict (numpy arrays + plain data), written atomically
-(temp file + rename) under <dir>/chk-<id>/; the `_metadata` name and
-completed-marker protocol mirror the reference's checkpoint layout. Device
-arrays must already be pulled to host by the snapshot capture."""
+(temp file + fsync + rename + parent-dir fsync) under <dir>/chk-<id>/; the
+`_metadata` name and completed-marker protocol mirror the reference's
+checkpoint layout. Device arrays must already be pulled to host by the
+snapshot capture.
+
+Durability contract (chaos-plane hardening): `save` fsyncs the temp file
+BEFORE the rename and the parent directory AFTER it, so a crash can leave
+either the previous checkpoint or the new one — never a torn `_metadata`
+that looks complete. `load` wraps every missing/torn-artifact failure in
+the typed :class:`CorruptCheckpointError`, so restore paths can skip a
+damaged checkpoint and rewind to the previous complete one instead of
+crash-looping on a bare ``UnpicklingError``."""
 
 from __future__ import annotations
 
@@ -15,6 +24,21 @@ import re
 import shutil
 import tempfile
 from typing import Dict, List, Optional, Tuple
+
+from flink_tpu.chaos import plan as _chaos
+
+
+class CorruptCheckpointError(Exception):
+    """A checkpoint artifact is missing or unreadable (torn/truncated
+    `_metadata`, deleted chk dir, evicted in-memory handle). Typed so
+    restore can distinguish "this checkpoint is damaged — rewind further"
+    from a programming error."""
+
+    def __init__(self, handle: str, cause: BaseException):
+        super().__init__(f"checkpoint artifact {handle!r} is missing or "
+                         f"corrupt: {cause!r}")
+        self.handle = handle
+        self.__cause__ = cause
 
 
 class CheckpointStorage:
@@ -28,6 +52,8 @@ class CheckpointStorage:
         raise NotImplementedError
 
     def load(self, handle: str) -> dict:
+        """Raises CorruptCheckpointError when the artifact is missing or
+        unreadable."""
         raise NotImplementedError
 
     def list_checkpoints(self) -> List[Tuple[int, str]]:
@@ -42,24 +68,57 @@ class CheckpointStorage:
         pass
 
 
+def _chaos_storage(site: str) -> Optional[str]:
+    """The chaos plane's storage seam: one is-None check when chaos is
+    off; `error` raises here, `torn` returns the directive for save()."""
+    hook = _chaos.HOOK
+    if hook is not None:
+        return hook("storage", site)
+    return None
+
+
 class MemoryCheckpointStorage(CheckpointStorage):
     def __init__(self):
         self._store: Dict[int, bytes] = {}
 
     def save(self, checkpoint_id: int, data: dict) -> str:
+        directive = _chaos_storage(f"save:{checkpoint_id}")
         blob = pickle.dumps(data, protocol=pickle.HIGHEST_PROTOCOL)
+        if directive == "torn":
+            blob = blob[: max(len(blob) // 3, 1)]
         self._store[checkpoint_id] = blob
         self.last_save_bytes = len(blob)
         return f"mem:{checkpoint_id}"
 
     def load(self, handle: str) -> dict:
-        return pickle.loads(self._store[int(handle.split(":", 1)[1])])
+        _chaos_storage(f"load:{handle}")
+        try:
+            return pickle.loads(self._store[int(handle.split(":", 1)[1])])
+        except CorruptCheckpointError:
+            raise
+        except Exception as e:  # noqa: BLE001 — missing key, torn pickle
+            raise CorruptCheckpointError(handle, e) from e
 
     def list_checkpoints(self) -> List[Tuple[int, str]]:
         return [(i, f"mem:{i}") for i in sorted(self._store)]
 
     def discard(self, checkpoint_id: int) -> None:
         self._store.pop(checkpoint_id, None)
+
+
+def _fsync_dir(path: str) -> None:
+    """fsync a directory so a just-renamed entry survives power loss
+    (no-op on platforms that cannot open directories)."""
+    try:
+        fd = os.open(path, os.O_RDONLY)
+    except OSError:
+        return
+    try:
+        os.fsync(fd)
+    except OSError:
+        pass                       # e.g. network fs refusing dir fsync
+    finally:
+        os.close(fd)
 
 
 class FsCheckpointStorage(CheckpointStorage):
@@ -73,6 +132,7 @@ class FsCheckpointStorage(CheckpointStorage):
         return os.path.join(self.directory, f"chk-{checkpoint_id}")
 
     def save(self, checkpoint_id: int, data: dict) -> str:
+        directive = _chaos_storage(f"save:{checkpoint_id}")
         chk = self._chk_dir(checkpoint_id)
         os.makedirs(chk, exist_ok=True)
         final = os.path.join(chk, "_metadata")
@@ -80,7 +140,20 @@ class FsCheckpointStorage(CheckpointStorage):
         try:
             with os.fdopen(fd, "wb") as f:
                 pickle.dump(data, f, protocol=pickle.HIGHEST_PROTOCOL)
+                f.flush()
+                # fsync BEFORE the rename: without it the rename can land
+                # while the data blocks are still dirty, and a crash leaves
+                # a torn file behind the atomic-completion marker
+                os.fsync(f.fileno())
             os.replace(tmp, final)  # atomic completion marker
+            # fsync the parent so the rename itself is durable
+            _fsync_dir(chk)
+            if directive == "torn":
+                # chaos: simulate the torn-metadata outcome fsync exists to
+                # prevent (disk corruption / pre-hardening crash artifact)
+                size = os.path.getsize(final)
+                with open(final, "r+b") as f:
+                    f.truncate(max(size // 3, 1))
             self.last_save_bytes = os.path.getsize(final)
         finally:
             if os.path.exists(tmp):
@@ -88,8 +161,15 @@ class FsCheckpointStorage(CheckpointStorage):
         return final
 
     def load(self, handle: str) -> dict:
-        with open(handle, "rb") as f:
-            return pickle.load(f)
+        _chaos_storage(f"load:{handle}")
+        try:
+            with open(handle, "rb") as f:
+                return pickle.load(f)
+        except CorruptCheckpointError:
+            raise
+        except Exception as e:  # noqa: BLE001 — missing dir/file, torn or
+            # truncated pickle (EOFError/UnpicklingError), unreadable bytes
+            raise CorruptCheckpointError(handle, e) from e
 
     def list_checkpoints(self) -> List[Tuple[int, str]]:
         out = []

@@ -327,6 +327,20 @@ class ExchangeOptions:
         "Target per-batch transit latency the debloater sizes toward "
         "(taskmanager.network.memory.buffer-debloat.target analogue)."
     )
+    RECONNECT_WINDOW_MS = (
+        ConfigOptions.key("exchange.reconnect.window-ms")
+        .duration_ms_type().default_value(5000)
+    ).with_description(
+        "Bounded window a keyed-exchange sender spends re-dialing a peer "
+        "after a transient dataplane failure (connection reset, injected "
+        "blip) before escalating to the normal task-failure/restart path. "
+        "The reconnect re-runs the open/credit negotiation and resumes "
+        "only when the receiver's next expected sequence number matches "
+        "the sender's (no frame was lost); a real loss, or a peer whose "
+        "TaskManager stopped heartbeating, fails over immediately. 0 "
+        "disables reconnection (every dataplane error restarts the job, "
+        "the pre-chaos behavior)."
+    )
 
 
 class CheckpointingOptions:
@@ -334,6 +348,19 @@ class CheckpointingOptions:
     DIRECTORY = ConfigOptions.key("execution.checkpointing.dir").string_type().no_default_value()
     MODE = ConfigOptions.key("execution.checkpointing.mode").string_type().default_value("EXACTLY_ONCE")
     MAX_RETAINED = ConfigOptions.key("execution.checkpointing.max-retained").int_type().default_value(3)
+    TOLERABLE_FAILED_CHECKPOINTS = (
+        ConfigOptions.key("execution.checkpointing.tolerable-failed-checkpoints")
+        .int_type().default_value(0)
+    ).with_description(
+        "Consecutive checkpoint failures (capture or persist) the job "
+        "tolerates before the failure restarts it (Flink's "
+        "execution.checkpointing.tolerable-failed-checkpoints). Each "
+        "tolerated failure still lands a FAILED record in the checkpoint "
+        "stats ring and bumps the consecutiveFailedCheckpoints gauge; a "
+        "completed checkpoint resets the count. 0 (default, reference "
+        "parity) restarts on the first failure. Savepoint declines never "
+        "count — an outrun savepoint retries by design."
+    )
 
 
 class DeviceOptions:
@@ -522,6 +549,60 @@ class ObservabilityOptions:
         "Peak compute (TFLOP/s) used as the denominator of the "
         "flopsUtilizationPct roofline gauge. 0 picks a per-platform "
         "default."
+    )
+
+
+class WatchdogOptions:
+    """Stuck-task detection (distributed JobManager). A task wedged inside
+    a live TaskManager — blocked UDF, dead device dispatch, a lost RPC
+    reply — is invisible to heartbeat failure detection: the TM keeps
+    beating while the task makes no progress forever."""
+
+    STUCK_TASK_TIMEOUT_MS = (
+        ConfigOptions.key("execution.watchdog.stuck-task-timeout-ms")
+        .duration_ms_type().default_value(0)
+    ).with_description(
+        "Fail a RUNNING job's task through the normal attributed "
+        "restart path when its heartbeat-reported step counter has not "
+        "advanced for this long while its TaskManager stays alive (and "
+        "the task has not finished). 0 (default) disables the watchdog. "
+        "Tune WELL above the longest legitimate pause a step can take — "
+        "device compiles, cold restores and backpressure stalls all "
+        "freeze the step counter; start at 10x the heartbeat timeout."
+    )
+
+
+class ChaosOptions:
+    """Deterministic fault injection (flink_tpu/chaos — docs/robustness.md).
+    Default OFF; when off the runtime pays one module-level `is None`
+    check per seam call and nothing else. Scenario tests and the
+    chaos_microbench install plans programmatically; these options exist
+    so a live cluster (jobmanager/taskmanager --conf) can run a drill."""
+
+    ENABLED = (
+        ConfigOptions.key("chaos.enabled").bool_type().default_value(False)
+    ).with_description(
+        "Install the configured FaultPlan process-wide at startup. Every "
+        "fault it injects is labeled and attributed `injected: true` in "
+        "the job's exception history. Never enable in production except "
+        "as a deliberate, supervised drill."
+    )
+    SEED = (
+        ConfigOptions.key("chaos.seed").int_type().default_value(0)
+    ).with_description(
+        "Seed for the FaultPlan's RNG (probability triggers): the same "
+        "seed over a deterministic workload replays the same fault "
+        "sequence."
+    )
+    RULES = (
+        ConfigOptions.key("chaos.rules").string_type().default_value("")
+    ).with_description(
+        "JSON list of FaultRule field dicts, e.g. "
+        '[{"scope": "rpc", "fault": "error", "match": '
+        '"jobmanager.ack_checkpoint", "nth": 3, "max_fires": 2}]. '
+        "Scopes: transport|rpc|dataplane|storage|device|heartbeat; "
+        "faults: error|crash|delay|drop|torn|partition; triggers: "
+        "nth-call, probability, window_s since install, max_fires."
     )
 
 

@@ -14,6 +14,9 @@ rules turn the informally-held invariants into CI:
   every contender into a convoy.
 - CONC004 thread-hygiene — unnamed/non-daemon threads are invisible in
   the dashboard's thread attribution and can wedge interpreter shutdown.
+- CONC005 no-silent-swallow — `except Exception: pass` in the runtime/
+  checkpoint subtrees hides the exact transient faults the chaos plane
+  exists to surface.
 """
 
 from __future__ import annotations
@@ -230,6 +233,81 @@ class BlockingUnderLockRule(Rule):
                     scope=scope,
                     symbol=base if n == 1 else f"{base}#{n}",
                     hint=self.hint)
+
+
+#: package-relative subtrees where a silent broad swallow is a violation:
+#: the runtime's control/data planes and the checkpoint layer — exactly
+#: where a swallowed transient fault becomes an undiagnosable hang or a
+#: silently-lost checkpoint (chaos-plane hardening, ISSUE-10)
+SWALLOW_SCOPED_SUBTREES = ("runtime", "checkpoint")
+_BROAD_EXC_NAMES = {"Exception", "BaseException"}
+
+
+def _is_broad_handler(type_node) -> bool:
+    """Bare `except:`, `except Exception/BaseException:`, or a tuple
+    containing one of those. Narrow handlers (OSError, KeyError, ...) are
+    deliberate per-fault decisions and stay legal."""
+    if type_node is None:
+        return True
+    if isinstance(type_node, ast.Name):
+        return type_node.id in _BROAD_EXC_NAMES
+    if isinstance(type_node, ast.Tuple):
+        return any(_is_broad_handler(e) for e in type_node.elts)
+    return False
+
+
+@register
+class NoSilentSwallowRule(Rule):
+    id = "CONC005"
+    name = "no-silent-swallow"
+    family = "concurrency"
+    rationale = (
+        "An `except Exception: pass` (or bare `except: pass`) on the "
+        "runtime/checkpoint planes erases the one signal that "
+        "distinguishes a transient fault from a real failure: the "
+        "heartbeat manager silently eating ping errors is exactly how a "
+        "partitioned TM stays 'alive' until the timeout, and a swallowed "
+        "checkpoint error is a lost recovery point nobody hears about. "
+        "Best-effort calls may survive peer failures, but they must LOG "
+        "or COUNT what they swallowed (missedPings, _swallow(site, e)) — "
+        "or carry a written justification in lint_baseline.json. Narrow "
+        "except types (OSError on a socket close) remain legal: they are "
+        "per-fault decisions, not blanket blindness."
+    )
+    hint = ("log/count the swallowed exception (see cluster._swallow, "
+            "heartbeat.missed_pings), narrow the except type, or justify "
+            "the entry in lint_baseline.json")
+
+    def check(self, index: ModuleIndex) -> Iterator[Violation]:
+        for layer in SWALLOW_SCOPED_SUBTREES:
+            for mod in index.in_subtree(layer):
+                parents = None
+                seen_in_scope: Dict[str, int] = {}
+                for node in ast.walk(mod.tree):
+                    if not isinstance(node, ast.ExceptHandler):
+                        continue
+                    if not _is_broad_handler(node.type):
+                        continue
+                    if not all(isinstance(s, ast.Pass) for s in node.body):
+                        continue
+                    if parents is None:
+                        parents = parent_map(mod.tree)
+                    scope = enclosing_scope(parents, node)
+                    # occurrence-indexed symbol (see CONC003): one baseline
+                    # entry must not cover every swallow in the scope
+                    n = seen_in_scope[scope] = seen_in_scope.get(scope, 0) + 1
+                    caught = ("bare except" if node.type is None
+                              else f"except {ast.unparse(node.type)}")
+                    yield Violation(
+                        rule_id=self.id, path=mod.rel_to_project,
+                        line=node.lineno,
+                        message=(f"{caught}: pass in "
+                                 f"{scope or '<module>'} silently swallows "
+                                 "every failure, transient or fatal"),
+                        scope=scope,
+                        symbol=(f"swallow@{scope}" if n == 1
+                                else f"swallow@{scope}#{n}"),
+                        hint=self.hint)
 
 
 @register

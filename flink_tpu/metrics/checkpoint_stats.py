@@ -31,6 +31,14 @@ import time
 from collections import deque
 from typing import Any, Callable, Dict, List, Optional
 
+# chaos-plane attribution: failures whose cause carries this marker were
+# INJECTED by an installed FaultPlan (flink_tpu/chaos — a stdlib-only leaf
+# module), and the exception history tags them `injected: true` so chaos
+# scenarios can assert exactly where the runtime blamed each fault. The
+# marker is a plain substring because the distributed path ships failures
+# as repr() strings over RPC.
+from flink_tpu.chaos.plan import INJECTED_MARKER
+
 # checkpoint lifecycle states (CheckpointStatsStatus analogue)
 PENDING = "PENDING"
 COMPLETED = "COMPLETED"
@@ -173,6 +181,11 @@ class CheckpointStatsTracker:
         self._lock = threading.Lock()
         self.num_completed = 0
         self.num_failed = 0
+        # failed-or-declined records since the last completion — the gauge
+        # behind execution.checkpointing.tolerable-failed-checkpoints
+        # dashboards (the enforcing counters live on the coordinator/JM,
+        # which distinguish real failures from benign savepoint declines)
+        self.consecutive_failed = 0
         self._last_completed: Optional[CheckpointStats] = None
         self._last_failed: Optional[CheckpointStats] = None
         # {"checkpoint_id", "restore_timestamp_ms", "restore_duration_ms"}
@@ -240,9 +253,18 @@ class CheckpointStatsTracker:
             if operator_bytes:
                 rec.operator_bytes = {k: int(v) for k, v in operator_bytes.items()}
             self.num_completed += 1
+            self.consecutive_failed = 0
             self._last_completed = rec
 
-    def report_failed(self, checkpoint_id: int, failure_cause: str) -> None:
+    def report_failed(self, checkpoint_id: int, failure_cause: str,
+                      benign: bool = False) -> None:
+        """`benign` marks failures that are NOT storage/capture faults —
+        savepoint outrun declines (which retry by design) and the sweeps
+        that fail in-flight records when a job restarts or rescales. They
+        count in num_failed (the record IS failed) but never in the
+        consecutiveFailedCheckpoints gauge, which must mirror what
+        tolerable-failed-checkpoints enforcement counts — a gauge
+        climbing on benign declines would page operators on healthy jobs."""
         now_ms = self._clock() * 1000.0
         with self._lock:
             rec = self._records.get(checkpoint_id)
@@ -255,6 +277,8 @@ class CheckpointStatsTracker:
             rec.end_to_end_duration_ms = max(now_ms - rec.trigger_ts_ms, 0.0)
             rec.failure_cause = str(failure_cause)
             self.num_failed += 1
+            if not benign:
+                self.consecutive_failed += 1
             self._last_failed = rec
 
     def report_restore(self, checkpoint_id: Optional[int],
@@ -287,6 +311,7 @@ class CheckpointStatsTracker:
             return {
                 prefix + "numberOfCompletedCheckpoints": self.num_completed,
                 prefix + "numberOfFailedCheckpoints": self.num_failed,
+                prefix + "consecutiveFailedCheckpoints": self.consecutive_failed,
                 prefix + "numberOfInProgressCheckpoints": self._pending_count(),
                 prefix + "lastCheckpointDuration": (
                     last.end_to_end_duration_ms if last is not None else 0),
@@ -299,6 +324,7 @@ class CheckpointStatsTracker:
         """Register the standard gauges on a metric group (names per the
         reference's CheckpointStatsTracker.registerMetrics)."""
         for name in ("numberOfCompletedCheckpoints", "numberOfFailedCheckpoints",
+                     "consecutiveFailedCheckpoints",
                      "numberOfInProgressCheckpoints", "lastCheckpointDuration",
                      "lastCheckpointSize", "lastCheckpointRestoreTimestamp"):
             group.gauge(name, lambda n=name: self.gauge_values()[n])
@@ -384,14 +410,21 @@ class ExceptionHistory:
                        task_manager: Optional[str] = None,
                        restart_number: int = 0,
                        exception: Optional[BaseException] = None) -> Dict[str, Any]:
+        chain = (root_cause_chain(exception)
+                 if exception is not None else [str(cause)])
         entry = {
             "timestamp_ms": self._clock() * 1000.0,
             "exception": str(cause),
-            "root_cause_chain": (root_cause_chain(exception)
-                                 if exception is not None else [str(cause)]),
+            "root_cause_chain": chain,
             "task": task,
             "task_manager": task_manager,
             "restart_number": int(restart_number),
+            # chaos attribution: true when the failure was injected by an
+            # installed FaultPlan (marker survives the distributed path's
+            # repr()-over-RPC shipping) — scenarios assert WHERE the
+            # runtime blamed each injected fault
+            "injected": (INJECTED_MARKER in str(cause)
+                         or any(INJECTED_MARKER in c for c in chain)),
         }
         with self._lock:
             self.entries.append(entry)
@@ -417,6 +450,7 @@ class ExceptionHistory:
                 "restart_number": int(restart_number),
                 "failed_at_ms": self._clock() * 1000.0,
                 "cause": str(cause),
+                "injected": INJECTED_MARKER in str(cause),
                 "steps_at_failure": steps_at_failure,
                 "events_at_failure": events_at_failure,
                 "restored_checkpoint_id": None,

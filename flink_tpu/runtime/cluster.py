@@ -30,6 +30,7 @@ equivalent of replaying from the source offset in the snapshot).
 
 from __future__ import annotations
 
+import logging
 import pickle
 import threading
 import time
@@ -39,6 +40,27 @@ from dataclasses import dataclass, field
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
 import numpy as np
+
+from flink_tpu.chaos import plan as _chaos
+
+_LOG = logging.getLogger(__name__)
+
+
+#: reply timeout for gateways carrying PAYLOAD-shipping calls — deploys
+#: restoring large snapshots, checkpoint acks the JM persists before
+#: replying, blob fetches. The default 10s wedge detector would hard-fail
+#: a genuinely big (and non-retryable) transfer; control-plane-only
+#: gateways keep the tight default.
+PAYLOAD_REPLY_TIMEOUT_S = 120.0
+
+
+def _swallow(site: str, exc: BaseException) -> None:
+    """Best-effort control-plane calls (cancel fan-out, decline-on-behalf,
+    state release, loop ticks) deliberately survive peer failures — but
+    never SILENTLY (lint CONC005 no-silent-swallow): every swallowed
+    exception is debug-logged with its site so a misbehaving plane is
+    diagnosable without a debugger."""
+    _LOG.debug("swallowed %r at %s", exc, site)
 
 from flink_tpu.core.keygroups import (
     KeyGroupRange,
@@ -59,10 +81,16 @@ from flink_tpu.metrics.registry import MetricRegistry, metrics_snapshot
 from flink_tpu.metrics.task_io import backpressure_level
 from flink_tpu.metrics.traces import Span, job_trace_id
 from flink_tpu.runtime.blob import BlobCache, BlobServerEndpoint
-from flink_tpu.runtime.dataplane import ExchangeServer, OutputChannel
+from flink_tpu.runtime.dataplane import (
+    ExchangeServer,
+    OutputChannel,
+    SequenceLostError,
+)
 from flink_tpu.runtime.heartbeat import HeartbeatManager
 from flink_tpu.runtime.rpc import (
+    RetryPolicy,
     RpcEndpoint,
+    RpcGateway,
     RpcService,
     current_trace_id,
     trace_context,
@@ -120,6 +148,9 @@ class DistributedJobSpec(_PickledSpec):
     # device-operator construction knobs (e.g. session num_slices /
     # key_capacity for skewed/out-of-order streams)
     operator_options: Optional[Dict[str, Any]] = None
+    # optional per-job Configuration (exchange.wire-format,
+    # exchange.reconnect.window-ms, observability.sampling.interval-ms...)
+    config: Optional[Any] = None
 
 
 @dataclass
@@ -211,6 +242,12 @@ class _JobState:
     num_rescales: int = 0
     last_rescale_duration_ms: float = 0.0
     rescale_started: Optional[float] = None
+    # stuck-task watchdog: per-shard (last reported step, monotonic stamp
+    # of the last time it ADVANCED) — cleared on every (re)deploy
+    progress: Dict[int, Tuple[int, float]] = field(default_factory=dict)
+    # execution.checkpointing.tolerable-failed-checkpoints accounting:
+    # consecutive persist/coordination failures; reset by a completion
+    consecutive_cp_failures: int = 0
 
     @property
     def failure(self) -> Optional[str]:
@@ -314,10 +351,18 @@ class JobManagerEndpoint(RpcEndpoint):
         checkpoint_history_size: int = 10,
         exception_history_size: int = 16,
         autoscaler_config=None,
+        tolerable_failed_checkpoints: int = 0,
+        stuck_task_timeout_ms: int = 0,
     ):
         super().__init__(name="jobmanager")
         self.rpc = rpc
         self.auto_records_per_task = auto_records_per_task
+        # execution.checkpointing.tolerable-failed-checkpoints: consecutive
+        # checkpoint failures absorbed (FAILED stats record + gauge) before
+        # the job takes the restart path
+        self.tolerable_failed_checkpoints = tolerable_failed_checkpoints
+        # execution.watchdog.stuck-task-timeout-ms: 0 = watchdog off
+        self.stuck_task_timeout_ms = stuck_task_timeout_ms
         # observability.checkpoint-history.size / .exception-history.size
         self.checkpoint_history_size = checkpoint_history_size
         self.exception_history_size = exception_history_size
@@ -367,8 +412,8 @@ class JobManagerEndpoint(RpcEndpoint):
         while not self._stopped.wait(self._autoscaler_interval):
             try:
                 self.run_in_main_thread(self._autoscale_tick).result(timeout=30)
-            except Exception:
-                pass
+            except Exception as e:
+                _swallow("autoscaler_loop", e)
 
     def _autoscale_tick(self) -> None:
         """One controller evaluation (JM main thread — the coordinator's
@@ -391,9 +436,42 @@ class JobManagerEndpoint(RpcEndpoint):
     def _schedule_loop(self) -> None:
         while not self._stopped.wait(max(self.restart_delay, 0.2)):
             try:
-                self.run_in_main_thread(self._try_schedule_all).result(timeout=30)
-            except Exception:
-                pass
+                self.run_in_main_thread(self._schedule_tick).result(timeout=30)
+            except Exception as e:
+                _swallow("schedule_loop", e)
+
+    def _schedule_tick(self) -> None:
+        self._try_schedule_all()
+        self._watchdog_tick()
+
+    def _watchdog_tick(self) -> None:
+        """Stuck-task watchdog (JM main thread): a task whose heartbeat-
+        reported step counter has not advanced for
+        `stuck_task_timeout_ms` while its TM keeps heartbeating is wedged
+        INSIDE a live process — invisible to heartbeat failure detection
+        — and is failed through the normal attributed restart path. TM
+        loss and finished shards are excluded (their own paths own them)."""
+        if self.stuck_task_timeout_ms <= 0:
+            return
+        now = time.monotonic()
+        for job in list(self._jobs.values()):
+            if job.status != "RUNNING":
+                continue
+            for shard, (step, stamped) in list(job.progress.items()):
+                if shard in job.finished:
+                    continue
+                tm_id = job.assignment.get(shard)
+                if tm_id is None or not self.heartbeats.is_alive(tm_id):
+                    continue      # dead TM: the heartbeat path handles it
+                stalled_ms = (now - stamped) * 1000.0
+                if stalled_ms >= self.stuck_task_timeout_ms:
+                    self._fail_job(
+                        job,
+                        f"shard {shard} stuck at step {step}: no progress "
+                        f"for {stalled_ms:.0f} ms while TM {tm_id} stayed "
+                        "alive (stuck-task watchdog)",
+                        task=f"shard-{shard}", task_manager=tm_id)
+                    break         # one failover per job per tick
 
     def stop(self) -> None:
         self._stopped.set()
@@ -405,18 +483,28 @@ class JobManagerEndpoint(RpcEndpoint):
                                exchange_address: str, slots: int = 1) -> dict:
         self._tms[tm_id] = {
             "rpc": rpc_address, "exchange": exchange_address, "slots": slots,
-            "gateway": self.rpc.gateway(rpc_address, "taskexecutor"),
+            # deploy_task ships restore snapshots: payload reply budget
+            "gateway": self.rpc.gateway(
+                rpc_address, "taskexecutor",
+                reply_timeout=PAYLOAD_REPLY_TIMEOUT_S),
         }
         self.heartbeats.monitor(tm_id)
         try:
             self._try_schedule_all()
-        except Exception:
-            pass  # scheduling trouble must not fail the registration
+        except Exception as e:
+            _swallow("register.try_schedule", e)  # scheduling trouble must
+            #                                      not fail the registration
         return {"registered": True, "jm_blob": "blob"}
 
     def heartbeat_tm(self, tm_id: str, steps: Optional[dict] = None,
                      metrics: Optional[dict] = None,
                      spans: Optional[list] = None) -> bool:
+        # chaos seam: a heartbeat-scope drop rule partitions this TM from
+        # the JM's liveness view — beats (and the steps/metrics riding
+        # them) vanish exactly as on a one-way network partition
+        hook = _chaos.HOOK
+        if hook is not None and hook("heartbeat", tm_id) == "drop":
+            return False
         self.heartbeats.receive_heartbeat(tm_id)
         # keys are (job_id, shard, attempt) — the attempt guard keeps an
         # in-flight heartbeat snapshotted before a rescale's cancel from
@@ -425,10 +513,16 @@ class JobManagerEndpoint(RpcEndpoint):
         # the autoscaler's signal windows for the whole new attempt);
         # 2-tuple keys (older TMs) are accepted unguarded
         if steps:
+            now = time.monotonic()
             for (job_id, shard, *att), step in steps.items():
                 job = self._jobs.get(job_id)
                 if job is not None and (not att or att[0] == job.attempt):
                     job.steps[shard] = step
+                    # watchdog progress stamp: refreshed only when the
+                    # step ADVANCES (a frozen counter is what stuck means)
+                    prev = job.progress.get(shard)
+                    if prev is None or prev[0] != step:
+                        job.progress[shard] = (step, now)
         if metrics:
             # TM-shipped metric snapshots (authenticated RPC plane): latest
             # snapshot per shard wins — the JM serves aggregates, history
@@ -444,6 +538,20 @@ class JobManagerEndpoint(RpcEndpoint):
                     job.spans.append(sd)
                     del job.spans[:-_MAX_JOB_SPANS]
         return True
+
+    def peer_alive(self, job_id: str, attempt: int, shard: int) -> bool:
+        """Is the TM hosting `shard` of `job_id` (attempt `attempt`) still
+        registered and heartbeating? A task seeing a dataplane error asks
+        this to distinguish a transient peer blip (TM alive → bounded
+        reconnect window) from real TM loss (→ escalate to the restart
+        path immediately; reconnecting to a dead peer only burns the
+        window)."""
+        job = self._jobs.get(job_id)
+        if job is None or job.attempt != attempt or job.status != "RUNNING":
+            return False
+        tm_id = job.assignment.get(shard)
+        return (tm_id is not None and tm_id in self._tms
+                and self.heartbeats.is_alive(tm_id))
 
     def _on_tm_dead(self, tm_id: str) -> None:
         self.run_in_main_thread(self._handle_tm_dead, tm_id)
@@ -587,6 +695,9 @@ class JobManagerEndpoint(RpcEndpoint):
         jm_gauges.update(job.exceptions.gauge_values(prefix="job."))
         jm_gauges["job.numRescales"] = job.num_rescales
         jm_gauges["job.lastRescaleDurationMs"] = job.last_rescale_duration_ms
+        # swallowed-ping accounting (heartbeat.py): a climbing value is the
+        # early signal of a flapping/partitioned control plane
+        jm_gauges["job.heartbeatMissedPings"] = self.heartbeats.missed_pings
         if "job.watermarkSkewMs" in agg:
             jm_gauges["job.watermarkSkewMs"] = agg["job.watermarkSkewMs"]
         agg.update(jm_gauges)
@@ -734,7 +845,8 @@ class JobManagerEndpoint(RpcEndpoint):
         # stats records would sit IN_PROGRESS forever in /jobs/:id/checkpoints
         for cp_id in list(job.pending):
             job.stats.report_failed(
-                cp_id, f"superseded by rescale {old}->{target}")
+                cp_id, f"superseded by rescale {old}->{target}",
+                benign=True)
         self._cancel_tasks(job)
         job.parallelism = target
         job.status = "RESCALING"
@@ -899,6 +1011,11 @@ class JobManagerEndpoint(RpcEndpoint):
         }
         job.finished = {}
         job.steps = {}
+        job.progress = {}   # watchdog stamps belong to the dead attempt
+        # the new attempt gets its full tolerable-failed-checkpoints
+        # budget — carrying an exhausted streak over would re-fail the
+        # restarted job on its first isolated persist hiccup
+        job.consecutive_cp_failures = 0
         # drop the dead attempt's shipped snapshots: after a rescale-down a
         # stale higher-shard snapshot would keep inflating the aggregates
         # (and the autoscaler's signals) forever
@@ -976,8 +1093,8 @@ class JobManagerEndpoint(RpcEndpoint):
             if tm is not None:
                 try:
                     tm["gateway"].cancel_task(job.job_id)
-                except Exception:
-                    pass
+                except Exception as e:
+                    _swallow("cancel_tasks", e)
 
     def _fail_job(self, job: _JobState, reason: str,
                   task: Optional[str] = None,
@@ -988,7 +1105,8 @@ class JobManagerEndpoint(RpcEndpoint):
         # in-flight checkpoints belong to the dead attempt: their acks can
         # never complete, so their stat records flip to FAILED now
         for cp_id in list(job.pending):
-            job.stats.report_failed(cp_id, f"job failure: {reason}")
+            job.stats.report_failed(cp_id, f"job failure: {reason}",
+                                    benign=True)
         self._cancel_tasks(job)
         if job.restarts >= self.restart_attempts:
             job.status = "FAILED"
@@ -1018,8 +1136,8 @@ class JobManagerEndpoint(RpcEndpoint):
             for gw in gateways:
                 try:
                     gw.release_job_state(job_id)
-                except Exception:
-                    pass
+                except Exception as e:
+                    _swallow("release_job_state", e)
 
         # off the JM main thread: the TM handler is one-directional, but a
         # dead TM's connect timeout must not stall scheduling
@@ -1176,13 +1294,41 @@ class JobManagerEndpoint(RpcEndpoint):
                         checkpoint_id,
                         {"job": job_id, "shards": handles, "step": step}
                     )
-                except BaseException as e:  # noqa: BLE001 — record, re-raise
+                except BaseException as e:  # noqa: BLE001 — record; tolerate
+                    # or fail over per tolerable-failed-checkpoints
                     # the entry already left job.pending, so _fail_job's
                     # pending sweep can never reach it — flip it here or the
                     # record stays PENDING forever (local-path _abort parity)
                     job.stats.report_failed(
                         checkpoint_id, f"persist failed: {e!r}")
-                    raise
+                    if not isinstance(e, Exception) \
+                            or isinstance(e, _chaos.InjectedCrash):
+                        # interpreter-level exceptions and chaos crash
+                        # faults are never "a tolerated brownout" — they
+                        # must reach the failure machinery (plan.py's
+                        # InjectedCrash contract)
+                        raise
+                    sp_fail = job.savepoint_paths.pop(checkpoint_id, None)
+                    if sp_fail is not None:
+                        job.failed_savepoints.append(
+                            f"{sp_fail[0]}: persist failed: {e!r}")
+                    job.consecutive_cp_failures += 1
+                    if (job.consecutive_cp_failures
+                            > self.tolerable_failed_checkpoints):
+                        # beyond tolerance: restart through the normal
+                        # attributed path (the JM owns the persist — the
+                        # acking task did nothing wrong, so the failure is
+                        # handled here instead of re-raising into its RPC)
+                        self._fail_job(
+                            job,
+                            f"checkpoint {checkpoint_id} persist failed "
+                            f"({job.consecutive_cp_failures} consecutive, "
+                            f"tolerable "
+                            f"{self.tolerable_failed_checkpoints}): {e!r}")
+                        return
+                    # tolerated brownout: the job keeps running; the next
+                    # periodic trigger retries with a fresh checkpoint id
+                    return
                 persist_ms = (time.perf_counter() - t_save) * 1000.0
                 state_bytes = self._storage.last_save_bytes
                 self._job_span(job, "checkpointing", "CheckpointPersist",
@@ -1205,6 +1351,7 @@ class JobManagerEndpoint(RpcEndpoint):
                 except OSError as e:
                     job.failed_savepoints.append(
                         f"{sp_path}: {e}")
+            job.consecutive_cp_failures = 0   # tolerance is CONSECUTIVE
             job.completed.append((checkpoint_id, handles, step))
             # per-operator breakdown from the stateBytes gauges the TMs
             # already ship on the heartbeat (latest snapshot per shard)
@@ -1250,7 +1397,8 @@ class JobManagerEndpoint(RpcEndpoint):
         if job is not None and attempt == job.attempt:
             if job.pending.pop(checkpoint_id, None) is not None:
                 job.stats.report_failed(
-                    checkpoint_id, f"declined by shard {shard}: {reason}")
+                    checkpoint_id, f"declined by shard {shard}: {reason}",
+                    benign=True)   # outrun declines retry by design
             job.pending_target.pop(checkpoint_id, None)
             sp = job.savepoint_paths.pop(checkpoint_id, None)
             if sp is None:
@@ -1276,8 +1424,8 @@ class JobManagerEndpoint(RpcEndpoint):
                 if job.status == "RUNNING":
                     try:
                         self.run_in_main_thread(self.trigger_checkpoint, job_id).result()
-                    except Exception:
-                        pass
+                    except Exception as e:
+                        _swallow("checkpoint_loop", e)
 
 
 # ---------------------------------------------------------------------------
@@ -1374,8 +1522,8 @@ class _ShardTask:
                     self.job_id, self.attempt, self.shard, cp_id,
                     "task already finished",
                 )
-            except Exception:
-                pass
+            except Exception as e:
+                _swallow("decline_after_finish", e)
 
         threading.Thread(target=_decline, daemon=True,
                          name=f"cp-decline-{self.job_id[:6]}-s{self.shard}").start()
@@ -1527,8 +1675,8 @@ class _ShardTask:
                 try:
                     ch.end()     # duplicate eos is harmless; frees receivers
                     ch.close()
-                except Exception:
-                    pass
+                except Exception as e:
+                    _swallow("stage_channel_close", e)
         if self.cancelled.is_set():
             return
         results: list = []
@@ -1609,8 +1757,8 @@ class _ShardTask:
             if not self.cancelled.is_set():
                 try:
                     self.jm.task_failed(self.job_id, self.attempt, self.shard, repr(e))
-                except Exception:
-                    pass
+                except Exception as e2:
+                    _swallow("report_task_failed", e2)
         finally:
             # close the request_checkpoint race: anything still queued when
             # the loop exits is declined here, and everything arriving later
@@ -1624,8 +1772,8 @@ class _ShardTask:
                         self.job_id, self.attempt, self.shard, cp_id,
                         f"task exited before target step {target}",
                     )
-                except Exception:
-                    pass
+                except Exception as e:
+                    _swallow("decline_leftover", e)
 
     def _make_operator(self):
         from flink_tpu.ops.aggregators import resolve
@@ -1808,6 +1956,9 @@ class _ShardTask:
 
         wire_fmt = (cfg.get(ExchangeOptions.WIRE_FORMAT) if cfg is not None
                     else ExchangeOptions.WIRE_FORMAT.default)
+        reconnect_window_ms = (
+            cfg.get(ExchangeOptions.RECONNECT_WINDOW_MS) if cfg is not None
+            else ExchangeOptions.RECONNECT_WINDOW_MS.default)
         exch_metrics_group = self.registry.group("job", "exchange")
         self_parts: deque = deque()
         outs: Dict[int, OutputChannel] = {}
@@ -1827,6 +1978,71 @@ class _ShardTask:
         for src, ch in ins.items():
             job_group.gauge(f"exchange.inPoolUsage.{src}", ch.occupancy)
             register_channel_metrics(exch_metrics_group, str(src), inbound=ch)
+        job_group.gauge("numDataplaneReconnects", lambda: sum(
+            ch.num_reconnects for ch in outs.values()))
+        # liveness probe for the reconnect window: its OWN tight-timeout
+        # gateway — the task's main jm gateway runs at the 120s payload
+        # reply budget, and a peer_alive probe blocking that long on a
+        # wedged JM would stretch the "bounded" reconnect window ~24x
+        probe_timeout = max(min(reconnect_window_ms / 1000.0 / 2, 2.0), 0.5)
+        probe_jm = RpcGateway(
+            self.jm.address, "jobmanager", timeout=probe_timeout,
+            security=self.te.rpc.security,
+            # single attempt: the retry deadline (8s) would stretch the
+            # reconnect window just like the payload reply budget; the
+            # send_part loop is the retry policy here
+            retry=RetryPolicy(max_attempts=1))
+
+        def send_part(dst: int, part) -> None:
+            """Transient-fault hardening on the keyed exchange: a send
+            failing with a connection error gets a BOUNDED reconnect
+            window (exchange.reconnect.window-ms) — but only while the JM
+            confirms the peer TM is still heartbeating, and only when the
+            re-run open/credit negotiation proves seq continuity (no frame
+            lost). Anything else re-raises into the normal task-failure →
+            checkpoint-rewind restart path. Credit-starvation TimeoutError
+            is NOT a connection fault and never reconnects (a reconnect
+            re-grants credits, which would tunnel through backpressure)."""
+            try:
+                outs[dst].send(part)
+                return
+            except _chaos.InjectedCrash:
+                raise
+            except TimeoutError:
+                raise
+            except OSError as first_err:
+                if reconnect_window_ms <= 0:
+                    raise
+                deadline = time.monotonic() + reconnect_window_ms / 1000.0
+                backoff = 0.05
+                last_err = first_err
+                while not self.cancelled.is_set():
+                    if time.monotonic() >= deadline:
+                        raise last_err
+                    try:
+                        alive = probe_jm.peer_alive(
+                            self.job_id, self.attempt, dst)
+                    except Exception as e:
+                        _swallow("peer_alive_probe", e)
+                        alive = True   # an unreachable JM is its own story
+                    if not alive:
+                        raise last_err   # real TM loss: fail over now
+                    try:
+                        outs[dst].reconnect()
+                        outs[dst].send(part)
+                        return
+                    except TimeoutError:
+                        raise
+                    except SequenceLostError:
+                        raise   # provably unrecoverable: re-dialing can
+                        #         never heal a lost frame — fail over NOW
+                    except OSError as e:
+                        last_err = e
+                        time.sleep(min(
+                            backoff,
+                            max(deadline - time.monotonic(), 0.0)))
+                        backoff = min(backoff * 2, 1.0)
+                raise last_err
 
         step = self.restore_step
         n_steps = len(batches)
@@ -1877,7 +2093,7 @@ class _ShardTask:
                     if dst == self.shard:
                         self_parts.append(part)
                     else:
-                        outs[dst].send(part)
+                        send_part(dst, part)
                 busy_dt = time.perf_counter() - busy_t0
 
                 # ---- merge one batch per input channel (min watermark) -----
@@ -1951,8 +2167,8 @@ class _ShardTask:
                 try:
                     ch.end()
                     ch.close()
-                except Exception:
-                    pass
+                except Exception as e:
+                    _swallow("channel_close", e)
 
 
 class TaskExecutorEndpoint(RpcEndpoint):
@@ -1996,7 +2212,8 @@ class TaskExecutorEndpoint(RpcEndpoint):
     def connect(self, jm_address: str) -> None:
         gw = self.rpc.gateway(jm_address, "jobmanager")
         self._jm_gateway = gw
-        self._blob = BlobCache(self.rpc.gateway(jm_address, "blob"))
+        self._blob = BlobCache(self.rpc.gateway(
+            jm_address, "blob", reply_timeout=PAYLOAD_REPLY_TIMEOUT_S))
         gw.register_task_executor(self.tm_id, self.rpc.address, self.exchange.address, self.slots)
         if self._hb_thread is None:
             self._hb_thread = threading.Thread(target=self._hb_loop, daemon=True,
@@ -2053,8 +2270,8 @@ class TaskExecutorEndpoint(RpcEndpoint):
                     raise
                 if shipping:
                     self._last_ship = now
-            except Exception:
-                pass
+            except Exception as e:
+                _swallow("hb_loop", e)
 
     # ---- RPC methods ------------------------------------------------------
     def ping(self) -> str:
@@ -2065,7 +2282,9 @@ class TaskExecutorEndpoint(RpcEndpoint):
                     restore: Optional[dict], restore_step: int,
                     restore_local_cp: Optional[int] = None) -> bool:
         spec = DistributedJobSpec.from_bytes(self._blob.get(blob_key))
-        jm = self.rpc.gateway(jm_address, "jobmanager")
+        # acks ship shard snapshots and block on the JM-side persist
+        jm = self.rpc.gateway(jm_address, "jobmanager",
+                              reply_timeout=PAYLOAD_REPLY_TIMEOUT_S)
         task = _ShardTask(self, job_id, attempt, shard, parallelism, spec, jm,
                           peers, restore, restore_step,
                           restore_local_cp=restore_local_cp)
@@ -2195,11 +2414,26 @@ def main(argv: Optional[List[str]] = None) -> None:
                 overlay["cluster_id"] = args.cluster_id
             security = _dc.replace(base, enabled=True, **overlay)
 
+    def _install_chaos_from_conf(conf) -> None:
+        # chaos.* config group: a --conf-driven fault drill (default off).
+        # Installed process-wide exactly once; every injected fault carries
+        # the injected-attribution marker (docs/robustness.md).
+        plan = _chaos.FaultPlan.from_config(conf)
+        if plan is not None and _chaos.active_plan() is None:
+            _chaos.install_plan(plan)
+            print(f"chaos plane ENABLED: {len(plan.rules)} rule(s), "
+                  f"seed {plan.seed}", flush=True)
+
     if args.role == "jobmanager":
         svc = RpcService(args.host, args.port, security=security)
         hist_kw = {}
         if args.conf:
-            from flink_tpu.config import Configuration, ObservabilityOptions
+            from flink_tpu.config import (
+                CheckpointingOptions,
+                Configuration,
+                ObservabilityOptions,
+                WatchdogOptions,
+            )
 
             conf = Configuration.load(args.conf).add_all(Configuration.from_env())
             hist_kw = dict(
@@ -2209,7 +2443,12 @@ def main(argv: Optional[List[str]] = None) -> None:
                     ObservabilityOptions.EXCEPTION_HISTORY_SIZE),
                 # autoscaler.* group (scheduler/): enabled=false is inert
                 autoscaler_config=conf,
+                tolerable_failed_checkpoints=conf.get(
+                    CheckpointingOptions.TOLERABLE_FAILED_CHECKPOINTS),
+                stuck_task_timeout_ms=conf.get(
+                    WatchdogOptions.STUCK_TASK_TIMEOUT_MS),
             )
+            _install_chaos_from_conf(conf)
         JobManagerEndpoint(
             svc,
             checkpoint_dir=args.checkpoint_dir,
@@ -2226,6 +2465,7 @@ def main(argv: Optional[List[str]] = None) -> None:
 
             conf = Configuration.load(args.conf).add_all(Configuration.from_env())
             ship_ms = conf.get(ObservabilityOptions.SHIPPING_INTERVAL_MS)
+            _install_chaos_from_conf(conf)
         te = TaskExecutorEndpoint(svc, slots=args.slots,
                                   shipping_interval_ms=ship_ms, config=conf)
         te.connect(args.jobmanager)

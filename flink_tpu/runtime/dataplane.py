@@ -48,6 +48,7 @@ import time
 from collections import deque
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
+from flink_tpu.chaos import plan as _chaos
 from flink_tpu.metrics.registry import Meter
 from flink_tpu.security.framing import FrameAuthError, RestrictedUnpicklingError
 from flink_tpu.security.transport import (
@@ -63,6 +64,14 @@ from flink_tpu.security.transport import (
     wrap_server_socket,
 )
 from flink_tpu.security.wire import WireFormatError, extract_columns
+
+
+class SequenceLostError(ConnectionError):
+    """Raised by OutputChannel.reconnect() when the re-run open/credit
+    negotiation proves a frame was LOST (receiver's next expected seq !=
+    sender's): provably unrecoverable at this layer — callers must
+    escalate to the checkpoint-rewind restart path immediately instead of
+    burning the reconnect window on re-dials that can never heal it."""
 
 
 def _validate_wire_format(wire_format: str) -> str:
@@ -159,6 +168,18 @@ class InputChannel:
         with self._cv:
             return min(len(self._ring) / max(self.capacity, 1), 1.0)
 
+    def on_reopen(self) -> "Tuple[int, int]":
+        """Credits + next expected seq for an open/re-open reply. A fresh
+        channel grants full capacity from seq 0 (identical to the old
+        protocol); a RECONNECTING sender gets only the currently FREE ring
+        slots — re-granting full capacity would mint credits for batches
+        still parked in the ring — plus the sequence number this receiver
+        will accept next, so the sender can verify that no frame was lost
+        before resuming (seq mismatch = real loss = restart, not resume)."""
+        with self._cv:
+            self._pending_credits = 0   # banked grants died with the socket
+            return max(self.capacity - len(self._ring), 0), self._next_seq
+
     @property
     def ended(self) -> bool:
         with self._cv:
@@ -234,14 +255,19 @@ class ExchangeServer:
                         # 4th element names the format this receiver will
                         # accept for the channel's batches. Old senders
                         # ignore extra elements; old receivers reply with a
-                        # 3-tuple, which new senders read as "pickle".
+                        # 3-tuple, which new senders read as "pickle". The
+                        # 5th element is the next seq this receiver expects
+                        # — 0 on a fresh channel, the resume point for a
+                        # sender re-running the open after a transient
+                        # disconnect (OutputChannel.reconnect).
                         offered = msg[2] if len(msg) > 2 else ()
                         chosen = ("binary"
                                   if server_self.wire_format == "binary"
                                   and "binary" in tuple(offered) else "pickle")
+                        grant_n, next_seq = ch.on_reopen()
                         with sock_lock:
-                            send_obj(sock, ("credit", channel, ch.capacity,
-                                            chosen), codec)
+                            send_obj(sock, ("credit", channel, grant_n,
+                                            chosen, next_seq), codec)
                     elif kind == "data":
                         ch = server_self._channels.get(channel)
                         if ch is not None:
@@ -299,23 +325,10 @@ class OutputChannel:
                  security: Optional[SecurityConfig] = None,
                  wire_format: str = "binary"):
         host, port = address.rsplit(":", 1)
+        self._addr = (host, int(port))
+        self._connect_timeout = connect_timeout
         self._wire_format = _validate_wire_format(wire_format)  # before the dial
         self.security = SecurityConfig.resolve() if security is None else security
-        sock = socket.create_connection((host, int(port)), timeout=connect_timeout)
-        try:
-            sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
-        except OSError:
-            pass
-        self._codec = None
-        if self.security.enabled:
-            try:
-                sock = wrap_client_socket(sock, self.security)
-                self._codec = client_handshake(sock, self.security)
-            except BaseException:
-                sock.close()
-                raise
-        sock.settimeout(None)
-        self._sock = sock
         self.channel_id = channel_id
         # negotiated on the open reply (None until the first credit grant
         # arrives; the first send always waits for that grant): "binary"
@@ -325,6 +338,9 @@ class OutputChannel:
         self._credits = 0
         self._cv = threading.Condition()
         self._seq = 0
+        # next seq the receiver advertised on the open reply (None from an
+        # old receiver) — reconnect() verifies continuity against it
+        self._advertised_seq: Optional[int] = None
         self._linger_timer: Optional[threading.Timer] = None
         self._send_lock = threading.Lock()
         # cumulative seconds send() spent blocked waiting for credits — the
@@ -334,46 +350,147 @@ class OutputChannel:
         self.backpressured_s = 0.0
         self.bytes_out = 0
         self._out_meter = Meter()
-        threading.Thread(target=self._credit_loop, daemon=True,
-                         name=f"credits-{channel_id}").start()
-        open_msg = (("open", channel_id, ("binary",))
-                    if self._wire_format == "binary" else ("open", channel_id))
+        # transient-fault hardening accounting (numDataplaneReconnects)
+        self.num_reconnects = 0
+        self._credit_thread: Optional[threading.Thread] = None
+        self._sock, self._codec = self._dial()
+        self._start_credit_loop(self._sock, self._codec)
+        self._send_open()
+
+    def _dial(self):
+        sock = socket.create_connection(self._addr,
+                                        timeout=self._connect_timeout)
+        try:
+            sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        except OSError:
+            pass
+        codec = None
+        if self.security.enabled:
+            try:
+                sock = wrap_client_socket(sock, self.security)
+                codec = client_handshake(sock, self.security)
+            except BaseException:
+                sock.close()
+                raise
+        sock.settimeout(None)
+        return sock, codec
+
+    def _start_credit_loop(self, sock, codec) -> None:
+        t = threading.Thread(target=self._credit_loop, args=(sock, codec),
+                             daemon=True, name=f"credits-{self.channel_id}")
+        self._credit_thread = t
+        t.start()
+
+    def _send_open(self) -> None:
+        open_msg = (("open", self.channel_id, ("binary",))
+                    if self._wire_format == "binary"
+                    else ("open", self.channel_id))
         with self._send_lock:
             n = send_obj(self._sock, open_msg, self._codec)
             self.bytes_out += n
             self._out_meter.mark(n)
 
-    def _credit_loop(self) -> None:
+    def _credit_loop(self, sock, codec) -> None:
         while True:
             try:
-                msg = recv_obj(self._sock, self._codec)
+                msg = recv_obj(sock, codec)
             except (OSError, FrameAuthError, RestrictedUnpicklingError):
                 msg = None
             if msg is None:
                 with self._cv:
-                    self._credits = -1  # poisoned: connection gone
-                    self._cv.notify_all()
+                    # a stale loop (its socket replaced by reconnect())
+                    # must not poison the NEW connection's credit state
+                    current = sock is self._sock
+                    if current:
+                        self._credits = -1  # poisoned: connection gone
+                        self._cv.notify_all()
                 # the peer closed (or close() shut down our write side and
                 # the peer answered with FIN): now fully close the socket
                 try:
-                    self._sock.close()
+                    sock.close()
                 except OSError:
                     pass
-                t = self._linger_timer
-                if t is not None:
-                    t.cancel()     # fast FIN: don't hold the timer thread
+                if current:
+                    t = self._linger_timer
+                    if t is not None:
+                        t.cancel()     # fast FIN: don't hold the timer thread
                 return
             if msg[0] == "credit" and msg[1] == self.channel_id:
                 with self._cv:
+                    if sock is not self._sock:
+                        continue        # grant raced a reconnect: stale
                     if self._wire is None:
                         # open reply: the receiver's chosen wire format (a
-                        # 3-tuple reply = old receiver = pickle)
+                        # 3-tuple reply = old receiver = pickle) and, from
+                        # new receivers, its next expected sequence number
                         self._wire = ("binary" if len(msg) > 3
                                       and msg[3] == "binary" else "pickle")
+                        if len(msg) > 4:
+                            self._advertised_seq = int(msg[4])
                     self._credits += msg[2]
                     self._cv.notify_all()
 
+    def reconnect(self, timeout: float = 5.0) -> None:
+        """Transient-fault hardening: re-dial the peer and re-run the
+        open/credit negotiation IN PLACE (same object, counters and seq
+        preserved, registered gauges stay valid). Resumes only on exact
+        sequence continuity — the receiver's advertised next seq must
+        equal this sender's, meaning no frame was lost — otherwise raises
+        ConnectionError so the caller escalates to the checkpoint-rewind
+        restart path. The caller owns retry pacing and the bounded
+        reconnect window (cluster._ShardTask)."""
+        try:
+            # shutdown, not just close: close() does NOT wake a recv
+            # already blocked in the credit thread (see close()'s linger
+            # note) — without it every reconnect over a still-readable
+            # socket burns the full join timeout and leaks the thread
+            self._sock.shutdown(socket.SHUT_RDWR)
+        except OSError:
+            pass
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+        t = self._credit_thread
+        if t is not None and t is not threading.current_thread():
+            t.join(timeout=2.0)
+        sock, codec = self._dial()
+        with self._cv:
+            self._sock, self._codec = sock, codec
+            self._credits = 0
+            self._wire = None
+            self._advertised_seq = None
+        self._start_credit_loop(sock, codec)
+        self._send_open()
+        deadline = time.monotonic() + timeout
+        with self._cv:
+            while self._wire is None and self._credits >= 0:
+                left = deadline - time.monotonic()
+                if left <= 0 or not self._cv.wait(timeout=left):
+                    raise ConnectionError(
+                        f"channel {self.channel_id}: no open reply within "
+                        f"{timeout}s of reconnect")
+            if self._credits < 0:
+                raise ConnectionError(
+                    f"channel {self.channel_id}: peer refused the reconnect")
+            adv = self._advertised_seq
+        with self._send_lock:
+            local = self._seq
+        if adv is not None and adv != local:
+            raise SequenceLostError(
+                f"channel {self.channel_id}: receiver expects seq {adv} but "
+                f"this sender is at {local} — frame(s) lost in transit; "
+                "only a checkpoint rewind can recover them")
+        self.num_reconnects += 1
+
     def send(self, payload, timeout: Optional[float] = 30.0) -> None:
+        # chaos seam: `error` raises before any credit/seq is consumed
+        # (reconnectable blip); `drop` consumes the seq but skips the wire
+        # write — a frame lost in transit, which the receiver surfaces as
+        # a sequence gap (the unrecoverable-loss path)
+        hook = _chaos.HOOK
+        directive = (hook("dataplane", self.channel_id)
+                     if hook is not None else None)
         with self._cv:
             if self._credits == 0:
                 t0 = time.perf_counter()
@@ -404,7 +521,9 @@ class OutputChannel:
             # seq, or the receiver would misread the next good frame as a
             # sequence gap
             seq = self._seq
-            if enc is not None:
+            if directive == "drop":
+                n = 0   # chaos: the frame "left" but never hits the wire
+            elif enc is not None:
                 n = send_data_frame(self._sock, self.channel_id, seq,
                                     enc[0], enc[1], self._codec)
             else:

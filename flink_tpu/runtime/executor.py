@@ -19,6 +19,7 @@ semantics, CPU).
 from __future__ import annotations
 
 import dataclasses
+import logging
 import time
 from collections import deque
 from typing import Any, Callable, Dict, List, Optional
@@ -26,6 +27,7 @@ from typing import Any, Callable, Dict, List, Optional
 import numpy as np
 
 from flink_tpu.api.functions import AggregateFunction, ProcessFunction, ReduceAggregate
+from flink_tpu.chaos import plan as _chaos
 from flink_tpu.config import (
     Configuration,
     ExecutionOptions,
@@ -599,6 +601,12 @@ class WindowStepRunner(StepRunner):
                             hbm, tflops)
 
     def on_batch(self, values: np.ndarray, timestamps: np.ndarray) -> None:
+        # chaos seam (device dispatch boundary): one is-None check per
+        # batch when chaos is off; an injected error surfaces exactly like
+        # a real dispatch failure and rides the normal restart path
+        hook = _chaos.HOOK
+        if hook is not None:
+            hook("device", self.uid)
         if self.key_traceable and len(timestamps):
             # fusion-off fallback of a traceable program: columnarize
             # record-mode sources and cast to the canonical dtype exactly
@@ -823,6 +831,9 @@ class DeviceChainRunner(WindowStepRunner):
         self._warned_object_columns = False
 
     def on_batch(self, values: np.ndarray, timestamps: np.ndarray) -> None:
+        hook = _chaos.HOOK   # chaos seam: fused-chain dispatch boundary
+        if hook is not None:
+            hook("device", self.uid)
         if len(timestamps) == 0:
             return   # idle poll / watermark-only step: nothing to stage
         vals = values
@@ -1816,8 +1827,9 @@ class JobRuntime:
                     # remember where it landed, for /jobs/:id/device
                     self.profiler_captures += 1
                     self.last_profiler_capture_dir = profile_dir
-                except Exception:
-                    pass
+                except Exception as e:   # observability never fails the job
+                    logging.getLogger(__name__).debug(
+                        "jax.profiler stop_trace failed: %r", e)
 
     def _run_loop(
         self,

@@ -11,6 +11,7 @@ accelerator.
 
 from __future__ import annotations
 
+import logging
 from typing import Any, Dict, List
 
 
@@ -56,8 +57,9 @@ class TpuDriver(ExternalResourceDriver):
                 stats = d.memory_stats() or {}
                 if "bytes_limit" in stats:
                     props["memory_bytes"] = stats["bytes_limit"]
-            except Exception:
-                pass
+            except Exception as e:
+                logging.getLogger(__name__).debug(
+                    "device memory_stats unavailable: %r", e)
             out.append(ExternalResourceInfo(props))
         return out
 

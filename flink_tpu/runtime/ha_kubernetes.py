@@ -17,6 +17,7 @@ semantics.
 
 from __future__ import annotations
 
+import logging
 import json
 import threading
 import time
@@ -250,6 +251,7 @@ class KubernetesLeaderElection:
                 body = self._body(rv)
                 body["spec"]["renewTime"] = "1970-01-01T00:00:00.000000Z"
                 self.api.replace_lease(self.namespace, self.lease_name, body)
-            except Exception:
-                pass
+            except Exception as e:
+                logging.getLogger(__name__).debug(
+                    "lease release failed (next holder waits it out): %r", e)
             self.is_leader = False

@@ -3,6 +3,14 @@
 Analogue of runtime/heartbeat/HeartbeatManagerImpl.java:49: a monitor tracks
 last-seen times per target, a sender thread pings peers via a callable, and
 targets silent for longer than the timeout are reported dead exactly once.
+
+Shutdown is prompt and observable: the loop waits on an Event (not a bare
+sleep), `stop()` joins the thread, and swallowed ping / on_dead callback
+exceptions are COUNTED (`missed_pings` / `on_dead_errors`) instead of
+silently passed. Note missedPings only moves for monitors registered WITH
+a ping callable (active probing); the JM's TM liveness is receive-only,
+so its gauge reads 0 by construction — partition drills there are
+observed through restart/exception history, not this counter.
 """
 
 from __future__ import annotations
@@ -25,8 +33,14 @@ class HeartbeatManager:
         self.on_dead = on_dead
         self._targets: Dict[str, dict] = {}
         self._lock = threading.Lock()
-        self._running = True
-        self._thread = threading.Thread(target=self._loop, name="heartbeat", daemon=True)
+        self._stop = threading.Event()
+        # swallowed-exception accounting (CONC005: no silent swallows);
+        # missed_pings moves only for ping-configured (actively probed)
+        # monitors — see the module docstring
+        self.missed_pings = 0
+        self.on_dead_errors = 0
+        self._thread = threading.Thread(target=self._loop, name="heartbeat",
+                                        daemon=True)
         self._thread.start()
 
     def monitor(self, target_id: str, ping: Optional[Callable[[], None]] = None) -> None:
@@ -52,7 +66,7 @@ class HeartbeatManager:
             return t is not None and not t["dead"]
 
     def _loop(self) -> None:
-        while self._running:
+        while True:
             now = time.monotonic()
             with self._lock:
                 items = list(self._targets.items())
@@ -66,7 +80,10 @@ class HeartbeatManager:
                         self.receive_heartbeat(tid)
                         continue
                     except Exception:
-                        pass  # treat like silence; timeout decides
+                        # treat like silence (timeout decides), but COUNT
+                        # it: consecutive missed pings are the early
+                        # warning a partition drill watches for
+                        self.missed_pings += 1
                 if now - t["last"] > self.timeout:
                     with self._lock:
                         if t["dead"]:
@@ -76,8 +93,17 @@ class HeartbeatManager:
                         try:
                             self.on_dead(tid)
                         except Exception:
-                            pass
-            time.sleep(self.interval)
+                            # a throwing death callback must not kill the
+                            # detector for every OTHER target — counted,
+                            # never silently dropped
+                            self.on_dead_errors += 1
+            # Event.wait, not time.sleep: stop() must not block shutdown
+            # for up to a full interval (leaked beat loops kept dialing
+            # dead JMs in test stacks)
+            if self._stop.wait(self.interval):
+                return
 
     def stop(self) -> None:
-        self._running = False
+        self._stop.set()
+        if threading.current_thread() is not self._thread:
+            self._thread.join(timeout=5.0)

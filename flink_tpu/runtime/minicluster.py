@@ -207,6 +207,31 @@ class MiniCluster:
         config: Configuration,
         savepoint_restore_path: Optional[str],
     ) -> None:
+        # chaos.* config group: a job config can run a fault drill on the
+        # in-process path too (tests/scenarios install plans through
+        # testing.harness.fault_injection instead; this never stacks).
+        # The plan is uninstalled when THIS job ends — a process-wide hook
+        # leaking past the drill would fault every later job for no reason
+        from flink_tpu.chaos import plan as _chaos
+
+        chaos_plan = _chaos.FaultPlan.from_config(config)
+        installed_chaos = False
+        if chaos_plan is not None and _chaos.active_plan() is None:
+            _chaos.install_plan(chaos_plan)
+            installed_chaos = True
+        try:
+            self._run_job_inner(client, graph, config, savepoint_restore_path)
+        finally:
+            if installed_chaos and _chaos.active_plan() is chaos_plan:
+                _chaos.uninstall_plan()
+
+    def _run_job_inner(
+        self,
+        client: JobClient,
+        graph: StepGraph,
+        config: Configuration,
+        savepoint_restore_path: Optional[str],
+    ) -> None:
         from flink_tpu.config import ObservabilityOptions
         from flink_tpu.metrics.checkpoint_stats import (
             CheckpointStatsTracker,
@@ -267,6 +292,8 @@ class MiniCluster:
                 config.get(CheckpointingOptions.MAX_RETAINED),
                 traces=client.traces,
                 stats=client.checkpoint_stats,
+                tolerable_failures=config.get(
+                    CheckpointingOptions.TOLERABLE_FAILED_CHECKPOINTS),
             )
             if interval > 0
             else None
@@ -298,6 +325,9 @@ class MiniCluster:
                                  traces=client.traces)
             client._runtime = runtime  # queryable-state surface (S13)
             if coordinator is not None:
+                # each attempt gets its full tolerable-failed-checkpoints
+                # budget (the coordinator outlives restarts)
+                coordinator.reset_failure_streak()
                 # per-operator breakdown for completed checkpoint records
                 # comes from THIS attempt's operators
                 coordinator.state_bytes_fn = runtime.operator_state_bytes

@@ -51,6 +51,7 @@ from __future__ import annotations
 
 import importlib.util
 import json
+import logging
 import threading
 import uuid
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
@@ -129,8 +130,9 @@ class _Handler(BaseHTTPRequestHandler):
             if self.jm is not None:
                 try:
                     jobs.extend(self.jm.list_jobs())
-                except Exception:
-                    pass   # an unreachable JM must not break local jobs
+                except Exception as e:   # unreachable JM: local jobs still
+                    logging.getLogger(__name__).debug(   # serve
+                        "jm list_jobs unavailable: %r", e)
             return self._json(200, {"jobs": jobs})
         if parts == ["metrics"]:
             texts = []
@@ -156,8 +158,9 @@ class _Handler(BaseHTTPRequestHandler):
                         if jm_side:
                             texts.append(prometheus_text_from_snapshot(
                                 jm_side, labels={"job": j["id"]}))
-                except Exception:
-                    pass
+                except Exception as e:   # unreachable JM: local exposition
+                    logging.getLogger(__name__).debug(   # still serves
+                        "jm metrics unavailable: %r", e)
             # one TYPE line per family, samples grouped — naive
             # concatenation is invalid exposition once two jobs/shards
             # share a family name

@@ -24,13 +24,18 @@ lives in dataplane.py.
 from __future__ import annotations
 
 import contextlib
+import dataclasses
+import random
 import socket
 import socketserver
 import threading
+import time
 import traceback
 import uuid
 from concurrent.futures import Future
-from typing import Any, Callable, Dict, Optional
+from typing import Any, Callable, Dict, FrozenSet, Optional
+
+from flink_tpu.chaos import plan as _chaos
 
 
 # ---------------------------------------------------------------------------
@@ -185,6 +190,22 @@ class RpcService:
                             endpoint, method, args, kwargs, trace_id = msg
                         else:
                             endpoint, method, args, kwargs = msg
+                        # chaos seam (server side): a delay rule wedges
+                        # this connection thread — the stuck-endpoint
+                        # model; drop severs the connection pre-dispatch;
+                        # crash ALSO severs it with no reply (a crashed
+                        # server cannot answer — shipping it back as a
+                        # RemoteRpcError would absorb the process-death
+                        # model into an ordinary handler error)
+                        hook = _chaos.HOOK
+                        if hook is not None:
+                            try:
+                                if hook("rpc",
+                                        f"server:{endpoint}.{method}") \
+                                        == "drop":
+                                    return
+                            except _chaos.InjectedCrash:
+                                return
                         with service._lock:
                             ep = service._endpoints.get(endpoint)
                         if ep is None:
@@ -222,8 +243,10 @@ class RpcService:
         with self._lock:
             self._endpoints.pop(name, None)
 
-    def gateway(self, address: str, endpoint: str, timeout: float = 10.0) -> "RpcGateway":
-        return RpcGateway(address, endpoint, timeout, security=self.security)
+    def gateway(self, address: str, endpoint: str, timeout: float = 10.0,
+                reply_timeout: Optional[float] = None) -> "RpcGateway":
+        return RpcGateway(address, endpoint, timeout, security=self.security,
+                          reply_timeout=reply_timeout)
 
     def stop(self) -> None:
         with self._lock:
@@ -241,17 +264,66 @@ class RemoteRpcError(RuntimeError):
         self.remote_traceback = remote_traceback
 
 
+@dataclasses.dataclass(frozen=True)
+class RetryPolicy:
+    """Exponential backoff + jitter + an overall deadline for gateway-side
+    retries of IDEMPOTENT control-plane calls (the transient-fault
+    hardening the chaos rpc-flap scenario exercises). Job-mutating calls
+    never retry: a re-sent submit/deploy/rescale whose first attempt DID
+    land server-side would double-apply."""
+
+    max_attempts: int = 5
+    initial_backoff_s: float = 0.05
+    multiplier: float = 2.0
+    max_backoff_s: float = 1.0
+    jitter: float = 0.5          # backoff scaled by U[1-jitter, 1+jitter]
+    deadline_s: float = 8.0      # overall wall budget across attempts
+
+
+#: control-plane methods safe to re-send after a transport-level failure
+#: (connection reset/refused, reply timeout): liveness reports, checkpoint
+#: acks/declines (the JM's handlers are attempt-guarded and level-
+#: triggered), registrations (keyed by tm_id), and pure reads. Everything
+#: else — submit_job, deploy_task, rescale_job, cancel_job, put — stays
+#: single-attempt.
+IDEMPOTENT_METHODS: FrozenSet[str] = frozenset({
+    "ping", "heartbeat_tm", "register_task_executor",
+    "ack_checkpoint", "decline_checkpoint",
+    "task_finished", "cancel_task", "release_job_state",
+    "peer_alive", "fetch_shard_restore",
+    "job_status", "job_result", "job_metrics", "job_spans",
+    "job_backpressure", "job_checkpoints", "job_checkpoint",
+    "job_exceptions", "job_autoscaler", "job_device", "list_jobs", "get",
+    # NOT here: trigger_checkpoint — the JM-side method of that name
+    # allocates a fresh checkpoint id per call, so a retry after a lost
+    # reply double-triggers (two barrier rounds, an orphaned savepoint)
+})
+
+
 class RpcGateway:
     """Dynamic proxy: gateway.method(*a, **kw) → remote invocation.
 
     One TCP connection per gateway, serialized calls (matching the
-    per-endpoint ordering guarantee of the reference's actor mailbox)."""
+    per-endpoint ordering guarantee of the reference's actor mailbox).
+    Replies are awaited under `reply_timeout` (default: the connect
+    `timeout`) — a wedged server handler surfaces as a loud TimeoutError
+    on a now-closed connection instead of blocking the caller forever —
+    and calls in :data:`IDEMPOTENT_METHODS` retry transport failures per
+    `retry`. Gateways carrying payload-shipping calls whose server-side
+    handling is legitimately slow (deploys restoring large snapshots,
+    acks persisting them) should pass a generous `reply_timeout` — the
+    cluster uses PAYLOAD_REPLY_TIMEOUT_S — so the wedge detector never
+    misfires on a genuinely big transfer."""
 
     def __init__(self, address: str, endpoint: str, timeout: float = 10.0,
-                 security: Optional[SecurityConfig] = None):
+                 security: Optional[SecurityConfig] = None,
+                 retry: Optional[RetryPolicy] = None,
+                 reply_timeout: Optional[float] = None):
         self._address = address
         self._endpoint = endpoint
         self._timeout = timeout
+        self._reply_timeout = timeout if reply_timeout is None else reply_timeout
+        self._retry = RetryPolicy() if retry is None else retry
         self._security = SecurityConfig.resolve() if security is None else security
         self._sock: Optional[socket.socket] = None
         self._codec = None
@@ -275,6 +347,13 @@ class RpcGateway:
             self._sock = sock
         return self._sock
 
+    @property
+    def address(self) -> str:
+        """host:port this gateway dials (for building sibling gateways to
+        the same service, e.g. a tight-timeout liveness probe next to a
+        payload-tier gateway)."""
+        return self._address
+
     def close(self) -> None:
         with self._lock:
             self._close_locked()
@@ -289,6 +368,46 @@ class RpcGateway:
                 self._sock = None
                 self._codec = None
 
+    def _attempt(self, method: str, frame) -> tuple:
+        """One wire attempt: connect (if needed), send, await the reply
+        under the gateway timeout. Any failure closes the connection (a
+        half-done exchange poisons frame alignment) and raises."""
+        with self._lock:
+            # chaos seam: drop = the connection "died" before the frame
+            # left; error/crash raise from the hook itself. Inside the
+            # attempt so retries re-consult the plan (nth-counting sees
+            # every attempt).
+            hook = _chaos.HOOK
+            if hook is not None and hook(
+                    "rpc", f"{self._endpoint}.{method}") == "drop":
+                self._close_locked()
+                raise _chaos.InjectedFault(
+                    f"rpc-drop:{self._endpoint}.{method}")
+            sock = self._connect()
+            try:
+                # armed for THIS call only: a wedged server handler (its
+                # endpoint main thread blocked in the invocation) must
+                # surface as a timeout, not hold the caller forever. The
+                # connection is closed on timeout, so a later call gets a
+                # fresh socket with no stale reply in flight.
+                sock.settimeout(self._reply_timeout)
+                send_obj(sock, frame, self._codec)
+                reply = recv_obj(sock, self._codec)
+                sock.settimeout(None)
+            except TimeoutError as e:
+                self._close_locked()
+                raise TimeoutError(
+                    f"rpc {self._endpoint}.{method} to {self._address} "
+                    f"timed out after {self._reply_timeout}s (wedged or "
+                    f"partitioned endpoint)") from e
+            except (OSError, FrameAuthError, RestrictedUnpicklingError):
+                self._close_locked()
+                raise
+            if reply is None:
+                self._close_locked()
+                raise ConnectionError(f"rpc connection to {self._address} closed")
+            return reply
+
     def __getattr__(self, method: str):
         if method.startswith("_"):
             raise AttributeError(method)
@@ -298,17 +417,36 @@ class RpcGateway:
             frame = ((self._endpoint, method, args, kwargs, trace_id)
                      if trace_id is not None
                      else (self._endpoint, method, args, kwargs))
-            with self._lock:
-                sock = self._connect()
+            retry = self._retry
+            can_retry = method in IDEMPOTENT_METHODS \
+                and retry.max_attempts > 1
+            deadline = time.monotonic() + retry.deadline_s
+            backoff = retry.initial_backoff_s
+            attempt = 0
+            while True:
+                attempt += 1
                 try:
-                    send_obj(sock, frame, self._codec)
-                    reply = recv_obj(sock, self._codec)
-                except (OSError, FrameAuthError, RestrictedUnpicklingError):
-                    self._close_locked()
-                    raise
-                if reply is None:
-                    self._close_locked()
-                    raise ConnectionError(f"rpc connection to {self._address} closed")
+                    reply = self._attempt(method, frame)
+                    break
+                except (FrameAuthError, RestrictedUnpicklingError):
+                    raise          # tampering is never transient
+                except _chaos.InjectedCrash:
+                    raise          # models process death: must escalate
+                except OSError:
+                    # transient transport failure (reset, refused, reply
+                    # timeout, injected flap): re-send with backoff +
+                    # jitter inside the overall deadline — but ONLY for
+                    # idempotent calls; the lock is NOT held across the
+                    # backoff sleep (CONC003), so other callers proceed
+                    now = time.monotonic()
+                    if (not can_retry or attempt >= retry.max_attempts
+                            or now >= deadline):
+                        raise
+                    pause = backoff * (1.0 + retry.jitter
+                                       * (2.0 * random.random() - 1.0))
+                    time.sleep(max(min(pause, deadline - now), 0.0))
+                    backoff = min(backoff * retry.multiplier,
+                                  retry.max_backoff_s)
             ok, payload = reply
             if ok:
                 return payload

@@ -47,6 +47,7 @@ from flink_tpu.security.framing import (
     restricted_loads,
 )
 from flink_tpu.security import wire
+from flink_tpu.chaos import plan as _chaos   # leaf module (stdlib-only)
 
 MAGIC = b"FTPU"
 PROTOCOL_VERSION = 1
@@ -396,6 +397,16 @@ def client_handshake(sock: socket.socket, sec: SecurityConfig) -> FrameCodec:
 def send_obj(sock: socket.socket, obj, codec: Optional[FrameCodec]) -> int:
     """Restricted-pickle frame send; returns bytes written to the wire (the
     dataplane's numBytesOut accounting reads this)."""
+    hook = _chaos.HOOK   # chaos seam: one is-None check when chaos is off
+    if hook is not None:
+        # port-qualified site so a rule can target ONE peer: "send_obj"
+        # alone matches every plane's sends process-wide
+        try:
+            peer = sock.getpeername()[1]
+        except OSError:
+            peer = 0
+        if hook("transport", f"send_obj:{peer}") == "drop":
+            return 0      # frame silently lost pre-wire (peer sees silence)
     payload = dumps(obj)
     mac_len = MAC_LEN if codec is not None else 0
     if len(payload) + mac_len >= wire.DATA_FLAG:
@@ -497,35 +508,63 @@ def recv_msg(sock: socket.socket, codec: Optional[FrameCodec]):
     with the payload's raw columns as zero-copy `np.frombuffer` views
     (security/wire.py). `nbytes` is the frame's full wire size, feeding the
     receiver's numBytesIn accounting."""
-    hdr = _read_n(sock, 4)
-    if hdr is None:
-        return None, 0
-    (n,) = struct.unpack(">I", hdr)
-    if not (n & wire.DATA_FLAG):
-        body = _read_n(sock, n)
-        if body is None:
+    while True:
+        hdr = _read_n(sock, 4)
+        if hdr is None:
             return None, 0
-        if codec is not None:
-            return restricted_loads(codec.open(body)), 4 + n
-        import pickle
+        # chaos seam (recv side), consulted only once a frame provably
+        # exists (after the length prefix) — at EOF a bounded drop rule
+        # must not burn its max_fires budget on nothing. A drop swallows
+        # the frame AFTER it is read (and MAC-verified) — exactly loss in
+        # transit past the NIC; a dropped data frame then surfaces as a
+        # sequence gap. The site carries the receiver's OWN port so one
+        # exchange server is targetable without every other live socket
+        # skewing nth-counts.
+        hook = _chaos.HOOK
+        dropping = False
+        if hook is not None:
+            try:
+                own = sock.getsockname()[1]
+            except OSError:
+                own = 0
+            dropping = hook("transport", f"recv_msg:{own}") == "drop"
+        (n,) = struct.unpack(">I", hdr)
+        if not (n & wire.DATA_FLAG):
+            body = _read_n(sock, n)
+            if body is None:
+                return None, 0
+            if codec is not None:
+                obj_bytes = codec.open(body)   # MAC-verify (and advance the
+                # replay counter) even for a dropped frame — the drop models
+                # loss ABOVE the authenticated transport, not a desync
+                if dropping:
+                    continue
+                return restricted_loads(obj_bytes), 4 + n
+            if dropping:
+                continue
+            import pickle
 
-        return pickle.loads(body), 4 + n
-    n &= wire.DATA_FLAG - 1
-    total = 4 + n
-    if codec is not None:
-        if n < MAC_LEN:
-            raise FrameAuthError("binary frame shorter than its MAC")
-        # one allocation, one recv_into stream for MAC + body together,
-        # with the body (byte MAC_LEN) placed on the alignment grid
-        buf = wire.alloc_body(n, lead=MAC_LEN)
-        if not _recv_into_exact(sock, buf):
-            return None, 0
-        body = memoryview(buf)[MAC_LEN:]
-        codec.open_parts(bytes(buf[:MAC_LEN]), (body,))
-        channel, seq, payload = wire.decode_frame(body)
-    else:
-        buf = wire.alloc_body(n)
-        if not _recv_into_exact(sock, buf):
-            return None, 0
-        channel, seq, payload = wire.decode_frame(buf, trusted_pickle=True)
-    return ("data", channel, seq, payload), total
+            return pickle.loads(body), 4 + n
+        n &= wire.DATA_FLAG - 1
+        total = 4 + n
+        if codec is not None:
+            if n < MAC_LEN:
+                raise FrameAuthError("binary frame shorter than its MAC")
+            # one allocation, one recv_into stream for MAC + body together,
+            # with the body (byte MAC_LEN) placed on the alignment grid
+            buf = wire.alloc_body(n, lead=MAC_LEN)
+            if not _recv_into_exact(sock, buf):
+                return None, 0
+            body = memoryview(buf)[MAC_LEN:]
+            codec.open_parts(bytes(buf[:MAC_LEN]), (body,))
+            if dropping:   # after MAC verify: the replay counter advanced
+                continue
+            channel, seq, payload = wire.decode_frame(body)
+        else:
+            buf = wire.alloc_body(n)
+            if not _recv_into_exact(sock, buf):
+                return None, 0
+            if dropping:
+                continue
+            channel, seq, payload = wire.decode_frame(buf, trusted_pickle=True)
+        return ("data", channel, seq, payload), total

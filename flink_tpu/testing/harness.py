@@ -24,6 +24,25 @@ def ephemeral_transport_security(cluster_id: str = "flink-tpu-test"):
 
 
 @contextlib.contextmanager
+def fault_injection(plan=None, *, rules=None, seed: int = 0):
+    """Install a chaos FaultPlan for the duration of the block (the chaos
+    scenarios' and tests' entry point — docs/robustness.md). Pass a built
+    :class:`flink_tpu.chaos.FaultPlan`, or `rules` (a list of FaultRule
+    field dicts) + `seed` to build one. Yields the plan so the body can
+    assert `plan.total_fired` / `plan.report()` afterwards; always
+    uninstalls, even when the body raises."""
+    from flink_tpu.chaos import FaultPlan, install_plan, uninstall_plan
+
+    if plan is None:
+        plan = FaultPlan.from_rules(list(rules or []), seed=seed)
+    install_plan(plan)
+    try:
+        yield plan
+    finally:
+        uninstall_plan()
+
+
+@contextlib.contextmanager
 def transport_security(sec=None):
     """Context manager pinning the PROCESS-DEFAULT SecurityConfig — every
     RpcService/ExchangeServer/OutputChannel/RpcGateway constructed inside

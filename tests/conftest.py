@@ -25,3 +25,4 @@ from jax._src import xla_bridge as _xb  # noqa: E402
 jax.config.update("jax_platforms", "cpu")
 _xb._backend_factories.pop("axon", None)
 _xb._topology_factories.pop("axon", None)
+

@@ -338,7 +338,10 @@ def test_aggregate_watermark_skew_ignores_min_watermark_sentinel():
 def test_jm_persist_failure_marks_checkpoint_failed(tmp_path):
     """Distributed parity with the coordinator's _abort: a persist that
     raises after the pending entry was popped must flip the stats record
-    to FAILED itself — _fail_job's pending sweep can no longer reach it."""
+    to FAILED itself — _fail_job's pending sweep can no longer reach it.
+    The JM owns the persist, so (beyond tolerable-failed-checkpoints,
+    default 0) it fails the job through the normal attributed restart
+    path instead of raising into the innocent acking task's RPC."""
     from flink_tpu.runtime.cluster import JobManagerEndpoint, _JobState
     from flink_tpu.runtime.rpc import RpcService
 
@@ -347,6 +350,7 @@ def test_jm_persist_failure_marks_checkpoint_failed(tmp_path):
     try:
         job = _JobState("j1", "bk", 1, "spec")
         job.attempt = 1
+        job.status = "RUNNING"
         jm._jobs["j1"] = job
         job.pending[5] = {}
         job.pending_target[5] = 10
@@ -356,11 +360,69 @@ def test_jm_persist_failure_marks_checkpoint_failed(tmp_path):
             raise OSError("disk full")
 
         jm._storage.save = boom
-        with pytest.raises(OSError, match="disk full"):
-            jm.ack_checkpoint("j1", 1, 0, 5, {"x": np.arange(4)})
+        jm.ack_checkpoint("j1", 1, 0, 5, {"x": np.arange(4)})
         rec = job.stats.checkpoint(5)
         assert rec["status"] == "FAILED" and "disk full" in rec["failure_cause"]
         assert job.stats.gauge_values()["numberOfInProgressCheckpoints"] == 0
+        # beyond tolerance (0): the job took the restart path, attributed
+        assert job.status == "RESTARTING"
+        assert "disk full" in job.failure and "persist" in job.failure
+    finally:
+        jm.stop()
+        svc.stop()
+
+
+def test_jm_tolerable_failed_checkpoints_absorbs_brownout(tmp_path):
+    """execution.checkpointing.tolerable-failed-checkpoints on the
+    distributed path: consecutive persist failures within the budget are
+    FAILED stats records (consecutiveFailedCheckpoints gauge climbing),
+    the job stays RUNNING; a completion resets the streak; exceeding the
+    budget restarts the job."""
+    from flink_tpu.runtime.cluster import JobManagerEndpoint, _JobState
+    from flink_tpu.runtime.rpc import RpcService
+
+    svc = RpcService()
+    jm = JobManagerEndpoint(svc, checkpoint_dir=str(tmp_path / "chk"),
+                            tolerable_failed_checkpoints=2)
+    try:
+        job = _JobState("j1", "bk", 1, "spec")
+        job.attempt = 1
+        job.status = "RUNNING"
+        jm._jobs["j1"] = job
+        real_save = jm._storage.save
+        boom_box = {"on": True}
+
+        def flaky_save(cid, data):
+            if boom_box["on"]:
+                raise OSError("storage brownout")
+            return real_save(cid, data)
+
+        jm._storage.save = flaky_save
+        for cid in (1, 2):
+            job.pending[cid] = {}
+            job.pending_target[cid] = 10
+            job.stats.report_pending(cid)
+            jm.ack_checkpoint("j1", 1, 0, cid, {"x": np.arange(4)})
+            assert job.status == "RUNNING", cid       # tolerated
+        assert job.consecutive_cp_failures == 2
+        assert job.stats.gauge_values()["consecutiveFailedCheckpoints"] == 2
+        # storage heals: a completion resets the streak
+        boom_box["on"] = False
+        job.pending[3] = {}
+        job.pending_target[3] = 10
+        job.stats.report_pending(3)
+        jm.ack_checkpoint("j1", 1, 0, 3, {"x": np.arange(4)})
+        assert job.consecutive_cp_failures == 0
+        assert job.status == "RUNNING"
+        # a fresh 3-failure streak exceeds tolerable=2 on the third
+        boom_box["on"] = True
+        for cid in (4, 5, 6):
+            job.pending[cid] = {}
+            job.pending_target[cid] = 10
+            job.stats.report_pending(cid)
+            jm.ack_checkpoint("j1", 1, 0, cid, {"x": np.arange(4)})
+        assert job.status == "RESTARTING"
+        assert "tolerable" in job.failure
     finally:
         jm.stop()
         svc.stop()

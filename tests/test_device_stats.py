@@ -410,6 +410,18 @@ def device_job():
     from flink_tpu.runtime.rest import RestServer
     from flink_tpu.utils.arrays import obj_array
 
+    # compile observability is detected through the jitted callables'
+    # cache growth, and superscan executables are cached MODULE-LEVEL by
+    # geometry — any earlier test whose wall-clock-dependent dispatch
+    # pattern (checkpoint flushes truncate superbatches at arbitrary T)
+    # happens to hit this fixture's geometry would pre-compile it and
+    # silently hide the compile/recompile events asserted below. Start
+    # from a clean executable cache so the events are THIS fixture's own.
+    from flink_tpu.runtime import fused_window_pipeline as _fwp
+
+    _fwp._build_superscan.cache_clear()
+    _fwp._CHAINED_CACHE.clear()
+
     def gen(idx):
         col = np.stack([(idx * 31) % 23, idx % 3], axis=1).astype(np.float32)
         return Batch(col, (idx * 5).astype(np.int64))

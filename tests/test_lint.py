@@ -118,6 +118,26 @@ def test_path_scoped_rules_are_not_vacuous():
     assert any(index.in_subtree("checkpoint")), (
         "checkpoint/ has no modules — ARCH002 is vacuous")
     assert index.get("config.py") is not None, "DOC001 is vacuous"
+    # CONC005 no-silent-swallow is path-scoped: every configured subtree
+    # must exist AND stay configured, or a rename/edit silently frees the
+    # runtime/checkpoint planes to grow `except Exception: pass` again
+    from flink_tpu.lint.rules_concurrency import SWALLOW_SCOPED_SUBTREES
+
+    assert set(SWALLOW_SCOPED_SUBTREES) >= {"runtime", "checkpoint"}, (
+        "CONC005 no longer scopes the runtime/checkpoint subtrees — "
+        "silent swallows on the failure-detection planes would pass CI")
+    for layer in SWALLOW_SCOPED_SUBTREES:
+        assert any(index.in_subtree(layer)), (
+            f"CONC005 subtree {layer!r} has no modules — the rule is "
+            "vacuous for it")
+    # the chaos plane's leaf module must stay where every seam imports it
+    # from (security/transport, rpc, dataplane, storage, executor), and
+    # the scenario matrix must stay runnable
+    for rel in ("chaos/plan.py", "chaos/scenarios.py"):
+        assert index.get(rel) is not None, (
+            f"{rel} missing — the chaos plane moved and the seams' "
+            "module-level hook (and the bench chaos gate) no longer "
+            "cover it")
 
 
 # ---------------------------------------------------------------------------

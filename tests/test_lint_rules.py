@@ -436,6 +436,71 @@ def test_conc004_clean_with_both_kwargs(tmp_path):
 
 
 # ---------------------------------------------------------------------------
+# CONC005 no-silent-swallow
+# ---------------------------------------------------------------------------
+
+_SWALLOW_SRC = """
+    def ship(gw):
+        try:
+            gw.send()
+        except Exception:
+            pass
+
+    def drain(gw):
+        try:
+            gw.recv()
+        except:
+            pass
+        try:
+            gw.ack()
+        except (ValueError, Exception):
+            pass
+"""
+
+
+def test_conc005_flags_broad_silent_swallows_in_runtime(tmp_path):
+    vs = run_rule("CONC005", tmp_path, {"runtime/hb.py": _SWALLOW_SRC})
+    assert len(vs) == 3
+    assert {v.symbol for v in vs} == {"swallow@ship", "swallow@drain",
+                                      "swallow@drain#2"}
+    assert any("bare except" in v.message for v in vs)
+
+
+def test_conc005_checkpoint_subtree_is_scoped_too(tmp_path):
+    vs = run_rule("CONC005", tmp_path, {"checkpoint/st.py": _SWALLOW_SRC})
+    assert len(vs) == 3
+
+
+def test_conc005_clean_outside_the_scoped_subtrees(tmp_path):
+    # the same swallows in api/ are not this rule's business
+    vs = run_rule("CONC005", tmp_path, {"api/ds.py": _SWALLOW_SRC})
+    assert vs == []
+
+
+def test_conc005_clean_when_narrow_or_logged(tmp_path):
+    vs = run_rule("CONC005", tmp_path, {"runtime/hb.py": """
+        import logging
+
+        def ship(gw):
+            try:
+                gw.send()
+            except OSError:
+                pass                    # narrow type: a per-fault decision
+
+        def drain(gw, counters):
+            try:
+                gw.recv()
+            except Exception as e:      # broad but COUNTED, not silent
+                counters["missed"] += 1
+            try:
+                gw.ack()
+            except Exception as e:
+                logging.getLogger(__name__).debug("swallowed %r", e)
+    """})
+    assert vs == []
+
+
+# ---------------------------------------------------------------------------
 # DEV001 host-sync-in-jit
 # ---------------------------------------------------------------------------
 
