@@ -1271,23 +1271,8 @@ def child_autoscaler() -> None:
 
 
 def run_autoscaler_scenario_child(timeout_s: float = 240.0) -> dict:
-    """Run the autoscaler scenario in a JAX_PLATFORMS=cpu subprocess and
-    return its result event (or an error dict — the headline must survive)."""
-    try:
-        r = subprocess.run(
-            [sys.executable, os.path.abspath(__file__), "--child",
-             "autoscaler", "0", "0", "0"],
-            stdout=subprocess.PIPE, stderr=subprocess.DEVNULL, text=True,
-            timeout=timeout_s, env={**os.environ, "JAX_PLATFORMS": "cpu"},
-        )
-        for line in reversed(r.stdout.splitlines()):
-            if line.startswith("{"):
-                obj = json.loads(line)
-                if obj.get("event") == "result":
-                    return obj["result"]
-        return {"error": "no result event from autoscaler child"}
-    except Exception as e:  # noqa: BLE001
-        return {"error": repr(e)[:300]}
+    """Autoscaler load-step scenario in a CPU-pinned child."""
+    return _run_cpu_child('autoscaler', timeout_s)
 
 
 def api_path_microbench(events: Optional[int] = None,
@@ -1624,24 +1609,40 @@ def child_device_plane() -> None:
     _emit({"event": "result", "result": device_plane_microbench()})
 
 
-def run_device_plane_child(timeout_s: float = 300.0) -> dict:
-    """Run the device-plane microbench in a JAX_PLATFORMS=cpu subprocess
-    and return its result event (or an error dict)."""
+def _run_cpu_child(label: str, timeout_s: float, *,
+                   force_mesh: bool = False) -> dict:
+    """Run `bench.py --child <label>` CPU-pinned and return its result
+    event (or an error dict — the headline must survive). This is THE
+    child protocol (env merge + reversed-stdout scan for the result
+    event), single-sourced: six scenarios ride it and a per-scenario copy
+    must never drift. `force_mesh` forces an 8-device virtual CPU mesh via
+    XLA_FLAGS — the multichip scenario and the chaos chip-loss scenario
+    need devices to lose."""
+    env = {**os.environ, "JAX_PLATFORMS": "cpu"}
+    if force_mesh:
+        env["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "") +
+                            " --xla_force_host_platform_device_count=8"
+                            ).strip()
     try:
         r = subprocess.run(
             [sys.executable, os.path.abspath(__file__), "--child",
-             "device-plane", "0", "0", "0"],
+             label, "0", "0", "0"],
             stdout=subprocess.PIPE, stderr=subprocess.DEVNULL, text=True,
-            timeout=timeout_s, env={**os.environ, "JAX_PLATFORMS": "cpu"},
+            timeout=timeout_s, env=env,
         )
         for line in reversed(r.stdout.splitlines()):
             if line.startswith("{"):
                 obj = json.loads(line)
                 if obj.get("event") == "result":
                     return obj["result"]
-        return {"error": "no result event from device-plane child"}
+        return {"error": f"no result event from {label} child"}
     except Exception as e:  # noqa: BLE001
         return {"error": repr(e)[:300]}
+
+
+def run_device_plane_child(timeout_s: float = 300.0) -> dict:
+    """Device-plane microbench in a CPU-pinned child."""
+    return _run_cpu_child('device-plane', timeout_s)
 
 
 def child_api_path() -> None:
@@ -1662,23 +1663,8 @@ def child_api_path() -> None:
 
 
 def run_api_path_microbench_child(timeout_s: float = 300.0) -> dict:
-    """Run the API-path microbench in a JAX_PLATFORMS=cpu subprocess and
-    return its result event (or an error dict — the headline must survive)."""
-    try:
-        r = subprocess.run(
-            [sys.executable, os.path.abspath(__file__), "--child",
-             "api-path", "0", "0", "0"],
-            stdout=subprocess.PIPE, stderr=subprocess.DEVNULL, text=True,
-            timeout=timeout_s, env={**os.environ, "JAX_PLATFORMS": "cpu"},
-        )
-        for line in reversed(r.stdout.splitlines()):
-            if line.startswith("{"):
-                obj = json.loads(line)
-                if obj.get("event") == "result":
-                    return obj["result"]
-        return {"error": "no result event from api-path child"}
-    except Exception as e:  # noqa: BLE001
-        return {"error": repr(e)[:300]}
+    """API-path microbench in a CPU-pinned child (same backend both paths)."""
+    return _run_cpu_child('api-path', timeout_s)
 
 
 def child_checkpoint() -> None:
@@ -1699,23 +1685,228 @@ def child_checkpoint() -> None:
 
 
 def run_checkpoint_microbench_child(timeout_s: float = 300.0) -> dict:
-    """Run the checkpoint microbench in a JAX_PLATFORMS=cpu subprocess and
-    return its result event (or an error dict — the headline must survive)."""
-    try:
-        r = subprocess.run(
-            [sys.executable, os.path.abspath(__file__), "--child",
-             "checkpoint", "0", "0", "0"],
-            stdout=subprocess.PIPE, stderr=subprocess.DEVNULL, text=True,
-            timeout=timeout_s, env={**os.environ, "JAX_PLATFORMS": "cpu"},
+    """Checkpoint microbench in a CPU-pinned child."""
+    return _run_cpu_child('checkpoint', timeout_s)
+
+
+def multichip_microbench(events: Optional[int] = None,
+                         batch: int = 8192,
+                         num_keys: Optional[int] = None,
+                         span_event_ms: int = 64_000,
+                         sweeps: int = 2,
+                         devices: int = 0,
+                         zipf_s: float = 1.0) -> dict:
+    """Multichip SPMD scenario (ISSUE-11): the SAME fused DataStream YSB
+    program — from_source().filter().key_by().window().count() with
+    traceable UDFs — run single-chip and sharded over the device mesh
+    (parallel.mesh.enabled), same backend, same data:
+
+      - `fused_selected` pins that graph translation chose the
+        DeviceChainRunner (the user-facing path, not a hand-built kernel),
+        and `sharded_selected` that the runner's operator actually targets
+        the mesh (mesh_devices > 1) — a silent fall-back to single-chip
+        would otherwise still read as perfect parity;
+      - `parity` is exact row-mode result equality mesh vs single-chip
+        (the single-chip fused path is itself oracle-gated by the api_path
+        scenario, so the chain of custody reaches the host oracle);
+      - `scaling_efficiency` = mesh tuples/s / (single-chip tuples/s x
+        devices). On a real n-chip mesh the acceptance bar is >= 0.8x
+        linear; on the virtual CPU mesh (this child, and CI) every "chip"
+        timeshares one host, so the ratio only gates against catastrophic
+        regressions — the structural keys are the contract;
+      - the zipf(`zipf_s`) SKEWED variant re-runs both sides with a
+        power-law key distribution and reports
+        `skewed_scaling_efficiency` plus the per-device telemetry it
+        exercises (meshLoadSkew, per-device records) — an imbalanced mesh
+        must be measurable, not inferred (ROADMAP item 4a's first step).
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from flink_tpu.api.datastream import StreamExecutionEnvironment
+    from flink_tpu.api.windowing.assigners import SlidingEventTimeWindows
+    from flink_tpu.config import (
+        Configuration,
+        ExecutionOptions,
+        ObservabilityOptions,
+        ParallelOptions,
+    )
+    from flink_tpu.connectors.source import Batch, DataGeneratorSource
+    from flink_tpu.core.watermarks import WatermarkStrategy
+    from flink_tpu.graph.transformation import plan
+    from flink_tpu.runtime.executor import JobRuntime, build_runners
+
+    events = events or int(
+        os.environ.get("BENCH_MULTICHIP_EVENTS", str(1 << 20)))
+    num_keys = num_keys or NUM_KEYS
+    from flink_tpu.parallel.mesh import usable_mesh_size
+
+    avail = len(jax.devices())
+    n = usable_mesh_size(devices, avail, num_keys)
+    if n < 2:
+        return {"error": f"no usable mesh ({avail} device(s), "
+                         f"{num_keys} keys)", "devices": int(n)}
+
+    # bounded zipf over the key vocabulary: p_k ~ 1/k^s, inverse-cdf
+    # sampled — np.random.zipf is unbounded and undefined at s=1.0
+    ranks = np.arange(1, num_keys + 1, dtype=np.float64)
+    zipf_cdf = np.cumsum(1.0 / ranks ** zipf_s)
+    zipf_cdf /= zipf_cdf[-1]
+    # hot ranks spread over the key-id space so the hot key-GROUPS (and
+    # with contiguous ranges, the hot DEVICES) are deterministic
+    perm = np.random.default_rng(11).permutation(num_keys)
+
+    def source(count, skewed: bool):
+        def gen(idx):
+            if skewed:
+                rng = np.random.default_rng(int(idx[0]) * 9176 + 13)
+                camp = perm[np.searchsorted(zipf_cdf, rng.random(len(idx)))]
+            else:
+                camp = (idx * 2654435761) % num_keys
+            etype = idx % 3
+            col = np.stack([camp, etype], axis=1).astype(np.float32)
+            ts = 10_000 + idx * span_event_ms // count
+            return Batch(col, ts.astype(np.int64))
+
+        return DataGeneratorSource(gen, count)
+
+    t_filter = lambda col: col[:, 1] < 0.5                    # noqa: E731
+    t_key = lambda col: col[:, 0].astype(jnp.int32)           # noqa: E731
+
+    def build(count, mesh_on, *, skewed=False, columnar=True, stats=False):
+        cfg = Configuration()
+        cfg.set(ExecutionOptions.CHAIN_FUSION, True)
+        cfg.set(ExecutionOptions.BATCH_SIZE, batch)
+        cfg.set(ExecutionOptions.KEY_CAPACITY, num_keys)
+        cfg.set(ExecutionOptions.COLUMNAR_OUTPUT, columnar)
+        cfg.set(ParallelOptions.MESH_ENABLED, mesh_on)
+        if mesh_on:
+            cfg.set(ParallelOptions.MESH_DEVICES, n)
+        cfg.set(ObservabilityOptions.DEVICE_STATS_ENABLED, stats)
+        if stats:
+            # collect on every due tick so the smoke-scale run still folds
+            cfg.set(ExecutionOptions.SUPERBATCH_STEPS, 8)
+            cfg.set(ObservabilityOptions.DEVICE_KEY_STATS_INTERVAL_MS, 0)
+        env = StreamExecutionEnvironment(cfg)
+        ds = env.from_source(
+            source(count, skewed),
+            watermark_strategy=WatermarkStrategy.for_bounded_out_of_orderness(0),
         )
-        for line in reversed(r.stdout.splitlines()):
-            if line.startswith("{"):
-                obj = json.loads(line)
-                if obj.get("event") == "result":
-                    return obj["result"]
-        return {"error": "no result event from checkpoint child"}
-    except Exception as e:  # noqa: BLE001
-        return {"error": repr(e)[:300]}
+        sink = (ds.filter(t_filter, traceable=True)
+                  .key_by(t_key, traceable=True)
+                  .window(SlidingEventTimeWindows.of(WINDOW_MS, SLIDE_MS))
+                  .aggregate("count")
+                  .collect())
+        return env, sink
+
+    # ---- reroute gate: translation must pick the fused runner AND the
+    # runner must actually target the sharded pipeline
+    env_probe, _ = build(batch, True)
+    runners, _ = build_runners(plan(env_probe._sinks), env_probe.config)
+    fused = [r for r in runners if type(r).__name__ == "DeviceChainRunner"]
+    fused_selected = bool(fused)
+    mesh_devices = fused[0].op.mesh_devices() if fused else 1
+    sharded_selected = mesh_devices > 1
+
+    def run(count, mesh_on, *, skewed=False, columnar=True):
+        env, sink = build(count, mesh_on, skewed=skewed, columnar=columnar)
+        t0 = time.perf_counter()
+        env.execute()
+        return sink.results, count / max(time.perf_counter() - t0, 1e-9)
+
+    # ---- parity gates in row mode (raw keys), exact equality
+    n_parity = max(events // 8, batch)
+    parity = {}
+    for skewed in (False, True):
+        rows = {
+            mesh_on: sorted((int(k), int(v)) for k, v in
+                            run(n_parity, mesh_on, skewed=skewed,
+                                columnar=False)[0])
+            for mesh_on in (True, False)
+        }
+        parity[skewed] = (len(rows[True]) > 0 and rows[True] == rows[False])
+
+    # ---- timed runs: interleaved max-of-N sweeps (the PR-3 protocol)
+    run(batch * 12, True)
+    run(batch * 12, False)
+    tps = {(m, s): 0.0 for m in (True, False) for s in (True, False)}
+    for _sweep in range(sweeps):
+        for skewed in (False, True):
+            for mesh_on in (True, False):
+                _r, t = run(events, mesh_on, skewed=skewed)
+                tps[(mesh_on, skewed)] = max(tps[(mesh_on, skewed)], t)
+
+    # ---- per-device telemetry under imbalance: one skewed mesh run with
+    # the device plane on; the [n, K_local] fold must SEE the hot devices
+    mesh_load_skew = None
+    per_device = []
+    key_skew = None
+    try:
+        env_t, _sink = build(max(events // 4, batch * 8), True,
+                             skewed=True, stats=True)
+        rt = JobRuntime(plan(env_t._sinks), env_t.config)
+        rt.run()
+        snap = rt.device_snapshot()
+        for entry in snap["operators"].values():
+            keys_blk = entry.get("keys") or {}
+            if keys_blk.get("perDevice"):
+                mesh_load_skew = keys_blk.get("meshLoadSkew")
+                per_device = [e["records"] for e in keys_blk["perDevice"]]
+                key_skew = keys_blk.get("keySkew")
+                break
+    except Exception as e:  # noqa: BLE001 — the block must survive
+        per_device = [f"error: {e!r}"[:120]]
+
+    eff = tps[(True, False)] / max(tps[(False, False)] * n, 1e-9)
+    eff_skewed = tps[(True, True)] / max(tps[(False, True)] * n, 1e-9)
+    return {
+        "devices": int(n),
+        "tuples_per_sec": round(tps[(True, False)], 1),
+        "single_chip_tuples_per_sec": round(tps[(False, False)], 1),
+        "scaling_efficiency": round(eff, 4),
+        "skewed_tuples_per_sec": round(tps[(True, True)], 1),
+        "skewed_single_chip_tuples_per_sec": round(tps[(False, True)], 1),
+        "skewed_scaling_efficiency": round(eff_skewed, 4),
+        "parity": bool(parity[False]),
+        "skewed_parity": bool(parity[True]),
+        "fused_selected": bool(fused_selected),
+        "sharded_selected": bool(sharded_selected),
+        "mesh_load_skew": mesh_load_skew,
+        "per_device_records": per_device[:16],
+        "key_skew": key_skew,
+        "zipf_s": zipf_s,
+        "events": events,
+        "num_keys": num_keys,
+        "window_ms": WINDOW_MS,
+        "slide_ms": SLIDE_MS,
+        "workload": "ysb_sliding_count_datastream_api_spmd",
+    }
+
+
+def child_multichip() -> None:
+    """Multichip child: CPU-pinned with a FORCED 8-device virtual mesh —
+    the single-client TPU relay exposes one chip, so the mesh promotion is
+    exercised on host devices (the same program rides ICI on real
+    multi-chip hardware; the driver's dryrun covers compile-correctness
+    there)."""
+    _emit({"event": "start", "device": "cpu-multichip", "pid": os.getpid()})
+    try:
+        import jax
+        from jax._src import xla_bridge as _xb
+
+        jax.config.update("jax_platforms", "cpu")
+        _xb._backend_factories.pop("axon", None)
+        _xb._topology_factories.pop("axon", None)
+    except Exception:
+        pass
+    _emit({"event": "result", "result": multichip_microbench()})
+
+
+def run_multichip_child(timeout_s: float = 420.0) -> dict:
+    """Multichip microbench in a CPU-pinned child on the 8-device virtual
+    mesh (the single-client TPU relay exposes one chip; the same program
+    rides ICI on real multi-chip hardware)."""
+    return _run_cpu_child('multichip', timeout_s, force_mesh=True)
 
 
 def chaos_microbench(names: Optional[list] = None) -> dict:
@@ -1733,9 +1924,9 @@ def chaos_microbench(names: Optional[list] = None) -> dict:
     result = scenarios.run_matrix(names)
     # compact per-scenario view for the artifact (full detail on failure)
     result["scenarios"] = [
-        {k: r[k] for k in ("name", "path", "passed", "parity", "restarts",
-                           "recovery_ms", "injected_fired", "attributed",
-                           "detail")}
+        {k: r.get(k) for k in ("name", "path", "passed", "parity",
+                               "restarts", "recovery_ms", "injected_fired",
+                               "attributed", "skipped", "detail")}
         for r in result["scenarios"]
     ]
     return result
@@ -1759,23 +1950,10 @@ def child_chaos() -> None:
 
 
 def run_chaos_microbench_child(timeout_s: float = 420.0) -> dict:
-    """Run the chaos matrix in a JAX_PLATFORMS=cpu subprocess and return
-    its result event (or an error dict — the headline must survive)."""
-    try:
-        r = subprocess.run(
-            [sys.executable, os.path.abspath(__file__), "--child",
-             "chaos", "0", "0", "0"],
-            stdout=subprocess.PIPE, stderr=subprocess.DEVNULL, text=True,
-            timeout=timeout_s, env={**os.environ, "JAX_PLATFORMS": "cpu"},
-        )
-        for line in reversed(r.stdout.splitlines()):
-            if line.startswith("{"):
-                obj = json.loads(line)
-                if obj.get("event") == "result":
-                    return obj["result"]
-        return {"error": "no result event from chaos child"}
-    except Exception as e:  # noqa: BLE001
-        return {"error": repr(e)[:300]}
+    """Chaos matrix in a CPU-pinned child with a FORCED 8-device virtual
+    mesh, so the chip-loss-sharded scenario exercises a real reduced-mesh
+    recovery, not a skip."""
+    return _run_cpu_child('chaos', timeout_s, force_mesh=True)
 
 
 def parent_main() -> None:
@@ -1828,6 +2006,12 @@ def parent_main() -> None:
     chaos = run_chaos_microbench_child()
     _emit({"event": "chaos_microbench", "result": chaos})
 
+    # multichip SPMD: the fused DataStream YSB program sharded over the
+    # (virtual 8-device) mesh vs single-chip — scaling efficiency, zipf
+    # skewed variant, per-device telemetry, reroute + parity gates
+    multichip = run_multichip_child()
+    _emit({"event": "multichip_microbench", "result": multichip})
+
     def consider(res, rank):
         nonlocal best, best_rank
         if res is None:
@@ -1846,6 +2030,13 @@ def parent_main() -> None:
             best["autoscaler"] = autoscaler
             best["api_path"] = api_path
             best["chaos"] = chaos
+            best["multichip"] = multichip
+            # top-level continuity keys for the trajectory table
+            if multichip.get("tuples_per_sec"):
+                best["multichip_tuples_per_sec"] = \
+                    multichip["tuples_per_sec"]
+                best["multichip_scaling_efficiency"] = \
+                    multichip.get("scaling_efficiency")
             # device_plane, NOT "device": the top-level "device" key is the
             # backend marker ("tpu"/"cpu-jit") the bench driver parses —
             # clobbering it would misclassify the whole artifact
@@ -1953,6 +2144,8 @@ def main() -> None:
             child_device_plane()
         elif label == "chaos":
             child_chaos()
+        elif label == "multichip":
+            child_multichip()
         else:
             child_cpu(T, 1 << int(sys.argv[4]), spans)
     else:

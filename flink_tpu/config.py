@@ -371,6 +371,59 @@ class DeviceOptions:
     DONATE_STATE = ConfigOptions.key("device.donate-state").bool_type().default_value(True)
 
 
+class ParallelOptions:
+    """Multichip SPMD execution over the local device mesh
+    (flink_tpu/parallel/, docs/multichip.md): eligible fused keyed window
+    jobs shard their window-state columns by key-group over the mesh and
+    run the keyBy shuffle as an on-device all-to-all inside the compiled
+    superscan — the mesh is a slot resource of the process, not a cluster
+    of tasks."""
+
+    MESH_ENABLED = (
+        ConfigOptions.key("parallel.mesh.enabled").bool_type().default_value(False)
+    ).with_description(
+        "Run eligible fused keyed window jobs SPMD over the local device "
+        "mesh: window-state columns shard by contiguous key-group range, "
+        "each device transforms and keys its slice of the ingest batch, and "
+        "ONE all-to-all collective per step routes records to their "
+        "key-range owners (the keyBy shuffle over ICI instead of a host "
+        "dataplane hop). Results are byte-identical to the single-chip "
+        "fused path; snapshots stay canonical [K, S], so checkpoints "
+        "restore across any mesh size. Requires >= 2 visible devices and a "
+        "jax build with shard_map; otherwise execution silently stays "
+        "single-chip."
+    )
+    MESH_DEVICES = (
+        ConfigOptions.key("parallel.mesh.devices").int_type().default_value(0)
+    ).with_description(
+        "Devices in the job's mesh. 0 (default) uses every visible device. "
+        "Clamped to the visible device count, then rounded down to the "
+        "largest divisor of the key capacity so contiguous key ranges "
+        "divide evenly across shards."
+    )
+    MESH_DEGRADE_ON_DEVICE_LOSS = (
+        ConfigOptions.key("parallel.mesh.degrade-on-device-loss")
+        .bool_type().default_value(True)
+    ).with_description(
+        "When a mesh job fails with a device-plane error (a lost chip/host "
+        "surfaces as an XLA runtime error; chaos drills inject the same "
+        "shape at the dispatch seam), the restart rebuilds the job at a "
+        "REDUCED mesh size instead of retrying the dead geometry forever: "
+        "the latest checkpoint's canonical [K, S] snapshot re-shards over "
+        "the surviving devices (halving per restart, floor 1 = single-chip). "
+        "Off restarts at the configured size every time."
+    )
+    MESH_AUTOSCALE = (
+        ConfigOptions.key("parallel.mesh.autoscale").bool_type().default_value(True)
+    ).with_description(
+        "Let the autoscaler (autoscaler.enabled) treat MESH SIZE as the "
+        "parallelism axis it rescales on the in-process path: scaling "
+        "decisions execute as a live checkpoint-rewind + key-group re-shard "
+        "onto a different device count at a step boundary, exactly-once. "
+        "Off keeps the autoscaler observe-only for mesh jobs."
+    )
+
+
 class MetricOptions:
     LATENCY_INTERVAL_MS = ConfigOptions.key("metrics.latency.interval").duration_ms_type().default_value(0)
     REPORTERS = ConfigOptions.key("metrics.reporters").list_type().default_value([])

@@ -30,6 +30,13 @@ LAYER_FORBIDDEN: Dict[str, List[str]] = {
     "ops": ["{pkg}.runtime", "{pkg}.api", "{pkg}.table", "{pkg}.cep",
             "{pkg}.scheduler"],
     "state": ["{pkg}.api", "{pkg}.table", "{pkg}.cep", "{pkg}.scheduler"],
+    # the mesh/shard-map library sits below the runtime like ops/state: it
+    # may import core/ops/state/config, never the runtime (the sharded
+    # pipeline's planner handle is a function-scoped lazy import), api, or
+    # the table/cep layers above — the runtime composes parallel, not the
+    # other way around
+    "parallel": ["{pkg}.runtime", "{pkg}.api", "{pkg}.table", "{pkg}.cep",
+                 "{pkg}.scheduler"],
     "graph": ["{pkg}.table", "{pkg}.cep", "{pkg}.runtime"],
     "api": ["{pkg}.table", "{pkg}.runtime"],
     # the autoscaler consumes metric-snapshot/state/config shapes and is

@@ -94,8 +94,16 @@ class KeyStatsCollector:
                  row_bytes_fn: Optional[Callable[[], int]] = None,
                  ready_fn: Optional[Callable[[], bool]] = None,
                  interval_ms: int = 1000,
-                 clock: Callable[[], float] = time.monotonic):
+                 clock: Callable[[], float] = time.monotonic,
+                 mesh_loads_fn: Optional[Callable[[], Any]] = None):
         self._loads_fn = loads_fn
+        # multichip (parallel/sharded_superscan.py): [n, K_local] per-device
+        # local loads. The GLOBAL histogram cannot see device imbalance —
+        # contiguous key ranges mean one device can own every hot key-group
+        # while the global skew reads even per-group — so the mesh fold
+        # keeps per-device load/skew and the scalar gauges take the MAX
+        # across devices (never device 0's view)
+        self._mesh_loads_fn = mesh_loads_fn
         self.num_key_groups = max(int(num_key_groups), 1)
         self.top_k = max(int(top_k), 1)
         self._row_bytes_fn = row_bytes_fn
@@ -118,6 +126,9 @@ class KeyStatsCollector:
         self._hot: List[List[int]] = []          # [[kid, count], ...]
         self._group_load: Dict[str, float] = {"count": 0}
         self._group_state_bytes: Dict[str, float] = {"count": 0}
+        # per-mesh-device view: [{device, records, activeKeys, keySkew}]
+        self._per_device: List[Dict[str, float]] = []
+        self._mesh_load_skew: Optional[float] = None
 
     # -- collection --------------------------------------------------------
     def maybe_collect(self, now: Optional[float] = None) -> bool:
@@ -165,6 +176,7 @@ class KeyStatsCollector:
                 row_bytes = int(self._row_bytes_fn())
             except Exception:  # noqa: BLE001
                 row_bytes = 0
+        per_device, mesh_load_skew = self._collect_per_device()
         mean_group = total / G
         with self._lock:
             self._total = total
@@ -177,7 +189,56 @@ class KeyStatsCollector:
             self._group_load = _stats(per_group)
             self._group_state_bytes = _stats(
                 active.astype(np.int64) * row_bytes)
+            self._per_device = per_device
+            self._mesh_load_skew = mesh_load_skew
         return True
+
+    def _collect_per_device(self):
+        """Mesh fold: one [n, K_local] readback -> per-device resident
+        records, active keys, and the worst GLOBAL key-group load among
+        the groups the device's key range intersects (against the global
+        mean group load). Attributing the FULL global group load — not
+        just the device's partial slice — keeps max-over-devices equal to
+        the global skew even when a group straddles a device boundary
+        (non-pow2 capacities after growth), so the scalar gauges stay
+        path-independent. Returns ([], None) off the mesh."""
+        if self._mesh_loads_fn is None:
+            return [], None
+        try:
+            mloads = self._mesh_loads_fn()
+        except Exception:  # noqa: BLE001 — observability never fails the job
+            return [], None
+        if mloads is None:
+            return [], None
+        m = np.asarray(mloads)
+        if m.ndim != 2 or m.shape[0] < 2:
+            return [], None
+        n_dev, kl = m.shape
+        k_total = n_dev * kl
+        g = min(self.num_key_groups, k_total)
+        gids = (np.arange(k_total, dtype=np.int64) * g) // k_total
+        total = int(m.sum())
+        mean_group = total / g if g else 0.0
+        grp = np.zeros(g, np.int64)
+        np.add.at(grp, gids, m.reshape(-1).astype(np.int64))
+        per_device: List[Dict[str, Any]] = []
+        for d in range(n_dev):
+            loads_d = m[d].astype(np.int64)
+            owned = grp[np.unique(gids[d * kl:(d + 1) * kl])]
+            per_device.append({
+                "device": d,
+                "records": int(loads_d.sum()),
+                "activeKeys": int((loads_d > 0).sum()),
+                "hotKeyLoad": int(loads_d.max()) if kl else 0,
+                "keySkew": (round(float(owned.max()) / mean_group, 4)
+                            if mean_group > 0 and owned.size else None),
+            })
+        mesh_load_skew = None
+        if total > 0:
+            mean_dev = total / n_dev
+            mesh_load_skew = round(
+                max(e["records"] for e in per_device) / mean_dev, 4)
+        return per_device, mesh_load_skew
 
     # -- gauges ------------------------------------------------------------
     def skew(self) -> Optional[float]:
@@ -200,6 +261,21 @@ class KeyStatsCollector:
         with self._lock:
             return self._hot[0][1] if self._hot else 0
 
+    def mesh_load_skew(self) -> Optional[float]:
+        """max/mean per-device resident records across the mesh (1.0 even,
+        n = one device owns everything); None off the mesh or pre-fold."""
+        with self._lock:
+            return self._mesh_load_skew
+
+    def per_device(self) -> List[Dict[str, Any]]:
+        with self._lock:
+            return [dict(e) for e in self._per_device]
+
+    def _per_device_map(self, field: str) -> Dict[str, float]:
+        with self._lock:
+            return {str(e["device"]): e[field] for e in self._per_device
+                    if e.get(field) is not None}
+
     def register(self, group) -> None:
         group.gauge("keySkew", self.skew)
         group.gauge("activeKeys", self.active_keys)
@@ -209,6 +285,18 @@ class KeyStatsCollector:
         group.gauge("keyGroupLoad", lambda: dict(self._group_load))
         group.gauge("keyGroupStateBytes",
                     lambda: dict(self._group_state_bytes))
+        if self._mesh_loads_fn is not None:
+            # per-mesh-device maps ({device: value}): shipped so the JM's
+            # aggregate_shard_metrics can fold MAX across the shard's own
+            # devices (an imbalanced mesh must be visible as its WORST
+            # device, never device 0's view)
+            group.gauge("meshLoadSkew", self.mesh_load_skew)
+            group.gauge("meshDeviceLoad",
+                        lambda: self._per_device_map("records"))
+            group.gauge("keySkewPerDevice",
+                        lambda: self._per_device_map("keySkew"))
+            group.gauge("hotKeyLoadPerDevice",
+                        lambda: self._per_device_map("hotKeyLoad"))
 
     # -- exposure ----------------------------------------------------------
     def payload(self) -> Dict[str, Any]:
@@ -223,4 +311,6 @@ class KeyStatsCollector:
                 "hotKeys": [list(e) for e in self._hot],
                 "keyGroupLoad": dict(self._group_load),
                 "keyGroupStateBytes": dict(self._group_state_bytes),
+                "perDevice": [dict(e) for e in self._per_device],
+                "meshLoadSkew": self._mesh_load_skew,
             }

@@ -1,0 +1,185 @@
+"""The per-step superscan body: ingest/fire/purge over the [K, S] slice ring.
+
+Shared by the single-chip fused superscan (runtime/fused_window_pipeline),
+the chained whole-graph-fusion program, and the shard_map sharded superscan
+(parallel/sharded_superscan — each shard runs this on its local key range).
+It lives in `ops` because it is a pure device-kernel builder over a
+DeviceAggregator: no runtime state, no host planning — exactly the layer
+matmul_hist and pallas_superscan occupy, and the reason `parallel/` can
+compose with it without importing the runtime (ARCH001).
+"""
+
+from __future__ import annotations
+
+
+def default_ingest() -> str:
+    """THE backend-dependent ingest choice, single-sourced: programs built
+    fresh per job (the chained single-chip superscan and both sharded
+    builds) use direct scatter-adds off-TPU — the [K, S] ring is
+    cache-resident on a scalar core and the dense one-hot MXU contraction
+    does K*NSB work per record there. On TPU the matmul-histogram form
+    wins. (The classic single-chip `_build_superscan` keeps its historical
+    explicit 'matmul' on every backend for executable-cache and bench
+    continuity.) Identical math either way — both are pure adds into the
+    same cells."""
+    import jax
+
+    return "matmul" if jax.default_backend() == "tpu" else "scatter"
+
+
+def make_superscan_step(agg, K, S, NSB, F, R, SPW, chunk, exact,
+                        ingest: str = "matmul", phase_counters: bool = False):
+    """The per-step ingest/fire/purge body, shared by the single-chip
+    superscan and the shard_map sharded superscan (each shard runs this on
+    its local key range).
+
+    `ingest` selects how add-combining fields land in the [K, S] ring:
+    'matmul' (default, unchanged) re-expresses the scatter as MXU one-hot
+    histograms — the TPU form; 'scatter' uses direct scatter-adds, which is
+    what wins on CPU backends (the [K, S] ring is cache-resident and the
+    dense one-hot contraction does K*NSB work per record on a scalar
+    core). Identical math either way: both are pure adds into the same
+    cells, counts exact in int32.
+
+    `phase_counters` (device-plane observability) threads an int32[3]
+    counter through the carry — [records ingested, fire slots executed,
+    steps that purged] — so a dispatch's device time can be attributed to
+    the ingest/fire/purge phases without any extra host sync (the counts
+    ride the same async readback as the fire rows). The carry becomes a
+    5-tuple; callers opt in, so the default executable shape is unchanged."""
+    import jax
+    import jax.numpy as jnp
+
+    from flink_tpu.ops import matmul_hist
+    from flink_tpu.ops.aggregators import VALUE
+
+    vfields = [
+        (f.name, jnp.dtype(f.dtype), f.scatter, f.identity)
+        for f in agg.fields
+        if f.source == VALUE
+    ]
+    nseg = K * NSB
+
+    def step(carry, args):
+        if phase_counters:
+            # `phase_c`, not `pc`: the ingest paths below use `pc` for
+            # their partial-count histograms
+            state, count, outs, count_out, phase_c = carry
+        else:
+            state, count, outs, count_out = carry
+        idx, vals, smin_pos, fire_pos, fire_valid, fire_row, purge_mask = args
+
+        # ingest: MXU histograms over (key, rel-slice) segments for
+        # add-combining fields (or direct scatter-adds on CPU backends);
+        # min/max fields always scatter-combine (no matmul form exists for
+        # order statistics — the scatter unit is the cost of supporting
+        # them on the fused path at all)
+        kid = idx // NSB
+        srel = idx % NSB
+        col = (smin_pos + srel) % S
+        safe_kid = jnp.where(idx >= 0, kid, K)  # OOB rows drop
+        cols = (smin_pos + jnp.arange(NSB, dtype=jnp.int32)) % S
+        # CPU add-ingest form: XLA lowers a FLAT 1-D index scatter ~2x
+        # faster than the 2-D (kid, col) scatter, so adds go through a
+        # [K*NSB] staging histogram folded densely into the ring columns —
+        # gated on the dense fold (nseg per step) staying small next to
+        # the batch, so huge-K geometries keep the direct scatter
+        flat_adds = ingest != "matmul" and nseg <= 16 * idx.shape[0]
+        if ingest == "matmul":
+            pc = matmul_hist.count_hist(idx, nseg, chunk=chunk).reshape(K, NSB)
+            count = count.at[:, cols].add(pc)
+        elif flat_adds:
+            # dead rows carry idx -1, which jax would WRAP to the last
+            # segment (numpy negative indexing; mode="drop" only drops
+            # past-the-end) — remap them to nseg so the drop is real
+            safe_idx = jnp.where(idx >= 0, idx, nseg)
+            pc = jnp.zeros((nseg,), jnp.int32).at[safe_idx].add(
+                jnp.int32(1), mode="drop").reshape(K, NSB)
+            count = count.at[:, cols].add(pc)
+        else:
+            count = count.at[safe_kid, col].add(jnp.int32(1), mode="drop")
+        new_state = {}
+        for name, dt, scatter, ident in vfields:
+            if scatter == "add":
+                if ingest == "matmul":
+                    ph = matmul_hist.weighted_hist(
+                        idx, vals, nseg, chunk=chunk, exact=exact
+                    ).reshape(K, NSB)
+                    new_state[name] = state[name].at[:, cols].add(ph.astype(dt))
+                elif flat_adds:
+                    ph = jnp.zeros((nseg,), dt).at[
+                        jnp.where(idx >= 0, idx, nseg)].add(
+                        vals.astype(dt), mode="drop").reshape(K, NSB)
+                    new_state[name] = state[name].at[:, cols].add(ph)
+                else:
+                    new_state[name] = state[name].at[safe_kid, col].add(
+                        vals.astype(dt), mode="drop")
+            else:
+                upd = getattr(state[name].at[safe_kid, col], scatter)
+                new_state[name] = upd(vals.astype(dt), mode="drop")
+        state = new_state if vfields else state
+
+        # fire: combine the window's slice columns, write compact rows.
+        # The WHOLE fire body sits under the cond, gathers included: most
+        # steps fire nothing, and the K*SPW column gather+combine per fire
+        # slot is the dominant per-step fixed cost when computed eagerly
+        # (at K=8192, SPW=10, F=2 that is 20x the ingest work of an 8k
+        # batch) — identical results, the eager crow was discarded unless
+        # fire_valid was set anyway
+        _COMBINE = {"add": lambda a: a.sum(axis=1),
+                    "min": lambda a: a.min(axis=1),
+                    "max": lambda a: a.max(axis=1)}
+
+        def write_fire(f, bufs):
+            pos = (fire_pos[f] + jnp.arange(SPW, dtype=jnp.int32)) % S
+            row = jnp.clip(fire_row[f], 0, R - 1)
+
+            def do_fire(b):
+                outs, count_out = b
+                crow = count[:, pos].sum(axis=1)
+                count_out = jax.lax.dynamic_update_index_in_dim(
+                    count_out, crow, row, 0)
+                new_outs = {}
+                for name, _dt, scatter, _ident in vfields:
+                    vrow = _COMBINE[scatter](state[name][:, pos])
+                    new_outs[name] = jax.lax.dynamic_update_index_in_dim(
+                        outs[name], vrow, row, 0)
+                return (new_outs if vfields else outs), count_out
+
+            return jax.lax.cond(fire_valid[f] > 0, do_fire, lambda b: b, bufs)
+
+        bufs = (outs, count_out)
+        for f in range(F):
+            bufs = write_fire(f, bufs)
+        outs, count_out = bufs
+
+        # purge expired ring columns (reset to the field's identity); under
+        # a cond for the same reason — the S*K multiply/where is pure
+        # identity on the all-ones masks most steps carry
+        def do_purge(sc):
+            state, count = sc
+            count = count * purge_mask[None, :]
+            if vfields:
+                state = {
+                    name: jnp.where(
+                        purge_mask[None, :] > 0,
+                        state[name],
+                        jnp.asarray(ident, dt),
+                    )
+                    for name, dt, _scatter, ident in vfields
+                }
+            return state, count
+
+        purged = jnp.any(purge_mask == 0)
+        state, count = jax.lax.cond(
+            purged, do_purge, lambda sc: sc, (state, count))
+        if phase_counters:
+            phase_c = phase_c + jnp.stack([
+                jnp.sum((idx >= 0).astype(jnp.int32)),
+                jnp.sum(fire_valid).astype(jnp.int32),
+                purged.astype(jnp.int32),
+            ])
+            return (state, count, outs, count_out, phase_c), None
+        return (state, count, outs, count_out), None
+
+    return step

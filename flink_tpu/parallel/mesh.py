@@ -32,6 +32,21 @@ def build_mesh(num_shards: Optional[int] = None, axis_name: str = SHARD_AXIS) ->
     return Mesh(np.array(devices[:n]), (axis_name,))
 
 
+def usable_mesh_size(want: int, available: int, key_capacity: int) -> int:
+    """THE mesh-size clamp, single-sourced: `want` devices (0 = all
+    available) clamped to the visible device count, then rounded DOWN to
+    the largest divisor of `key_capacity` so contiguous key ranges divide
+    evenly across shards. 1 means no multi-device mesh applies. Every
+    consumer of the clamp (runner construction, the autoscaler's
+    reachability pre-check, the chaos scenario's expected-size math, the
+    bench) must call this — a privately re-derived copy can silently
+    diverge and turn accepted rescale targets into no-op churn."""
+    n = max(1, min(int(want) or int(available), int(available)))
+    while n > 1 and key_capacity % n != 0:
+        n -= 1
+    return n
+
+
 def shard_ranges(mesh: Mesh, max_parallelism: int, axis_name: str = SHARD_AXIS) -> List[KeyGroupRange]:
     """Key-group range per shard (the reference's operator-index ranges)."""
     n = mesh.shape[axis_name]

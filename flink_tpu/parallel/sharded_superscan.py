@@ -7,8 +7,8 @@ across a mesh but dispatches per step. This module composes the two: ONE
 to their key-range owners with a `lax.all_to_all` over ICI — the in-scan
 analogue of the reference's network shuffle (KeyGroupStreamPartitioner →
 RecordWriter.emit:105) — and (b) runs the shared superscan ingest/fire/purge
-body (`fused_window_pipeline.make_superscan_step`) on the shard's local key
-range. Data parallelism over sources, key parallelism over state, zero host
+body (`ops/superscan.make_superscan_step`) on the shard's local key range.
+Data parallelism over sources, key parallelism over state, zero host
 involvement between steps.
 
 Keys partition into contiguous ranges: shard = kid // K_local, and since the
@@ -19,20 +19,35 @@ INVALID, so the all-to-all needs no data-dependent compaction; each shard
 then ingests n*B lanes per step (mostly INVALID, dropped for free by the
 one-hot/scatter semantics).
 
+With a `TracedPrologue` (whole-graph fusion, PR 7) the pipeline additionally
+runs the user's traceable map/filter/map_ts chain + key/value extraction
+INSIDE the per-shard program, BEFORE the shuffle: each device transforms its
+slice of the raw source columns, bins the surviving records by owning
+key-group, and one all-to-all replaces what used to be a host dataplane hop.
+This is what lets `DeviceChainRunner` point a fused user job — not just the
+bench kernel — at the mesh.
+
 Fire/purge control is replicated (all shards fire the same window rows);
 each shard writes its own [R, K_local] slab and the host concatenates along
 the key axis at resolve. Snapshots are canonical [K, S] global arrays,
 interchangeable with single-chip `FusedWindowPipeline` snapshots — which
 makes n -> m shard rescaling a restore.
 
-Validated on the virtual 8-device CPU mesh (tests/test_sharded_superscan.py)
-and dry-run by the driver via __graft_entry__.dryrun_multichip; on real
-hardware the same program rides ICI.
+Layering: `parallel` sits below the runtime (ARCH001 — it may import
+core/ops/state/config, never runtime/api/table). The single-chip planner it
+drives (`FusedWindowPipeline`, plan-only: pure host cursor state, no device
+arrays) is imported lazily at construction, the sanctioned function-scoped
+escape hatch.
+
+Validated on the virtual 8-device CPU mesh (tests/test_sharded_superscan.py,
+tests/test_multichip_runtime.py) and dry-run by the driver via
+__graft_entry__.dryrun_multichip; on real hardware the same program rides
+ICI.
 """
 
 from __future__ import annotations
 
-from typing import Any, Dict, List, Optional, Sequence, Tuple
+from typing import Any, Dict, Optional, Sequence
 
 import numpy as np
 import jax
@@ -41,33 +56,39 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from flink_tpu.utils.jax_compat import shard_map
 
-from flink_tpu.api.windowing.assigners import WindowAssigner
-from flink_tpu.ops.aggregators import VALUE, resolve
-from flink_tpu.runtime.fused_window_pipeline import (
-    DeferredEmissions,
-    FusedWindowPipeline,
-    make_superscan_step,
-)
+from flink_tpu.ops.aggregators import VALUE
+from flink_tpu.ops.superscan import default_ingest, make_superscan_step
 
 
 class ShardedFusedPipeline:
-    """Keyed window aggregation over a device mesh, T steps per dispatch."""
+    """Keyed window aggregation over a device mesh, T steps per dispatch.
+
+    Presents the same pipeline surface `FusedWindowOperator` drives on one
+    chip (process_superbatch / process_superbatch_raw / ensure_key_capacity
+    / snapshot / restore plus the planner-geometry delegates), so the
+    operator adapter — and through it DeviceChainRunner — is mesh-agnostic.
+    """
 
     def __init__(
         self,
         mesh: Mesh,
-        assigner: WindowAssigner,
+        assigner,
         aggregate,
         *,
         key_capacity: int,
-        num_slices: int = 32,
+        num_slices: Optional[int] = None,
         nsb: int = 4,
         fires_per_step: int = 2,
         out_rows: int = 64,
         chunk: int = 1024,
         exact_sums: bool = True,
         axis: str = "shards",
+        prologue=None,
     ):
+        # runtime import is function-scoped: parallel/ sits below runtime in
+        # the layer DAG (ARCH001), and the planner is pure host state
+        from flink_tpu.runtime.fused_window_pipeline import FusedWindowPipeline
+
         self.mesh = mesh
         self.axis = axis
         self.n = mesh.shape[axis]
@@ -83,8 +104,10 @@ class ShardedFusedPipeline:
             key_capacity=key_capacity, num_slices=num_slices, nsb=nsb,
             fires_per_step=fires_per_step, out_rows=out_rows, chunk=chunk,
             exact_sums=exact_sums, backend="xla", plan_only=True,
+            prologue=prologue,
         )
         self.agg = self._planner.agg
+        self.prologue = prologue
         self.K = key_capacity
         self.K_local = key_capacity // self.n
         self.S = self._planner.S
@@ -96,13 +119,33 @@ class ShardedFusedPipeline:
         self._value_fields = [f for f in self.agg.fields if f.source == VALUE]
         self._needs_vals = bool(self._value_fields)
         self._init_state()
-        self._fn_cache: Dict[Tuple, Any] = {}
+        self._fn_cache: Dict[tuple, Any] = {}
         # device-plane observability: an attached CompileTracker wraps the
         # sharded dispatch; phase counters thread through the shared
         # superscan step body (summed over shards at resolve, accumulated
         # into the planner's phase_totals)
         self.compile_tracker = None
         self.phase_counters = False
+
+    # ------------------------------------------------------------------
+    # planner-geometry delegation: StepNormalizer, DeferredEmissions, and
+    # the operator adapter read the frontier/geometry surface of a
+    # single-chip pipeline (g/sl/spw/offset/size_ms/slide_ms, the
+    # watermark/fire/purge cursors, _j_*/_slice_of/_window_of,
+    # phase_totals, num_late_records_dropped). On the mesh that state
+    # lives in the plan-only planner — one source of truth for the window
+    # math — so every attribute this class does not define itself
+    # forwards there wholesale: a per-member delegate list would drift
+    # (a forgotten entry surfaces only as a mesh-path AttributeError).
+    # ------------------------------------------------------------------
+    @property
+    def planner(self):
+        return self._planner
+
+    def __getattr__(self, name):
+        if name == "_planner":   # guard: no recursion before __init__ set it
+            raise AttributeError(name)
+        return getattr(self._planner, name)
 
     # ------------------------------------------------------------------
     def attach_device_stats(self, tracker, phase_counters: bool = True) -> None:
@@ -123,6 +166,16 @@ class ShardedFusedPipeline:
         if count is None:
             return None
         return count.reshape(self.K, self.S).sum(axis=1)
+
+    def per_device_key_loads(self):
+        """Per-device local per-key record counts ([n, K_local]): the
+        input of the per-device skew fold — an even GLOBAL histogram can
+        still leave one device owning every hot key-group, and the mesh
+        telemetry must see that device, not device 0's view."""
+        count = getattr(self, "_count", None)
+        if count is None:
+            return None
+        return count.sum(axis=2)
 
     def key_stats_ready(self) -> bool:
         return self._planner.max_seen_slice is not None
@@ -149,10 +202,47 @@ class ShardedFusedPipeline:
     def num_late_records_dropped(self) -> int:
         return self._planner.num_late_records_dropped
 
+    def ensure_key_capacity(self, required: int) -> None:
+        """Grow the GLOBAL key dimension when the host dictionary outgrows
+        K (classic keyed path only — traced chains fix capacity up front).
+        Growth is to the next power of two rounded up to a multiple of the
+        mesh size, so the contiguous key ranges keep dividing evenly; the
+        canonical [K, S] grow-then-reshard costs one host round trip and
+        one recompile, amortized by doubling exactly like the single-chip
+        pipeline."""
+        if required <= self.K:
+            return
+        new_k = 1 << (required - 1).bit_length()
+        if new_k % self.n != 0:
+            new_k = -(-new_k // self.n) * self.n
+        n, S = self.n, self.S
+        pad = new_k - self.K
+        count = np.asarray(self._count).reshape(self.K, S)
+        count = np.concatenate(
+            [count, np.zeros((pad, S), np.int32)])
+        state = {}
+        for f in self._value_fields:
+            arr = np.asarray(self._state[f.name]).reshape(self.K, S)
+            state[f.name] = np.concatenate(
+                [arr, np.full((pad, S), f.identity, np.dtype(f.dtype))])
+        self.K = new_k
+        self.K_local = new_k // n
+        self._planner.K = new_k
+        self._count = jax.device_put(
+            jnp.asarray(count.reshape(n, self.K_local, S)),
+            self._shard_spec(None, None))
+        self._state = {
+            k: jax.device_put(
+                jnp.asarray(v.reshape(n, self.K_local, S)),
+                self._shard_spec(None, None))
+            for k, v in state.items()
+        }
+        self._fn_cache.clear()   # executables captured the old K_local
+
     # ------------------------------------------------------------------
     def _build(self, T: int, B: int):
         phases = self.phase_counters
-        key = (T, B, phases)
+        key = ("classic", T, B, phases)
         if key in self._fn_cache:
             return self._fn_cache[key]
 
@@ -165,7 +255,7 @@ class ShardedFusedPipeline:
             chunk //= 2
         step = make_superscan_step(
             self.agg, Kl, S, NSB, self.F, R, self._planner.spw, chunk,
-            self.exact, phase_counters=phases,
+            self.exact, ingest=default_ingest(), phase_counters=phases,
         )
         nf = len(self._value_fields)
 
@@ -292,6 +382,8 @@ class ShardedFusedPipeline:
 
     def process_superbatch(self, batches, watermarks, *, staged=None,
                            defer: bool = False):
+        from flink_tpu.runtime.fused_window_pipeline import DeferredEmissions
+
         if staged is None:
             staged = self.stage_superbatch(batches, watermarks)
         idx_d, vals_d, plan = staged
@@ -330,6 +422,282 @@ class ShardedFusedPipeline:
         return deferred if defer else deferred.resolve()
 
     # ------------------------------------------------------------------
+    # traced-chain path (whole-graph fusion over the mesh): every shard
+    # runs the user's traceable chain + key extraction on ITS slice of the
+    # raw source columns, then ONE all-to-all per step routes each record
+    # to its key-range owner — the keyBy shuffle as an ICI collective
+    # inside the compiled scan, replacing the host dataplane hop
+    # ------------------------------------------------------------------
+    def _build_raw(self, T: int, B: int):
+        phases = self.phase_counters
+        key = ("raw", T, B, phases)
+        if key in self._fn_cache:
+            return self._fn_cache[key]
+
+        n, Kl, K, S, axis = self.n, self.K_local, self.K, self.S, self.axis
+        NSB, R = self.NSB, self.R
+        lanes = n * B   # post-shuffle ingest width per shard
+        chunk = self.chunk
+        while lanes % chunk != 0:
+            chunk //= 2
+        step = make_superscan_step(
+            self.agg, Kl, S, NSB, self.F, R, self._planner.spw, chunk,
+            self.exact, ingest=default_ingest(), phase_counters=phases,
+        )
+        nf = len(self._value_fields)
+        pro = self.prologue
+        needs_ts = pro.needs_ts
+        transforms = tuple(pro.transforms)
+        key_fn, value_fn = pro.key_fn, pro.value_fn
+
+        def per_shard(count, state_t, raw, srel, *rest):
+            count = count[0]
+            raw = raw[0]
+            srel = srel[0]
+            if needs_ts:
+                ts, rest = rest[0][0], rest[1:]
+            else:
+                ts = None
+            smin_pos, fire_pos, fire_valid, fire_row, purge_mask = rest
+            state = {
+                f.name: state_t[i][0]
+                for i, f in enumerate(self._value_fields)
+            }
+            base = jax.lax.axis_index(axis).astype(jnp.int32) * Kl
+
+            def routed_step(carry, args):
+                inner, key_bounds = carry
+                if needs_ts:
+                    raw_row, srel_row, ts_row = args[0], args[1], args[2]
+                    plan_row = args[3:]
+                else:
+                    raw_row, srel_row = args[0], args[1]
+                    ts_row = None
+                    plan_row = args[2:]
+                # the traced chain runs on THIS shard's raw lanes, before
+                # any routing: filter/projection/keying happen where the
+                # data landed, only survivors cross the interconnect
+                col = raw_row
+                mask = srel_row >= 0
+                for kind, fn in transforms:
+                    if kind == "map":
+                        col = fn(col)
+                    elif kind == "map_ts":
+                        col = fn(col, ts_row)
+                    else:  # filter
+                        mask = mask & jnp.asarray(fn(col)).astype(bool)
+                keys = jnp.asarray(key_fn(col)).astype(jnp.int32)
+                live = mask & (keys >= 0) & (keys < K)
+                idx = jnp.where(live, keys * NSB + srel_row,
+                                jnp.int32(-1)).astype(jnp.int32)
+                # key range observed over every SURVIVING record (pre range
+                # clamp), exactly like the single-chip chained program: an
+                # out-of-range key is a hard error at resolve, never a
+                # silent drop or a silent alias of another shard's row
+                key_bounds = jnp.stack([
+                    jnp.maximum(key_bounds[0],
+                                jnp.max(jnp.where(mask, keys, jnp.int32(-1)))),
+                    jnp.minimum(key_bounds[1],
+                                jnp.min(jnp.where(mask, keys, jnp.int32(0)))),
+                ])
+                # the keyBy exchange: bin by owning key range, one
+                # all-to-all over the mesh interconnect per step
+                dst = jnp.where(live, keys // Kl, -1)
+                rows = jnp.arange(n, dtype=jnp.int32)[:, None]
+                route = rows == dst[None, :]                     # [n, B]
+                send_idx = jnp.where(route, idx[None, :], -1)
+                recv_idx = jax.lax.all_to_all(
+                    send_idx, axis, split_axis=0, concat_axis=0, tiled=False
+                ).reshape(-1)                                    # [n*B]
+                local_idx = jnp.where(
+                    recv_idx >= 0, recv_idx - base * NSB, -1)
+                if nf:
+                    vcol = value_fn(col) if value_fn is not None else col
+                    # dead/pad rows hold uninitialized staging bytes; zero
+                    # them BEFORE the shuffle so 0 * NaN can never poison
+                    # an owner shard's sums
+                    vals = jnp.where(
+                        live, jnp.asarray(vcol).astype(jnp.float32), 0.0)
+                    send_v = jnp.where(route, vals[None, :], 0.0)
+                    recv_v = jax.lax.all_to_all(
+                        send_v, axis, split_axis=0, concat_axis=0,
+                        tiled=False,
+                    ).reshape(-1)
+                else:
+                    recv_v = jnp.zeros((1,), jnp.float32)
+                inner, _ = step(inner, (local_idx, recv_v) + plan_row)
+                return (inner, key_bounds), None
+
+            outs0 = {
+                f.name: jnp.zeros((R, Kl), jnp.dtype(f.dtype))
+                for f in self._value_fields
+            }
+            count_out0 = jnp.zeros((R, Kl), jnp.int32)
+            inner0 = (state, count, outs0, count_out0)
+            if phases:
+                inner0 = inner0 + (jnp.zeros((3,), jnp.int32),)
+            kb0 = jnp.asarray([-1, 0], jnp.int32)
+            xs = (raw, srel)
+            if needs_ts:
+                xs = xs + (ts,)
+            xs = xs + (smin_pos, fire_pos, fire_valid, fire_row, purge_mask)
+            (inner, key_bounds), _ = jax.lax.scan(
+                routed_step, (inner0, kb0), xs)
+            if phases:
+                state, count, outs, count_out, pc = inner
+            else:
+                state, count, outs, count_out = inner
+            names = [f.name for f in self._value_fields]
+            out = (
+                count[None], tuple(state[nm][None] for nm in names),
+                count_out[None], tuple(outs[nm][None] for nm in names),
+                key_bounds[None],                         # [1, 2] per shard
+            )
+            if phases:
+                out = out + (pc[None],)
+            return out
+
+        raw_ndim = 3 + len(self._planner._raw_shape or ())
+        out_specs = (
+            P(axis, None, None),
+            (P(axis, None, None),) * nf,
+            P(axis, None, None),
+            (P(axis, None, None),) * nf,
+            P(axis, None),                                # key bounds [n,2]
+        )
+        if phases:
+            out_specs = out_specs + (P(axis, None),)
+        in_specs = (
+            P(axis, None, None),                          # count [n,Kl,S]
+            (P(axis, None, None),) * nf,                  # field states
+            P(axis, *([None] * (raw_ndim - 1))),          # raw [n,T,Bs,...]
+            P(axis, None, None),                          # srel [n,T,Bs]
+        )
+        if needs_ts:
+            in_specs = in_specs + (P(axis, None, None),)  # ts [n,T,Bs]
+        in_specs = in_specs + (
+            P(None), P(None, None), P(None, None), P(None, None),
+            P(None, None),                                # plan (replicated)
+        )
+        sharded = shard_map(
+            per_shard, mesh=self.mesh,
+            in_specs=in_specs, out_specs=out_specs, check_vma=False,
+        )
+
+        def run(*args):
+            out = sharded(*args)
+            if phases:
+                count, states, count_out, outs, kb, pc = out
+            else:
+                count, states, count_out, outs, kb = out
+                pc = None
+            # global key bounds: worst over shards (each shard saw only
+            # its own pre-shuffle lanes)
+            kb_g = jnp.stack([kb[:, 0].max(), kb[:, 1].min()])
+            if phases:
+                return count, states, count_out, outs, kb_g, pc
+            return count, states, count_out, outs, kb_g
+
+        fn = jax.jit(run)
+        self._fn_cache[key] = fn
+        return fn
+
+    def stage_superbatch_raw(self, steps, watermarks):
+        """Host planning + mesh staging for one traced-chain dispatch:
+        the planner fills the same flat [T, B] staging buffers the
+        single-chip path uses, then lanes are dealt contiguously across
+        the n source shards (any split works — the in-scan all-to-all
+        re-routes every record to its key owner)."""
+        raw_h, srel_h, ts_h, plan_np, fires = self._planner._stage_raw_host(
+            steps, watermarks)
+        T, B = srel_h.shape
+        n = self.n
+        Bs = -(-B // n)
+        if Bs * n != B:
+            pad = Bs * n - B
+            srel_h = np.concatenate(
+                [srel_h, np.full((T, pad), -1, np.int32)], axis=1)
+            raw_h = np.concatenate(
+                [raw_h, np.zeros((T, pad) + raw_h.shape[2:], raw_h.dtype)],
+                axis=1)
+            if ts_h is not None:
+                ts_h = np.concatenate(
+                    [ts_h, np.zeros((T, pad), ts_h.dtype)], axis=1)
+        trail = raw_h.shape[2:]
+        raw_d = jax.device_put(
+            jnp.asarray(
+                raw_h.reshape((T, n, Bs) + trail)
+                .transpose((1, 0, 2) + tuple(range(3, 3 + len(trail))))),
+            self._shard_spec(*([None] * (2 + len(trail)))))
+        srel_d = jax.device_put(
+            jnp.asarray(srel_h.reshape(T, n, Bs).transpose(1, 0, 2)),
+            self._shard_spec(None, None))
+        ts_d = None
+        if ts_h is not None:
+            ts_d = jax.device_put(
+                jnp.asarray(ts_h.reshape(T, n, Bs).transpose(1, 0, 2)),
+                self._shard_spec(None, None))
+        plan = tuple(jax.device_put(a) for a in plan_np) + (fires,)
+        return raw_d, srel_d, ts_d, plan
+
+    def process_superbatch_raw(self, steps, watermarks, *,
+                               staged: Optional[tuple] = None,
+                               defer: bool = False):
+        """Run T traced-chain steps in one sharded dispatch (the
+        prologue-bearing sibling of process_superbatch; same defer
+        contract as the single-chip pipeline)."""
+        from flink_tpu.runtime.fused_window_pipeline import DeferredEmissions
+
+        if staged is None and all(len(step[1]) == 0 for step in steps):
+            # watermark-only dispatch: with zero rows the prologue is
+            # irrelevant — run the classic fire/purge program over the
+            # same sharded state (mirrors the single-chip fallback, and
+            # covers restore-then-watermark before geometry is known)
+            empty = [(np.empty(0, np.int32), None, np.empty(0, np.int64))
+                     for _ in steps]
+            return self.process_superbatch(empty, watermarks, defer=defer)
+        if staged is None:
+            staged = self.stage_superbatch_raw(steps, watermarks)
+        raw_d, srel_d, ts_d, plan = staged
+        smin_pos, fire_pos, fire_valid, fire_row, purge_mask, fires = plan
+        T = int(srel_d.shape[1])
+        B = int(srel_d.shape[2])
+        run = self._build_raw(T, B)
+        names = [f.name for f in self._value_fields]
+        args = (self._count, tuple(self._state[nm] for nm in names),
+                raw_d, srel_d)
+        if ts_d is not None:
+            args = args + (ts_d,)
+        args = args + (smin_pos, fire_pos, fire_valid, fire_row, purge_mask)
+        if self.compile_tracker is not None:
+            out = self.compile_tracker.call(
+                "sharded_chained_superscan", run, args,
+                {"T": T, "B": B, "K": self.K, "S": self.S, "n": self.n,
+                 "raw_dtype": str(raw_d.dtype),
+                 "dtype": "+".join(str(np.dtype(f.dtype))
+                                   for f in self._value_fields) or "count"})
+        else:
+            out = run(*args)
+        pc_total = None
+        if self.phase_counters:
+            count, states, count_out, field_outs, kb, pc = out
+            pc_total = pc.sum(axis=0)
+        else:
+            count, states, count_out, field_outs, kb = out
+        self._count = count
+        self._state = dict(zip(names, states))
+        count_rows = jnp.transpose(count_out, (1, 0, 2)).reshape(self.R, self.K)
+        out_rows = {
+            nm: jnp.transpose(o, (1, 0, 2)).reshape(self.R, self.K)
+            for nm, o in zip(names, field_outs)
+        }
+        deferred = DeferredEmissions(self._planner, fires, count_rows,
+                                     out_rows, key_bounds=kb,
+                                     key_capacity=self.K,
+                                     phase_counts=pc_total)
+        return deferred if defer else deferred.resolve()
+
+    # ------------------------------------------------------------------
     def snapshot(self) -> dict:
         """Canonical [K, S] global arrays — interchangeable with single-chip
         FusedWindowPipeline snapshots (restore re-shards, so n -> m shard
@@ -350,18 +718,43 @@ class ShardedFusedPipeline:
         return snap
 
     def restore(self, snap: dict) -> None:
-        if snap["count"].shape[0] != self.K:
-            raise ValueError(
-                f"snapshot key capacity {snap['count'].shape[0]} != {self.K}"
-            )
+        count = snap["count"]
+        state = dict(snap["state"])
+        snap_k = int(count.shape[0])
+        if snap_k % self.n != 0:
+            # a grown snapshot K (classic keyed path: pow2 rounded to the
+            # OLD mesh's multiple) need not divide the NEW mesh — e.g. a
+            # K=1024 checkpoint rescaled onto 6 devices. Identity-pad up
+            # to the next multiple: rows beyond the key dictionary are
+            # never addressed (dense ids < len(keydict) <= snap_k), so
+            # padding is exact — and failing here instead would wedge the
+            # job in a restart loop against the same checkpoint
+            pad = -(-snap_k // self.n) * self.n - snap_k
+            count = np.concatenate(
+                [count, np.zeros((pad, self.S), count.dtype)])
+            idents = {f.name: (f.identity, np.dtype(f.dtype))
+                      for f in self._value_fields}
+            state = {
+                k: np.concatenate(
+                    [v, np.full((pad, self.S), *idents[k])])
+                for k, v in state.items()
+            }
+            snap_k += pad
+        if snap_k != self.K:
+            # capacity may have grown pre-snapshot (classic keyed path):
+            # adopt the snapshot's K, exactly like the single-chip restore
+            self.K = snap_k
+            self.K_local = snap_k // self.n
+            self._planner.K = snap_k
+            self._fn_cache.clear()
         n, Kl, S = self.n, self.K_local, self.S
         self._count = jax.device_put(
-            jnp.asarray(snap["count"].reshape(n, Kl, S)),
+            jnp.asarray(count.reshape(n, Kl, S)),
             self._shard_spec(None, None))
         self._state = {
             k: jax.device_put(
                 jnp.asarray(v.reshape(n, Kl, S)), self._shard_spec(None, None))
-            for k, v in snap["state"].items()
+            for k, v in state.items()
         }
         self._planner.watermark = snap["watermark"]
         self._planner.fire_cursor = snap["fire_cursor"]

@@ -276,12 +276,29 @@ def _shard_combine(key: str) -> str:
     leaf = key.rsplit(".", 1)[-1]
     if leaf.startswith("current"):
         return "min"
-    if leaf in ("keySkew", "recompileStorm", "hotKeyLoad"):
+    if leaf in ("keySkew", "recompileStorm", "hotKeyLoad", "meshLoadSkew",
+                "meshDevices") or leaf in _PER_DEVICE_MAX_GAUGES:
+        # meshDevices included: each shard reports ITS mesh size — summing
+        # across shards would misreport a plain 2-shard job as a 2-device
+        # mesh (the job-level view is the largest mesh any shard runs)
         return "max"
     if "Ratio" in leaf or leaf.endswith("TimeMsPerSecond") \
             or leaf.endswith("UtilizationPct") or "inPoolUsage" in key:
         return "mean"
     return "sum"
+
+
+#: gauges shipped as {device_index: value} maps by mesh shards
+#: (metrics/key_stats.py): each is a MAX-rule family, and the fold must
+#: take the max across the shard's OWN mesh devices FIRST — the generic
+#: dict branch below merges per stat key, which for a per-device map means
+#: whichever device index collides across shards wins and the job-level
+#: scalar silently becomes device 0's view
+#: exactly the maps metrics/key_stats.py registers on mesh operators —
+#: keep the two lists in lockstep (compile tracking is per-process SPMD,
+#: one program for the whole mesh, so it has no per-device form)
+_PER_DEVICE_MAX_GAUGES = ("keySkewPerDevice", "hotKeyLoadPerDevice",
+                          "meshDeviceLoad")
 
 
 def aggregate_shard_metrics(per_shard: Dict[int, dict]) -> dict:
@@ -293,6 +310,18 @@ def aggregate_shard_metrics(per_shard: Dict[int, dict]) -> dict:
     agg: dict = {}
     for snap in per_shard.values():
         for key, val in snap.items():
+            if (isinstance(val, dict)
+                    and key.rsplit(".", 1)[-1] in _PER_DEVICE_MAX_GAUGES):
+                # per-mesh-device map: fold across THIS shard's devices
+                # first (MAX — the job's view of a skew/storm/hot-key
+                # family is its worst device, and device indexes repeat
+                # across shards so elementwise merging would be
+                # meaningless), then the scalar MAX rule across shards
+                devs = [v for v in val.values()
+                        if isinstance(v, (int, float))]
+                if devs:
+                    scalars.setdefault(key, []).append(float(max(devs)))
+                continue
             if isinstance(val, dict):
                 cur = agg.setdefault(key, {})
                 for stat, v in val.items():
@@ -925,12 +954,14 @@ class JobManagerEndpoint(RpcEndpoint):
             if ".device." in k or k.rsplit(".", 1)[-1] in (
                 "keySkew", "activeKeys", "hotKeyLoad", "keyGroupLoad",
                 "keyGroupStateBytes", "hbmUtilizationPct",
-                "flopsUtilizationPct")
+                "flopsUtilizationPct", "meshLoadSkew", "meshDevices")
+            or k.rsplit(".", 1)[-1] in _PER_DEVICE_MAX_GAUGES
         }
         payload["metrics"] = device_keys
         payload["per_shard"] = {
             s: {k: v for k, v in snap.items()
-                if ".device." in k or "keySkew" in k}
+                if ".device." in k or "keySkew" in k or "meshLoadSkew" in k
+                or k.rsplit(".", 1)[-1] in _PER_DEVICE_MAX_GAUGES}
             for s, snap in per_shard.items()
         }
         payload["enabled"] = bool(device_keys or events)
@@ -1916,6 +1947,13 @@ class _ShardTask:
                     row_bytes_fn=getattr(op, "state_row_bytes", None),
                     ready_fn=getattr(op, "key_stats_ready", None),
                     interval_ms=_opt(O.DEVICE_KEY_STATS_INTERVAL_MS),
+                    # mesh operators expose per-device local loads; the
+                    # shipped {device: value} maps fold MAX across this
+                    # shard's devices in aggregate_shard_metrics
+                    mesh_loads_fn=(
+                        getattr(op, "per_device_key_loads", None)
+                        if getattr(op, "mesh_devices", lambda: 1)() > 1
+                        else None),
                 )
                 key_stats.register(op_group)
                 # the job-level gauge the autoscaler's signal extractor

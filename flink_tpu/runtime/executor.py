@@ -32,6 +32,7 @@ from flink_tpu.config import (
     Configuration,
     ExecutionOptions,
     ObservabilityOptions,
+    ParallelOptions,
     PipelineOptions,
 )
 from flink_tpu.core.time import MAX_WATERMARK, MIN_TIMESTAMP, MIN_WATERMARK
@@ -196,6 +197,54 @@ def _fused_chunk(batch_size: int) -> int:
     window runner and the fused device chain, so the two paths can never
     silently drift to different dispatch geometries."""
     return min(4096, max(256, 1 << (max(batch_size, 1) - 1).bit_length()))
+
+
+def _mesh_for_config(config: Configuration, key_capacity: int):
+    """The job's device mesh when multichip execution applies, else None.
+
+    parallel.mesh.enabled makes the mesh a slot resource of this process:
+    the requested device count (0 = all visible) is clamped to what the
+    backend exposes, then rounded DOWN to the largest divisor of the
+    operator's key capacity so the contiguous key-group ranges divide
+    evenly — a capacity/mesh mismatch degrades the mesh, never the
+    key-range semantics. Under 2 usable devices (or a jax build without
+    shard_map) the job silently stays single-chip."""
+    if not config.get(ParallelOptions.MESH_ENABLED):
+        return None
+    from flink_tpu.utils.jax_compat import HAS_SHARD_MAP
+
+    if not HAS_SHARD_MAP:
+        import warnings
+
+        warnings.warn(
+            "parallel.mesh.enabled is set but this jax build lacks "
+            "shard_map; running single-chip",
+            RuntimeWarning,
+        )
+        return None
+    import jax
+
+    from flink_tpu.parallel.mesh import build_mesh, usable_mesh_size
+
+    n = usable_mesh_size(config.get(ParallelOptions.MESH_DEVICES),
+                         len(jax.devices()), key_capacity)
+    if n <= 1:
+        return None
+    return build_mesh(n)
+
+
+class MeshRescaleRequested(BaseException):
+    """Control-flow signal, not a failure: the run loop reached a step
+    boundary with a pending mesh-rescale request. Carries the target
+    device count and the step-aligned state capture the rebuilt runtime
+    restores from (checkpoint rewind across device counts — the snapshot
+    is canonical [K, S], so any mesh size re-shards it). BaseException so
+    ordinary `except Exception` operator guards can never swallow it."""
+
+    def __init__(self, target: int, snapshot: dict):
+        super().__init__(f"mesh rescale to {target} devices")
+        self.target = int(target)
+        self.snapshot = snapshot
 
 
 def _columnarize_records(vals, where: str):
@@ -480,16 +529,20 @@ class WindowStepRunner(StepRunner):
             # (deferred superbatch resolution); everywhere else drain is a
             # host list swap and timing it would inflate deviceDispatches
             self._drain_resolves_device = True
+            # start small, grow by doubling with the key dictionary —
+            # superscan cost scales with key capacity, so tiny jobs must
+            # not pay for the configured maximum up front
+            capacity = min(1 << 10, config.get(ExecutionOptions.KEY_CAPACITY))
             self.op = FusedWindowOperator(
                 assigner,
                 device_agg,
-                # start small, grow by doubling with the key dictionary —
-                # superscan cost scales with key capacity, so tiny jobs must
-                # not pay for the configured maximum up front
-                key_capacity=min(1 << 10, config.get(ExecutionOptions.KEY_CAPACITY)),
+                key_capacity=capacity,
                 superbatch_steps=config.get(ExecutionOptions.SUPERBATCH_STEPS),
                 chunk=_fused_chunk(batch_size),
                 columnar_output=config.get(ExecutionOptions.COLUMNAR_OUTPUT),
+                # multichip (parallel.mesh.*): the same fused operator runs
+                # SPMD over the mesh; None keeps today's single-chip path
+                mesh=_mesh_for_config(config, capacity),
             )
             self.device = True
         elif use_device:
@@ -581,6 +634,13 @@ class WindowStepRunner(StepRunner):
                 ready_fn=getattr(self.op, "key_stats_ready", None),
                 interval_ms=config.get(
                     ObservabilityOptions.DEVICE_KEY_STATS_INTERVAL_MS),
+                # mesh operators additionally expose per-device local
+                # loads, so the skew fold sees the worst DEVICE too;
+                # single-chip operators keep a clean gauge surface
+                mesh_loads_fn=(
+                    getattr(self.op, "per_device_key_loads", None)
+                    if getattr(self.op, "mesh_devices", lambda: 1)() > 1
+                    else None),
             )
 
     def _device_stats_tick(self) -> None:
@@ -805,17 +865,23 @@ class DeviceChainRunner(WindowStepRunner):
             value_fn=cfg.get("value_fn"),
         )
         batch_size = config.get(ExecutionOptions.BATCH_SIZE)
+        # dense device keying cannot grow mid-dispatch: capacity is the
+        # configured bound, and an out-of-range traced key raises at
+        # resolve (never silently aliases another key's row)
+        capacity = config.get(ExecutionOptions.KEY_CAPACITY)
         self.op = FusedWindowOperator(
             cfg["assigner"],
             cfg["aggregate"],
-            # dense device keying cannot grow mid-dispatch: capacity is the
-            # configured bound, and an out-of-range traced key raises at
-            # resolve (never silently aliases another key's row)
-            key_capacity=config.get(ExecutionOptions.KEY_CAPACITY),
+            key_capacity=capacity,
             superbatch_steps=config.get(ExecutionOptions.SUPERBATCH_STEPS),
             chunk=_fused_chunk(batch_size),
             columnar_output=config.get(ExecutionOptions.COLUMNAR_OUTPUT),
             prologue=prologue,
+            # multichip SPMD (parallel.mesh.*): the fused USER job — not a
+            # hand-built kernel — shards over the mesh; the traced prologue
+            # runs on each device's slice and one in-scan all-to-all per
+            # step is the keyBy exchange
+            mesh=_mesh_for_config(config, capacity),
         )
         self.device = True
         self.window_fn = None
@@ -1637,6 +1703,10 @@ class JobRuntime:
                 self.io.add_backpressure_source(bp)
         self.io.register(job_group)
         job_group.gauge("numRecordsIn", lambda: self.records_in)
+        # mesh-as-slot-resource visibility: 1 on the single-chip path, the
+        # actual shard count when parallel.mesh.enabled promoted the job —
+        # dashboards and the autoscaler read THIS, not the requested config
+        job_group.gauge("meshDevices", self.mesh_devices)
         job_group.gauge("deviceTimeMsTotal", lambda: sum(
             r.device_timer.total_s * 1000.0
             for r in self.runners
@@ -1730,6 +1800,16 @@ class JobRuntime:
             if isinstance(r, SinkRunner):
                 r.commit_epoch(str(checkpoint_id))
 
+    def mesh_devices(self) -> int:
+        """Devices this attempt's keyed state is sharded over (worst
+        operator; 1 = single-chip)."""
+        return max(
+            (int(fn()) for fn in (
+                getattr(getattr(r, "op", None), "mesh_devices", None)
+                for r in self.runners) if fn is not None),
+            default=1,
+        )
+
     def operator_state_bytes(self) -> Dict[str, int]:
         """Per-operator state footprint from the operators' own
         state_bytes() (the same source as the stateBytes gauges) — the
@@ -1797,6 +1877,7 @@ class JobRuntime:
         coordinator=None,
         cancel_check: Optional[Callable[[], bool]] = None,
         savepoint_request: Optional[Callable[[], Optional[str]]] = None,
+        rescale_request: Optional[Callable[[], Optional[int]]] = None,
     ) -> None:
         batch_size = self.config.get(ExecutionOptions.BATCH_SIZE)
         if coordinator is not None:
@@ -1816,7 +1897,7 @@ class JobRuntime:
                               RuntimeWarning)
         try:
             self._run_loop(batch_size, coordinator, cancel_check,
-                           savepoint_request)
+                           savepoint_request, rescale_request)
         finally:
             if profiling:
                 try:
@@ -1837,6 +1918,7 @@ class JobRuntime:
         coordinator,
         cancel_check: Optional[Callable[[], bool]],
         savepoint_request: Optional[Callable[[], Optional[str]]],
+        rescale_request: Optional[Callable[[], Optional[int]]] = None,
     ) -> None:
         for d in self.sources:
             if d.current_split is None and not d.done:
@@ -1928,6 +2010,15 @@ class JobRuntime:
                     path = savepoint_request()
                     if path is not None:
                         self._write_savepoint(path)
+                if rescale_request is not None:
+                    target = rescale_request()
+                    if target is not None and target != self.mesh_devices():
+                        # mesh rescale: hand a step-aligned capture to the
+                        # job master, which rebuilds this runtime over the
+                        # new device count and restores — checkpoint rewind
+                        # across mesh sizes, exactly-once by construction
+                        # (the capture IS the checkpoint path's capture)
+                        raise MeshRescaleRequested(target, self.capture())
                 now_ms = time.time() * 1000.0
                 if now_ms - self._last_pt_tick >= 50.0:
                     # ProcessingTimeService tick: drive wall-clock timers

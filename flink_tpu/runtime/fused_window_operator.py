@@ -364,6 +364,7 @@ class FusedWindowOperator:
         chunk: int = 4096,
         columnar_output: bool = False,
         prologue=None,
+        mesh=None,
     ):
         self.agg = resolve(aggregate)
         if self.agg is None:
@@ -373,12 +374,30 @@ class FusedWindowOperator:
         # superscan itself; steps then carry RAW source columns and keying
         # is dense-int on device (no host key dictionary on the hot path)
         self.prologue = prologue
-        self.pipe = FusedWindowPipeline(
-            assigner, self.agg,
-            key_capacity=key_capacity, num_slices=num_slices, nsb=nsb,
-            fires_per_step=fires_per_step, out_rows=out_rows, chunk=chunk,
-            prologue=prologue,
-        )
+        self.mesh = mesh
+        self._construction_key_capacity = key_capacity
+        if mesh is not None:
+            # multichip SPMD (parallel.mesh.*): same operator surface, the
+            # dispatch runs sharded over the mesh with the keyBy shuffle as
+            # an in-scan all-to-all; snapshots stay canonical [K, S], so
+            # this operator checkpoints/restores across mesh sizes
+            from flink_tpu.parallel.sharded_superscan import (
+                ShardedFusedPipeline,
+            )
+
+            self.pipe = ShardedFusedPipeline(
+                mesh, assigner, self.agg,
+                key_capacity=key_capacity, num_slices=num_slices, nsb=nsb,
+                fires_per_step=fires_per_step, out_rows=out_rows,
+                chunk=chunk, prologue=prologue,
+            )
+        else:
+            self.pipe = FusedWindowPipeline(
+                assigner, self.agg,
+                key_capacity=key_capacity, num_slices=num_slices, nsb=nsb,
+                fires_per_step=fires_per_step, out_rows=out_rows, chunk=chunk,
+                prologue=prologue,
+            )
         self.T = superbatch_steps
         self.keydict = KeyDictionary(dense_int_keys or prologue is not None)
         self.norm = StepNormalizer(self.pipe, raw_payload=prologue is not None)
@@ -573,8 +592,12 @@ class FusedWindowOperator:
         if kid is None:
             return {}
         pipe = self.pipe
-        count = np.asarray(pipe._count)[kid]
-        acc = {k: np.asarray(v)[kid] for k, v in pipe._state.items()}
+        # canonical [K, S] view: the sharded pipeline holds [n, K_local, S]
+        # and the contiguous key ranges make the reshape exact (a no-op on
+        # the single-chip layout)
+        count = np.asarray(pipe._count).reshape(pipe.K, pipe.S)[kid]
+        acc = {k: np.asarray(v).reshape(pipe.K, pipe.S)[kid]
+               for k, v in pipe._state.items()}
         slices: Dict[int, Dict[str, Any]] = {}
         lo = pipe.purged_to if pipe.purged_to is not None else pipe.min_used_slice
         hi = pipe.max_seen_slice
@@ -624,6 +647,25 @@ class FusedWindowOperator:
     def key_loads(self):
         """Device-resident per-key record counts for the key-stats fold."""
         return self.pipe.key_loads()
+
+    def per_device_key_loads(self):
+        """[n, K_local] per-device local loads on the mesh path (None on a
+        single chip): the per-device skew fold's input — a globally even
+        key histogram can still pile every hot key-group on one device."""
+        fn = getattr(self.pipe, "per_device_key_loads", None)
+        return fn() if fn is not None else None
+
+    def mesh_devices(self) -> int:
+        """Devices this operator's state is sharded over (1 = single chip)."""
+        return int(getattr(self.pipe, "n", 1))
+
+    def mesh_capacity(self) -> int:
+        """The key capacity the mesh clamp used at CONSTRUCTION time — a
+        rescale-target pre-check must clamp against this, not the grown
+        pipe.K: a rebuilt operator starts from this capacity again (the
+        grown snapshot re-adopts K at restore), so a target reachable only
+        under the grown K would tear the job down for a no-op rebuild."""
+        return int(self._construction_key_capacity)
 
     def key_stats_ready(self) -> bool:
         """O(1) host probe: has any superbatch dispatch landed data in the

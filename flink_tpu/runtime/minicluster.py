@@ -27,9 +27,58 @@ from flink_tpu.checkpoint.storage import (
     FsCheckpointStorage,
     MemoryCheckpointStorage,
 )
-from flink_tpu.config import CheckpointingOptions, Configuration
+from flink_tpu.config import CheckpointingOptions, Configuration, ParallelOptions
 from flink_tpu.graph.transformation import StepGraph
-from flink_tpu.runtime.executor import JobCancelledException, JobRuntime
+from flink_tpu.runtime.executor import (
+    JobCancelledException,
+    JobRuntime,
+    MeshRescaleRequested,
+)
+
+
+def _effective_mesh_target(runtime: JobRuntime, target: int) -> Optional[int]:
+    """Clamp a mesh-rescale target EXACTLY like runner construction will:
+    shard_map availability, visible devices, and the largest divisor of
+    the operators' construction-time key capacity (NOT the grown pipe.K —
+    the rebuilt operator starts from the construction capacity again, so
+    clamping against grown state would accept targets the rebuild cannot
+    reach and tear the job down for a no-op). None = the job has no
+    mesh-capable operator / no mesh backend; otherwise the device count
+    the rebuild will actually produce."""
+    from flink_tpu.utils.jax_compat import HAS_SHARD_MAP
+
+    if not HAS_SHARD_MAP:
+        return None
+    caps = [
+        op.mesh_capacity()
+        for op in (getattr(r, "op", None) for r in runtime.runners)
+        if op is not None and hasattr(op, "mesh_capacity")
+    ]
+    if not caps:
+        return None
+    import jax
+
+    from flink_tpu.parallel.mesh import usable_mesh_size
+
+    return usable_mesh_size(max(1, int(target)), len(jax.devices()),
+                            min(caps))
+
+
+def _is_device_loss(e: BaseException) -> bool:
+    """Does this failure look like the device plane died under the job?
+    Real chip/host loss surfaces as an XLA runtime error from the dispatch;
+    chaos drills inject the same seam with a `device`-scoped marker. Walks
+    the cause chain (cycle-safe) so wrapping never hides the origin."""
+    seen = set()
+    cur: Optional[BaseException] = e
+    while cur is not None and id(cur) not in seen:
+        seen.add(id(cur))
+        if "XlaRuntimeError" in type(cur).__name__:
+            return True
+        if "[chaos-injected:device" in str(cur):
+            return True
+        cur = cur.__cause__ or cur.__context__
+    return False
 
 
 class JobStatus(enum.Enum):
@@ -58,6 +107,13 @@ class JobClient:
         self.records_in = 0
         self.num_restarts = 0
         self.num_checkpoints = 0
+        # multichip (parallel.mesh.*): live mesh-size rescales performed on
+        # this job (checkpoint rewind + key-group re-shard across device
+        # counts) and the pending target the run loop picks up at the next
+        # step boundary
+        self.mesh_rescales = 0
+        self.last_mesh_rescale_duration_ms = 0.0
+        self._mesh_rescale_target: Optional[int] = None
 
     # -- status -----------------------------------------------------------
     def status(self) -> JobStatus:
@@ -96,6 +152,20 @@ class JobClient:
             self._savepoint_path = None
             return path
         return None
+
+    def rescale_mesh(self, devices: int) -> None:
+        """Request a live mesh-size rescale of a RUNNING mesh job (the
+        manual sibling of the autoscaler's decision): at the next step
+        boundary the job captures its state, rebuilds over `devices`
+        devices, and restores — exactly-once, no restart counted. No-op
+        on jobs without parallel.mesh.enabled."""
+        self._mesh_rescale_target = max(1, int(devices))
+
+    def _poll_mesh_rescale(self) -> Optional[int]:
+        t = self._mesh_rescale_target
+        if t is not None:
+            self._mesh_rescale_target = None
+        return t
 
     # -- queryable state (S13: KvStateServer/ClientProxy analogue) ---------
     def query_state(self, uid: str, key) -> dict:
@@ -266,23 +336,52 @@ class MiniCluster:
         client.exceptions = ExceptionHistory(
             size=config.get(ObservabilityOptions.EXCEPTION_HISTORY_SIZE))
         client.exceptions.register_metrics(job_group)
-        # elastic autoscaler, observe-only: an in-process job runs as ONE
-        # task, so there is nothing to rescale — but the same signal
-        # windows + policy run against the job's own registry and the
-        # decision log serves at /jobs/:id/autoscaler, so a pipeline can
-        # be profiled for scaling behavior before cluster deployment
+        # elastic autoscaler: an in-process job runs as ONE task, so the
+        # slot-parallelism axis has nothing to rescale — but with a device
+        # MESH (parallel.mesh.enabled) the mesh size IS a parallelism axis
+        # this process owns, and the coordinator gets a real executor:
+        # decisions turn into live checkpoint-rewind + key-group re-shard
+        # onto a different device count at a step boundary. Without a mesh
+        # the coordinator stays observe-only (decision log only).
         from flink_tpu.config import AutoscalerOptions
 
+        mesh_enabled = config.get(ParallelOptions.MESH_ENABLED)
+        mesh_autoscale = (mesh_enabled
+                          and config.get(ParallelOptions.MESH_AUTOSCALE))
         if config.get(AutoscalerOptions.ENABLED):
             from flink_tpu.metrics.registry import metrics_snapshot
             from flink_tpu.scheduler import AutoscalerCoordinator
 
-            client.autoscaler = AutoscalerCoordinator.from_config(config)
-            # observe-only mode never rescales, so these read a constant
-            # 0 — registered anyway so the gauge surface matches the
-            # distributed JM and dashboards scrape one shape
-            job_group.gauge("numRescales", lambda: 0)
-            job_group.gauge("lastRescaleDurationMs", lambda: 0.0)
+            mesh_executor = None
+            if mesh_autoscale:
+                def mesh_executor(job_id, target, reason, _c=client):
+                    rt = getattr(_c, "_runtime", None)
+                    if rt is None:
+                        return False, "no running attempt"
+                    # pre-apply the SAME clamp the rebuild will apply
+                    # (_effective_mesh_target), so an unreachable target
+                    # — no mesh-capable operator, no shard_map backend,
+                    # or a device count the construction-time capacity
+                    # cannot divide — reads as rejected instead of
+                    # tearing the job down for a no-op rebuild and
+                    # re-firing every stabilization window
+                    eff = _effective_mesh_target(rt, int(target))
+                    if eff is None:
+                        return False, "job has no mesh-capable operator"
+                    cur = rt.mesh_devices()
+                    if eff == cur:
+                        return False, f"mesh already at {cur} device(s)"
+                    _c._mesh_rescale_target = eff
+                    return True, f"mesh rescale {cur} -> {eff} requested"
+
+            client.autoscaler = AutoscalerCoordinator.from_config(
+                config, rescale_executor=mesh_executor)
+            # without a mesh executor these read a constant 0 — registered
+            # anyway so the gauge surface matches the distributed JM and
+            # dashboards scrape one shape
+            job_group.gauge("numRescales", lambda: client.mesh_rescales)
+            job_group.gauge("lastRescaleDurationMs",
+                            lambda: client.last_mesh_rescale_duration_ms)
             client._autoscaler_metrics = (
                 lambda c=client: metrics_snapshot(c.metrics.all_metrics()))
         coordinator = (
@@ -304,6 +403,11 @@ class MiniCluster:
                     setattr(c, "num_checkpoints", co.num_completed))
         strategy = restart_strategy_from_config(config)
         attempt = 0
+        # mesh-size override for the NEXT attempt: set by a live rescale
+        # (autoscaler decision or manual rescale_mesh) and by the
+        # device-loss degrade policy; None = the configured size
+        mesh_override: Optional[int] = None
+        pending_rescale: Optional[dict] = None
 
         restore_snap = None
         restore_ms = 0.0
@@ -321,7 +425,11 @@ class MiniCluster:
             restore_ms = (time.perf_counter() - t_restore) * 1000.0
 
         while True:
-            runtime = JobRuntime(graph, config, registry=client.metrics,
+            cfg = config
+            if mesh_override is not None:
+                cfg = config.clone()
+                cfg.set(ParallelOptions.MESH_DEVICES, mesh_override)
+            runtime = JobRuntime(graph, cfg, registry=client.metrics,
                                  traces=client.traces)
             client._runtime = runtime  # queryable-state surface (S13)
             if coordinator is not None:
@@ -334,8 +442,13 @@ class MiniCluster:
             try:
                 if restore_snap is not None:
                     runtime.restore(restore_snap)
-                    client.checkpoint_stats.report_restore(
-                        restore_snap.get("checkpoint_id"), restore_ms)
+                    if pending_rescale is None:
+                        # a live mesh rescale restores from its own
+                        # step-aligned capture, not a stored checkpoint —
+                        # stamping a "restored checkpoint None" record
+                        # would pollute the checkpoint-restore telemetry
+                        client.checkpoint_stats.report_restore(
+                            restore_snap.get("checkpoint_id"), restore_ms)
                 client._set_status(JobStatus.RUNNING)
                 # the restarted attempt is live again: close the recovery
                 # timeline record (downtime = fail -> RUNNING)
@@ -347,21 +460,55 @@ class MiniCluster:
                         client.records_in - restore_snap.get("records_in", 0)
                         if restore_snap is not None else client.records_in),
                 )
+                if pending_rescale is not None:
+                    # the rebuilt attempt is serving at the new mesh size:
+                    # stamp the completed rescale (counter + duration) and
+                    # close the loop back into the autoscaler's learning
+                    # history, target-tagged like the distributed JM does
+                    duration_ms = (time.perf_counter()
+                                   - pending_rescale["t0"]) * 1000.0
+                    client.mesh_rescales += 1
+                    client.last_mesh_rescale_duration_ms = duration_ms
+                    auto = getattr(client, "autoscaler", None)
+                    if auto is not None:
+                        auto.rescale_completed(
+                            client.job_id, duration_ms,
+                            target=runtime.mesh_devices())
+                    pending_rescale = None
 
                 def cancel_check():
                     client.records_in = runtime.records_in  # progress gauge
                     auto = getattr(client, "autoscaler", None)
                     if auto is not None:
                         # throttled: maybe_observe snapshots the registry
-                        # only when an autoscaler.interval-ms tick is due
-                        auto.maybe_observe(client.job_id, 1,
-                                           client._autoscaler_metrics)
+                        # only when an autoscaler.interval-ms tick is due.
+                        # On a mesh job the parallelism the policy sees IS
+                        # the mesh size (the axis its executor rescales)
+                        auto.maybe_observe(
+                            client.job_id,
+                            runtime.mesh_devices() if mesh_autoscale else 1,
+                            client._autoscaler_metrics)
                     return client._cancel.is_set()
+
+                def poll_mesh_rescale(rt=runtime):
+                    # manual rescale_mesh targets arrive unclamped; apply
+                    # the construction-time clamp HERE so an unreachable
+                    # target (or one landing on the current size) never
+                    # costs a stop-the-world rebuild that changes nothing
+                    t = client._poll_mesh_rescale()
+                    if t is None:
+                        return None
+                    eff = _effective_mesh_target(rt, t)
+                    if eff is None or eff == rt.mesh_devices():
+                        return None
+                    return eff
 
                 runtime.run(
                     coordinator=coordinator,
                     cancel_check=cancel_check,
                     savepoint_request=lambda: self._savepoint_hook(client, runtime),
+                    rescale_request=(poll_mesh_rescale
+                                     if mesh_enabled else None),
                 )
                 client.records_in = runtime.records_in
                 client._set_status(JobStatus.FINISHED)
@@ -369,9 +516,42 @@ class MiniCluster:
             except JobCancelledException:
                 client._set_status(JobStatus.CANCELED)
                 return
+            except MeshRescaleRequested as mr:
+                # deliberate live rescale, not a failure: rebuild the
+                # runtime over the new device count and restore from the
+                # step-aligned capture the run loop handed us (checkpoint
+                # rewind + key-group re-shard across mesh sizes; no restart
+                # counted, no backoff, restart_attempts untouched)
+                client.records_in = runtime.records_in
+                mesh_override = mr.target
+                restore_snap = mr.snapshot
+                restore_ms = 0.0
+                pending_rescale = {"t0": time.perf_counter(),
+                                   "target": mr.target}
+                client._set_status(JobStatus.RESTARTING)
+                client.exceptions.begin_recovery(
+                    client.num_restarts,
+                    cause=f"mesh rescale to {mr.target} device(s)",
+                    events_at_failure=client.records_in,
+                    kind="rescale")
+                continue
             except BaseException as e:  # noqa: BLE001 — failover boundary
                 attempt += 1
                 client.error = e
+                # a mid-rescale failure must not stamp a completed-rescale
+                # duration (PR-6 outcome hygiene): the job degraded into
+                # the plain restart path instead
+                pending_rescale = None
+                if (mesh_enabled
+                        and config.get(
+                            ParallelOptions.MESH_DEGRADE_ON_DEVICE_LOSS)
+                        and runtime.mesh_devices() > 1
+                        and _is_device_loss(e)):
+                    # chip/host loss: restart the job at a REDUCED mesh
+                    # size — the canonical [K, S] checkpoint re-shards over
+                    # whatever devices survive (halving per restart,
+                    # floor 1 = single-chip)
+                    mesh_override = max(1, runtime.mesh_devices() // 2)
                 # bounded exception history (ExceptionHistoryEntry analogue):
                 # timestamp, failing-operator attribution, root-cause chain
                 client.exceptions.record_failure(

@@ -98,6 +98,19 @@ def test_path_scoped_rules_are_not_vacuous():
     assert index.get("graph/fusion.py") is not None, (
         "graph/fusion.py missing — the whole-graph fusion planner moved "
         "and ARCH001's graph-layer ban no longer covers it")
+    # the multichip library must stay in parallel/ under the parallel
+    # layer's runtime/api ban: the sharded superscan is a kernel/state
+    # library the runtime composes (FusedWindowOperator targets it), and
+    # a module-level runtime import would invert that DAG
+    assert "parallel" in LAYER_FORBIDDEN and any(
+        "runtime" in b for b in LAYER_FORBIDDEN["parallel"]), (
+        "parallel layer unregistered from ARCH001 (or no longer forbids "
+        "runtime imports) — the mesh library may not reach into the "
+        "executor")
+    for rel in ("parallel/mesh.py", "parallel/sharded_superscan.py"):
+        assert index.get(rel) is not None, (
+            f"{rel} missing — the multichip SPMD core moved and the "
+            "parallel layer's ARCH001 entry no longer covers it")
     # the device-plane observability modules must stay in metrics/ under
     # the metrics layer's runtime ban: compile/key telemetry flows OUTWARD
     # (runtime callers hand in jitted fns and load columns), and a tracker
