@@ -1909,6 +1909,312 @@ def run_multichip_child(timeout_s: float = 420.0) -> dict:
     return _run_cpu_child('multichip', timeout_s, force_mesh=True)
 
 
+def millikey_microbench(events: Optional[int] = None,
+                        batch: int = 8192,
+                        num_keys: Optional[int] = None,
+                        hot_capacity: int = 4096,
+                        parity_keys: int = 1 << 15,
+                        span_event_ms: int = 64_000,
+                        zipf_s: float = 1.0,
+                        admission_min_count: int = 2,
+                        mesh: bool = True) -> dict:
+    """Million-key state plane scenario (ISSUE-12, ROADMAP item 2): the
+    YSB sliding-count DataStream job over a key vocabulary three orders
+    of magnitude larger than the resident HBM capacity
+    (state.tier.enabled): at most `hot_capacity` keys own device ring
+    rows, the rest aggregate in the cold tier, and checkpoints are
+    incremental (state.changelog.enabled).
+
+    Gates, per variant (uniform + zipf(`zipf_s`)):
+
+      - `parity`: exact row-mode equality of the TIERED run against the
+        UNTIRED fused run at `parity_keys` cardinality (the untired
+        operator materializes every key as an HBM row, so the oracle
+        cannot hold the full vocabulary — that impossibility is the
+        feature's premise) AND of the full-cardinality tiered run
+        against a numpy host oracle over the identical record stream;
+      - `resident_keys <= hot_capacity` with `evictions > 0`: the
+        vocabulary actually bounds HBM instead of growing;
+      - `incremental_ratio`: median per-checkpoint-interval changelog
+        bytes / the materialized full-state base size — the < 0.25
+        acceptance bar for delta-scaled snapshot cost;
+      - `sharded_parity`: the same tiered job over the device mesh
+        (parallel.mesh.enabled) when >= 2 devices are visible.
+    """
+    from flink_tpu.api.datastream import StreamExecutionEnvironment
+    from flink_tpu.api.windowing.assigners import SlidingEventTimeWindows
+    from flink_tpu.config import (
+        CheckpointingOptions,
+        Configuration,
+        ExecutionOptions,
+        ParallelOptions,
+        StateTierOptions,
+    )
+    from flink_tpu.connectors.sink import CollectSink
+    from flink_tpu.connectors.source import Batch, DataGeneratorSource
+    from flink_tpu.core.watermarks import WatermarkStrategy
+    import statistics as _stats
+    import tempfile as _tempfile
+
+    events = events or int(
+        os.environ.get("BENCH_MILLIKEY_EVENTS", str(1 << 18)))
+    num_keys = num_keys or int(
+        os.environ.get("BENCH_MILLIKEY_KEYS", str(10_000_000)))
+
+    # bounded zipf over the full vocabulary (the multichip pattern:
+    # inverse-cdf, hot ranks permuted over the id space)
+    ranks = np.arange(1, num_keys + 1, dtype=np.float64)
+    zipf_cdf = np.cumsum(1.0 / ranks ** zipf_s)
+    zipf_cdf /= zipf_cdf[-1]
+
+    def keys_of(idx: np.ndarray, n_keys: int, skewed: bool) -> np.ndarray:
+        if skewed:
+            # STATELESS uniform draw per element (splitmix-style hash):
+            # the host oracle re-generates the stream under different
+            # chunk boundaries, so a chunk-seeded rng would diverge
+            z = (idx.astype(np.uint64) + np.uint64(0x9E3779B97F4A7C15))
+            z = (z ^ (z >> np.uint64(30))) * np.uint64(0xBF58476D1CE4E5B9)
+            z = (z ^ (z >> np.uint64(27))) * np.uint64(0x94D049BB133111EB)
+            z = z ^ (z >> np.uint64(31))
+            u = z.astype(np.float64) / 2.0 ** 64
+            if n_keys == num_keys:
+                return np.searchsorted(zipf_cdf, u).astype(np.int64)
+            r = np.arange(1, n_keys + 1, dtype=np.float64)
+            cdf = np.cumsum(1.0 / r ** zipf_s)
+            return np.searchsorted(cdf / cdf[-1], u).astype(np.int64)
+        return ((idx * 2654435761) % n_keys).astype(np.int64)
+
+    def ts_of(idx: np.ndarray, count: int) -> np.ndarray:
+        return (10_000 + idx * span_event_ms // count).astype(np.int64)
+
+    def source(count, n_keys, skewed):
+        def gen(idx):
+            return Batch(keys_of(idx, n_keys, skewed), ts_of(idx, count))
+
+        return DataGeneratorSource(gen, count)
+
+    def build(count, n_keys, skewed, *, tiered, cap, mesh_on=False,
+              chk=None, admission=None):
+        cfg = Configuration()
+        cfg.set(ExecutionOptions.BATCH_SIZE, batch)
+        cfg.set(ExecutionOptions.KEY_CAPACITY, max(n_keys, 1024))
+        if tiered:
+            cfg.set(StateTierOptions.TIER_ENABLED, True)
+            cfg.set(StateTierOptions.HOT_KEY_CAPACITY, cap)
+            cfg.set(StateTierOptions.CHANGELOG_ENABLED, True)
+            # the tiny-LFU doorkeeper: one-touch keys of the heavy tail
+            # aggregate cold instead of churning hot rows — the realistic
+            # operating point at key cardinality >> capacity
+            cfg.set(StateTierOptions.ADMISSION_MIN_COUNT,
+                    admission_min_count if admission is None else admission)
+            if chk is not None:
+                cfg.set(StateTierOptions.CHANGELOG_DIR,
+                        os.path.join(chk, "changelog"))
+                cfg.set(StateTierOptions.COLD_DIR, os.path.join(chk, "cold"))
+        if chk is not None:
+            cfg.set(CheckpointingOptions.INTERVAL_MS, 1)
+            cfg.set(CheckpointingOptions.DIRECTORY, os.path.join(chk, "chk"))
+        if mesh_on:
+            cfg.set(ParallelOptions.MESH_ENABLED, True)
+        env = StreamExecutionEnvironment(cfg)
+        ds = env.from_source(
+            source(count, n_keys, skewed),
+            watermark_strategy=WatermarkStrategy
+            .for_bounded_out_of_orderness(0),
+        )
+        sink = CollectSink()
+        (ds.key_by(lambda col: col, vectorized=True)
+           .window(SlidingEventTimeWindows.of(WINDOW_MS, SLIDE_MS))
+           .count()
+           .sink_to(sink))
+        return env, sink
+
+    def run(count, n_keys, skewed, *, tiered, cap, mesh_on=False,
+            chk=None, admission=None):
+        env, sink = build(count, n_keys, skewed, tiered=tiered, cap=cap,
+                          mesh_on=mesh_on, chk=chk, admission=admission)
+        t0 = time.perf_counter()
+        client = env.execute_async("millikey")
+        client.wait(600)
+        dt = max(time.perf_counter() - t0, 1e-9)
+        rows = sorted((int(k), int(n)) for k, n in sink.results)
+        return client, rows, count / dt
+
+    def host_oracle(count, n_keys, skewed):
+        """Expected (key, count) rows over ALL fired windows: every
+        record lands in spw sliding windows — a pure numpy fold that
+        holds the full vocabulary where the untired operator cannot."""
+        out: dict = {}
+        spw = WINDOW_MS // SLIDE_MS
+        for lo in range(0, count, 1 << 18):
+            idx = np.arange(lo, min(lo + (1 << 18), count), dtype=np.int64)
+            k = keys_of(idx, n_keys, skewed)
+            s = ts_of(idx, count) // SLIDE_MS
+            for shift in range(spw):
+                # window j = s - shift contains every record whose slide
+                # granule is s, for shift in [0, spw)
+                pairs, cnts = np.unique(
+                    np.stack([k, s - shift], axis=1), axis=0,
+                    return_counts=True)
+                for (kk, jj), c in zip(pairs.tolist(), cnts.tolist()):
+                    out[(kk, jj)] = out.get((kk, jj), 0) + c
+        return sorted((kk, c) for (kk, _jj), c in out.items())
+
+    result: dict = {"events": events, "num_keys": num_keys,
+                    "hot_key_capacity": hot_capacity,
+                    "parity_keys": parity_keys, "zipf_s": zipf_s,
+                    "window_ms": WINDOW_MS, "slide_ms": SLIDE_MS,
+                    "workload": "ysb_sliding_count_datastream_tiered"}
+
+    for skewed, label in ((False, "uniform"), (True, "zipf")):
+        blk: dict = {}
+        # ---- reduced-cardinality exact parity: tiered vs untired fused
+        n_par = min(events, max(batch * 8, 1 << 16))
+        p_keys = min(parity_keys, num_keys)
+        _c, rows_ref, _t = run(n_par, p_keys, skewed, tiered=False,
+                               cap=hot_capacity)
+        chk = _tempfile.mkdtemp(prefix="flink-tpu-millikey-")
+        try:
+            c_t, rows_t, _t2 = run(n_par, p_keys, skewed, tiered=True,
+                                   cap=min(hot_capacity, p_keys // 8),
+                                   chk=chk)
+            blk["parity_vs_untired"] = (len(rows_t) > 0
+                                        and rows_t == rows_ref)
+            tier_par = _tier_payload(c_t)
+            blk["parity_run_evictions"] = (tier_par or {}).get("evictions")
+        finally:
+            import shutil as _sh
+
+            _sh.rmtree(chk, ignore_errors=True)
+
+        # ---- full-cardinality tiered run: host-oracle parity, bounded
+        # residency, throughput, incremental checkpoint ratio
+        chk = _tempfile.mkdtemp(prefix="flink-tpu-millikey-")
+        try:
+            client, rows, tps = run(events, num_keys, skewed, tiered=True,
+                                    cap=hot_capacity, chk=chk)
+            expected = host_oracle(events, num_keys, skewed)
+            blk["parity"] = len(rows) > 0 and rows == expected
+            blk["tuples_per_sec"] = round(tps, 1)
+            tier = _tier_payload(client)
+            if tier is not None:
+                blk.update(
+                    vocab_size=tier["vocabSize"],
+                    resident_keys=tier["residentKeys"],
+                    evictions=tier["evictions"],
+                    promotions=tier["promotions"],
+                    spilled_bytes=tier["spilledBytes"],
+                    cold_records=tier["coldRecords"],
+                )
+                blk["resident_bounded"] = \
+                    tier["residentKeys"] <= hot_capacity
+            mgr = _tier_manager(client)
+            if mgr is not None and mgr.interval_bytes_history \
+                    and mgr.last_base_bytes() > 0:
+                med = _stats.median(mgr.interval_bytes_history)
+                blk["changelog_interval_bytes_p50"] = int(med)
+                blk["full_snapshot_bytes"] = mgr.last_base_bytes()
+                blk["incremental_ratio"] = round(
+                    med / mgr.last_base_bytes(), 6)
+                blk["checkpoints"] = len(mgr.interval_bytes_history)
+        finally:
+            import shutil as _sh
+
+            _sh.rmtree(chk, ignore_errors=True)
+        result[label] = blk
+
+    # ---- sharded variant: the same tiered job over the mesh
+    import jax as _jax
+
+    from flink_tpu.parallel.mesh import usable_mesh_size
+
+    n_mesh = usable_mesh_size(0, len(_jax.devices()), hot_capacity) \
+        if mesh else 1
+    if n_mesh >= 2:
+        n_par = min(events, max(batch * 4, 1 << 15))
+        p_keys = min(parity_keys, num_keys)
+        _c, rows_ref, _t = run(n_par, p_keys, False, tiered=False,
+                               cap=hot_capacity)
+        # admission doorkeeper off for this leg: the point is the
+        # demote/promote machinery ON the mesh, so force churn. chk dir
+        # given so the changelog/cold temp dirs are cleaned up with it.
+        chk = _tempfile.mkdtemp(prefix="flink-tpu-millikey-")
+        try:
+            c_m, rows_m, _t2 = run(n_par, p_keys, False, tiered=True,
+                                   cap=min(hot_capacity, p_keys // 8),
+                                   mesh_on=True, admission=1, chk=chk)
+            tier_m = _tier_payload(c_m)
+        finally:
+            import shutil as _sh
+
+            _sh.rmtree(chk, ignore_errors=True)
+        result["sharded"] = {
+            "devices": int(n_mesh),
+            "parity": len(rows_m) > 0 and rows_m == rows_ref,
+            "evictions": (tier_m or {}).get("evictions"),
+            "mesh_selected": bool(
+                c_m._runtime is not None
+                and c_m._runtime.mesh_devices() > 1),
+        }
+    else:
+        result["sharded"] = {"devices": int(n_mesh), "skipped": True}
+
+    # headline continuity keys
+    result["parity"] = bool(result["uniform"].get("parity")
+                            and result["zipf"].get("parity")
+                            and result["uniform"].get("parity_vs_untired")
+                            and result["zipf"].get("parity_vs_untired"))
+    result["tuples_per_sec"] = result["uniform"].get("tuples_per_sec", 0.0)
+    result["incremental_ratio"] = result["uniform"].get("incremental_ratio")
+    return result
+
+
+def _tier_payload(client) -> Optional[dict]:
+    """The tier block of the job's device snapshot (MiniCluster path)."""
+    try:
+        snap = client._runtime.device_snapshot()
+        for entry in snap["operators"].values():
+            if entry.get("tier"):
+                return entry["tier"]
+    except Exception:  # noqa: BLE001 — the bench must survive
+        return None
+    return None
+
+
+def _tier_manager(client):
+    """The live TieredStateManager of the job's window runner."""
+    try:
+        for r in client._runtime.runners:
+            t = getattr(getattr(r, "op", None), "tier", None)
+            if t is not None:
+                return t
+    except Exception:  # noqa: BLE001
+        return None
+    return None
+
+
+def child_millikey() -> None:
+    """Millikey child: CPU-pinned with the 8-device virtual mesh forced,
+    so the sharded tiered variant exercises a real mesh (single-client
+    TPU relay exposes one chip)."""
+    _emit({"event": "start", "device": "cpu-millikey", "pid": os.getpid()})
+    try:
+        import jax
+        from jax._src import xla_bridge as _xb
+
+        jax.config.update("jax_platforms", "cpu")
+        _xb._backend_factories.pop("axon", None)
+        _xb._topology_factories.pop("axon", None)
+    except Exception:
+        pass
+    _emit({"event": "result", "result": millikey_microbench()})
+
+
+def run_millikey_child(timeout_s: float = 600.0) -> dict:
+    """Millikey microbench in a CPU-pinned child on the virtual mesh."""
+    return _run_cpu_child('millikey', timeout_s, force_mesh=True)
+
+
 def chaos_microbench(names: Optional[list] = None) -> dict:
     """Resilience gate (ISSUE-10): run the chaos scenario matrix
     (flink_tpu/chaos/scenarios.py — injected rpc flaps, dataplane blips,
@@ -2012,6 +2318,12 @@ def parent_main() -> None:
     multichip = run_multichip_child()
     _emit({"event": "multichip_microbench", "result": multichip})
 
+    # million-key state plane: YSB at a key cardinality orders of
+    # magnitude past the resident HBM capacity — bounded residency,
+    # cold-tier churn, incremental checkpoint ratio, host-oracle parity
+    millikey = run_millikey_child()
+    _emit({"event": "millikey_microbench", "result": millikey})
+
     def consider(res, rank):
         nonlocal best, best_rank
         if res is None:
@@ -2031,6 +2343,12 @@ def parent_main() -> None:
             best["api_path"] = api_path
             best["chaos"] = chaos
             best["multichip"] = multichip
+            best["state_tier"] = millikey
+            if millikey.get("tuples_per_sec"):
+                best["millikey_tuples_per_sec"] = \
+                    millikey["tuples_per_sec"]
+                best["millikey_incremental_ratio"] = \
+                    millikey.get("incremental_ratio")
             # top-level continuity keys for the trajectory table
             if multichip.get("tuples_per_sec"):
                 best["multichip_tuples_per_sec"] = \
@@ -2146,6 +2464,8 @@ def main() -> None:
             child_chaos()
         elif label == "multichip":
             child_multichip()
+        elif label == "millikey":
+            child_millikey()
         else:
             child_cpu(T, 1 << int(sys.argv[4]), spans)
     else:

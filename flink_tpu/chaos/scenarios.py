@@ -26,6 +26,7 @@ package ``__init__`` (which must stay a stdlib-only leaf for the seams).
 from __future__ import annotations
 
 import contextlib
+import os
 import shutil
 import statistics
 import tempfile
@@ -468,6 +469,131 @@ def scenario_chip_loss_sharded() -> Dict[str, Any]:
                    recovery_ms=recovery_ms, attributed=attributed)
 
 
+def scenario_cold_tier_read_error() -> Dict[str, Any]:
+    """One injected read error on the COLD TIER during a promotion
+    (million-key state plane, state/tier_manager.py): the keyed job runs
+    with a hot capacity far below its key cardinality, so every batch
+    demotes/promotes rows through the cold store — the storage-scope rule
+    errors the 60th promotion read. The job must restart through the
+    normal attributed path, restore from the latest INCREMENTAL
+    (changelog) checkpoint, and finish at exact parity with the untired
+    oracle; the tier keeps evicting after recovery (resident keys stay
+    bounded)."""
+    problems: List[str] = []
+    from flink_tpu.config import StateTierOptions
+
+    def gen_rotating(num_keys: int, batch: int):
+        # rotate each batch's key order so the batch-pinned working set
+        # shifts: the vocabulary must evict the previous batch's
+        # residents and re-admit (promote) them when they cycle back —
+        # a fixed key order would pin one resident set forever and the
+        # promotion seam under test would never fire
+        def key_of(i: int) -> int:
+            return int((i + (i // batch) * 17) % num_keys)
+        return key_of
+
+    key_of = gen_rotating(64, 200)
+
+    def run(name: str, *, tiered: bool, chk: Optional[str] = None):
+        from flink_tpu.api.datastream import StreamExecutionEnvironment
+        from flink_tpu.api.windowing.assigners import TumblingEventTimeWindows
+        from flink_tpu.config import (
+            CheckpointingOptions,
+            Configuration,
+            ExecutionOptions,
+            RestartOptions,
+        )
+        from flink_tpu.connectors.sink import CollectSink
+        from flink_tpu.connectors.source import Batch, DataGeneratorSource
+        from flink_tpu.core.watermarks import WatermarkStrategy
+        from flink_tpu.utils.arrays import obj_array
+
+        config = Configuration()
+        config.set(ExecutionOptions.BATCH_SIZE, 200)
+        config.set(ExecutionOptions.KEY_CAPACITY, 768)
+        config.set(RestartOptions.INITIAL_BACKOFF_MS, 1)
+        if tiered:
+            config.set(StateTierOptions.TIER_ENABLED, True)
+            config.set(StateTierOptions.HOT_KEY_CAPACITY, 16)
+            config.set(StateTierOptions.CHANGELOG_ENABLED, True)
+            # dirs under the checkpoint dir: every attempt of the job
+            # shares one changelog/cold store, like a real deployment
+            config.set(StateTierOptions.CHANGELOG_DIR,
+                       os.path.join(chk, "changelog"))
+            config.set(StateTierOptions.COLD_DIR,
+                       os.path.join(chk, "cold"))
+        if chk is not None:
+            config.set(CheckpointingOptions.INTERVAL_MS, 1)
+            config.set(CheckpointingOptions.DIRECTORY, chk)
+            config.set(CheckpointingOptions.MAX_RETAINED, 50)
+
+        def gen(idx: np.ndarray) -> Batch:
+            values = [(key_of(int(i)), 1.0, int(i * 10)) for i in idx]
+            return Batch(obj_array(values), (idx * 10).astype(np.int64))
+
+        env = StreamExecutionEnvironment(config)
+        stream = env.from_source(
+            DataGeneratorSource(gen, count=2600, num_splits=1),
+            watermark_strategy=WatermarkStrategy.for_monotonous_timestamps(),
+        )
+        sink = CollectSink()
+        (stream.key_by(lambda x: x[0])
+               .window(TumblingEventTimeWindows.of(1000)).count()
+               .sink_to(sink))
+        client = env.execute_async(name)
+        client.wait(120)
+        return client, sorted((int(k), int(n)) for k, n in sink.results)
+
+    _oracle_client, expected = run("cold-tier-oracle", tiered=False)
+    chk = tempfile.mkdtemp(prefix="flink-tpu-coldtier-")
+    try:
+        with fault_injection(rules=[
+            {"scope": "storage", "fault": "error",
+             "match": "cold-tier:get", "nth": 60},
+        ]) as plan:
+            client, results = run("cold-tier-read-error", tiered=True,
+                                  chk=chk)
+        parity = results == expected
+        _check(problems, client.status().value == "FINISHED",
+               f"job ended {client.status().value}")
+        _check(problems, parity, "result parity broken vs untired oracle")
+        _check(problems, client.num_restarts == 1,
+               f"expected 1 restart, saw {client.num_restarts}")
+        _check(problems, plan.total_fired == 1,
+               f"expected 1 injected cold read error, fired "
+               f"{plan.total_fired}")
+        exc = client.exceptions.payload()
+        entry = exc["entries"][0] if exc["entries"] else {}
+        attributed = bool(entry.get("injected"))
+        _check(problems, attributed,
+               "injected cold-tier error not attributed injected:true")
+        recs = exc["recoveries"]
+        recovery_ms = recs[0]["downtime_ms"] if recs else None
+        _check(problems,
+               bool(recs) and recs[0]["restored_checkpoint_id"] is not None,
+               "recovery timeline missing the rewound checkpoint")
+        # the restored checkpoint must be the INCREMENTAL kind, and the
+        # tier must still be bounded + churning after recovery
+        tier = None
+        for e in client._runtime.device_snapshot()["operators"].values():
+            if e.get("tier"):
+                tier = e["tier"]
+        _check(problems, tier is not None, "tier payload missing")
+        if tier is not None:
+            _check(problems, bool(tier["changelogEnabled"]),
+                   "checkpoints were not incremental (changelog off)")
+            _check(problems, tier["residentKeys"] <= 16,
+                   f"resident keys {tier['residentKeys']} exceed the cap")
+            _check(problems, tier["evictions"] > 0 and tier["promotions"] > 0,
+                   "no eviction/promotion churn — the seam under test "
+                   "never exercised")
+    finally:
+        shutil.rmtree(chk, ignore_errors=True)
+    return _result("cold-tier-read-error", "mini", plan, problems,
+                   parity=parity, restarts=client.num_restarts,
+                   recovery_ms=recovery_ms, attributed=attributed)
+
+
 def scenario_rpc_flap() -> Dict[str, Any]:
     """Transient rpc-plane flap on idempotent control calls: the first two
     checkpoint-ack attempts and two heartbeat shipments fail with
@@ -642,6 +768,7 @@ SCENARIOS: Dict[str, Callable[[], Dict[str, Any]]] = {
     "storage-brownout": scenario_storage_brownout,
     "device-dispatch-error": scenario_device_dispatch_error,
     "chip-loss-sharded": scenario_chip_loss_sharded,
+    "cold-tier-read-error": scenario_cold_tier_read_error,
     "rpc-flap": scenario_rpc_flap,
     "dataplane-blip": scenario_dataplane_blip,
     "tm-crash-during-rescale": scenario_tm_crash_during_rescale,

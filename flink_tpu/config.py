@@ -424,6 +424,105 @@ class ParallelOptions:
     )
 
 
+class StateTierOptions:
+    """The million-key state plane (flink_tpu/state/vocab.py +
+    tier_manager.py, docs/state.md): a dynamic key vocabulary bounds the
+    RESIDENT key set to a fixed number of HBM ring rows, demotes cold
+    keys' rows through the host/disk cold tier, promotes them on
+    re-admission, and (optionally) journals every interval's delta so
+    checkpoints are incremental."""
+
+    TIER_ENABLED = (
+        ConfigOptions.key("state.tier.enabled").bool_type().default_value(False)
+    ).with_description(
+        "Decouple key cardinality from HBM key capacity on the host-keyed "
+        "fused window path: at most state.tier.hot-key-capacity keys stay "
+        "RESIDENT as device ring rows (admission/eviction per "
+        "state.tier.eviction-policy), every other key's state lives in the "
+        "cold tier (host memtable + spilled runs) and aggregates there; "
+        "window fires merge both tiers exactly. Results are identical to "
+        "the untired path — tiering is placement, never semantics. Applies "
+        "to FusedWindowOperator jobs with host key dictionaries (traced "
+        "dense-keyed chains keep their fixed device keying) and forces "
+        "row-mode emission (dense ids are recycled, so packed columnar "
+        "output would alias keys)."
+    )
+    HOT_KEY_CAPACITY = (
+        ConfigOptions.key("state.tier.hot-key-capacity").int_type()
+        .default_value(1 << 13)
+    ).with_description(
+        "Resident dense-id capacity of the hot tier (HBM [K, S] ring "
+        "rows) when state.tier.enabled. Power of two recommended (the "
+        "mesh clamp divides it across shards). Unlike "
+        "execution.state.key-capacity this never grows: the vocabulary "
+        "evicts instead."
+    )
+    EVICTION_POLICY = (
+        ConfigOptions.key("state.tier.eviction-policy").string_type()
+        .default_value("lru")
+    ).with_description(
+        "Victim selection when the hot tier is full: 'lru' (least "
+        "recently used, frequency tiebreak) or 'lfu' (least frequently "
+        "used, recency tiebreak). Keys touched by the batch being routed "
+        "are pinned either way."
+    )
+    ADMISSION_MIN_COUNT = (
+        ConfigOptions.key("state.tier.admission-min-count").int_type()
+        .default_value(1)
+    ).with_description(
+        "Doorkeeper: while the hot tier is full, a key must be sighted "
+        "this many times before it may evict a resident (tiny-LFU "
+        "admission; 1 = always admit). Raise under heavy-tailed traffic "
+        "so one-touch keys aggregate cold instead of churning hot rows."
+    )
+    COLD_DIR = (
+        ConfigOptions.key("state.tier.cold-dir").string_type().default_value("")
+    ).with_description(
+        "Directory for the cold tier's spilled runs (and the native LSM "
+        "store when available). Empty = a fresh temp directory per "
+        "operator instance; set it to survive in-place restarts."
+    )
+    CHANGELOG_ENABLED = (
+        ConfigOptions.key("state.changelog.enabled").bool_type()
+        .default_value(False)
+    ).with_description(
+        "Incremental checkpoints for tiered operators: cold-tier "
+        "mutations and vocabulary ops journal into an append-only segment "
+        "log as they happen, and each checkpoint appends ONE entry with "
+        "the interval-touched device cells — a checkpoint handle is "
+        "(materialized base, log offset), so checkpoint bytes scale with "
+        "the per-interval delta, not the full [K, S] state. Restore "
+        "replays the log over the base host-side into the canonical full "
+        "snapshot (mesh-size independent). Requires state.tier.enabled."
+    )
+    CHANGELOG_DIR = (
+        ConfigOptions.key("state.changelog.dir").string_type().default_value("")
+    ).with_description(
+        "Directory for changelog segments and materialized bases. Empty = "
+        "a fresh temp directory per operator instance (restores still "
+        "find the original via the checkpoint handle's absolute path); "
+        "set it so every attempt of a job shares one log."
+    )
+    CHANGELOG_MATERIALIZE_INTERVAL = (
+        ConfigOptions.key("state.changelog.materialize-interval").int_type()
+        .default_value(8)
+    ).with_description(
+        "Checkpoints between full materializations: every Nth checkpoint "
+        "folds the log into a fresh base file and truncates segments "
+        "below the oldest retained base. Lower = faster restores, higher "
+        "= smaller amortized checkpoint cost."
+    )
+    CHANGELOG_RETAINED_BASES = (
+        ConfigOptions.key("state.changelog.retained-bases").int_type()
+        .default_value(4)
+    ).with_description(
+        "Materialized base files kept on disk. Must cover the checkpoint "
+        "coordinator's max-retained window (a restorable handle must "
+        "always find its base), mirroring the cold tier's manifest GC "
+        "window."
+    )
+
+
 class MetricOptions:
     LATENCY_INTERVAL_MS = ConfigOptions.key("metrics.latency.interval").duration_ms_type().default_value(0)
     REPORTERS = ConfigOptions.key("metrics.reporters").list_type().default_value([])

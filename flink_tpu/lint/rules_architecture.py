@@ -29,7 +29,12 @@ LAYER_FORBIDDEN: Dict[str, List[str]] = {
               "{pkg}.scheduler"],
     "ops": ["{pkg}.runtime", "{pkg}.api", "{pkg}.table", "{pkg}.cep",
             "{pkg}.scheduler"],
-    "state": ["{pkg}.api", "{pkg}.table", "{pkg}.cep", "{pkg}.scheduler"],
+    # the state plane (columnar/heap backends, vocab, tier manager,
+    # changelog) is composed BY the runtime: operators hand device
+    # accessors in as callables; a runtime import here would invert that
+    # and drag the executor into every state-backend import
+    "state": ["{pkg}.api", "{pkg}.table", "{pkg}.cep", "{pkg}.scheduler",
+              "{pkg}.runtime"],
     # the mesh/shard-map library sits below the runtime like ops/state: it
     # may import core/ops/state/config, never the runtime (the sharded
     # pipeline's planner handle is a function-scoped lazy import), api, or

@@ -698,6 +698,72 @@ class ShardedFusedPipeline:
         return deferred if defer else deferred.resolve()
 
     # ------------------------------------------------------------------
+    # tiered-state row surface (state/tier_manager.py): same contract as
+    # the single-chip pipeline's accessors — these MUST shadow the planner
+    # delegation (the plan-only planner has no device state). All run off
+    # the dispatch hot path (demotion/promotion between superbatches,
+    # cell gathers at checkpoint), so the simple canonical round trip —
+    # pull [K, S], mutate on host, re-shard — is the whole implementation;
+    # note_external_slices needs no shadow (it mutates the planner's host
+    # cursors, which ARE the mesh pipeline's canonical cursor state).
+    # ------------------------------------------------------------------
+    def gather_key_rows(self, kids):
+        k = np.asarray(kids, np.int64)
+        counts = np.asarray(self._count).reshape(self.K, self.S)[k]
+        fields = {
+            name: np.asarray(a).reshape(self.K, self.S)[k]
+            for name, a in self._state.items()
+        }
+        return counts, fields
+
+    def _put_canonical(self, count: np.ndarray,
+                       state: "Dict[str, np.ndarray]") -> None:
+        n, Kl, S = self.n, self.K_local, self.S
+        self._count = jax.device_put(
+            jnp.asarray(count.reshape(n, Kl, S)),
+            self._shard_spec(None, None))
+        self._state = {
+            name: jax.device_put(
+                jnp.asarray(v.reshape(n, Kl, S)),
+                self._shard_spec(None, None))
+            for name, v in state.items()
+        }
+
+    def clear_key_rows(self, kids) -> None:
+        k = np.asarray(kids, np.int64)
+        count = np.asarray(self._count).reshape(self.K, self.S).copy()
+        count[k] = 0
+        idents = {f.name: f.identity for f in self._value_fields}
+        state = {}
+        for name, a in self._state.items():
+            arr = np.asarray(a).reshape(self.K, self.S).copy()
+            arr[k] = idents[name]
+            state[name] = arr
+        self._put_canonical(count, state)
+
+    def write_cells(self, kids, spos, counts, fields) -> None:
+        k = np.asarray(kids, np.int64)
+        s = np.asarray(spos, np.int64)
+        count = np.asarray(self._count).reshape(self.K, self.S).copy()
+        count[k, s] = np.asarray(counts)
+        state = {}
+        for name, a in self._state.items():
+            arr = np.asarray(a).reshape(self.K, self.S).copy()
+            arr[k, s] = np.asarray(fields[name], arr.dtype)
+            state[name] = arr
+        self._put_canonical(count, state)
+
+    def gather_cells(self, kids, spos):
+        k = np.asarray(kids, np.int64)
+        s = np.asarray(spos, np.int64)
+        counts = np.asarray(self._count).reshape(self.K, self.S)[k, s]
+        fields = {
+            name: np.asarray(a).reshape(self.K, self.S)[k, s]
+            for name, a in self._state.items()
+        }
+        return counts, fields
+
+    # ------------------------------------------------------------------
     def snapshot(self) -> dict:
         """Canonical [K, S] global arrays — interchangeable with single-chip
         FusedWindowPipeline snapshots (restore re-shards, so n -> m shard

@@ -300,6 +300,16 @@ def _shard_combine(key: str) -> str:
 _PER_DEVICE_MAX_GAUGES = ("keySkewPerDevice", "hotKeyLoadPerDevice",
                           "meshDeviceLoad")
 
+#: state-tier gauge family (state/tier_manager.py, registered by the
+#: window-step runner): counters and sizes SUM across shards — each shard
+#: owns its contiguous key range, so the job-level vocabulary/eviction/
+#: spilled view is the total, never the worst shard — while
+#: tierHotFillRatio (a per-shard fraction) takes the generic "Ratio" MEAN
+#: rule. Listed here so the distributed /jobs/:id/device payload filter
+#: carries them; the fold itself needs no extra rule (sum is the default).
+_TIER_GAUGES = ("vocabSize", "residentKeys", "evictions", "promotions",
+                "spilledBytes", "changelogBytes", "tierHotFillRatio")
+
 
 def aggregate_shard_metrics(per_shard: Dict[int, dict]) -> dict:
     """Fold per-shard metric snapshots into one job-level view per
@@ -955,12 +965,14 @@ class JobManagerEndpoint(RpcEndpoint):
                 "keySkew", "activeKeys", "hotKeyLoad", "keyGroupLoad",
                 "keyGroupStateBytes", "hbmUtilizationPct",
                 "flopsUtilizationPct", "meshLoadSkew", "meshDevices")
+            or k.rsplit(".", 1)[-1] in _TIER_GAUGES
             or k.rsplit(".", 1)[-1] in _PER_DEVICE_MAX_GAUGES
         }
         payload["metrics"] = device_keys
         payload["per_shard"] = {
             s: {k: v for k, v in snap.items()
                 if ".device." in k or "keySkew" in k or "meshLoadSkew" in k
+                or k.rsplit(".", 1)[-1] in _TIER_GAUGES
                 or k.rsplit(".", 1)[-1] in _PER_DEVICE_MAX_GAUGES}
             for s, snap in per_shard.items()
         }

@@ -233,6 +233,29 @@ def _mesh_for_config(config: Configuration, key_capacity: int):
     return build_mesh(n)
 
 
+def _tier_for_config(config: Configuration):
+    """The fused window path's TierConfig when the million-key state
+    plane applies (state.tier.enabled), else None. Tiering needs the host
+    key dictionary, so the traced-chain runner (dense device keying)
+    never receives one."""
+    from flink_tpu.config import StateTierOptions as _ST
+
+    if not config.get(_ST.TIER_ENABLED):
+        return None
+    from flink_tpu.state.tier_manager import TierConfig
+
+    return TierConfig(
+        hot_key_capacity=config.get(_ST.HOT_KEY_CAPACITY),
+        eviction_policy=config.get(_ST.EVICTION_POLICY),
+        admission_min_count=config.get(_ST.ADMISSION_MIN_COUNT),
+        cold_dir=config.get(_ST.COLD_DIR) or None,
+        changelog_enabled=config.get(_ST.CHANGELOG_ENABLED),
+        changelog_dir=config.get(_ST.CHANGELOG_DIR) or None,
+        materialize_interval=config.get(_ST.CHANGELOG_MATERIALIZE_INTERVAL),
+        retained_bases=config.get(_ST.CHANGELOG_RETAINED_BASES),
+    )
+
+
 class MeshRescaleRequested(BaseException):
     """Control-flow signal, not a failure: the run loop reached a step
     boundary with a pending mesh-rescale request. Carries the target
@@ -531,8 +554,15 @@ class WindowStepRunner(StepRunner):
             self._drain_resolves_device = True
             # start small, grow by doubling with the key dictionary —
             # superscan cost scales with key capacity, so tiny jobs must
-            # not pay for the configured maximum up front
-            capacity = min(1 << 10, config.get(ExecutionOptions.KEY_CAPACITY))
+            # not pay for the configured maximum up front. With the state
+            # tier enabled (state.tier.*) capacity is FIXED at the hot
+            # cap instead: the vocabulary evicts, capacity never grows.
+            tier = _tier_for_config(config)
+            if tier is not None:
+                capacity = tier.hot_key_capacity
+            else:
+                capacity = min(1 << 10,
+                               config.get(ExecutionOptions.KEY_CAPACITY))
             self.op = FusedWindowOperator(
                 assigner,
                 device_agg,
@@ -543,9 +573,21 @@ class WindowStepRunner(StepRunner):
                 # multichip (parallel.mesh.*): the same fused operator runs
                 # SPMD over the mesh; None keeps today's single-chip path
                 mesh=_mesh_for_config(config, capacity),
+                tier=tier,
             )
             self.device = True
         elif use_device:
+            # the per-batch classic path honors the state tier too, via
+            # its grow-only hot/cold id split (ids past the hot cap
+            # aggregate in the cold tier) — no vocabulary/eviction here,
+            # but HBM stays bounded when the fused path is switched off
+            tier = _tier_for_config(config)
+            tier_kwargs = {}
+            if tier is not None and cfg["allowed_lateness"] == 0:
+                tier_kwargs = dict(
+                    hot_key_capacity=tier.hot_key_capacity,
+                    cold_tier_dir=tier.cold_dir,
+                )
             self.op = TpuWindowOperator(
                 assigner,
                 device_agg,
@@ -553,6 +595,7 @@ class WindowStepRunner(StepRunner):
                 key_capacity=config.get(ExecutionOptions.KEY_CAPACITY),
                 emit_late_to_side_output=cfg["side_output_late"],
                 columnar_output=config.get(ExecutionOptions.COLUMNAR_OUTPUT),
+                **tier_kwargs,
             )
             self.device = True
         else:
@@ -829,6 +872,17 @@ class WindowStepRunner(StepRunner):
                 group.gauge("phasePurgeSteps", lambda: phases()["purgeSteps"])
         if self.key_stats is not None:
             self.key_stats.register(group)
+        # state-tier gauges (state/tier_manager.py): one gauge per family
+        # key; shipped on heartbeats like every registered gauge, folded
+        # job-level by aggregate_shard_metrics (counters/sizes SUM across
+        # shards — each shard owns its key range; tierHotFillRatio means
+        # via the generic Ratio rule)
+        tier_gauges = getattr(self.op, "tier_gauges", None)
+        if callable(tier_gauges) and tier_gauges() is not None:
+            for key in ("vocabSize", "residentKeys", "evictions",
+                        "promotions", "spilledBytes", "changelogBytes",
+                        "tierHotFillRatio"):
+                group.gauge(key, lambda k=key: self.op.tier_gauges().get(k))
 
     def snapshot(self) -> dict:
         return {"operator": self.op.snapshot()}
@@ -1841,7 +1895,9 @@ class JobRuntime:
             tracker = getattr(r, "device_stats", None)
             ks = getattr(r, "key_stats", None)
             timer = getattr(r, "device_timer", None)
-            if tracker is None and ks is None:
+            tier_fn = getattr(getattr(r, "op", None), "tier_payload", None)
+            has_tier = callable(tier_fn) and tier_fn() is not None
+            if tracker is None and ks is None and not has_tier:
                 continue
             entry: Dict[str, Any] = {}
             if timer is not None:
@@ -1857,6 +1913,12 @@ class JobRuntime:
                 entry["phases"] = phases()
             if ks is not None:
                 entry["keys"] = ks.payload()
+            tier_payload = getattr(getattr(r, "op", None), "tier_payload",
+                                   None)
+            if callable(tier_payload):
+                tp = tier_payload()
+                if tp is not None:
+                    entry["tier"] = tp
             ops[getattr(r, "uid", f"runner-{idx}")] = entry
         payload["operators"] = ops
         payload["compile"] = merge_compile_payloads(

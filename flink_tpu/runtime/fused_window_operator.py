@@ -308,6 +308,18 @@ class StepNormalizer:
         for kid, vals, ts in fut:
             self._append_data(out, kid, vals, ts)  # still-unfit rows re-buffer
 
+    def note_slices(self, smin: int, smax: int) -> None:
+        """Tier-promotion sibling of the pipeline's note_external_slices:
+        rows written into the ring outside a pushed step must count as
+        resident data for the normalizer's fire capping and ring-floor
+        math too, or the two frontier mirrors diverge."""
+        self.max_seen = smax if self.max_seen is None else max(self.max_seen, smax)
+        self.min_used = smin if self.min_used is None else min(self.min_used, smin)
+        cand = self.p._j_oldest(smin)
+        if self.wm > MIN_WATERMARK:
+            cand = max(cand, self._j_fired_upto(self.wm) + 1)
+        self.fire_cursor = cand if self.fire_cursor is None else min(self.fire_cursor, cand)
+
     # ------------------------------------------------------------------
     def snapshot(self) -> dict:
         return {
@@ -365,10 +377,26 @@ class FusedWindowOperator:
         columnar_output: bool = False,
         prologue=None,
         mesh=None,
+        tier=None,
     ):
         self.agg = resolve(aggregate)
         if self.agg is None:
             raise ValueError(f"aggregate {aggregate!r} has no device form")
+        # million-key state plane (state/tier_manager.py): a TierConfig
+        # bounds the RESIDENT key set to hot_key_capacity HBM rows; the
+        # vocabulary demotes/promotes rows through the cold tier and the
+        # emission merges both tiers. Host-keyed path only — a traced
+        # chain's dense device keying has no host vocabulary to evict from.
+        if tier is not None:
+            if prologue is not None:
+                raise ValueError(
+                    "state.tier.enabled needs the host key dictionary; "
+                    "a traced device chain keys on device (dense ids)")
+            key_capacity = tier.hot_key_capacity
+            dense_int_keys = False
+            # dense ids are RECYCLED under eviction: packed columnar
+            # output would alias keys downstream
+            columnar_output = False
         # whole-graph fusion (graph/fusion.py): with a TracedPrologue the
         # pipeline compiles chain transforms + key/value extraction into the
         # superscan itself; steps then carry RAW source columns and keying
@@ -400,6 +428,14 @@ class FusedWindowOperator:
             )
         self.T = superbatch_steps
         self.keydict = KeyDictionary(dense_int_keys or prologue is not None)
+        self.tier = None
+        if tier is not None:
+            from flink_tpu.state.tier_manager import TieredStateManager
+
+            self.tier = TieredStateManager(self.agg, self.pipe.S, tier)
+            self.tier.attach_device(self.pipe.gather_key_rows,
+                                    self.pipe.clear_key_rows,
+                                    self.pipe.write_cells)
         self.norm = StepNormalizer(self.pipe, raw_payload=prologue is not None)
         self._steps: List[_Step] = []
         self._inflight: Optional[tuple] = None  # (DeferredEmissions, wm)
@@ -426,6 +462,10 @@ class FusedWindowOperator:
             )
         if len(timestamps) == 0:
             return
+        if self.tier is not None:
+            self._process_batch_tiered(np.asarray(keys), values,
+                                       np.asarray(timestamps, np.int64))
+            return
         ids, required = self.keydict.lookup_or_insert(np.asarray(keys))
         self.pipe.ensure_key_capacity(required)
         vals = np.asarray(values, np.float32) if self._needs_value else None
@@ -433,6 +473,66 @@ class FusedWindowOperator:
             self.norm.push(ids.astype(np.int32), vals,
                            np.asarray(timestamps, np.int64))
         )
+        self._maybe_dispatch()
+
+    # ------------------------------------------------------------------
+    # tiered-state path (state/tier_manager.py)
+    # ------------------------------------------------------------------
+    def _tier_span(self):
+        """(floor, device_hi, ring_limit): the live slice span the tier
+        may move rows within. floor mirrors the normalizer's ring-floor
+        math (min ever used, clamped by the purge frontier, cold touches
+        included); ring_limit = floor + S - NSB is the hold-back bound —
+        a promotion writing past it would alias ring positions earlier
+        data still owns."""
+        p = self.pipe
+        touched = self.tier._touched
+        cands = [x for x in (p.min_used_slice,
+                             min(touched) if touched else None)
+                 if x is not None]
+        if not cands:
+            return None, None, None
+        lo = min(cands)
+        if p.purged_to is not None:
+            lo = max(lo, p.purged_to)
+        hi = p.max_seen_slice if p.max_seen_slice is not None else lo
+        return lo, hi, lo + p.S - p.NSB
+
+    def _process_batch_tiered(self, keys: np.ndarray, values,
+                              ts: np.ndarray) -> None:
+        tier = self.tier
+        s_abs = np.asarray(self.pipe._slice_of(ts))
+        wm = self.norm.wm
+        late = (s_abs < self.norm._min_live_slice(wm)
+                if wm > MIN_WATERMARK else np.zeros(len(ts), bool))
+        # an eviction reassigns dense ids — every buffered/in-flight step
+        # (and its pending emissions, which map ids back to keys at
+        # resolve) must land BEFORE the vocabulary moves; the check
+        # over-approximates, so a flush can be spurious but never missed
+        if tier.vocab.would_evict(keys):
+            self.flush_all()
+        vals = (np.asarray(values, np.float32)
+                if self._needs_value and values is not None else None)
+        routed = tier.route(keys, s_abs, vals, np.asarray(late, bool))
+        if routed.demotions or routed.promotions:
+            lo, hi, limit = self._tier_span()
+            tier.apply_demotions(routed.demotions, lo, hi)
+            span = tier.apply_promotions(routed.promotions, lo,
+                                         None if limit is None
+                                         else limit - 1, limit)
+            if span is not None:
+                # promoted rows are resident data the planner never saw
+                # as steps: both frontier mirrors must account for them
+                # or windows covering only promoted slices never fire
+                self.pipe.note_external_slices(*span)
+                self.norm.note_slices(*span)
+        tier.journal_vocab_ops()
+        ids = routed.ids
+        live_hot = (ids >= 0) & ~np.asarray(late, bool)
+        if live_hot.any():
+            tier.note_hot_cells(ids[live_hot].astype(np.int64),
+                                s_abs[live_hot])
+        self._steps.extend(self.norm.push(ids.astype(np.int32), vals, ts))
         self._maybe_dispatch()
 
     def process_raw_batch(self, values: np.ndarray,
@@ -517,19 +617,28 @@ class FusedWindowOperator:
             d = self.pipe.process_superbatch(
                 [(s.kid, s.vals, s.ts) for s in group], wms, defer=True)
         self._resolve_inflight()
-        self._inflight = (d, group[-1].wm)
+        # the purge frontier as of THIS dispatch's staging: cold-tier rows
+        # below it may only be deleted after this dispatch's emissions
+        # have resolved (they read the cold rows of the windows that just
+        # fired) — a lagged frontier, applied at resolve time
+        self._inflight = (d, group[-1].wm, self.pipe.purged_to)
 
     def _resolve_inflight(self) -> None:
         if self._inflight is None:
             return
-        d, wm = self._inflight
+        d, wm, purged_to = self._inflight
         self._inflight = None
         for window, counts, fields in d.resolve():
             self._emit(window, counts, fields)
         if wm > self.emitted_watermark:
             self.emitted_watermark = wm
+        if self.tier is not None:
+            self.tier.purge_below(purged_to)
 
     def _emit(self, window, counts, fields) -> None:
+        if self.tier is not None:
+            self._emit_tiered(window, counts, fields)
+            return
         if self.prologue is not None:
             # dense device keying: the emitted key IS the id the traced
             # selector produced — every capacity row may be live
@@ -573,6 +682,60 @@ class FusedWindowOperator:
         for k, i in zip(keys, live):
             self.output.append((k, window, result[i].item(), ts))
 
+    def _emit_tiered(self, window, counts, fields) -> None:
+        """Row-mode emission merging both tiers: resident keys fire from
+        the device rows, cold keys from the cold store. A key whose data
+        is SPLIT across tiers for this window (partial promotion left
+        far-future rows cold) combines per the field scatter ops before
+        extraction, so placement can never change a result."""
+        p = self.pipe
+        j = (window.start - p.offset) // p.slide_ms
+        slice_range = range(j * p.sl, j * p.sl + p.spw)
+        counts = np.asarray(counts).astype(np.int64).copy()
+        vals = {f.name: np.asarray(fields[f.name]).copy()
+                for f in self.agg.fields if f.source != ONE}
+        cold = self.tier.cold_fire(slice_range)
+        combine = {"add": lambda a, b: a + b, "min": min, "max": max}
+        extras: List[tuple] = []   # (key, counts, {field: value}) cold-only
+        if cold is not None:
+            ckids, cfields, ccounts = cold
+            vocab = self.tier.vocab
+            for i, cid in enumerate(ckids):
+                key = vocab.key_of_cold_id(int(cid))
+                hid = None if key is None else vocab.resident_id(key)
+                if hid is not None:
+                    counts[hid] += int(ccounts[i])
+                    for f in self.agg.fields:
+                        if f.source == ONE:
+                            continue
+                        vals[f.name][hid] = combine[f.scatter](
+                            vals[f.name][hid].item(),
+                            cfields[f.name][i].item())
+                elif key is not None:
+                    extras.append((key, int(ccounts[i]),
+                                   {n: cfields[n][i] for n in cfields}))
+        ts = window.max_timestamp()
+        live = np.flatnonzero(counts > 0)
+        if live.size:
+            fdict = {f.name: (counts if f.source == ONE else vals[f.name])
+                     for f in self.agg.fields}
+            result = np.asarray(self.agg.extract(fdict))
+            vocab = self.tier.vocab
+            for i in live:
+                self.output.append((vocab.key_of_id(int(i)), window,
+                                    result[i].item(), ts))
+        if extras:
+            e_counts = np.asarray([e[1] for e in extras], np.int64)
+            fdict_e = {
+                f.name: (e_counts if f.source == ONE
+                         else np.asarray([e[2][f.name] for e in extras],
+                                         np.dtype(f.dtype)))
+                for f in self.agg.fields
+            }
+            result_e = np.asarray(self.agg.extract(fdict_e))
+            for i, (key, _c, _f) in enumerate(extras):
+                self.output.append((key, window, result_e[i].item(), ts))
+
     def drain_output(self) -> List[Tuple[Any, Any, Any, int]]:
         out = self.output
         self.output = []
@@ -587,6 +750,13 @@ class FusedWindowOperator:
                 "queryable state is unavailable on the fused chain path: "
                 "buffered steps hold raw pre-transform columns, so a "
                 "consistent per-key view would need the traced UDFs on host"
+            )
+        if self.tier is not None:
+            raise RuntimeError(
+                "queryable state is unavailable on the tiered path: a "
+                "key's cells may be split across the HBM ring and the "
+                "cold store mid-movement; read the window emissions "
+                "instead"
             )
         kid = self.keydict.lookup(key)
         if kid is None:
@@ -686,7 +856,89 @@ class FusedWindowOperator:
         return n
 
     def state_key_count(self) -> int:
+        if self.tier is not None:
+            return self.tier.vocab.vocab_size
         return len(self.keydict)
+
+    # -- state-tier observability --------------------------------------
+    def tier_gauges(self):
+        """The tier gauge family (vocabSize/residentKeys/evictions/
+        promotions/spilledBytes/changelogBytes/tierHotFillRatio), or None
+        when tiering is off — the runner registers one gauge per key."""
+        return None if self.tier is None else self.tier.gauges()
+
+    def tier_payload(self):
+        """/jobs/:id/device tier block (None when tiering is off)."""
+        return None if self.tier is None else self.tier.payload()
+
+    def _pack_output(self):
+        """Undrained emissions ride every checkpoint; in the tiered
+        incremental path they dominate the per-interval delta, so scalar
+        numeric rows pack columnar (~3x smaller pickled than a list of
+        (key, TimeWindow, value, ts) tuples). Non-scalar rows fall back
+        to the raw list."""
+        rows = self.output
+        from flink_tpu.core.time import TimeWindow as _TW
+
+        if rows and all(
+                isinstance(r[1], _TW) and np.isscalar(r[2]) for r in rows):
+            return {
+                "packed": True,
+                "keys": [r[0] for r in rows],
+                "starts": np.asarray([r[1].start for r in rows], np.int64),
+                "ends": np.asarray([r[1].end for r in rows], np.int64),
+                "vals": np.asarray([r[2] for r in rows]),
+                "ts": np.asarray([r[3] for r in rows], np.int64),
+            }
+        return {"packed": False, "rows": list(rows)}
+
+    @staticmethod
+    def _unpack_output(packed) -> list:
+        if not packed.get("packed"):
+            return list(packed["rows"])
+        from flink_tpu.core.time import TimeWindow as _TW
+
+        return [
+            (k, _TW(int(s), int(e)), v.item(), int(t))
+            for k, s, e, v, t in zip(
+                packed["keys"], packed["starts"], packed["ends"],
+                packed["vals"], packed["ts"])
+        ]
+
+    def _tier_meta(self) -> dict:
+        """Host-side stream position + operator state that rides every
+        tiered checkpoint (full or incremental): what restore_changelog
+        overlays on the reconstructed arrays."""
+        p = self.pipe
+        return {
+            "watermark": p.watermark,
+            "fire_cursor": p.fire_cursor,
+            "purged_to": p.purged_to,
+            "min_used_slice": p.min_used_slice,
+            "max_seen_slice": p.max_seen_slice,
+            "num_late_dropped": p.num_late_records_dropped,
+            "norm": self.norm.snapshot(),
+        }
+
+    def _envelope(self) -> dict:
+        """The transient operator surface that rides the checkpoint
+        ENVELOPE, not the state changelog: resolved-but-undrained
+        emissions are output, not keyed state — journaling them would
+        charge every interval delta for rows the pre-checkpoint flush
+        regenerates wholesale."""
+        return {
+            "output": self._pack_output(),
+            "emitted_watermark": self.emitted_watermark,
+            "current_watermark": self.current_watermark,
+        }
+
+    def _apply_tier_meta(self, meta: dict, envelope: dict) -> None:
+        self.norm.restore(meta["norm"])
+        self._steps = []
+        self._inflight = None
+        self.output = self._unpack_output(envelope["output"])
+        self.emitted_watermark = envelope["emitted_watermark"]
+        self.current_watermark = envelope["current_watermark"]
 
     def snapshot(self) -> dict:
         # flush buffered steps so keyed state lives in exactly one place
@@ -694,6 +946,18 @@ class FusedWindowOperator:
         # and ride the checkpoint, so they are re-emitted after restore
         # rather than lost (their fire_cursor has already advanced)
         self.flush_all()
+        if self.tier is not None:
+            meta = self._tier_meta()
+            if self.tier.log is not None:
+                # incremental: ONE cells entry + a (base, offset) handle —
+                # checkpoint bytes scale with the interval delta
+                return {"tier_changelog": self.tier.checkpoint(
+                    meta, self.pipe.gather_cells,
+                    lambda: self.pipe.snapshot()),
+                    **self._envelope()}
+            return {"pipe": self.pipe.snapshot(),
+                    "tier": self.tier.full_snapshot(),
+                    "meta": meta, **self._envelope()}
         return {
             "pipe": self.pipe.snapshot(),
             "keydict": self.keydict.snapshot(),
@@ -714,6 +978,35 @@ class FusedWindowOperator:
         }
 
     def restore(self, snap: dict) -> None:
+        if "tier_changelog" in snap:
+            if self.tier is None:
+                raise RuntimeError(
+                    "this checkpoint is an incremental (changelog) tiered "
+                    "snapshot; the restoring operator has state.tier "
+                    "disabled")
+            out = self.tier.restore_changelog(snap["tier_changelog"])
+            self.pipe.restore(out["pipe"])
+            self._apply_tier_meta(out["meta"], snap)
+            return
+        if "tier" in snap:
+            if self.tier is None:
+                raise RuntimeError(
+                    "this checkpoint is a tiered snapshot; the restoring "
+                    "operator has state.tier disabled")
+            self.pipe.restore(snap["pipe"])
+            self.tier.restore_full(snap["tier"])
+            self._apply_tier_meta(snap["meta"], snap)
+            return
+        if self.tier is not None:
+            # the reverse direction must fail as loudly as the forward
+            # one: restoring a classic (grow-only keydict) snapshot into
+            # a tiered operator would route new keys through an EMPTY
+            # vocabulary whose recycled dense ids alias the restored
+            # rows' old keys — silent misattribution, never an error
+            raise RuntimeError(
+                "this checkpoint is a classic (untired) snapshot; the "
+                "restoring operator has state.tier enabled — restore it "
+                "with tiering off, or take a fresh tiered checkpoint")
         self.pipe.restore(snap["pipe"])
         self.keydict = KeyDictionary.restore(snap["keydict"])
         self.norm.restore(snap["normalizer"])

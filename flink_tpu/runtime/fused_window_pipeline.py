@@ -429,6 +429,88 @@ class FusedWindowPipeline:
         self._pallas = None  # geometry changed; re-decide backend
 
     # ------------------------------------------------------------------
+    # tiered-state row surface (state/tier_manager.py): the tier manager
+    # moves whole key rows between the HBM ring and the cold tier through
+    # these accessors. All of them run OFF the dispatch hot path
+    # (demotion/promotion happens between superbatches, cell gathers at
+    # checkpoint time), so they use eager device ops, canonical layout.
+    # ------------------------------------------------------------------
+    def gather_key_rows(self, kids: np.ndarray):
+        """Read whole rows: (counts np[m, S], {field: np[m, S]})."""
+        self._require_state()
+        self._to_canonical()
+        import jax.numpy as jnp
+
+        k = jnp.asarray(np.asarray(kids, np.int32))
+        counts = np.asarray(self._count[k])
+        fields = {n: np.asarray(a[k]) for n, a in self._state.items()}
+        return counts, fields
+
+    def clear_key_rows(self, kids: np.ndarray) -> None:
+        """Reset rows to identity (the demotion cut)."""
+        self._require_state()
+        self._to_canonical()
+        import jax.numpy as jnp
+
+        k = jnp.asarray(np.asarray(kids, np.int32))
+        self._count = self._count.at[k].set(0)
+        idents = {f.name: f.identity for f in self.agg.fields
+                  if f.source == VALUE}
+        self._state = {
+            n: a.at[k].set(jnp.asarray(idents[n], a.dtype))
+            for n, a in self._state.items()
+        }
+
+    def write_cells(self, kids: np.ndarray, spos: np.ndarray,
+                    counts: np.ndarray, fields: Dict[str, np.ndarray]) -> None:
+        """Set individual ring cells (the promotion scatter). Target rows
+        must hold identity at the written positions (fresh or cleared) —
+        the caller's tier invariant, so .set never clobbers live data."""
+        self._require_state()
+        self._to_canonical()
+        import jax.numpy as jnp
+
+        k = jnp.asarray(np.asarray(kids, np.int32))
+        s = jnp.asarray(np.asarray(spos, np.int32))
+        self._count = self._count.at[k, s].set(
+            jnp.asarray(np.asarray(counts, np.int32)))
+        self._state = {
+            n: a.at[k, s].set(jnp.asarray(
+                np.asarray(fields[n]), a.dtype))
+            for n, a in self._state.items()
+        }
+
+    def gather_cells(self, kids: np.ndarray, spos: np.ndarray):
+        """Point-read cells: (counts np[m], {field: np[m]}) — the
+        changelog delta's checkpoint-time value source."""
+        self._require_state()
+        self._to_canonical()
+        import jax.numpy as jnp
+
+        k = jnp.asarray(np.asarray(kids, np.int32))
+        s = jnp.asarray(np.asarray(spos, np.int32))
+        counts = np.asarray(self._count[k, s])
+        fields = {n: np.asarray(a[k, s]) for n, a in self._state.items()}
+        return counts, fields
+
+    def note_external_slices(self, smin: int, smax: int) -> None:
+        """Account for rows placed into the ring OUTSIDE a planned step
+        (tier promotion): the fire planner must treat the span as
+        resident data or windows covering only promoted slices would
+        never fire. Mirrors _PlanCursor.observe's frontier updates; the
+        fire-cursor candidate clamps to already-fired windows so a
+        promotion can never re-fire."""
+        self.min_used_slice = (smin if self.min_used_slice is None
+                               else min(self.min_used_slice, smin))
+        self.max_seen_slice = (smax if self.max_seen_slice is None
+                               else max(self.max_seen_slice, smax))
+        cand = self._j_oldest(smin)
+        if self.watermark > MIN_WATERMARK:
+            cand = max(cand, self._j_fired_upto(self.watermark) + 1)
+        self.fire_cursor = (cand if self.fire_cursor is None
+                            else min(self.fire_cursor, cand))
+
+    # ------------------------------------------------------------------
     # window geometry (identical formulas to TpuWindowOperator)
     # ------------------------------------------------------------------
     def _slice_of(self, ts: np.ndarray) -> np.ndarray:
@@ -650,8 +732,13 @@ class FusedWindowPipeline:
                 smin = int(live.min())
                 cur.observe(smin, int(live.max()))
                 srel = (s_abs - smin).astype(np.int32)
+                # kid -1 = a cold-routed record (state/tier_manager.py):
+                # it rides the step so fires over its slices get PLANNED,
+                # but it must never scatter into a hot row — mask to the
+                # same -1 the ingest drops (pad-row semantics)
+                kid64 = np.asarray(kid, dtype=np.int64)
                 idx_h[t, :n] = np.where(
-                    keep, np.asarray(kid, dtype=np.int64) * self.NSB + srel, -1
+                    keep & (kid64 >= 0), kid64 * self.NSB + srel, -1
                 ).astype(np.int32)
                 if vals is not None and self._needs_vals:
                     vals_h[t, :n] = np.where(keep, vals, 0.0)

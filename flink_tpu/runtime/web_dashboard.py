@@ -178,7 +178,28 @@ function deviceSection(dev) {
     <th>compiles/re</th><th>hbm/flops %</th><th>ingest/fire/purge</th>
     <th>key skew</th><th>active keys</th><th>hot keys</th></tr></thead>
     <tbody>${ops.join("")}</tbody></table>` : "")
+    + tierTable(dev)
     + (evs ? `<div class="spans">${evs}</div>` : "");
+}
+
+function tierTable(dev) {
+  // million-key state plane (state.tier.*): vocabulary size vs resident
+  // HBM rows, eviction/promotion churn, cold-tier footprint and the
+  // incremental-checkpoint delta size per operator
+  const rows = Object.entries(dev.operators ?? {})
+    .filter(([, o]) => o.tier)
+    .map(([uid, o]) => `<tr><td>${esc(uid)}</td>
+      <td>${fmt(o.tier.vocabSize)}</td>
+      <td>${fmt(o.tier.residentKeys)} / ${fmt(o.tier.hotKeyCapacity)}</td>
+      <td>${fmt(o.tier.evictions)} / ${fmt(o.tier.promotions)}</td>
+      <td>${fmt(o.tier.spilledBytes)}</td>
+      <td>${o.tier.changelogEnabled ? fmt(o.tier.changelogBytes) : "off"}</td>
+      <td>${esc(o.tier.evictionPolicy ?? "")}</td></tr>`);
+  if (!rows.length) return "";
+  return `<h3>state tier</h3><table><thead><tr><th>operator</th>
+    <th>vocab</th><th>resident/cap</th><th>evict/promote</th>
+    <th>spilled bytes</th><th>changelog bytes</th><th>policy</th>
+    </tr></thead><tbody>${rows.join("")}</tbody></table>`;
 }
 
 function operatorTable(metrics) {

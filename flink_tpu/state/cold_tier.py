@@ -22,6 +22,7 @@ from typing import Dict, List, Optional, Tuple
 
 import numpy as np
 
+from flink_tpu.chaos import plan as _chaos
 from flink_tpu.ops.aggregators import DeviceAggregator, ONE
 
 _COMBINE = {
@@ -31,6 +32,13 @@ _COMBINE = {
 }
 
 _SLICE_SHIFT = np.uint64(32)
+
+
+class ColdTierError(RuntimeError):
+    """A cold-tier artifact is missing or unreadable (corrupt manifest,
+    store-kind mismatch, decode failure). Typed so restore paths can treat
+    a broken cold artifact like a corrupt checkpoint — skip back to an
+    older complete one — instead of dying on a raw pickle/base64 error."""
 
 
 class _PyStoreFallback:
@@ -81,7 +89,15 @@ class _PyStoreFallback:
         import base64
         import pickle
 
-        self._d = pickle.loads(base64.b64decode(manifest[3:]))  # full replace
+        if not isinstance(manifest, str) or not manifest.startswith("py:"):
+            raise ColdTierError(
+                "cold-tier manifest is not a python-store artifact (was "
+                "this snapshot taken by the native LSM store?)")
+        try:
+            self._d = pickle.loads(base64.b64decode(manifest[3:]))
+        except Exception as e:  # noqa: BLE001 — surface as a typed artifact
+            raise ColdTierError(
+                f"corrupt cold-tier manifest: {e!r}") from e
 
 
 class ColdKeyTier:
@@ -179,6 +195,119 @@ class ColdKeyTier:
         result = np.asarray(self.agg.extract(fields), dtype=self.agg.result_dtype)
         return result, counts
 
+    # -- tier-manager surface (state/tier_manager.py) -------------------
+    def _guarded_get(self, skeys: np.ndarray, site: str):
+        """All promotion/fire reads go through here: the chaos plane's
+        storage seam covers the cold tier (site ``cold-tier:<what>``), so
+        a scenario can model a lost/corrupt cold artifact mid-promotion;
+        one is-None check when chaos is off."""
+        hook = _chaos.HOOK
+        if hook is not None:
+            hook("storage", f"cold-tier:{site}")
+        try:
+            return self.store.get_batch(skeys)
+        except ColdTierError:
+            raise
+        except Exception as e:  # noqa: BLE001 — typed artifact error
+            raise ColdTierError(f"cold-tier read failed: {e!r}") from e
+
+    def absorb_rows(self, cold_kid: np.ndarray, s_abs: np.ndarray,
+                    rows: np.ndarray, counts: np.ndarray) -> None:
+        """Merge PRE-AGGREGATED per-(key, slice) rows into the store —
+        the demotion path: a hot HBM row's live cells move here when its
+        key is evicted. `rows` is [m, num_fields] in field order, `counts`
+        [m]; cells already present combine by each field's scatter op
+        (so demote-then-reingest and plain ingest are order-independent)."""
+        if len(cold_kid) == 0:
+            return
+        skeys = self._store_keys(np.asarray(cold_kid, np.uint64),
+                                 np.asarray(s_abs))
+        uniq, inverse = np.unique(skeys, return_inverse=True)
+        nf = len(self.fields)
+        merged = np.zeros((len(uniq), nf + 1), dtype=np.float64)
+        for fi, f in enumerate(self.fields):
+            if f.scatter != "add":
+                merged[:, fi] = np.asarray(f.identity, dtype=np.float64)
+            getattr(np, {"add": "add", "min": "minimum", "max": "maximum"}
+                    [f.scatter]).at(merged[:, fi], inverse,
+                                    np.asarray(rows[:, fi], np.float64))
+        np.add.at(merged[:, -1], inverse, np.asarray(counts, np.float64))
+        old, found = self.store.get_batch(uniq)
+        old_rows = old.view(np.float64).reshape(len(uniq), nf + 1)
+        for fi, f in enumerate(self.fields):
+            merged[found, fi] = _COMBINE[f.scatter](
+                merged[found, fi], old_rows[found, fi])
+        merged[found, -1] += old_rows[found, -1]
+        self.store.put_batch(uniq, merged.view(np.uint8))
+        self.num_cold_rows_written += len(uniq)
+        if self.store.mem_entries >= self.flush_threshold:
+            self.store.flush()
+
+    def read_rows(self, cold_kid: int, slices: np.ndarray):
+        """Promotion read: one cold key's rows at `slices` (absolute).
+        Returns (rows [m, num_fields] f64, counts [m] f64, found [m]
+        bool). Raises :class:`ColdTierError` on an unreadable artifact."""
+        slices = np.asarray(slices, dtype=np.int64)
+        nf = len(self.fields)
+        if slices.size == 0:
+            return (np.zeros((0, nf), np.float64), np.zeros(0, np.float64),
+                    np.zeros(0, bool))
+        skeys = self._store_keys(
+            np.full(slices.size, cold_kid, np.uint64), slices)
+        vals, found = self._guarded_get(skeys, "get")
+        rows = vals.view(np.float64).reshape(slices.size, nf + 1)
+        return rows[:, :nf].copy(), rows[:, -1].copy(), found
+
+    def clear_rows(self, cold_kid: int, slices: np.ndarray) -> None:
+        """Promotion cut: overwrite one cold key's rows at `slices` with
+        identity/zero-count rows (the store has no point delete; a
+        zero-count row reads as absent everywhere)."""
+        slices = np.asarray(slices, dtype=np.int64)
+        if slices.size == 0:
+            return
+        skeys = self._store_keys(
+            np.full(slices.size, cold_kid, np.uint64), slices)
+        nf = len(self.fields)
+        rows = np.tile(
+            np.asarray([f.identity for f in self.fields] + [0.0],
+                       dtype=np.float64),
+            (slices.size, 1))
+        self.store.put_batch(skeys, rows.view(np.uint8))
+
+    def fire_ids(self, cold_kids: np.ndarray, slice_range):
+        """Window fire over an EXPLICIT cold-id set (the tier manager's
+        touched-slice index bounds it): combine the window's slices for
+        those ids only — O(touched x slices-per-window), never O(all cold
+        keys). Returns (fields {name: [m]}, counts [m] f64)."""
+        cold_kids = np.asarray(cold_kids, dtype=np.uint64)
+        m = len(cold_kids)
+        nf = len(self.fields)
+        acc = np.tile(
+            np.asarray([f.identity for f in self.fields], dtype=np.float64),
+            (max(m, 1), 1))
+        counts = np.zeros(max(m, 1), dtype=np.float64)
+        if m == 0:
+            return {f.name: acc[:0, fi] for fi, f in enumerate(self.fields)}, \
+                counts[:0]
+        for s in slice_range:
+            skeys = self._store_keys(
+                cold_kids, np.full(m, s, dtype=np.int64))
+            vals, found = self._guarded_get(skeys, "fire")
+            rows = vals.view(np.float64).reshape(m, nf + 1)
+            for fi, f in enumerate(self.fields):
+                acc[found, fi] = _COMBINE[f.scatter](
+                    acc[found, fi], rows[found, fi])
+            counts[found] += rows[found, -1]
+        fields = {f.name: acc[:, fi].astype(f.dtype)
+                  for fi, f in enumerate(self.fields)}
+        return fields, counts
+
+    def approx_bytes(self) -> int:
+        """Resident cold-store footprint estimate (memtable rows x row
+        width) — the spilledBytes gauge's source. Spilled run files on
+        disk are deliberately not walked per gauge read."""
+        return int(self.store.mem_entries) * self.width
+
     def purge_below_slice(self, frontier_slice: int) -> None:
         """Retention cut: rows for slices below `frontier_slice` can never
         fire again (every window containing them has fired and purged) —
@@ -215,7 +344,32 @@ class ColdKeyTier:
                 "native": self.native, "purged_to_slice": self._purged_to_slice}
 
     def restore(self, snap: dict) -> None:
-        self.store.restore(snap["manifest"])
+        # adopt the snapshot's store kind + directory: a rebuilt operator
+        # (restart with an unconfigured cold-dir) gets a fresh temp dir,
+        # but a NATIVE manifest names run files relative to the dir the
+        # snapshot was taken over — restoring it anywhere else reads
+        # nothing. The py manifest is self-contained; a py snapshot into a
+        # native instance downgrades to the py store (correct either way).
+        if snap.get("native"):
+            if not self.native:
+                raise ColdTierError(
+                    "cold-tier snapshot was taken by the native LSM store "
+                    "but this build has no native bridge")
+            if snap.get("dir") and snap["dir"] != self.dir:
+                from flink_tpu.utils.native_bridge import NativeSpillStore
+
+                self.dir = snap["dir"]
+                self.store = NativeSpillStore(self.width, self.dir)
+        elif self.native:
+            self.store = _PyStoreFallback(self.width)
+            self.native = False
+        try:
+            self.store.restore(snap["manifest"])
+        except ColdTierError:
+            raise
+        except Exception as e:  # noqa: BLE001 — typed artifact error
+            raise ColdTierError(
+                f"cold-tier restore failed: {e!r}") from e
         # A fresh instance must not GC away files that checkpoints from
         # BEFORE the restore still reference (the coordinator may retain
         # several older than the one restored, and their manifests are not

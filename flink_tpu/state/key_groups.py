@@ -74,7 +74,12 @@ def reshardable(handles: Dict[int, dict]) -> Tuple[bool, str]:
     re-shard inside the sharded device state instead."""
     for shard in sorted(handles):
         op = handles[shard].get("operator", {})
-        if "columnar" in op or "cnt" in op:
+        if "columnar" in op or "cnt" in op or "pipe" in op \
+                or "tier" in op or "tier_changelog" in op:
+            # "pipe" = fused-superscan ring state; "tier"/"tier_changelog"
+            # = the million-key state plane's full/incremental snapshots —
+            # all device-resident (or device-referencing) forms that the
+            # heap-table merge cannot re-shard
             return False, (
                 "device-operator snapshots re-shard by key group inside "
                 "the sharded device state, not via heap-table merge; "

@@ -21,6 +21,13 @@ from typing import Any, Dict, List, Optional
 from flink_tpu.state.heap import HeapKeyedStateBackend, StateDescriptor
 
 
+class SpillReadError(RuntimeError):
+    """A spilled key-group artifact is missing or unreadable. Typed (vs a
+    raw FileNotFoundError/UnpicklingError) so callers can distinguish
+    "the spill tier lost data" from an ordinary state-access bug and take
+    the restore-from-checkpoint path."""
+
+
 class SpillableKeyedStateBackend:
     """Heap backend + key-group spill tier."""
 
@@ -127,8 +134,19 @@ class SpillableKeyedStateBackend:
         path = self._spilled.pop(kg, None)
         if path is None:
             return
-        with open(path, "rb") as f:
-            payload = pickle.load(f)
+        try:
+            with open(path, "rb") as f:
+                payload = pickle.load(f)
+        except Exception as e:  # noqa: BLE001 — unpickling garbage raises
+            # anything (UnpicklingError, EOFError, ValueError, ...);
+            # re-raised typed below, never swallowed
+            # the artifact stays registered so a retry/restore sees the
+            # same state it faulted on (popping it would silently turn a
+            # lost key-group into an empty one)
+            self._spilled[kg] = path
+            raise SpillReadError(
+                f"spilled key-group {kg} unreadable at {path}: {e!r}"
+            ) from e
         for name, slot in payload.items():
             self.inner._tables.setdefault(name, {})[kg] = slot
             self._entries_at_check += len(slot)

@@ -1,0 +1,609 @@
+"""Tiered state manager: hot HBM rows, host/disk cold rows, changelog
+checkpoints.
+
+The state plane of ROADMAP item 2 ("million-key state"): the fused window
+operator keeps the HOT working set as dense [K, S] HBM ring columns (the
+existing layout) and this manager owns everything beyond it —
+
+- the **dynamic key vocabulary** (state/vocab.py) decides which keys are
+  resident; this manager executes its decisions: a demotion gathers the
+  victim's live device row into the cold tier (state/cold_tier.py) and
+  clears it; a promotion moves a re-admitted key's cold rows back into its
+  fresh device row.
+- **cold ingest/fire**: records routed cold aggregate straight into the
+  cold store under (cold_id, absolute slice); a per-slice TOUCHED index
+  bounds window fires to the cold ids that actually hold data in the fired
+  range (never O(all cold keys)).
+- **incremental checkpoints**: every structural mutation (cold absorbs,
+  promotion clears, vocabulary ops) journals into a
+  :class:`~flink_tpu.state.changelog.FsStateChangelog`; at checkpoint time
+  ONE ``cells`` entry captures the interval-touched device cells plus the
+  pipeline/normalizer meta, so a checkpoint handle is (base file, log
+  offset) and its cost scales with the per-interval delta, not the full
+  [K, S] state. A periodic materialization folds the log into a fresh
+  base. Restore reconstructs the CANONICAL full snapshot host-side (pure
+  numpy replay over the base arrays), so a checkpoint taken on one mesh
+  size restores on any other exactly like a full snapshot would (the
+  PR-10 canonical-form contract).
+
+Layering: state sits below the runtime (ARCH001). The manager never
+imports the runtime — the operator hands in its device accessors
+(gather/clear/write row callables) via :meth:`attach_device`, the same
+outward-callback pattern the checkpoint layer uses.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import pickle
+import tempfile
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from flink_tpu.ops.aggregators import DeviceAggregator, ONE
+from flink_tpu.state.changelog import FsStateChangelog
+from flink_tpu.state.cold_tier import ColdKeyTier, ColdTierError
+from flink_tpu.state.vocab import DynamicKeyVocabulary, RoutedBatch
+
+#: snapshot-dict marker of an incremental (changelog) checkpoint handle
+CHANGELOG_HANDLE_KIND = "flink-tpu-changelog-v1"
+
+
+@dataclasses.dataclass
+class TierConfig:
+    """Construction-time knobs (state.tier.* / state.changelog.*)."""
+
+    hot_key_capacity: int = 1 << 13
+    eviction_policy: str = "lru"
+    admission_min_count: int = 1
+    cold_dir: Optional[str] = None
+    changelog_enabled: bool = False
+    changelog_dir: Optional[str] = None
+    materialize_interval: int = 8
+    retained_bases: int = 4
+
+
+class TieredStateManager:
+    """One keyed window operator's hot/cold placement + changelog."""
+
+    def __init__(self, agg: DeviceAggregator, ring_slices: int,
+                 config: Optional[TierConfig] = None):
+        self.cfg = config or TierConfig()
+        self.agg = agg
+        self.S = int(ring_slices)
+        self.vocab = DynamicKeyVocabulary(
+            self.cfg.hot_key_capacity,
+            policy=self.cfg.eviction_policy,
+            admission_min_count=self.cfg.admission_min_count)
+        self.cold = ColdKeyTier(agg, ring_slices,
+                                directory=self.cfg.cold_dir)
+        self._fields = list(agg.fields)   # store column order == agg order
+        # per-absolute-slice touched cold ids (bounds cold fires; sets —
+        # promotion membership checks run per (slice, cold id) on the
+        # batch path, so scans over per-batch array chunks would go
+        # quadratic under churn)
+        self._touched: Dict[int, set] = {}
+        # device accessors, wired by the operator (attach_device)
+        self._gather_rows: Optional[Callable] = None
+        self._clear_rows: Optional[Callable] = None
+        self._write_cells: Optional[Callable] = None
+        self.num_demoted_rows = 0
+        self.num_promoted_rows = 0
+        self.num_cold_records = 0
+        # incremental checkpointing
+        self.log: Optional[FsStateChangelog] = None
+        self._dirty: List[Tuple[np.ndarray, np.ndarray]] = []  # (kid, s_abs)
+        self._bases: List[Tuple[int, str]] = []   # (offset, base file)
+        self._cp_since_base = 0
+        self.changelog_bytes_last_interval = 0
+        #: per-checkpoint-interval appended bytes (bounded ring): the
+        #: bench's incremental-vs-full measurement reads the median
+        self.interval_bytes_history: List[int] = []
+        self._bytes_mark = 0
+        # post-restore log-truncation holdoff (the ColdKeyTier gc_holdoff
+        # pattern): checkpoints retained from BEFORE the restore may
+        # reference older bases/offsets this instance cannot know
+        self._truncate_holdoff = 0
+        if self.cfg.changelog_enabled:
+            d = self.cfg.changelog_dir or tempfile.mkdtemp(
+                prefix="flink_tpu_changelog_")
+            os.makedirs(d, exist_ok=True)
+            self.log = FsStateChangelog(d)
+
+    # ------------------------------------------------------------------
+    # device accessors (runtime hands them in; state never imports runtime)
+    # ------------------------------------------------------------------
+    def attach_device(self, gather_rows: Callable, clear_rows: Callable,
+                      write_cells: Callable) -> None:
+        """gather_rows(kids)->(counts[m,S], {field:[m,S]}) numpy;
+        clear_rows(kids) resets rows to identity; write_cells(kids, spos,
+        counts, {field: vals}) sets individual ring cells."""
+        self._gather_rows = gather_rows
+        self._clear_rows = clear_rows
+        self._write_cells = write_cells
+
+    # ------------------------------------------------------------------
+    # routing + movement
+    # ------------------------------------------------------------------
+    def route(self, keys: np.ndarray, s_abs: np.ndarray,
+              vals: Optional[np.ndarray], late: np.ndarray) -> RoutedBatch:
+        """Vocabulary routing + cold ingest of the batch's cold records.
+        `late` rows route with id -1 and are NOT cold-ingested (the fused
+        pipeline drops and counts them — tiering must not resurrect
+        them)."""
+        routed = self.vocab.observe_batch(keys)
+        ids = routed.ids
+        cold_rows = (ids < 0) & ~late
+        if late.any():
+            ids = np.where(late, np.int32(-1), ids)
+            routed.ids = ids
+        if cold_rows.any():
+            ckids = routed.cold_ids[cold_rows]
+            cs = np.asarray(s_abs)[cold_rows]
+            cvals = (np.asarray(vals, np.float32)[cold_rows]
+                     if vals is not None
+                     else np.zeros(int(cold_rows.sum()), np.float32))
+            self.cold.ingest(ckids.astype(np.int64), cs, cvals)
+            self.num_cold_records += len(ckids)
+            self._note_touched(ckids, cs)
+            # narrow the journaled id column when it fits (the common
+            # case by ~2^32): replay casts back up
+            ck = (ckids.astype(np.int32)
+                  if ckids.max(initial=0) < 2 ** 31 else ckids)
+            self._journal(("cold_ingest", ck, cs, cvals))
+        return routed
+
+    def _note_touched(self, ckids: np.ndarray, s_abs: np.ndarray) -> None:
+        for s in np.unique(s_abs):
+            self._touched.setdefault(int(s), set()).update(
+                int(c) for c in ckids[s_abs == s])
+
+    def note_hot_cells(self, kids: np.ndarray, s_abs: np.ndarray) -> None:
+        """Record device cells written this checkpoint interval (the
+        changelog delta gathers exactly these at checkpoint time)."""
+        if self.log is None or len(kids) == 0:
+            return
+        self._dirty.append((np.asarray(kids, np.int64).copy(),
+                            np.asarray(s_abs, np.int64).copy()))
+
+    def apply_demotions(self, demotions: List[Tuple[Any, int, int]],
+                        live_lo: Optional[int],
+                        live_hi: Optional[int]) -> None:
+        """Move each victim's live device row into the cold tier and clear
+        it. Caller guarantees no buffered/in-flight step still references
+        the victim ids (the operator flushes first)."""
+        if not demotions or live_lo is None or live_hi is None \
+                or self._gather_rows is None:
+            if demotions and self._clear_rows is not None:
+                # no live span yet: rows are identity — just recycle
+                self._clear_rows(np.asarray([d[1] for d in demotions],
+                                            np.int64))
+            return
+        kids = np.asarray([d[1] for d in demotions], np.int64)
+        counts, fields = self._gather_rows(kids)
+        span = np.arange(live_lo, live_hi + 1, dtype=np.int64)
+        spos = (span % self.S).astype(np.int64)
+        nf = len(self._fields)
+        all_ckids, all_slices = [], []
+        all_rows, all_counts = [], []
+        for i, (_key, _hid, cid) in enumerate(demotions):
+            c = np.asarray(counts[i])[spos]
+            live = np.flatnonzero(c > 0)
+            if live.size == 0:
+                continue
+            rows = np.zeros((live.size, nf), np.float64)
+            for fi, f in enumerate(self._fields):
+                if f.source == ONE:
+                    rows[:, fi] = c[live]
+                else:
+                    rows[:, fi] = np.asarray(fields[f.name][i])[spos][live]
+            all_ckids.append(np.full(live.size, cid, np.int64))
+            all_slices.append(span[live])
+            all_rows.append(rows)
+            all_counts.append(c[live].astype(np.float64))
+        if all_ckids:
+            ckids = np.concatenate(all_ckids)
+            slices = np.concatenate(all_slices)
+            rows = np.concatenate(all_rows)
+            cc = np.concatenate(all_counts)
+            self.cold.absorb_rows(ckids, slices, rows, cc)
+            self._note_touched(ckids, slices)
+            self.num_demoted_rows += len(ckids)
+            self._journal(("cold_absorb", ckids, slices, rows, cc))
+        self._clear_rows(kids)
+        # the cleared LIVE cells' checkpoint-time values are identity —
+        # they must land in the delta or a restore resurrects the demoted
+        # rows on the device AND in the cold store (cells that never held
+        # data need no note: identity before, identity after)
+        if self.log is not None and all_ckids:
+            for i, (_key, hid, _cid) in enumerate(demotions):
+                c = np.asarray(counts[i])[spos]
+                live = np.flatnonzero(c > 0)
+                if live.size:
+                    self.note_hot_cells(
+                        np.full(live.size, hid, np.int64), span[live])
+
+    def apply_promotions(self, promotions: List[Tuple[Any, int, int]],
+                         live_lo: Optional[int], live_hi: Optional[int],
+                         ring_limit: Optional[int]
+                         ) -> Optional[Tuple[int, int]]:
+        """Move each re-admitted key's cold rows (within the live,
+        ring-safe span) into its fresh device row. Cold rows beyond
+        `ring_limit` stay cold (the emission merge covers the split).
+        Returns the (smin, smax) span written to the device, or None.
+        Raises :class:`ColdTierError` when the cold artifact is
+        unreadable — promotion must fail loudly, never admit a key with
+        silently missing history."""
+        if not promotions or live_lo is None or live_hi is None \
+                or self._write_cells is None:
+            return None
+        hi = live_hi if ring_limit is None else min(live_hi, ring_limit - 1)
+        if hi < live_lo:
+            return None
+        span = np.arange(live_lo, hi + 1, dtype=np.int64)
+        w_kids, w_spos, w_counts = [], [], []
+        w_fields: Dict[str, list] = {f.name: [] for f in self._fields}
+        cleared: List[Tuple[int, np.ndarray]] = []
+        smin = smax = None
+        for _key, hid, cid in promotions:
+            # only slices this cold id actually touched are read
+            touched = [s for s in span if self._has_touched(int(s), cid)]
+            if not touched:
+                continue
+            t = np.asarray(touched, np.int64)
+            rows, cc, found = self.cold.read_rows(int(cid), t)
+            live = np.flatnonzero(found & (cc > 0))
+            if live.size == 0:
+                continue
+            w_kids.append(np.full(live.size, hid, np.int64))
+            w_spos.append((t[live] % self.S).astype(np.int64))
+            w_counts.append(cc[live])
+            for fi, f in enumerate(self._fields):
+                w_fields[f.name].append(rows[live, fi])
+            cleared.append((int(cid), t[live]))
+            lo_i, hi_i = int(t[live].min()), int(t[live].max())
+            smin = lo_i if smin is None else min(smin, lo_i)
+            smax = hi_i if smax is None else max(smax, hi_i)
+        if not w_kids:
+            return None
+        kids = np.concatenate(w_kids)
+        spos = np.concatenate(w_spos)
+        counts = np.concatenate(w_counts).astype(np.int64)
+        fields = {name: np.concatenate(chunks)
+                  for name, chunks in w_fields.items() if chunks}
+        self._write_cells(kids, spos, counts, fields)
+        self.num_promoted_rows += len(kids)
+        abs_slices = np.concatenate([t for _cid, t in cleared])
+        for cid, t in cleared:
+            self.cold.clear_rows(cid, t)
+        self._journal(("cold_clear", [(cid, t) for cid, t in cleared]))
+        # promoted device cells belong to this interval's delta, and the
+        # absolute slice is recoverable from (spos, span) only here
+        if self.log is not None:
+            self.note_hot_cells(kids, abs_slices)
+        return smin, smax
+
+    def _has_touched(self, s: int, cid: int) -> bool:
+        ids = self._touched.get(s)
+        return ids is not None and cid in ids
+
+    # ------------------------------------------------------------------
+    # fires + retention
+    # ------------------------------------------------------------------
+    def cold_fire(self, slice_range) -> Optional[
+            Tuple[np.ndarray, Dict[str, np.ndarray], np.ndarray]]:
+        """Cold contributions to one fired window: (cold_ids, fields,
+        counts) over the touched ids in the range, or None."""
+        union: set = set()
+        for s in slice_range:
+            union.update(self._touched.get(int(s), ()))
+        if not union:
+            return None
+        ids = np.asarray(sorted(union), dtype=np.int64)
+        # ids promoted/cleared since touch still resolve: their rows read
+        # back as zero-count and fall out below
+        fields, counts = self.cold.fire_ids(ids, slice_range)
+        live = np.flatnonzero(counts > 0)
+        if live.size == 0:
+            return None
+        return (ids[live],
+                {n: v[live] for n, v in fields.items()},
+                counts[live])
+
+    def purge_below(self, frontier_slice: Optional[int]) -> None:
+        if frontier_slice is None:
+            return
+        self.cold.purge_below_slice(int(frontier_slice))
+        for s in [s for s in self._touched if s < frontier_slice]:
+            del self._touched[s]
+
+    # ------------------------------------------------------------------
+    # gauges
+    # ------------------------------------------------------------------
+    def gauges(self) -> Dict[str, Any]:
+        v = self.vocab
+        return {
+            "vocabSize": v.vocab_size,
+            "residentKeys": v.resident_count,
+            "evictions": v.num_evictions,
+            "promotions": v.num_promotions,
+            "spilledBytes": self.cold.approx_bytes(),
+            "changelogBytes": self.changelog_bytes_last_interval,
+            "tierHotFillRatio": round(
+                v.resident_count / max(v.capacity, 1), 4),
+        }
+
+    def payload(self) -> Dict[str, Any]:
+        """/jobs/:id/device tier block (JSON-safe)."""
+        p = dict(self.gauges())
+        p.update({
+            "hotKeyCapacity": self.vocab.capacity,
+            "evictionPolicy": self.vocab.policy,
+            "admissionMinCount": self.vocab.admission_min_count,
+            "coldRecords": self.num_cold_records,
+            "demotedRows": self.num_demoted_rows,
+            "promotedRows": self.num_promoted_rows,
+            "changelogEnabled": self.log is not None,
+        })
+        return p
+
+    # ------------------------------------------------------------------
+    # checkpointing
+    # ------------------------------------------------------------------
+    def _journal(self, entry: tuple) -> None:
+        if self.log is not None:
+            self.log.append(entry)
+
+    def _dirty_cells(self) -> Tuple[np.ndarray, np.ndarray]:
+        if not self._dirty:
+            return np.zeros(0, np.int64), np.zeros(0, np.int64)
+        kid = np.concatenate([k for k, _ in self._dirty])
+        s = np.concatenate([s for _, s in self._dirty])
+        packed = np.unique(np.stack([kid, s], axis=1), axis=0)
+        return packed[:, 0], packed[:, 1]
+
+    def full_snapshot(self) -> dict:
+        """The tier's own full (materialization-grade) state."""
+        return {
+            "vocab": self.vocab.snapshot(),
+            "cold": self.cold.snapshot(),
+            "touched": {int(s): sorted(ids)
+                        for s, ids in self._touched.items() if ids},
+            "counters": [self.num_demoted_rows, self.num_promoted_rows,
+                         self.num_cold_records],
+        }
+
+    def restore_full(self, snap: dict) -> None:
+        self.vocab = DynamicKeyVocabulary.restore(snap["vocab"])
+        self.cold.restore(snap["cold"])
+        self._touched = {int(s): set(int(i) for i in ids)
+                         for s, ids in snap["touched"].items()}
+        (self.num_demoted_rows, self.num_promoted_rows,
+         self.num_cold_records) = snap["counters"]
+        self._dirty = []
+
+    def checkpoint(self, pipe_meta: dict, gather_cells: Callable,
+                   full_pipe_snapshot: Callable[[], dict]) -> dict:
+        """Incremental checkpoint: append ONE `cells` entry (the
+        interval-touched device cells at their current values + the
+        pipeline/operator meta) and return a (base, offset) handle.
+        Every `materialize_interval` checkpoints the full state folds
+        into a fresh base file and the log truncates below the oldest
+        retained base."""
+        assert self.log is not None, "checkpoint() needs changelog_enabled"
+        if not self._bases or self._cp_since_base >= \
+                max(self.cfg.materialize_interval, 1):
+            self._materialize(full_pipe_snapshot)
+        kids, s_abs = self._dirty_cells()
+        self._dirty = []
+        counts, fields = (gather_cells(kids, (s_abs % self.S))
+                          if kids.size else
+                          (np.zeros(0, np.int64),
+                           {f.name: np.zeros(0) for f in self._fields
+                            if f.source != ONE}))
+        self.log.append(("cells", {
+            # hot ids are < capacity and counts are the int32 ring's —
+            # narrow dtypes keep the per-interval delta lean (s_abs stays
+            # int64: absolute slice indices are unbounded)
+            "kids": kids.astype(np.int32), "s_abs": s_abs,
+            "counts": np.asarray(counts).astype(np.int32),
+            "fields": {k: np.asarray(v) for k, v in fields.items()},
+            "meta": pipe_meta,
+        }))
+        self._cp_since_base += 1
+        self.changelog_bytes_last_interval = \
+            self.log.bytes_written - self._bytes_mark
+        self._bytes_mark = self.log.bytes_written
+        self.interval_bytes_history.append(
+            self.changelog_bytes_last_interval)
+        if len(self.interval_bytes_history) > 256:
+            del self.interval_bytes_history[:-256]
+        base_offset, base_file = self._bases[-1]
+        return {
+            "kind": CHANGELOG_HANDLE_KIND,
+            "dir": self.log.dir,
+            "base_offset": base_offset,
+            "base_file": base_file,
+            "log_offset": self.log.offset,
+        }
+
+    def _materialize(self, full_pipe_snapshot: Callable[[], dict]) -> None:
+        assert self.log is not None
+        base = {
+            "pipe": full_pipe_snapshot(),
+            "tier": self.full_snapshot(),
+        }
+        offset = self.log.offset
+        path = os.path.join(self.log.dir, f"base-{offset:012d}.mat")
+        tmp = path + ".tmp"
+        with open(tmp, "wb") as f:
+            pickle.dump(base, f, protocol=pickle.HIGHEST_PROTOCOL)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+        self._bases.append((offset, path))
+        self._cp_since_base = 0
+        retained = max(self.cfg.retained_bases, 1)
+        if len(self._bases) > retained:
+            for _off, old in self._bases[:-retained]:
+                try:
+                    os.unlink(old)
+                except OSError:
+                    pass
+            self._bases = self._bases[-retained:]
+        # log entries below the OLDEST retained base can never be replayed
+        # (every restorable handle references a retained base's offset) —
+        # EXCEPT right after a restore, when the coordinator may still
+        # retain checkpoints referencing older bases: hold the cut until
+        # the retention window has provably rolled past them
+        if self._truncate_holdoff > 0:
+            self._truncate_holdoff -= 1
+        else:
+            self.log.truncate(self._bases[0][0])
+
+    def last_base_bytes(self) -> int:
+        """On-disk size of the newest materialized base — the FULL-state
+        snapshot the per-interval deltas are measured against."""
+        if not self._bases:
+            return 0
+        try:
+            return os.path.getsize(self._bases[-1][1])
+        except OSError:
+            return 0
+
+    def restore_changelog(self, handle: dict) -> dict:
+        """Rebuild the canonical full snapshot from (base, log range):
+        pure numpy replay — mesh-size independent by construction. The
+        manager adopts the handle's log dir (trimmed of any dead
+        timeline) and restores its own vocab/cold/touched state; the
+        returned dict carries the reconstructed `pipe` snapshot + `meta`
+        for the operator to apply."""
+        if handle.get("kind") != CHANGELOG_HANDLE_KIND:
+            raise ValueError("not a changelog checkpoint handle")
+        log = (self.log if self.log is not None
+               and self.log.dir == handle["dir"]
+               else FsStateChangelog(handle["dir"]))
+        try:
+            with open(handle["base_file"], "rb") as f:
+                base = pickle.load(f)
+        except Exception as e:  # noqa: BLE001 — typed artifact error
+            raise ColdTierError(
+                f"changelog base unreadable: {e!r}") from e
+        pipe_snap = base["pipe"]
+        state = {k: np.array(v) for k, v in pipe_snap["state"].items()}
+        count = np.array(pipe_snap["count"])
+        self.restore_full(base["tier"])
+        meta: Optional[dict] = None
+        purged_to = pipe_snap.get("purged_to")
+        entries = log.read_entries(handle["base_offset"],
+                                   handle["log_offset"])
+        expected = handle["log_offset"] - handle["base_offset"]
+        if len(entries) != expected:
+            raise ColdTierError(
+                f"changelog range ({handle['base_offset']}, "
+                f"{handle['log_offset']}] incomplete: "
+                f"{len(entries)}/{expected} entries readable")
+        identities = {f.name: f.identity for f in self._fields
+                      if f.source != ONE}
+        S = count.shape[1]
+        for _seq, entry in entries:
+            kind = entry[0]
+            if kind == "cold_ingest":
+                _k, ckids, cs, cvals = entry
+                self.cold.ingest(ckids, cs, cvals)
+                self.num_cold_records += len(ckids)
+                self._note_touched(ckids, cs)
+            elif kind == "cold_absorb":
+                _k, ckids, slices, rows, cc = entry
+                self.cold.absorb_rows(ckids, slices, rows, cc)
+                self._note_touched(ckids, slices)
+                self.num_demoted_rows += len(ckids)
+            elif kind == "cold_clear":
+                for cid, t in entry[1]:
+                    self.cold.clear_rows(cid, t)
+            elif kind == "vocab":
+                self.vocab.apply_ops(entry[1])
+            elif kind == "vocab_cold_batch":
+                self.vocab.apply_ops(
+                    [("cold", int(k), int(c))
+                     for k, c in zip(entry[1], entry[2])])
+            elif kind == "cells":
+                d = entry[1]
+                meta = d["meta"]
+                new_p = meta.get("purged_to")
+                if new_p is not None and (purged_to is None
+                                          or new_p > purged_to):
+                    lo = purged_to if purged_to is not None \
+                        else new_p - S
+                    if new_p - lo >= S:
+                        count[:, :] = 0
+                        for name, arr in state.items():
+                            arr[:, :] = identities[name]
+                    else:
+                        cols = (np.arange(max(lo, new_p - S), new_p)
+                                % S).astype(np.int64)
+                        count[:, cols] = 0
+                        for name, arr in state.items():
+                            arr[:, cols] = identities[name]
+                    purged_to = new_p
+                kids = np.asarray(d["kids"], np.int64)
+                if kids.size:
+                    spos = (np.asarray(d["s_abs"], np.int64) % S)
+                    count[kids, spos] = np.asarray(d["counts"])
+                    for name, vals in d["fields"].items():
+                        state[name][kids, spos] = np.asarray(vals)
+            else:
+                raise ColdTierError(
+                    f"unknown changelog entry kind {kind!r}")
+        if meta is None:
+            raise ColdTierError(
+                "changelog range holds no `cells` checkpoint entry")
+        # dead-timeline cut, then adopt this log for the new attempt
+        log.trim_above(handle["log_offset"])
+        self.log = log
+        self._bytes_mark = log.bytes_written
+        self._bases = [(handle["base_offset"], handle["base_file"])]
+        self._cp_since_base = max(self.cfg.materialize_interval, 1)
+        self._truncate_holdoff = max(self.cfg.retained_bases, 1)
+        self._dirty = []
+        full_pipe = dict(pipe_snap)
+        full_pipe["state"] = state
+        full_pipe["count"] = count
+        for k in ("watermark", "fire_cursor", "purged_to",
+                  "min_used_slice", "max_seen_slice", "num_late_dropped"):
+            if k in meta:
+                full_pipe[k] = meta[k]
+        return {"pipe": full_pipe, "meta": meta}
+
+    def journal_vocab_ops(self) -> None:
+        """Flush the vocabulary's structural-op journal into the log
+        (called once per routed batch by the operator, so replay applies
+        ops in stream order relative to the cold absorbs). The dominant
+        op under high cardinality — ("cold", key, cid), one per NEW key —
+        packs columnar when keys are ints (~3x smaller pickled than a
+        tuple list); packing them FIRST preserves per-key order, since
+        within one batch a key's cold op always precedes its admit/evict/
+        promote ops."""
+        if self.log is None:
+            self.vocab.drain_ops()
+            return
+        ops = self.vocab.drain_ops()
+        if not ops:
+            return
+        cold_keys: List[int] = []
+        cold_cids: List[int] = []
+        rest: List[tuple] = []
+        for op in ops:
+            if op[0] == "cold" and type(op[1]) is int:
+                cold_keys.append(op[1])
+                cold_cids.append(op[2])
+            else:
+                rest.append(op)
+        if cold_keys:
+            self.log.append(("vocab_cold_batch",
+                             np.asarray(cold_keys, np.int64),
+                             np.asarray(cold_cids, np.int64)))
+        if rest:
+            self.log.append(("vocab", rest))

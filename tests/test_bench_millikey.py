@@ -1,0 +1,119 @@
+"""Bench smoke gate for the million-key state plane (ISSUE-12).
+
+Runs the real `bench.millikey_microbench` at smoke scale (key cardinality
+~50x the resident HBM capacity, uniform + zipf(1.0) variants, the sharded
+variant on the virtual 8-device CPU mesh) and asserts the result JSON
+carries the `state_tier.*` keys every BENCH_*.json must now track — so a
+regression that silently breaks tier parity, lets the resident key set
+grow unbounded (the vocabulary stopped evicting), stops exercising the
+cold tier, or inflates incremental checkpoints back to full-state cost
+fails tier-1, not just a human reading the next bench artifact.
+
+Absolute throughput is deliberately not asserted (2-vCPU CI); the
+structural keys and the parity/boundedness/ratio gates are the contract.
+"""
+
+import importlib.util
+import pathlib
+
+import pytest
+
+_BENCH = pathlib.Path(__file__).resolve().parents[1] / "bench.py"
+
+#: acceptance bar: median per-checkpoint-interval changelog bytes must
+#: stay below a quarter of the materialized full-state base size
+INCREMENTAL_RATIO_BAR = 0.25
+
+
+@pytest.fixture(scope="module")
+def bench():
+    spec = importlib.util.spec_from_file_location("bench_millikey_smoke",
+                                                  _BENCH)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+@pytest.fixture(scope="module")
+def result(bench):
+    # smoke scale: 100k-key vocabulary over a 2048-row hot tier — far
+    # past capacity so admission/eviction/cold routing all engage, small
+    # enough for the tier-1 budget
+    return bench.millikey_microbench(events=49152, batch=2048,
+                                     num_keys=100_000, hot_capacity=2048,
+                                     parity_keys=4096)
+
+
+def test_result_carries_the_tracked_state_tier_keys(result):
+    assert "error" not in result, result.get("error")
+    for key in ("events", "num_keys", "hot_key_capacity", "parity",
+                "tuples_per_sec", "incremental_ratio", "uniform", "zipf",
+                "sharded"):
+        assert key in result, f"bench state_tier block lost {key!r}"
+    for variant in ("uniform", "zipf"):
+        blk = result[variant]
+        for key in ("parity", "parity_vs_untired", "tuples_per_sec",
+                    "vocab_size", "resident_keys", "evictions",
+                    "promotions", "cold_records", "resident_bounded",
+                    "changelog_interval_bytes_p50", "full_snapshot_bytes",
+                    "incremental_ratio"):
+            assert key in blk, f"state_tier.{variant} lost {key!r}"
+
+
+def test_parity_both_variants_and_both_oracles(result):
+    for variant in ("uniform", "zipf"):
+        blk = result[variant]
+        assert blk["parity_vs_untired"], (
+            f"{variant}: tiered run diverged from the untired fused run")
+        assert blk["parity"], (
+            f"{variant}: full-cardinality tiered run diverged from the "
+            "host oracle")
+    assert result["parity"]
+
+
+def test_resident_keys_bounded_with_eviction_under_skew(result):
+    for variant in ("uniform", "zipf"):
+        blk = result[variant]
+        assert blk["resident_bounded"], (
+            f"{variant}: resident keys {blk['resident_keys']} exceed the "
+            f"hot capacity {result['hot_key_capacity']} — the vocabulary "
+            "stopped bounding HBM")
+        assert blk["vocab_size"] > result["hot_key_capacity"], (
+            f"{variant}: the workload never exceeded the hot capacity — "
+            "the scenario stopped testing tiering at all")
+    # the zipf head churns the hot boundary: zero evictions means the
+    # vocabulary stopped evicting (unbounded-HBM regression incoming)
+    assert result["zipf"]["evictions"] > 0, (
+        "zero evictions under zipf — eviction is dead")
+    assert result["zipf"]["promotions"] > 0, (
+        "zero promotions under zipf — re-admission never moves cold rows "
+        "back")
+    # the cold tier must actually hold data in both variants
+    for variant in ("uniform", "zipf"):
+        assert result[variant]["cold_records"] > 0, (
+            f"{variant}: no record ever routed cold")
+
+
+def test_incremental_checkpoints_beat_full_snapshots(result):
+    for variant in ("uniform", "zipf"):
+        blk = result[variant]
+        assert blk.get("checkpoints", 0) > 3, (
+            f"{variant}: too few checkpoints completed to judge the "
+            "incremental ratio")
+        assert blk["incremental_ratio"] < INCREMENTAL_RATIO_BAR, (
+            f"{variant}: per-interval changelog bytes "
+            f"({blk['changelog_interval_bytes_p50']}) are "
+            f"{blk['incremental_ratio']:.2f}x the full snapshot "
+            f"({blk['full_snapshot_bytes']}) — incremental checkpoints "
+            f"no longer scale with the delta (bar {INCREMENTAL_RATIO_BAR})")
+
+
+def test_sharded_variant_runs_at_parity(result):
+    sh = result["sharded"]
+    if sh.get("skipped"):
+        pytest.skip(f"no usable mesh ({sh.get('devices')} device(s))")
+    assert sh["mesh_selected"], (
+        "the tiered job fell back to single-chip — parallel.mesh.enabled "
+        "no longer promotes tiered jobs to the mesh")
+    assert sh["parity"], "tiered mesh run diverged from the untired run"
+    assert sh["evictions"] and sh["evictions"] > 0

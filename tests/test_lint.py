@@ -111,6 +111,17 @@ def test_path_scoped_rules_are_not_vacuous():
         assert index.get(rel) is not None, (
             f"{rel} missing — the multichip SPMD core moved and the "
             "parallel layer's ARCH001 entry no longer covers it")
+    # the million-key state plane must stay in state/ under the state
+    # layer's runtime ban: the vocabulary decides placement and the tier
+    # manager moves bytes through operator-injected callables — a module
+    # that imported the runtime would invert that DAG
+    assert any("runtime" in b for b in LAYER_FORBIDDEN["state"]), (
+        "state layer no longer forbids runtime imports — vocab.py/"
+        "tier_manager.py could silently grow executor dependencies")
+    for rel in ("state/vocab.py", "state/tier_manager.py"):
+        assert index.get(rel) is not None, (
+            f"{rel} missing — the state plane moved and the state "
+            "layer's ARCH001 entry no longer covers it")
     # the device-plane observability modules must stay in metrics/ under
     # the metrics layer's runtime ban: compile/key telemetry flows OUTWARD
     # (runtime callers hand in jitted fns and load columns), and a tracker

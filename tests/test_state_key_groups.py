@@ -203,7 +203,9 @@ def test_merge_timers_tolerates_missing_and_none_watermarks():
 def test_reshardable_rejects_device_operator_snapshots():
     ok, why = reshardable({0: {"operator": {"state": {}, "timers": {}}}})
     assert ok and why == ""
-    for marker in ("columnar", "cnt"):
+    # "pipe" = fused-superscan rings; "tier"/"tier_changelog" = the
+    # million-key state plane's full/incremental snapshot forms
+    for marker in ("columnar", "cnt", "pipe", "tier", "tier_changelog"):
         ok, why = reshardable({
             0: {"operator": {"state": {}}},
             1: {"operator": {marker: object()}},
