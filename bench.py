@@ -1422,6 +1422,201 @@ def api_path_microbench(events: Optional[int] = None,
     }
 
 
+def sql_path_microbench(events: Optional[int] = None,
+                        batch: int = 8192,
+                        span_event_ms: int = 64_000) -> dict:
+    """SQL front-door scenario (ISSUE-13): the YSB sliding count written
+    as SQL — `SELECT campaign, COUNT(*) ... GROUP BY campaign, HOP(...)`
+    over a columnar table — through THREE paths in one process on the
+    same data:
+
+      - SQL-fused (table.device-fusion true, the default): the planner
+        (flink_tpu/planner) lowers the statement onto the same
+        whole-graph-fusion StepGraph a hand-built DataStream job takes —
+        DeviceChainRunner runs filter + key/value extraction + window as
+        ONE compiled superscan;
+      - interpreted table path (table.device-fusion false): the legacy
+        TableEnvironment translation — per-record row view, host keying,
+        per-batch device window — what every SQL statement paid before;
+      - hand-built DataStream-fused: the SAME program written against the
+        fluent API with traceable UDFs, with the SAME SQL-shaped output
+        row assembly, so `ratio_vs_datastream_fused` isolates what the
+        SQL front door costs over hand fusion (the ~1.2x acceptance bar)
+        rather than re-measuring the row-materialization tax both pay.
+
+    `parity` is exact three-way row equality; `fused_selected` pins that
+    graph translation actually chose DeviceChainRunner for the SQL job
+    (the reroute gate) AND the planner reported the fused path. A session
+    -window statement additionally runs through the same TableEnvironment
+    to pin the fallback contract: it must EXECUTE on the interpreted path
+    with its catalogued reason attributed, not fail."""
+    from flink_tpu.api.datastream import StreamExecutionEnvironment
+    from flink_tpu.api.windowing.assigners import SlidingEventTimeWindows
+    from flink_tpu.config import Configuration, ExecutionOptions, TableOptions
+    from flink_tpu.connectors.source import Batch, DataGeneratorSource
+    from flink_tpu.core.watermarks import WatermarkStrategy
+    from flink_tpu.graph.transformation import plan
+    from flink_tpu.runtime.executor import build_runners
+    from flink_tpu.table import TableEnvironment, TableSchema
+
+    events = events or int(os.environ.get("BENCH_SQL_EVENTS", str(1 << 21)))
+
+    def source(n):
+        def gen(idx):
+            camp = (idx * 2654435761) % NUM_KEYS
+            etype = idx % 3
+            col = np.stack([camp, etype], axis=1).astype(np.float32)
+            ts = 10_000 + idx * span_event_ms // n
+            return Batch(col, ts.astype(np.int64))
+
+        return DataGeneratorSource(gen, n)
+
+    SQL = (
+        "SELECT campaign, COUNT(*) AS views, WINDOW_END AS wend FROM ysb "
+        "WHERE event_type < 0.5 GROUP BY campaign, "
+        f"HOP(rowtime, INTERVAL '{SLIDE_MS}' MILLISECOND, "
+        f"INTERVAL '{WINDOW_MS}' MILLISECOND)"
+    )
+
+    def config(fused: bool) -> Configuration:
+        cfg = Configuration()
+        cfg.set(TableOptions.DEVICE_FUSION, fused)
+        cfg.set(ExecutionOptions.BATCH_SIZE, batch)
+        cfg.set(ExecutionOptions.KEY_CAPACITY, NUM_KEYS)
+        return cfg
+
+    def build_sql(n, fused):
+        env = StreamExecutionEnvironment.get_execution_environment(config(fused))
+        tenv = TableEnvironment(env)
+        stream = env.from_source(
+            source(n),
+            watermark_strategy=WatermarkStrategy.for_bounded_out_of_orderness(0),
+        )
+        tenv.register_table(
+            "ysb", stream,
+            TableSchema(["campaign", "event_type", "rowtime"],
+                        rowtime="rowtime",
+                        field_types=["int", "float", "int"]),
+            columnar=True,
+        )
+        sink = tenv.sql_query(SQL).collect()
+        return env, tenv, sink
+
+    # shared UDF objects across runs: compiled chain executables memoize on
+    # fn identity, so warmup pays compilation once (api_path economics)
+    t_filter = lambda col: col[:, 1] < 0.5                    # noqa: E731
+    t_key = lambda col: col[:, 0].astype("int32")             # noqa: E731
+
+    def ds_to_row(rec, ts):
+        # the SQL statement's output shape, hand-written: what a user
+        # replacing SQL with the fluent API would still have to emit
+        return {"campaign": rec[0], "views": rec[1], "wend": ts + 1}
+
+    def build_ds(n):
+        env = StreamExecutionEnvironment.get_execution_environment(config(True))
+        ds = env.from_source(
+            source(n),
+            watermark_strategy=WatermarkStrategy.for_bounded_out_of_orderness(0),
+        )
+        win = (
+            ds.filter(t_filter, traceable=True)
+            .key_by(t_key, traceable=True)
+            .window(SlidingEventTimeWindows.of(WINDOW_MS, SLIDE_MS))
+            .aggregate("count")
+        )
+        sink = win.map_with_timestamp(ds_to_row, name="sql_shape_output").collect()
+        return env, sink
+
+    def norm(rows):
+        return sorted((int(r["campaign"]), int(r["wend"]), int(r["views"]))
+                      for r in rows)
+
+    def run_sql(n, fused):
+        env, _tenv, sink = build_sql(n, fused)
+        t0 = time.perf_counter()
+        env.execute()
+        return sink.results, n / max(time.perf_counter() - t0, 1e-9)
+
+    def run_ds(n):
+        env, sink = build_ds(n)
+        t0 = time.perf_counter()
+        env.execute()
+        return sink.results, n / max(time.perf_counter() - t0, 1e-9)
+
+    # ---- reroute gate: the SQL program's own graph must translate to
+    # DeviceChainRunner AND the planner must report the fused path
+    env_probe, tenv_probe, _ = build_sql(batch, True)
+    probe_runners, _ = build_runners(plan(env_probe._sinks), env_probe.config)
+    report = tenv_probe.last_plan_report
+    fused_selected = bool(
+        any(type(r).__name__ == "DeviceChainRunner" for r in probe_runners)
+        and report is not None and report.fused
+    )
+
+    # ---- fallback contract: an unsupported statement EXECUTES on the
+    # interpreted path with its reason attributed (never fails)
+    env_fb = StreamExecutionEnvironment.get_execution_environment(config(True))
+    tenv_fb = TableEnvironment(env_fb)
+    tenv_fb.from_rows(
+        "pay",
+        [{"user": i % 5, "amount": float(i % 3), "rowtime": i * 100}
+         for i in range(512)],
+        TableSchema(["user", "amount", "rowtime"], rowtime="rowtime",
+                    field_types=["int", "float", "int"]),
+    )
+    fb_rows = tenv_fb.execute_sql_to_list(
+        "SELECT user, COUNT(*) AS n FROM pay "
+        "GROUP BY user, SESSION(rowtime, INTERVAL '1' SECOND)")
+    fb_report = tenv_fb.last_plan_report
+    fallback_attributed = bool(
+        fb_rows and fb_report is not None
+        and fb_report.path == "interpreted"
+        and fb_report.reason == "session-window")
+
+    # ---- parity gate: exact three-way row equality. The interpreted path
+    # is per-record host work; a reduced slice keeps the gate O(seconds)
+    # while still covering every window shape the others see.
+    n_parity = max(events // 16, batch)
+    rows_fused = norm(run_sql(n_parity, True)[0])
+    rows_interp = norm(run_sql(n_parity, False)[0])
+    rows_ds = norm(run_ds(n_parity)[0])
+    parity = bool(len(rows_fused) > 0
+                  and rows_fused == rows_interp == rows_ds)
+
+    # ---- timed runs: interleaved max-of-N sweeps (PR-3 protocol); the
+    # interpreted path runs fewer events — its per-event rate is flat and
+    # it IS the gap being measured
+    run_sql(batch * 12, True)
+    run_ds(batch * 12)
+    tps_sql = tps_interp = tps_ds = 0.0
+    res_sql = []
+    for _sweep in range(3):
+        res_sql, t = run_sql(events, True)
+        tps_sql = max(tps_sql, t)
+        _r, t = run_sql(max(events // 16, batch), False)
+        tps_interp = max(tps_interp, t)
+        _r, t = run_ds(events)
+        tps_ds = max(tps_ds, t)
+    return {
+        "sql_tuples_per_sec": round(tps_sql, 1),
+        "interpreted_tuples_per_sec": round(tps_interp, 1),
+        "datastream_fused_tuples_per_sec": round(tps_ds, 1),
+        "speedup_vs_interpreted": round(tps_sql / max(tps_interp, 1e-9), 2),
+        "ratio_vs_datastream_fused": round(tps_ds / max(tps_sql, 1e-9), 3),
+        "parity": parity,
+        "fused_selected": fused_selected,
+        "fallback_attributed": fallback_attributed,
+        "fallback_reason_demo": getattr(fb_report, "reason", None),
+        "windows_emitted": len(res_sql),
+        "events": events,
+        "num_keys": NUM_KEYS,
+        "window_ms": WINDOW_MS,
+        "slide_ms": SLIDE_MS,
+        "statement": SQL,
+        "workload": "ysb_sliding_count_sql",
+    }
+
+
 def device_plane_microbench(events: Optional[int] = None,
                             batch: int = 8192,
                             num_keys: Optional[int] = None,
@@ -1665,6 +1860,28 @@ def child_api_path() -> None:
 def run_api_path_microbench_child(timeout_s: float = 300.0) -> dict:
     """API-path microbench in a CPU-pinned child (same backend both paths)."""
     return _run_cpu_child('api-path', timeout_s)
+
+
+def child_sql_path() -> None:
+    """SQL-path child: CPU-pinned like child_api_path — the three-way
+    comparison is CPU-jit vs CPU-jit (same backend all paths), and the
+    parent must never lose the single-client TPU relay to it."""
+    _emit({"event": "start", "device": "cpu-sql-path", "pid": os.getpid()})
+    try:
+        import jax
+        from jax._src import xla_bridge as _xb
+
+        jax.config.update("jax_platforms", "cpu")
+        _xb._backend_factories.pop("axon", None)
+        _xb._topology_factories.pop("axon", None)
+    except Exception:
+        pass
+    _emit({"event": "result", "result": sql_path_microbench()})
+
+
+def run_sql_path_microbench_child(timeout_s: float = 300.0) -> dict:
+    """SQL-path microbench in a CPU-pinned child (same backend all paths)."""
+    return _run_cpu_child('sql-path', timeout_s)
 
 
 def child_checkpoint() -> None:
@@ -2300,6 +2517,13 @@ def parent_main() -> None:
     api_path = run_api_path_microbench_child()
     _emit({"event": "api_path_microbench", "result": api_path})
 
+    # SQL front door: the YSB sliding count as SQL through the planner's
+    # fused lowering vs the interpreted table path vs the hand-built
+    # DataStream-fused yardstick — three-way parity + the reroute gate,
+    # CPU-pinned child like the api-path scenario
+    sql_path = run_sql_path_microbench_child()
+    _emit({"event": "sql_path_microbench", "result": sql_path})
+
     # device-plane observability: compile/recompile tracking, roofline +
     # phase attribution, key skew, and the measured overhead of the
     # enabled plane — CPU-pinned child like the api-path scenario
@@ -2341,6 +2565,13 @@ def parent_main() -> None:
             best["checkpoint"] = checkpoint
             best["autoscaler"] = autoscaler
             best["api_path"] = api_path
+            best["sql_path"] = sql_path
+            # top-level continuity key for the trajectory table: the SQL
+            # front door's fused throughput, tracked per PR like the
+            # api-path number
+            sql_tps = sql_path.get("sql_tuples_per_sec")
+            if sql_tps:
+                best["sql_path_tuples_per_sec"] = sql_tps
             best["chaos"] = chaos
             best["multichip"] = multichip
             best["state_tier"] = millikey
@@ -2458,6 +2689,8 @@ def main() -> None:
             child_autoscaler()
         elif label == "api-path":
             child_api_path()
+        elif label == "sql-path":
+            child_sql_path()
         elif label == "device-plane":
             child_device_plane()
         elif label == "chaos":

@@ -283,6 +283,27 @@ class ExecutionOptions:
     )
 
 
+class TableOptions:
+    """The Table/SQL front door (flink_tpu/table + flink_tpu/planner)."""
+
+    DEVICE_FUSION = (
+        ConfigOptions.key("table.device-fusion").bool_type().default_value(True)
+    ).with_description(
+        "Route SQL statements through the table-plan planner "
+        "(flink_tpu/planner): supported windowed GROUP BY aggregates lower "
+        "onto the SAME fused StepGraph path a hand-built DataStream job "
+        "takes (one compiled superscan via whole-graph fusion, "
+        "docs/sql.md) — requires declared field_types (columnar or "
+        "row-mode registration; the GROUP BY key must be a declared "
+        "int). Statements outside the fused core (joins, "
+        "session windows, UDF/ML projections, untyped row tables, ...) "
+        "fall back to the interpreted table path with an attributed "
+        "reason; set to false to force the interpreted path for every "
+        "statement. A perf switch, never a semantics switch: both paths "
+        "produce identical rows."
+    )
+
+
 class ExchangeOptions:
     """The cross-host dataplane exchange (runtime/dataplane.py — the DCN
     counterpart of the reference's Netty shuffle and its

@@ -43,6 +43,13 @@ LAYER_FORBIDDEN: Dict[str, List[str]] = {
     "parallel": ["{pkg}.runtime", "{pkg}.api", "{pkg}.table", "{pkg}.cep",
                  "{pkg}.scheduler"],
     "graph": ["{pkg}.table", "{pkg}.cep", "{pkg}.runtime"],
+    # the SQL planner translates table plans into graph transformations:
+    # it may import table (parsed Query shapes), graph, core, and config —
+    # never the runtime (it emits plans, the executor runs them), the api
+    # (assigner construction is a function-scoped lazy import), the
+    # scheduler, or cep
+    "planner": ["{pkg}.runtime", "{pkg}.api", "{pkg}.scheduler",
+                "{pkg}.cep"],
     "api": ["{pkg}.table", "{pkg}.runtime"],
     # the autoscaler consumes metric-snapshot/state/config shapes and is
     # driven by the runtime through injected callables — it may import

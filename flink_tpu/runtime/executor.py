@@ -615,6 +615,10 @@ class WindowStepRunner(StepRunner):
             self.device = False
         self.processing_time = not assigner.is_event_time
         self.uid = t.uid
+        # SQL-originated window steps (flink_tpu/planner lowering) are
+        # marked so the job can report which execution path SQL selected
+        # (job.sqlFusedSelected gauge + /jobs/:id visibility)
+        self.sql_origin = bool(cfg.get("sql_origin"))
         # per-fused-stage device-time attribution (host clock around the
         # already-synchronous dispatch/readback sections; never adds syncs)
         self._drain_resolves_device = getattr(
@@ -941,6 +945,7 @@ class DeviceChainRunner(WindowStepRunner):
         self.window_fn = None
         self.processing_time = False
         self.uid = t.uid
+        self.sql_origin = bool(cfg.get("sql_origin"))
         self._drain_resolves_device = True
         self.device_timer = (
             DeviceTimer()
@@ -1761,6 +1766,19 @@ class JobRuntime:
         # actual shard count when parallel.mesh.enabled promoted the job —
         # dashboards and the autoscaler read THIS, not the requested config
         job_group.gauge("meshDevices", self.mesh_devices)
+        # SQL front-door visibility: present only for SQL-originated jobs
+        # (planner-lowered window terminals carry sql_origin). 1 when every
+        # SQL window step selected the fused DeviceChainRunner — the
+        # reroute gate dashboards and the sql_path bench read; 0 means the
+        # planner fell back (or translation rerouted) to interpreted-style
+        # execution for at least one of them.
+        sql_runners = [r for r in self.runners
+                       if getattr(r, "sql_origin", False)]
+        if sql_runners:
+            job_group.gauge(
+                "sqlFusedSelected",
+                lambda rs=tuple(sql_runners): int(all(
+                    isinstance(r, DeviceChainRunner) for r in rs)))
         job_group.gauge("deviceTimeMsTotal", lambda: sum(
             r.device_timer.total_s * 1000.0
             for r in self.runners

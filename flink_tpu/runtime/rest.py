@@ -102,10 +102,10 @@ class _Handler(BaseHTTPRequestHandler):
     def _authorized(self) -> bool:
         if self.auth_token is None:
             return True
-        import hmac as _hmac
+        from flink_tpu.security import bearer_header_equal
 
-        got = self.headers.get("Authorization", "")
-        if _hmac.compare_digest(got, f"Bearer {self.auth_token}"):
+        if bearer_header_equal(self.headers.get("Authorization", ""),
+                               self.auth_token):
             return True
         self._json(401, {"error": "missing or invalid bearer token"})
         return False
@@ -190,19 +190,26 @@ class _Handler(BaseHTTPRequestHandler):
                     return self._jm_job_routes(parts)
                 return self._json(404, {"error": f"unknown job {parts[1]}"})
             if len(parts) == 2:
-                return self._json(
-                    200,
-                    {
-                        "id": client.job_id,
-                        "name": client.job_name,
-                        "status": client.status().value,
-                        "records_in": client.records_in,
-                        "num_restarts": client.num_restarts,
-                        "num_checkpoints": getattr(client, "num_checkpoints", 0),
-                        "trace_id": getattr(client, "trace_id", None),
-                        "error": repr(client.error) if client.error else None,
-                    },
-                )
+                detail = {
+                    "id": client.job_id,
+                    "name": client.job_name,
+                    "status": client.status().value,
+                    "records_in": client.records_in,
+                    "num_restarts": client.num_restarts,
+                    "num_checkpoints": getattr(client, "num_checkpoints", 0),
+                    "trace_id": getattr(client, "trace_id", None),
+                    "error": repr(client.error) if client.error else None,
+                }
+                # SQL front-door path selection: jobs whose window steps
+                # came from the SQL planner carry job.sqlFusedSelected
+                # (1 = fused superscan, 0 = interpreted-style execution);
+                # non-SQL jobs simply omit the field
+                if hasattr(client, "metrics"):
+                    g = client.metrics.all_metrics().get(
+                        "job.sqlFusedSelected")
+                    if g is not None:
+                        detail["sqlFusedSelected"] = g.value()
+                return self._json(200, detail)
             if parts[2] == "vertices" and len(parts) == 5 \
                     and parts[4] == "backpressure":
                 return self._backpressure(client, parts[3])
@@ -409,17 +416,8 @@ class _Handler(BaseHTTPRequestHandler):
     do_PATCH = do_POST
 
 
-def _jsonable(obj):
-    """Best-effort JSON coercion (int dict keys -> str, numpy scalars)."""
-    if isinstance(obj, dict):
-        return {str(k): _jsonable(v) for k, v in obj.items()}
-    if isinstance(obj, (list, tuple)):
-        return [_jsonable(v) for v in obj]
-    if hasattr(obj, "item"):
-        return obj.item()
-    if isinstance(obj, (int, float, str, bool)) or obj is None:
-        return obj
-    return repr(obj)
+# single-sourced with the SQL gateway (utils/arrays.jsonable)
+from flink_tpu.utils.arrays import jsonable as _jsonable  # noqa: E402
 
 
 def _run_application(cluster: MiniCluster, module_path: str, entry: str):

@@ -14,13 +14,18 @@ from flink_tpu.security.framing import (
     restricted_loads,
     trusted_loads,
 )
-from flink_tpu.security.transport import SecurityConfig, rest_bearer_token
+from flink_tpu.security.transport import (
+    SecurityConfig,
+    bearer_header_equal,
+    rest_bearer_token,
+)
 
 __all__ = [
     "FrameAuthError",
     "FrameCodec",
     "RestrictedUnpicklingError",
     "SecurityConfig",
+    "bearer_header_equal",
     "rest_bearer_token",
     "restricted_loads",
     "trusted_loads",

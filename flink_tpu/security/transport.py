@@ -270,6 +270,19 @@ def rest_bearer_token(sec: SecurityConfig) -> str:
     return hmac.new(sec.secret, _REST_BEARER, hashlib.sha256).hexdigest()
 
 
+def bearer_header_equal(header_value: str, token: str) -> bool:
+    """Constant-time check of an Authorization header against
+    `Bearer <token>` — THE comparison both HTTP planes (runtime/rest.py
+    and the SQL gateway) use. Compares latin-1 BYTES: compare_digest
+    raises TypeError on non-ASCII str input, and http.server decodes
+    headers as latin-1, so a garbage header must read as unauthorized,
+    never kill the handler thread."""
+    return hmac.compare_digest(
+        (header_value or "").encode("latin-1", "replace"),
+        f"Bearer {token}".encode("latin-1", "replace"),
+    )
+
+
 # ---------------------------------------------------------------------------
 # TLS layering (security.ssl.internal.* analogue)
 # ---------------------------------------------------------------------------

@@ -6,7 +6,7 @@ the device window operator — the same sliced-window execution the reference
 SQL runtime uses via tvf/slicing)."""
 
 from flink_tpu.table.table_env import TableEnvironment, TableSchema
-from flink_tpu.table.sql import parse_query
+from flink_tpu.table.sql import SqlParseError, parse_query
 from flink_tpu.table.changelog import (
     DELETE,
     INSERT,

@@ -126,7 +126,10 @@ class Table:
             raise KeyError(
                 f"unknown table {self._name!r}; registered: "
                 f"{list(self._tenv._tables)}")
-        stream = tab.stream
+        # columnar tables read through the dict-row view here: the fluent
+        # API's predicates/projections are row closures (the SQL planner's
+        # fused lowering is the columnar consumer)
+        stream = self._tenv._row_stream(tab)
         for pred, label in self._filters:
             stream = stream.filter(pred, name=f"where[{label}]")
         return stream

@@ -39,28 +39,68 @@ import re
 from typing import Any, Callable, List, Optional, Tuple
 
 _TOKEN_RE = re.compile(
-    r"\s*(?:(?P<num>\d+\.\d+|\d+)|(?P<str>'[^']*')|(?P<op><=|>=|!=|<>|=|<|>|\(|\)|,|\*)"
+    r"\s*(?:(?P<num>-?\d+\.\d+|-?\d+)|(?P<str>'[^']*')|(?P<op><=|>=|!=|<>|=|<|>|\(|\)|,|\*)"
     r"|(?P<word>[A-Za-z_][A-Za-z_0-9.]*))"
 )
 
 AGG_FUNCS = {"COUNT", "SUM", "MIN", "MAX", "AVG"}
+
+#: SQL aggregate function -> builtin DeviceAggregator name. THE single
+#: source for both front doors: the interpreted translation (table_env)
+#: and the planner's agg-call mapping (planner/rules) read this one dict,
+#: so they can never disagree about which aggregates have a device form.
+DEVICE_AGG_OF = {
+    "COUNT": "count", "SUM": "sum", "MIN": "min", "MAX": "max",
+    "AVG": "mean",
+}
 _UNIT_MS = {
     "MILLISECOND": 1, "SECOND": 1000, "MINUTE": 60_000, "HOUR": 3_600_000,
     "DAY": 86_400_000,
 }
 
 
-def _tokenize(sql: str) -> List[str]:
-    tokens, pos = [], 0
+class SqlParseError(ValueError):
+    """A parse failure with position + snippet context. Subclasses
+    ValueError so callers catching the parser's historical error type keep
+    working; the gain is a *diagnostic* (where in the statement, with a
+    caret) instead of a bare crash message — the reference throws
+    SqlParseException with line/column for the same reason."""
+
+    def __init__(self, message: str, sql: str, pos: int):
+        self.reason = message
+        self.sql = sql
+        self.pos = max(0, min(pos, len(sql)))
+        super().__init__(f"{message}\n{self.snippet()}")
+
+    def snippet(self, width: int = 40) -> str:
+        """The statement text around the failure with a caret under it."""
+        start = max(0, self.pos - width)
+        end = min(len(self.sql), self.pos + width)
+        prefix = "..." if start > 0 else ""
+        suffix = "..." if end < len(self.sql) else ""
+        line = prefix + self.sql[start:end] + suffix
+        caret = " " * (len(prefix) + self.pos - start) + "^"
+        return f"  {line}\n  {caret} (at position {self.pos})"
+
+
+def _tokenize(sql: str) -> Tuple[List[str], List[int]]:
+    """Tokens plus their start offsets (for SqlParseError diagnostics)."""
+    tokens: List[str] = []
+    positions: List[int] = []
+    pos = 0
     while pos < len(sql):
         m = _TOKEN_RE.match(sql, pos)
         if not m:
-            if sql[pos:].strip():
-                raise ValueError(f"cannot tokenize at: {sql[pos:pos+20]!r}")
+            rest = sql[pos:]
+            if rest.strip():
+                bad = pos + (len(rest) - len(rest.lstrip()))
+                raise SqlParseError(
+                    f"cannot tokenize at: {sql[bad:bad + 20]!r}", sql, bad)
             break
         tokens.append(m.group(0).strip())
+        positions.append(m.end() - len(tokens[-1]))
         pos = m.end()
-    return tokens
+    return tokens, positions
 
 
 @dataclasses.dataclass
@@ -88,6 +128,94 @@ class WindowSpec:
     time_col: str
     size_ms: int
     slide_ms: Optional[int] = None  # hop only; for hop arg order: slide, size
+
+
+@dataclasses.dataclass(frozen=True)
+class Operand:
+    """One side of a comparison: a column reference or a literal."""
+
+    kind: str                 # 'column' | 'number' | 'string'
+    value: Any
+
+
+@dataclasses.dataclass(frozen=True)
+class Comparison:
+    """`lhs op rhs` with op in = != <> < <= > >=."""
+
+    left: Operand
+    op: str
+    right: Operand
+
+
+@dataclasses.dataclass(frozen=True)
+class BoolExpr:
+    """AND/OR combination of comparisons (parenthesization is structural)."""
+
+    op: str                   # 'and' | 'or'
+    left: Any                 # Comparison | BoolExpr
+    right: Any
+
+
+#: comparison op -> callable. Pure operator closures that work both
+#: per-row (scalars; compile_predicate) and columnar/traced (elementwise
+#: on numpy/jax arrays; planner/lowering's mask builder) — one table for
+#: every consumer of the dialect's operators.
+CMP_OPS = {
+    "=": lambda a, b: a == b,
+    "!=": lambda a, b: a != b,
+    "<>": lambda a, b: a != b,
+    "<": lambda a, b: a < b,
+    "<=": lambda a, b: a <= b,
+    ">": lambda a, b: a > b,
+    ">=": lambda a, b: a >= b,
+}
+_CMP_OPS = CMP_OPS   # historical internal alias
+
+
+def compile_predicate(node) -> Callable[[dict], bool]:
+    """Row-closure view of a predicate AST (the interpreted path's form).
+    Semantics: NULL comparisons are not-TRUE (SQL three-valued logic),
+    AND/OR short-circuit like Python's."""
+    if isinstance(node, BoolExpr):
+        l, r = compile_predicate(node.left), compile_predicate(node.right)
+        if node.op == "and":
+            return lambda row: l(row) and r(row)
+        return lambda row: l(row) or r(row)
+    fn = _CMP_OPS[node.op]
+    lhs, rhs = _compile_operand(node.left), _compile_operand(node.right)
+
+    def compare(row):
+        a, b = lhs(row), rhs(row)
+        if a is None or b is None:
+            return False        # SQL three-valued logic: NULL cmp -> not TRUE
+        return fn(a, b)
+
+    return compare
+
+
+def _compile_operand(op: Operand):
+    if op.kind == "column":
+        name = op.value
+        return lambda row: row[name]
+    lit = op.value
+    return lambda row: lit
+
+
+def predicate_columns(node) -> List[str]:
+    """Column names a predicate AST references, in first-use order."""
+    out: List[str] = []
+
+    def walk(n):
+        if isinstance(n, BoolExpr):
+            walk(n.left)
+            walk(n.right)
+            return
+        for side in (n.left, n.right):
+            if side.kind == "column" and side.value not in out:
+                out.append(side.value)
+
+    walk(node)
+    return out
 
 
 @dataclasses.dataclass
@@ -122,12 +250,29 @@ class Query:
         default_factory=list)                          # (col, descending)
     limit: Optional[int] = None
     union_all: Optional["Query"] = None               # concatenated branch
+    # structural predicate ASTs (Comparison/BoolExpr): what the planner
+    # (flink_tpu/planner/) reads — the closures above are the interpreted
+    # path's compiled view of the same trees
+    where_ast: Any = None
+    having_ast: Any = None
 
 
 class _Parser:
-    def __init__(self, tokens: List[str]):
+    def __init__(self, tokens: List[str], positions: List[int], sql: str):
         self.tokens = tokens
+        self.positions = positions
+        self.sql = sql
         self.i = 0
+
+    def pos(self) -> int:
+        """Character offset of the current token (end of input when past)."""
+        if self.i < len(self.positions):
+            return self.positions[self.i]
+        return len(self.sql)
+
+    def error(self, message: str, at: Optional[int] = None) -> SqlParseError:
+        return SqlParseError(message, self.sql,
+                             self.pos() if at is None else at)
 
     def peek(self) -> Optional[str]:
         return self.tokens[self.i] if self.i < len(self.tokens) else None
@@ -139,14 +284,15 @@ class _Parser:
     def next(self) -> str:
         t = self.peek()
         if t is None:
-            raise ValueError("unexpected end of query")
+            raise self.error("unexpected end of query")
         self.i += 1
         return t
 
     def expect(self, word: str) -> None:
+        at = self.pos()
         t = self.next()
         if t.upper() != word.upper():
-            raise ValueError(f"expected {word}, got {t!r}")
+            raise self.error(f"expected {word}, got {t!r}", at=at)
 
     # -- grammar ----------------------------------------------------------
     def query(self) -> Query:
@@ -196,10 +342,10 @@ class _Parser:
                     f"{alias2}.<col>, got {left} = {right}"
                 )
             join = (table2, alias1, alias2, left, right)
-        where = where_text = None
+        where = where_text = where_ast = None
         if self.peek_upper() == "WHERE":
             self.next()
-            where, where_text = self.where_expr()
+            where, where_text, where_ast = self.where_expr()
         group_by: List[str] = []
         window = None
         if self.peek_upper() == "GROUP":
@@ -214,12 +360,12 @@ class _Parser:
                     self.next()
                     continue
                 break
-        having = having_text = None
+        having = having_text = having_ast = None
         if self.peek_upper() == "HAVING":
             if not group_by and window is None:
-                raise ValueError("HAVING requires GROUP BY")
+                raise self.error("HAVING requires GROUP BY")
             self.next()
-            having, having_text = self.where_expr()
+            having, having_text, having_ast = self.where_expr()
         order_by: List[Tuple[str, bool]] = []
         if self.peek_upper() == "ORDER":
             self.next()
@@ -237,7 +383,17 @@ class _Parser:
         limit = None
         if self.peek_upper() == "LIMIT":
             self.next()
-            limit = int(self.next())
+            at = self.pos()
+            lit = self.next()
+            try:
+                limit = int(lit)
+            except ValueError:
+                raise self.error(
+                    f"LIMIT expects an integer literal, got {lit!r}", at=at
+                ) from None
+            if limit < 0:
+                raise self.error(
+                    f"LIMIT must be non-negative, got {limit}", at=at)
         if join is None and alias1 != table:
             raise ValueError(
                 "table aliases are only meaningful on join queries; "
@@ -268,7 +424,8 @@ class _Parser:
                 )
             return Query(select, table, where, where_text, group_by, None,
                          JoinSpec(join[0], join[1], join[2], join[3],
-                                  join[4], jwindow, join_type))
+                                  join[4], jwindow, join_type),
+                         where_ast=where_ast)
         union_all = None
         if self.peek_upper() == "UNION":
             self.next()
@@ -278,7 +435,8 @@ class _Parser:
             raise ValueError(f"trailing tokens: {self.tokens[self.i:]}")
         return Query(select, table, where, where_text, group_by, window,
                      having=having, having_text=having_text,
-                     order_by=order_by, limit=limit, union_all=union_all)
+                     order_by=order_by, limit=limit, union_all=union_all,
+                     where_ast=where_ast, having_ast=having_ast)
 
     def select_item(self) -> SelectItem:
         t = self.next()
@@ -328,37 +486,43 @@ class _Parser:
 
     def interval(self) -> int:
         self.expect("INTERVAL")
+        at = self.pos()
         lit = self.next()
         if not (lit.startswith("'") and lit.endswith("'")):
-            raise ValueError(f"INTERVAL literal expected, got {lit!r}")
-        n = float(lit[1:-1])
+            raise self.error(f"INTERVAL literal expected, got {lit!r}", at=at)
+        try:
+            n = float(lit[1:-1])
+        except ValueError:
+            raise self.error(
+                f"INTERVAL literal must be numeric, got {lit!r}", at=at
+            ) from None
+        at = self.pos()
         unit = self.next().upper()
         key = unit[:-1] if unit.endswith("S") and unit[:-1] in _UNIT_MS else unit
         if key not in _UNIT_MS:
-            raise ValueError(f"unknown interval unit {unit!r}")
+            raise self.error(f"unknown interval unit {unit!r}", at=at)
         return int(n * _UNIT_MS[key])
 
     # -- WHERE ------------------------------------------------------------
-    def where_expr(self) -> Tuple[Callable[[dict], bool], str]:
+    def where_expr(self) -> Tuple[Callable[[dict], bool], str, Any]:
+        """(compiled closure, source text, predicate AST)."""
         start = self.i
         node = self.or_expr()
         text = " ".join(self.tokens[start:self.i])
-        return node, text
+        return compile_predicate(node), text, node
 
     def or_expr(self):
         left = self.and_expr()
         while self.peek_upper() == "OR":
             self.next()
-            right = self.and_expr()
-            left = (lambda l, r: lambda row: l(row) or r(row))(left, right)
+            left = BoolExpr("or", left, self.and_expr())
         return left
 
     def and_expr(self):
         left = self.comparison()
         while self.peek_upper() == "AND":
             self.next()
-            right = self.comparison()
-            left = (lambda l, r: lambda row: l(row) and r(row))(left, right)
+            left = BoolExpr("and", left, self.comparison())
         return left
 
     def comparison(self):
@@ -368,42 +532,39 @@ class _Parser:
             self.expect(")")
             return inner
         lhs = self.operand()
+        at = self.pos()
         op = self.next()
         rhs = self.operand()
-        ops = {
-            "=": lambda a, b: a == b,
-            "!=": lambda a, b: a != b,
-            "<>": lambda a, b: a != b,
-            "<": lambda a, b: a < b,
-            "<=": lambda a, b: a <= b,
-            ">": lambda a, b: a > b,
-            ">=": lambda a, b: a >= b,
-        }
-        if op not in ops:
-            raise ValueError(f"unknown comparison operator {op!r}")
-        fn = ops[op]
+        if op not in _CMP_OPS:
+            raise self.error(f"unknown comparison operator {op!r}", at=at)
+        return Comparison(lhs, op, rhs)
 
-        def compare(row):
-            a, b = lhs(row), rhs(row)
-            if a is None or b is None:
-                return False        # SQL three-valued logic: NULL cmp -> not TRUE
-            return fn(a, b)
-
-        return compare
-
-    def operand(self):
+    def operand(self) -> Operand:
+        at = self.pos()
         t = self.next()
         if t.startswith("'") and t.endswith("'"):
-            lit = t[1:-1]
-            return lambda row: lit
+            return Operand("string", t[1:-1])
         try:
-            num = float(t) if "." in t else int(t)
-            return lambda row: num
+            return Operand("number", float(t) if "." in t else int(t))
         except ValueError:
             pass
-        name = t
-        return lambda row: row[name]
+        if not re.match(r"[A-Za-z_]", t):
+            raise self.error(
+                f"expected a column or literal operand, got {t!r}", at=at)
+        return Operand("column", t)
 
 
 def parse_query(sql: str) -> Query:
-    return _Parser(_tokenize(sql)).query()
+    """Parse one statement. Every parse failure surfaces as SqlParseError
+    (a ValueError subclass) with position + snippet context — a raw
+    IndexError/ValueError escaping the recursive descent is a crash, not a
+    diagnostic, so any stray one is wrapped at the current token here."""
+    tokens, positions = _tokenize(sql)
+    parser = _Parser(tokens, positions, sql)
+    try:
+        return parser.query()
+    except SqlParseError:
+        raise
+    except (ValueError, IndexError) as e:
+        raise SqlParseError(
+            str(e) or type(e).__name__, sql, parser.pos()) from e

@@ -25,9 +25,13 @@ from flink_tpu.api.windowing.assigners import (
 )
 from flink_tpu.connectors.sink import CollectSink, Sink
 from flink_tpu.core.watermarks import WatermarkStrategy
-from flink_tpu.table.sql import AGG_FUNCS, Query, SelectItem, parse_query
-
-_DEVICE_AGG = {"COUNT": "count", "SUM": "sum", "MIN": "min", "MAX": "max", "AVG": "mean"}
+from flink_tpu.table.sql import (
+    AGG_FUNCS,
+    DEVICE_AGG_OF as _DEVICE_AGG,   # single-sourced with planner/rules
+    Query,
+    SelectItem,
+    parse_query,
+)
 
 
 @dataclasses.dataclass
@@ -35,12 +39,37 @@ class TableSchema:
     fields: List[str]
     rowtime: Optional[str] = None          # event-time column (ms)
     watermark_delay_ms: int = 0            # bounded out-of-orderness
+    # optional declared field types ('int' | 'float' | 'str', one per
+    # field): what lets the SQL planner (flink_tpu/planner) prove numeric
+    # columns at plan time and lower the statement onto the fused device
+    # path. Untyped row-mode tables always take the interpreted path.
+    field_types: Optional[List[str]] = None
+
+    def __post_init__(self):
+        if self.field_types is not None and \
+                len(self.field_types) != len(self.fields):
+            raise ValueError(
+                f"field_types ({len(self.field_types)}) must match fields "
+                f"({len(self.fields)})")
+
+    def py_cast(self, field: str):
+        """Python-type cast for a field's values (identity when untyped)."""
+        if self.field_types is None:
+            return lambda v: v
+        t = self.field_types[self.fields.index(field)]
+        return {"int": int, "float": float, "str": str}.get(t, lambda v: v)
 
 
 @dataclasses.dataclass
 class _Table:
     stream: DataStream
     schema: TableSchema
+    # columnar: the stream carries numeric [n, F] batches where column i is
+    # the i-th non-rowtime schema field and the rowtime rides the batch
+    # timestamps — the device-ready registration the fused SQL path stages
+    # straight into the superscan. Row-mode (dict) tables interpret, or
+    # fuse window-only when field_types declare numeric columns.
+    columnar: bool = False
 
 
 class _MultiAgg(AggregateFunction):
@@ -99,14 +128,24 @@ class TableEnvironment:
         self.env = env or StreamExecutionEnvironment.get_execution_environment()
         self._tables: Dict[str, _Table] = {}
         self._models: Dict[str, Any] = {}
+        # planning outcome of the last sql_query()/execute_sql* call — the
+        # gateway reports it per statement (executionPath + fallbackReason)
+        self.last_plan_report = None
 
     # -- registration -----------------------------------------------------
     def register_model(self, name: str, provider) -> None:
         """Register a PredictRuntimeProvider for SQL ML_PREDICT (T5)."""
         self._models[name] = provider
 
-    def register_table(self, name: str, stream: DataStream, schema: TableSchema) -> None:
-        self._tables[name] = _Table(stream, schema)
+    def register_table(self, name: str, stream: DataStream,
+                       schema: TableSchema, columnar: bool = False) -> None:
+        """Register a stream as a table. `columnar=True` declares the
+        device-ready contract: the stream's batches are numeric [n, F]
+        columns, column i = the i-th non-rowtime schema field, event time
+        rides the batch timestamps. Columnar tables are what the SQL
+        planner fuses whole (docs/sql.md); the interpreted path reads them
+        through a per-record row view."""
+        self._tables[name] = _Table(stream, schema, columnar=columnar)
 
     def from_rows(self, name: str, rows: Sequence[dict], schema: TableSchema) -> None:
         """Register an in-memory table (fromValues analogue)."""
@@ -131,8 +170,66 @@ class TableEnvironment:
 
     # -- queries ----------------------------------------------------------
     def sql_query(self, sql: str) -> DataStream:
+        # cleared BEFORE parsing: a statement that fails to parse or
+        # translate must not inherit the previous statement's report (the
+        # gateway stamps this onto the operation as executionPath)
+        self.last_plan_report = None
         q = parse_query(sql)
-        return self._translate(q)
+        return self._plan_and_translate(q)
+
+    def explain_sql(self, sql: str):
+        """Plan-only view of a statement: the SqlPlanReport the planner
+        produces (fused logical tree, or the attributed fallback reason)."""
+        from flink_tpu.config import TableOptions
+        from flink_tpu.planner import SqlPlanReport, plan_query
+
+        q = parse_query(sql)
+        if not self.env.config.get(TableOptions.DEVICE_FUSION):
+            return SqlPlanReport(path="interpreted", reason="disabled",
+                                 detail="table.device-fusion is false")
+        return plan_query(q, self._catalog())
+
+    def _catalog(self):
+        from flink_tpu.planner import TableInfo
+
+        return {
+            name: TableInfo(
+                name=name,
+                fields=tuple(t.schema.fields),
+                rowtime=t.schema.rowtime,
+                field_types=(tuple(t.schema.field_types)
+                             if t.schema.field_types is not None else None),
+                columnar=t.columnar,
+            )
+            for name, t in self._tables.items()
+        }
+
+    def _plan_and_translate(self, q: Query) -> DataStream:
+        """Route through the SQL planner (flink_tpu/planner) behind
+        table.device-fusion: fused-lowerable statements compile onto the
+        whole-graph-fusion device path; everything else keeps the
+        interpreted translation below, with the fallback reason recorded
+        in `last_plan_report` (and surfaced by the gateway)."""
+        from flink_tpu.config import TableOptions
+        from flink_tpu.planner import SqlPlanReport, plan_query
+
+        if not self.env.config.get(TableOptions.DEVICE_FUSION):
+            self.last_plan_report = SqlPlanReport(
+                path="interpreted", reason="disabled",
+                detail="table.device-fusion is false")
+            return self._translate(q)
+        report = plan_query(
+            q, self._catalog(),
+            sources={n: t.stream.transform
+                     for n, t in self._tables.items()},
+        )
+        self.last_plan_report = report
+        if report.lowered is None:
+            return self._translate(q)
+        low = report.lowered
+        result = DataStream(self.env, low.terminal)
+        return self._windowed_output_stage(
+            result, q, [low.group_col], extract=None)
 
     def _translate(self, q: Query) -> DataStream:
         if q.union_all is not None:
@@ -152,7 +249,7 @@ class TableEnvironment:
         if q.table not in self._tables:
             raise KeyError(f"unknown table {q.table!r}; registered: {list(self._tables)}")
         table = self._tables[q.table]
-        stream = table.stream
+        stream = self._row_stream(table)
 
         if q.join is not None:
             return self._join_query(q)
@@ -248,7 +345,39 @@ class TableEnvironment:
                 "windowed aggregate queries require GROUP BY columns "
                 "alongside the TUMBLE/HOP/SESSION window"
             )
+        # unknown columns fail at translation with a diagnostic, not as a
+        # per-record KeyError from inside the key/value selectors
+        known = set(table.schema.fields)
+        missing = [c for c in q.group_by if c not in known] + [
+            i.name for i in aggs if i.name != "*" and i.name not in known
+        ]
+        if missing:
+            raise ValueError(
+                f"unknown column(s) {sorted(set(missing))} in GROUP "
+                f"BY/aggregates; table {q.table!r} declares "
+                f"{table.schema.fields}")
         return self._grouped_window_query(q, stream)
+
+    def _row_stream(self, table: _Table) -> DataStream:
+        """Dict-row view of a table for the interpreted path. Row-mode
+        tables pass through; columnar tables get a per-record adapter
+        (vector row + batch timestamp -> schema dict, cast through the
+        declared field types so both paths emit the same Python values)."""
+        if not table.columnar:
+            return table.stream
+        schema = table.schema
+        rowtime = schema.rowtime
+        value_fields = [f for f in schema.fields if f != rowtime]
+        casts = [schema.py_cast(f) for f in value_fields]
+
+        def to_row(v, ts, _fs=tuple(value_fields), _casts=tuple(casts),
+                   _rt=rowtime):
+            row = {f: c(v[i]) for i, (f, c) in enumerate(zip(_fs, _casts))}
+            if _rt is not None:
+                row[_rt] = int(ts)
+            return row
+
+        return table.stream.map_with_timestamp(to_row, name="sql_row_view")
 
     def _continuous_agg_query(self, q: Query, stream: DataStream) -> DataStream:
         """Non-windowed GROUP BY: continuous aggregation over the unbounded
@@ -330,39 +459,63 @@ class TableEnvironment:
             result = windowed.aggregate(
                 _DEVICE_AGG[item.func], value_fn, name=f"sql_{item.func.lower()}"
             )
-            extract = lambda r: (r,)  # noqa: E731
+            extract = None                # single device agg: result IS rec[1]
         else:
             result = windowed.aggregate(_MultiAgg(aggs), name="sql_multi_agg")
-            extract = lambda r: tuple(r)  # noqa: E731
+            extract = tuple               # composite accumulator result
+        # mark the SQL origin on the interpreted path's window terminal
+        # too: the job gauge sqlFusedSelected then reports 0 (SQL ran, but
+        # not on the fused runner) instead of being absent
+        result.transform.config["sql_origin"] = True
+        return self._windowed_output_stage(result, q, group_cols, extract)
 
+    def _windowed_output_stage(self, result: DataStream, q: Query,
+                               group_cols: List[str], extract) -> DataStream:
+        """Post-window host stage shared VERBATIM by the interpreted path
+        and the planner's fused lowering: output-row assembly + HAVING +
+        per-window top-N. One implementation is what makes the two paths'
+        rows identical by construction (the three-way parity bar)."""
         # assemble output rows: group cols + aggregates + window bounds
         # (emission timestamp = window.maxTimestamp ⇒ end = ts+1,
-        # start = end - size; session windows get end-only fidelity)
-        out_items = q.select
+        # start = end - size; session windows get end-only fidelity).
+        # The assembler is CODE-GENERATED as one dict-literal lambda over
+        # (rec, ts) — the reference compiles generated Java for exactly
+        # this stage; here the closure tier is the codegen target. It runs
+        # once per emitted window on the hot fused path, where a generic
+        # kind-dispatch loop costs more than the compiled superscan saves
+        # (the sql_path bench's ratio_vs_datastream_fused is the gate).
         size_ms = q.window.size_ms
         topn = bool(q.order_by) or q.limit is not None
-
-        def to_row(rec, ts):
-            key, res = rec
-            agg_vals = list(extract(res))
-            row = {}
-            ai = 0
-            for item in out_items:
-                if item.kind == "column":
-                    if len(group_cols) == 1:
-                        row[item.output_name] = key
-                    else:
-                        row[item.output_name] = key[group_cols.index(item.name)]
-                elif item.kind == "agg":
-                    row[item.output_name] = agg_vals[ai]
-                    ai += 1
-                elif item.kind == "window_end":
-                    row[item.output_name] = ts + 1
-                elif item.kind == "window_start":
-                    row[item.output_name] = ts + 1 - size_ms
-            if topn:
-                row["__wend"] = ts + 1     # per-window grouping key (internal)
-            return row
+        single = extract is None          # single device aggregate: rec[1]
+        parts = []
+        ai = 0
+        for item in q.select:
+            if item.kind == "column":
+                if item.name not in group_cols:
+                    # non-grouped columns are undefined for aggregates; a
+                    # silent key-value stand-in would be plausibly-shaped
+                    # wrong data (the continuous-agg path already refuses)
+                    raise ValueError(
+                        f"SELECT column {item.name!r} must appear in "
+                        "GROUP BY (non-grouped columns are not defined "
+                        "for aggregates)")
+                expr = ("rec[0]" if len(group_cols) == 1
+                        else f"rec[0][{group_cols.index(item.name)}]")
+            elif item.kind == "agg":
+                expr = "rec[1]" if single else f"_ex(rec[1])[{ai}]"
+                ai += 1
+            elif item.kind == "window_end":
+                expr = "ts + 1"
+            elif item.kind == "window_start":
+                expr = f"ts + 1 - {size_ms}"
+            else:
+                continue
+            parts.append(f"{item.output_name!r}: {expr}")
+        if topn:
+            parts.append("'__wend': ts + 1")  # per-window key (internal)
+        src = f"lambda rec, ts: {{{', '.join(parts)}}}"
+        to_row = eval(src, {"__builtins__": {}, "_ex": extract})  # noqa: S307
+        to_row.__sql_codegen__ = src       # introspection/debugging handle
 
         out = result.map_with_timestamp(to_row, name="sql_output")
         if q.having is not None:
@@ -435,8 +588,8 @@ class TableEnvironment:
         if j.window is not None and j.window.kind == "session":
             raise ValueError("session windows are not supported for joins")
 
-        s1 = self._tables[q.table].stream
-        s2 = self._tables[j.table2].stream
+        s1 = self._row_stream(self._tables[q.table])
+        s2 = self._row_stream(self._tables[j.table2])
         lcol = j.left_col.split(".", 1)[1]
         rcol = j.right_col.split(".", 1)[1]
         cols1 = set(self._tables[q.table].schema.fields)

@@ -1,0 +1,80 @@
+"""Bench smoke gate for the SQL-path scenario (the SQL front door).
+
+Runs the real `bench.sql_path_microbench` at smoke scale and asserts the
+result JSON carries the keys every BENCH_*.json must now track — so a
+regression that silently reroutes SQL back to the interpreted path
+(fused_selected False), breaks three-way result parity, or turns the
+fallback contract into a failure fails tier-1, not just a human eyeballing
+the next bench run. Throughput NUMBERS are deliberately not asserted
+(sandbox scheduler noise); the structural keys and the parity/selection/
+attribution booleans are the gate. The ~1.2x ratio_vs_datastream_fused
+acceptance bar is judged on the full-scale bench artifact, where the
+fixed window-output volume amortizes.
+"""
+
+import importlib.util
+import pathlib
+
+import pytest
+
+_BENCH = pathlib.Path(__file__).resolve().parents[1] / "bench.py"
+
+
+@pytest.fixture(scope="module")
+def bench():
+    spec = importlib.util.spec_from_file_location("bench_smoke", _BENCH)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+@pytest.fixture(scope="module")
+def result(bench):
+    # smoke scale: small batch + few events keeps compile+run well under a
+    # minute on the CPU backend while exercising the planner, all three
+    # paths' parity, the fallback demo, and the timed sweeps exactly as
+    # the real bench does
+    return bench.sql_path_microbench(events=8192, batch=2048)
+
+
+def test_result_carries_the_tracked_keys(result):
+    for key in (
+        "sql_tuples_per_sec",
+        "interpreted_tuples_per_sec",
+        "datastream_fused_tuples_per_sec",
+        "speedup_vs_interpreted",
+        "ratio_vs_datastream_fused",
+        "parity",
+        "fused_selected",
+        "fallback_attributed",
+    ):
+        assert key in result, f"bench result JSON lost {key!r}"
+    assert result["sql_tuples_per_sec"] > 0
+
+
+def test_sql_path_parity_is_exact(result):
+    assert result["parity"] is True, (
+        "SQL-fused vs interpreted-table vs hand-built DataStream results "
+        "diverged — the SQL front door is emitting different windows than "
+        "its oracles"
+    )
+
+
+def test_fused_runner_is_actually_selected(result):
+    assert result["fused_selected"] is True, (
+        "the planner (or graph translation) no longer routes the SQL YSB "
+        "statement to DeviceChainRunner — parity would still hold on the "
+        "slow path, so this flag is the reroute gate"
+    )
+
+
+def test_fallbacks_are_attributed_not_failures(result):
+    assert result["fallback_attributed"] is True, (
+        "the session-window statement must EXECUTE on the interpreted "
+        "path with reason 'session-window' attributed"
+    )
+    assert result["fallback_reason_demo"] == "session-window"
+
+
+def test_windows_were_emitted(result):
+    assert result["windows_emitted"] > 0

@@ -98,6 +98,23 @@ def test_path_scoped_rules_are_not_vacuous():
     assert index.get("graph/fusion.py") is not None, (
         "graph/fusion.py missing — the whole-graph fusion planner moved "
         "and ARCH001's graph-layer ban no longer covers it")
+    # the SQL planner must stay REGISTERED with its runtime AND api bans:
+    # it emits transformations the executor consumes — an executor (or
+    # fluent-api) import here inverts the translation DAG, and a deleted
+    # dict entry would let planner/ grow those imports silently
+    assert "planner" in LAYER_FORBIDDEN, (
+        "planner layer unregistered from ARCH001 — the SQL planner may "
+        "not import the runtime or the api")
+    assert any("runtime" in b for b in LAYER_FORBIDDEN["planner"]), (
+        "planner layer no longer forbids runtime imports")
+    assert any(b.endswith(".api") for b in LAYER_FORBIDDEN["planner"]), (
+        "planner layer no longer forbids api imports (assigner "
+        "construction must stay a function-scoped lazy import)")
+    for mod in ("planner/__init__.py", "planner/logical.py",
+                "planner/rules.py", "planner/lowering.py"):
+        assert index.get(mod) is not None, (
+            f"{mod} missing — the SQL planner moved and ARCH001's "
+            f"planner-layer bans no longer cover it")
     # the multichip library must stay in parallel/ under the parallel
     # layer's runtime/api ban: the sharded superscan is a kernel/state
     # library the runtime composes (FusedWindowOperator targets it), and

@@ -1,0 +1,853 @@
+"""SQL planner (flink_tpu/planner): golden plans, the fallback catalog,
+parser diagnostics, and three-way parity of the fused front door.
+
+The planner translates parsed Query objects into logical relational
+plans, optimizes them (predicate pushdown below the window, projection
+pruning, window normalization, agg-call -> DeviceAggregator mapping), and
+lowers supported statements onto the SAME whole-graph-fusion StepGraph a
+hand-built DataStream job takes. These tests pin:
+
+- parse failures are typed SqlParseError diagnostics (position + caret
+  snippet), never raw IndexError/ValueError crashes;
+- the optimized logical plan's golden text for the clause matrix
+  (TUMBLE/HOP, WHERE pushdown, projection pruning, COUNT/SUM/MIN/MAX/AVG);
+- every unsupported shape falls back to the interpreted path with its
+  catalogued reason attributed (and still EXECUTES);
+- exact three-way row parity: SQL-fused == interpreted table path ==
+  hand-built DataStream program, incl. snapshot/restore mid-stream;
+- the job gauge + REST + gateway visibility of the selected path.
+
+Values are integer-valued floats with sums far below 2**24, so float32
+accumulation is exact in any order and every comparison is exact.
+"""
+
+import json
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+jnp = pytest.importorskip("jax.numpy")
+
+from flink_tpu.api.datastream import StreamExecutionEnvironment
+from flink_tpu.api.windowing.assigners import SlidingEventTimeWindows
+from flink_tpu.config import Configuration, ExecutionOptions, TableOptions
+from flink_tpu.connectors.source import Batch, DataGeneratorSource
+from flink_tpu.core.watermarks import WatermarkStrategy
+from flink_tpu.graph.transformation import plan
+from flink_tpu.planner import (
+    FALLBACK_CATALOG,
+    TableInfo,
+    plan_query,
+)
+from flink_tpu.runtime.executor import (
+    DeviceChainRunner,
+    JobRuntime,
+    build_runners,
+)
+from flink_tpu.table import TableEnvironment, TableSchema
+from flink_tpu.table.sql import (
+    BoolExpr,
+    Comparison,
+    SqlParseError,
+    parse_query,
+)
+
+NUM_KEYS = 16
+
+
+# ---------------------------------------------------------------------------
+# helpers
+# ---------------------------------------------------------------------------
+
+def _source(n, num_keys=NUM_KEYS, span_ms=8000):
+    """Columnar (campaign, event_type) batches; event time rides the
+    batch timestamps. All values integral -> float32 math is exact."""
+
+    def gen(idx):
+        camp = (idx * 7919) % num_keys
+        etype = idx % 3
+        col = np.stack([camp, etype], axis=1).astype(np.float32)
+        ts = 10_000 + idx * span_ms // max(n, 1)
+        return Batch(col, ts.astype(np.int64))
+
+    return DataGeneratorSource(gen, n)
+
+
+def _columnar_env(n=4096, fused=True, batch=512):
+    cfg = Configuration()
+    cfg.set(TableOptions.DEVICE_FUSION, fused)
+    cfg.set(ExecutionOptions.BATCH_SIZE, batch)
+    cfg.set(ExecutionOptions.KEY_CAPACITY, NUM_KEYS)
+    env = StreamExecutionEnvironment.get_execution_environment(cfg)
+    tenv = TableEnvironment(env)
+    stream = env.from_source(
+        _source(n),
+        watermark_strategy=WatermarkStrategy.for_bounded_out_of_orderness(0),
+    )
+    tenv.register_table(
+        "ysb", stream,
+        TableSchema(["campaign", "event_type", "rowtime"],
+                    rowtime="rowtime",
+                    field_types=["int", "float", "int"]),
+        columnar=True,
+    )
+    return env, tenv
+
+
+_ROWS = [
+    {"user": i % 7, "amount": float(i % 5), "rowtime": i * 40}
+    for i in range(1500)
+]
+
+
+def _typed_rows_env(fused=True, rows=_ROWS, types=("int", "float", "int")):
+    cfg = Configuration()
+    cfg.set(TableOptions.DEVICE_FUSION, fused)
+    cfg.set(ExecutionOptions.BATCH_SIZE, 256)
+    env = StreamExecutionEnvironment.get_execution_environment(cfg)
+    tenv = TableEnvironment(env)
+    tenv.from_rows(
+        "pay", rows,
+        TableSchema(["user", "amount", "rowtime"], rowtime="rowtime",
+                    field_types=list(types) if types else None),
+    )
+    return env, tenv
+
+
+def _norm(rows):
+    """Exact-comparison form: every value through its Python type."""
+    return sorted(
+        tuple(sorted((k, _py(v)) for k, v in r.items())) for r in rows
+    )
+
+
+def _py(v):
+    return v.item() if hasattr(v, "item") and callable(v.item) else v
+
+
+_CATALOG = {
+    "ysb": TableInfo(
+        name="ysb", fields=("campaign", "event_type", "rowtime"),
+        rowtime="rowtime", field_types=("int", "float", "int"),
+        columnar=True),
+    "pay": TableInfo(
+        name="pay", fields=("user", "amount", "rowtime"),
+        rowtime="rowtime", field_types=("int", "float", "int"),
+        columnar=False),
+    "untyped": TableInfo(
+        name="untyped", fields=("user", "amount", "rowtime"),
+        rowtime="rowtime", field_types=None, columnar=False),
+    "strkey": TableInfo(
+        name="strkey", fields=("name", "amount", "rowtime"),
+        rowtime="rowtime", field_types=("str", "float", "int"),
+        columnar=False),
+}
+
+
+# ---------------------------------------------------------------------------
+# parser diagnostics (satellite: typed SqlParseError with position context)
+# ---------------------------------------------------------------------------
+
+def test_parse_error_is_a_positioned_diagnostic():
+    sql = ("SELECT a FROM t GROUP BY k, "
+           "TUMBLE(ts, INTERVAL '1' FORTNIGHT)")
+    with pytest.raises(SqlParseError) as exc:
+        parse_query(sql)
+    e = exc.value
+    assert isinstance(e, ValueError)          # historical contract
+    assert e.pos == sql.index("FORTNIGHT")
+    assert "FORTNIGHT" in str(e) and "^" in str(e)
+    assert "position" in e.snippet()
+
+
+def test_parse_error_limit_non_integer():
+    with pytest.raises(SqlParseError, match="LIMIT expects an integer"):
+        parse_query(
+            "SELECT k, COUNT(*) AS n FROM t GROUP BY k, "
+            "TUMBLE(ts, INTERVAL '1' SECOND) ORDER BY n LIMIT lots")
+
+
+def test_parse_error_at_end_of_query_points_past_the_text():
+    sql = "SELECT a FROM"
+    with pytest.raises(SqlParseError) as exc:
+        parse_query(sql)
+    assert exc.value.pos == len(sql)
+
+
+def test_tokenizer_error_points_at_the_bad_character():
+    sql = "SELECT a FROM t WHERE a ; 5"
+    with pytest.raises(SqlParseError) as exc:
+        parse_query(sql)
+    assert exc.value.pos == sql.index(";")
+
+
+def test_interval_literal_must_be_numeric():
+    with pytest.raises(SqlParseError, match="must be numeric"):
+        parse_query("SELECT k, COUNT(*) FROM t GROUP BY k, "
+                    "TUMBLE(ts, INTERVAL 'ten' SECOND)")
+
+
+def test_negative_number_literals_parse_and_filter():
+    """Latent parser bug fixed: '-5' used to fail tokenization."""
+    q = parse_query("SELECT k, COUNT(*) FROM t WHERE v > -5 AND v < -1 "
+                    "GROUP BY k, TUMBLE(ts, INTERVAL '1' SECOND)")
+    assert q.where({"v": -3}) is True
+    assert q.where({"v": 0}) is False
+    assert q.where({"v": -9}) is False
+
+
+def test_predicate_ast_shape_preserves_parenthesization():
+    q = parse_query(
+        "SELECT k, COUNT(*) FROM t WHERE a < 1 AND (b = 2 OR c >= 3) "
+        "GROUP BY k, TUMBLE(ts, INTERVAL '1' SECOND)")
+    ast = q.where_ast
+    assert isinstance(ast, BoolExpr) and ast.op == "and"
+    assert isinstance(ast.left, Comparison) and ast.left.op == "<"
+    assert isinstance(ast.right, BoolExpr) and ast.right.op == "or"
+    # the compiled closure and the AST agree
+    assert q.where({"a": 0, "b": 9, "c": 3}) is True
+    assert q.where({"a": 0, "b": 9, "c": 0}) is False
+
+
+# ---------------------------------------------------------------------------
+# golden plans (clause matrix)
+# ---------------------------------------------------------------------------
+
+def test_golden_plan_hop_count_with_pushdown():
+    q = parse_query(
+        "SELECT campaign, COUNT(*) AS views, WINDOW_END AS wend FROM ysb "
+        "WHERE event_type < 0.5 GROUP BY campaign, "
+        "HOP(rowtime, INTERVAL '1' SECOND, INTERVAL '10' SECOND)")
+    report = plan_query(q, _CATALOG)
+    assert report.fused
+    assert report.describe() == (
+        "Output[campaign,views,wend]\n"
+        "  WindowAggregate[key=campaign, "
+        "hop(size=10000ms slide=1000ms slice=1000ms), "
+        "count(*) AS views -> count]\n"
+        "    Filter[event_type < 0.5, device-pushdown]\n"
+        "      Scan[ysb, fields=campaign,event_type,rowtime, "
+        "read=campaign,event_type]"
+    )
+
+
+def test_golden_plan_tumble_sum_no_filter():
+    q = parse_query(
+        "SELECT user, SUM(amount) AS total FROM pay "
+        "GROUP BY user, TUMBLE(rowtime, INTERVAL '2' SECOND)")
+    report = plan_query(q, _CATALOG)
+    assert report.fused
+    assert report.describe() == (
+        "Output[user,total]\n"
+        "  WindowAggregate[key=user, tumble(size=2000ms slice=2000ms), "
+        "sum(amount) AS total -> sum]\n"
+        "    Scan[pay, fields=user,amount,rowtime, read=user,amount]"
+    )
+
+
+def test_window_slice_is_the_gcd_of_size_and_slide():
+    q = parse_query(
+        "SELECT campaign, COUNT(*) FROM ysb GROUP BY campaign, "
+        "HOP(rowtime, INTERVAL '2500' MILLISECOND, "
+        "INTERVAL '4' SECOND)")
+    report = plan_query(q, _CATALOG)
+    assert report.fused
+    assert report.plan.window_agg.window.slice_ms == 500
+
+
+@pytest.mark.parametrize("func,device", [
+    ("COUNT(*)", "count"), ("SUM(amount)", "sum"), ("MIN(amount)", "min"),
+    ("MAX(amount)", "max"), ("AVG(amount)", "mean"),
+])
+def test_agg_call_maps_onto_the_builtin_device_aggregator(func, device):
+    q = parse_query(f"SELECT user, {func} AS x FROM pay "
+                    "GROUP BY user, TUMBLE(rowtime, INTERVAL '1' SECOND)")
+    report = plan_query(q, _CATALOG)
+    assert report.fused
+    assert report.plan.window_agg.agg.device_agg == device
+
+
+def test_projection_pruning_reads_only_referenced_fields():
+    catalog = {"wide": TableInfo(
+        name="wide", fields=("k", "a", "b", "c", "d", "rowtime"),
+        rowtime="rowtime",
+        field_types=("int", "float", "float", "float", "float", "int"))}
+    q = parse_query(
+        "SELECT k, SUM(b) AS s FROM wide WHERE d > 1 "
+        "GROUP BY k, TUMBLE(rowtime, INTERVAL '1' SECOND)")
+    report = plan_query(q, catalog)
+    assert report.fused
+    assert report.plan.scan.required == ["k", "b", "d"]
+
+
+# ---------------------------------------------------------------------------
+# fallback catalog: every unsupported shape is attributed, none fail
+# ---------------------------------------------------------------------------
+
+_FALLBACKS = [
+    ("SELECT a.user, b.user FROM pay AS a JOIN pay AS b ON a.user = b.user "
+     "WINDOW TUMBLE(INTERVAL '1' SECOND)", "join"),
+    ("SELECT user, COUNT(*) AS n FROM pay "
+     "GROUP BY user, SESSION(rowtime, INTERVAL '1' SECOND)",
+     "session-window"),
+    ("SELECT user, COUNT(*) AS n FROM pay GROUP BY user", "no-window"),
+    ("SELECT user FROM pay", "no-aggregate"),
+    ("SELECT COUNT(*) AS n FROM pay "
+     "GROUP BY TUMBLE(rowtime, INTERVAL '1' SECOND)", "no-group-by"),
+    ("SELECT user, amount, COUNT(*) AS n FROM pay GROUP BY user, amount, "
+     "TUMBLE(rowtime, INTERVAL '1' SECOND)", "composite-group-key"),
+    ("SELECT user, COUNT(*) AS n, SUM(amount) AS s FROM pay "
+     "GROUP BY user, TUMBLE(rowtime, INTERVAL '1' SECOND)",
+     "multi-aggregate"),
+    ("SELECT user, COUNT(*) AS n FROM untyped "
+     "GROUP BY user, TUMBLE(rowtime, INTERVAL '1' SECOND)",
+     "untyped-schema"),
+    ("SELECT name, COUNT(*) AS n FROM strkey "
+     "GROUP BY name, TUMBLE(rowtime, INTERVAL '1' SECOND)",
+     "non-integer-group-key"),
+    ("SELECT name, COUNT(*) AS n FROM strkey WHERE name != 'spam' "
+     "GROUP BY name, TUMBLE(rowtime, INTERVAL '1' SECOND)",
+     "non-traceable-predicate"),
+    ("SELECT user, COUNT(*) AS n FROM pay "
+     "GROUP BY user, TUMBLE(amount, INTERVAL '1' SECOND)",
+     "window-not-on-rowtime"),
+    ("SELECT user, SUM(rowtime) AS s FROM pay "
+     "GROUP BY user, TUMBLE(rowtime, INTERVAL '1' SECOND)",
+     "rowtime-in-expression"),
+    ("SELECT user, COUNT(*) AS n FROM nowhere "
+     "GROUP BY user, TUMBLE(rowtime, INTERVAL '1' SECOND)",
+     "unknown-table"),
+    ("SELECT nope, COUNT(*) AS n FROM pay "
+     "GROUP BY nope, TUMBLE(rowtime, INTERVAL '1' SECOND)",
+     "unknown-column"),
+    ("SELECT user, SUM(nope) AS s FROM pay "
+     "GROUP BY user, TUMBLE(rowtime, INTERVAL '1' SECOND)",
+     "unknown-column"),
+    ("SELECT user, COUNT(*) AS n FROM pay WHERE nope > 1 "
+     "GROUP BY user, TUMBLE(rowtime, INTERVAL '1' SECOND)",
+     "unknown-column"),
+    ("SELECT user, COUNT(*) AS n FROM pay "
+     "GROUP BY user, TUMBLE(rowtime, INTERVAL '1' SECOND) "
+     "UNION ALL SELECT user, COUNT(*) AS n FROM pay "
+     "GROUP BY user, TUMBLE(rowtime, INTERVAL '1' SECOND)", "union"),
+]
+
+
+@pytest.mark.parametrize("sql,reason", _FALLBACKS,
+                         ids=[r for _s, r in _FALLBACKS])
+def test_unsupported_shapes_fall_back_with_the_catalogued_reason(sql, reason):
+    report = plan_query(parse_query(sql), _CATALOG)
+    assert report.path == "interpreted"
+    assert report.reason == reason
+    assert reason in FALLBACK_CATALOG
+    assert report.detail
+
+
+def test_string_predicate_on_string_key_still_executes_interpreted():
+    """A fallback is attributed, never a failure: the statement runs on
+    the interpreted path and produces its rows."""
+    rows = [{"name": f"u{i % 3}", "amount": float(i % 4), "rowtime": i * 100}
+            for i in range(200)]
+    env, tenv = _typed_rows_env(
+        fused=True, rows=rows, types=("str", "float", "int"))
+    tenv.from_rows("strkey", rows, TableSchema(
+        ["name", "amount", "rowtime"], rowtime="rowtime",
+        field_types=["str", "float", "int"]))
+    out = tenv.execute_sql_to_list(
+        "SELECT name, COUNT(*) AS n FROM strkey WHERE name != 'u0' "
+        "GROUP BY name, TUMBLE(rowtime, INTERVAL '10' SECOND)")
+    assert tenv.last_plan_report.path == "interpreted"
+    assert tenv.last_plan_report.reason == "non-traceable-predicate"
+    assert {r["name"] for r in out} == {"u1", "u2"}
+
+
+def test_non_grouped_select_column_is_refused_not_mislabeled():
+    """Review regression: `SELECT v, COUNT(*) ... GROUP BY k` used to
+    classify as fused and silently emit k's values under the name v. Both
+    paths (and the plan-only view) must refuse it identically."""
+    sql = ("SELECT amount, COUNT(*) AS n FROM pay "
+           "GROUP BY user, TUMBLE(rowtime, INTERVAL '1' SECOND)")
+    with pytest.raises(ValueError, match="must appear in GROUP BY"):
+        plan_query(parse_query(sql), _CATALOG)
+    for fused in (True, False):
+        env, tenv = _typed_rows_env(fused=fused)
+        with pytest.raises(ValueError, match="must appear in GROUP BY"):
+            tenv.sql_query(sql)
+
+
+def test_failed_statement_does_not_inherit_the_previous_report():
+    """Review regression: a parse failure used to leave the PREVIOUS
+    statement's plan report in place, which the gateway then stamped onto
+    the failed operation as executionPath."""
+    env, tenv = _typed_rows_env(fused=True)
+    tenv.sql_query("SELECT user, COUNT(*) AS n FROM pay "
+                   "GROUP BY user, TUMBLE(rowtime, INTERVAL '1' SECOND)")
+    assert tenv.last_plan_report is not None and tenv.last_plan_report.fused
+    with pytest.raises(SqlParseError):
+        tenv.sql_query("SELEC nonsense")
+    assert tenv.last_plan_report is None
+
+
+def test_predicate_reason_codes_are_structural_not_substring():
+    """Review regression: a str column whose NAME contains 'rowtime' must
+    attribute as non-traceable-predicate, not rowtime-in-expression."""
+    catalog = {"t": TableInfo(
+        name="t", fields=("k", "rowtime_tag", "rowtime"),
+        rowtime="rowtime", field_types=("int", "str", "int"))}
+    q = parse_query("SELECT k, COUNT(*) AS n FROM t "
+                    "WHERE rowtime_tag != 'x' "
+                    "GROUP BY k, TUMBLE(rowtime, INTERVAL '1' SECOND)")
+    report = plan_query(q, catalog)
+    assert report.reason == "non-traceable-predicate"
+
+
+def test_unknown_group_by_column_is_a_translation_diagnostic():
+    """Review regression: the attributed unknown-column fallback used to
+    die with a raw per-record KeyError on the interpreted path."""
+    env, tenv = _typed_rows_env(fused=True)
+    sql = ("SELECT nope, COUNT(*) AS n FROM pay "
+           "GROUP BY nope, TUMBLE(rowtime, INTERVAL '1' SECOND)")
+    with pytest.raises(ValueError, match="unknown column"):
+        tenv.sql_query(sql)
+    assert tenv.last_plan_report.reason == "unknown-column"
+
+
+def test_null_predicate_values_match_interpreted_semantics():
+    """Review regression: a NULL in a predicate-only column crashed the
+    fused columnarizer while the interpreted path applied SQL NULL
+    semantics (NULL cmp -> not TRUE). NaN-encoded NULLs + null-aware
+    masks now drop those rows identically — incl. for `!=`."""
+    rows = [{"user": i % 3,
+             "amount": (None if i % 4 == 0 else float(i % 5)),
+             "rowtime": i * 100} for i in range(200)]
+    for where in ("amount > 1", "amount != 2"):
+        sql = (f"SELECT user, COUNT(*) AS n FROM pay WHERE {where} "
+               "GROUP BY user, TUMBLE(rowtime, INTERVAL '5' SECOND)")
+
+        def run(fused):
+            env, tenv = _typed_rows_env(fused=fused, rows=rows)
+            sink = tenv.sql_query(sql).collect()
+            env.execute()
+            return _norm(sink.results), tenv.last_plan_report
+
+        fused_rows, report = run(True)
+        interp_rows, _ = run(False)
+        assert report.fused
+        assert len(fused_rows) > 0 and fused_rows == interp_rows
+
+
+def test_null_group_key_or_agg_input_is_refused_loudly():
+    rows = [{"user": (None if i == 7 else i % 3), "amount": 1.0,
+             "rowtime": i * 100} for i in range(20)]
+    env, tenv = _typed_rows_env(fused=True, rows=rows)
+    tenv.sql_query("SELECT user, COUNT(*) AS n FROM pay "
+                   "GROUP BY user, TUMBLE(rowtime, INTERVAL '1' SECOND)"
+                   ).collect()
+    with pytest.raises(Exception, match="no NULL representation"):
+        env.execute()
+
+
+def test_columnar_table_without_types_attributes_untyped_schema():
+    """Review regression: was misattributed as \"declared 'float'\" —
+    a declaration the user never made."""
+    catalog = {"c": TableInfo(
+        name="c", fields=("k", "v", "rowtime"), rowtime="rowtime",
+        field_types=None, columnar=True)}
+    q = parse_query("SELECT k, SUM(v) AS s FROM c "
+                    "GROUP BY k, TUMBLE(rowtime, INTERVAL '1' SECOND)")
+    report = plan_query(q, catalog)
+    assert report.reason == "untyped-schema"
+    assert "field_types" in report.detail
+
+
+def test_columnarizer_refuses_int_keys_float32_cannot_represent():
+    """Review regression: a declared-int key >= 2**24 loses exactness in
+    the float32 column — the row-mode bridge must raise loudly instead of
+    silently aliasing distinct keys on the device."""
+    rows = [{"user": 16_777_216 + i, "amount": 1.0, "rowtime": i * 100}
+            for i in range(4)]
+    env, tenv = _typed_rows_env(fused=True, rows=rows)
+    sink = tenv.sql_query(
+        "SELECT user, COUNT(*) AS n FROM pay "
+        "GROUP BY user, TUMBLE(rowtime, INTERVAL '1' SECOND)").collect()
+    assert tenv.last_plan_report.fused
+    with pytest.raises(Exception, match="float32 cannot represent"):
+        env.execute()
+    del sink
+
+
+def test_gateway_401s_on_non_ascii_authorization_header():
+    """Review regression: hmac.compare_digest raises TypeError on
+    non-ASCII str input — a garbage header must 401, not kill the
+    handler thread with no HTTP response."""
+    from flink_tpu.table.gateway import SqlGateway
+
+    gw = SqlGateway(auth_token="sekrit")
+    try:
+        req = urllib.request.Request(gw.address + "/v1/sessions",
+                                     data=b"{}", method="POST")
+        req.add_header("Authorization", "Bearer \xa3bogus")
+        with pytest.raises(urllib.error.HTTPError) as exc:
+            urllib.request.urlopen(req, timeout=10)
+        assert exc.value.code == 401
+    finally:
+        gw.stop()
+
+
+def test_agg_mapping_is_single_sourced_with_the_interpreted_path():
+    """Review regression: the planner's agg map and table_env's were two
+    hand-copies that could drift — they must be the same object."""
+    from flink_tpu.planner import rules
+    from flink_tpu.table import table_env
+
+    assert rules.DEVICE_AGG_OF is table_env._DEVICE_AGG
+
+
+def test_device_fusion_off_reports_disabled():
+    env, tenv = _columnar_env(n=256)
+    env.config.set(TableOptions.DEVICE_FUSION, False)
+    tenv.sql_query("SELECT campaign, COUNT(*) AS n FROM ysb "
+                   "GROUP BY campaign, TUMBLE(rowtime, INTERVAL '1' SECOND)")
+    assert tenv.last_plan_report.path == "interpreted"
+    assert tenv.last_plan_report.reason == "disabled"
+
+
+def test_explain_sql_is_plan_only():
+    env, tenv = _columnar_env(n=256)
+    report = tenv.explain_sql(
+        "SELECT campaign, COUNT(*) AS n FROM ysb "
+        "GROUP BY campaign, TUMBLE(rowtime, INTERVAL '1' SECOND)")
+    assert report.fused and report.lowered is None
+    assert "WindowAggregate" in report.describe()
+    # explain does not execute and does not disturb the env's sinks
+    assert env._sinks == []
+
+
+# ---------------------------------------------------------------------------
+# three-way parity: SQL-fused == interpreted == hand-built DataStream
+# ---------------------------------------------------------------------------
+
+_SQL_YSB = (
+    "SELECT campaign, COUNT(*) AS views, WINDOW_END AS wend FROM ysb "
+    "WHERE event_type < 0.5 GROUP BY campaign, "
+    "HOP(rowtime, INTERVAL '500' MILLISECOND, INTERVAL '2' SECOND)"
+)
+
+
+def _run_sql(fused, n=4096):
+    env, tenv = _columnar_env(n=n, fused=fused)
+    sink = tenv.sql_query(_SQL_YSB).collect()
+    env.execute()
+    return _norm(sink.results), tenv.last_plan_report
+
+
+def test_three_way_parity_on_the_sql_ysb_job():
+    fused_rows, report = _run_sql(True)
+    interp_rows, _ = _run_sql(False)
+    assert report.fused
+
+    # the hand-built DataStream program with the same output shape
+    cfg = Configuration()
+    cfg.set(ExecutionOptions.BATCH_SIZE, 512)
+    cfg.set(ExecutionOptions.KEY_CAPACITY, NUM_KEYS)
+    env = StreamExecutionEnvironment.get_execution_environment(cfg)
+    win = (
+        env.from_source(
+            _source(4096),
+            watermark_strategy=WatermarkStrategy.for_bounded_out_of_orderness(0))
+        .filter(lambda col: col[:, 1] < 0.5, traceable=True)
+        .key_by(lambda col: col[:, 0].astype(jnp.int32), traceable=True)
+        .window(SlidingEventTimeWindows.of(2000, 500))
+        .aggregate("count")
+    )
+    sink = win.map_with_timestamp(
+        lambda rec, ts: {"campaign": rec[0], "views": rec[1], "wend": ts + 1},
+        name="sql_shape").collect()
+    env.execute()
+    ds_rows = _norm(sink.results)
+
+    assert len(fused_rows) > 0
+    assert fused_rows == interp_rows == ds_rows
+
+
+@pytest.mark.parametrize("agg,alias", [
+    ("SUM(event_type)", "s"), ("MIN(event_type)", "lo"),
+    ("MAX(event_type)", "hi"), ("AVG(event_type)", "m"),
+])
+def test_fused_vs_interpreted_parity_per_aggregate(agg, alias):
+    sql = (f"SELECT campaign, {agg} AS {alias}, WINDOW_START AS ws FROM ysb "
+           "GROUP BY campaign, TUMBLE(rowtime, INTERVAL '1' SECOND)")
+
+    def run(fused):
+        env, tenv = _columnar_env(n=2048, fused=fused)
+        sink = tenv.sql_query(sql).collect()
+        env.execute()
+        return _norm(sink.results), tenv.last_plan_report
+
+    fused_rows, report = run(True)
+    interp_rows, _ = run(False)
+    assert report.fused
+    assert len(fused_rows) > 0 and fused_rows == interp_rows
+
+
+def test_having_and_topn_ride_the_fused_path():
+    sql = ("SELECT campaign, COUNT(*) AS n, WINDOW_END AS we FROM ysb "
+           "GROUP BY campaign, TUMBLE(rowtime, INTERVAL '1' SECOND) "
+           "HAVING n > 2 ORDER BY n DESC, campaign ASC LIMIT 3")
+
+    def run(fused):
+        env, tenv = _columnar_env(n=2048, fused=fused)
+        sink = tenv.sql_query(sql).collect()
+        env.execute()
+        return _norm(sink.results), tenv.last_plan_report
+
+    fused_rows, report = run(True)
+    interp_rows, _ = run(False)
+    assert report.fused, (
+        "HAVING/ORDER BY/LIMIT are post-window host stages and must not "
+        "knock the window off the fused path")
+    assert len(fused_rows) > 0 and fused_rows == interp_rows
+
+
+def test_typed_row_table_fuses_window_only_at_parity():
+    sql = ("SELECT user, SUM(amount) AS total FROM pay WHERE amount > 1 "
+           "GROUP BY user, TUMBLE(rowtime, INTERVAL '2' SECOND)")
+
+    def run(fused):
+        env, tenv = _typed_rows_env(fused=fused)
+        sink = tenv.sql_query(sql).collect()
+        report = tenv.last_plan_report
+        runners, _ = build_runners(plan(env._sinks), env.config)
+        selected = any(isinstance(r, DeviceChainRunner) for r in runners)
+        env.execute()
+        return _norm(sink.results), report, selected
+
+    fused_rows, report, selected = run(True)
+    interp_rows, _, _ = run(False)
+    assert report.fused and report.lowered.host_prologue
+    assert selected, "typed row tables must still select the fused runner"
+    assert len(fused_rows) > 0 and fused_rows == interp_rows
+
+
+# ---------------------------------------------------------------------------
+# reroute gate + snapshot/restore through the fused SQL program
+# ---------------------------------------------------------------------------
+
+def test_sql_job_selects_the_fused_runner_and_the_gauge_reports_it():
+    env, tenv = _columnar_env(n=1024)
+    tenv.sql_query(_SQL_YSB).collect()
+    graph = plan(env._sinks)
+    runners, _ = build_runners(graph, env.config)
+    assert any(isinstance(r, DeviceChainRunner) for r in runners)
+
+    rt = JobRuntime(graph, env.config)
+    gauge = rt.registry.all_metrics().get("job.sqlFusedSelected")
+    assert gauge is not None and gauge.value() == 1
+
+
+def test_interpreted_sql_job_reports_gauge_zero():
+    env, tenv = _columnar_env(n=1024, fused=False)
+    tenv.sql_query(_SQL_YSB).collect()
+    graph = plan(env._sinks)
+    rt = JobRuntime(graph, env.config)
+    gauge = rt.registry.all_metrics().get("job.sqlFusedSelected")
+    assert gauge is not None and gauge.value() == 0
+
+
+def test_non_sql_job_has_no_sql_gauge():
+    cfg = Configuration()
+    cfg.set(ExecutionOptions.BATCH_SIZE, 256)
+    env = StreamExecutionEnvironment.get_execution_environment(cfg)
+    (
+        env.from_source(_source(512),
+                        watermark_strategy=WatermarkStrategy.for_bounded_out_of_orderness(0))
+        .key_by(lambda col: col[:, 0].astype(jnp.int32), traceable=True)
+        .window(SlidingEventTimeWindows.of(2000, 500))
+        .aggregate("count")
+        .collect()
+    )
+    rt = JobRuntime(plan(env._sinks), cfg)
+    assert "job.sqlFusedSelected" not in rt.registry.all_metrics()
+
+
+def test_sql_fused_snapshot_restore_midstream_parity():
+    """Snapshot the SQL-lowered fused runner mid-stream, restore into a
+    fresh build of the same statement, continue: the union of emitted
+    rows matches an uninterrupted run (PR 7's fused-runner contract, now
+    through the planner's lowering)."""
+    cfg = Configuration()
+    cfg.set(ExecutionOptions.SUPERBATCH_STEPS, 2)
+    cfg.set(ExecutionOptions.KEY_CAPACITY, NUM_KEYS)
+
+    def build():
+        env = StreamExecutionEnvironment.get_execution_environment(cfg)
+        tenv = TableEnvironment(env)
+        stream = env.from_source(
+            _source(16),   # source unused: batches are driven by hand
+            watermark_strategy=WatermarkStrategy.for_bounded_out_of_orderness(0),
+        )
+        tenv.register_table(
+            "ysb", stream,
+            TableSchema(["campaign", "event_type", "rowtime"],
+                        rowtime="rowtime",
+                        field_types=["int", "float", "int"]),
+            columnar=True,
+        )
+        sink = tenv.sql_query(
+            "SELECT campaign, SUM(event_type) AS s FROM ysb "
+            "GROUP BY campaign, TUMBLE(rowtime, INTERVAL '1' SECOND)"
+        ).collect()
+        runners, feeds = build_runners(plan(env._sinks), cfg)
+        (entry, _ordinal), = next(iter(feeds.values()))
+        assert isinstance(entry, DeviceChainRunner)
+        return entry, runners, sink
+
+    def batches():
+        for t0 in range(8):
+            base = 10_000 + t0 * 400
+            vals = np.asarray(
+                [[float(t0 % 3), 2.0], [float((t0 + 1) % 3), 3.0]],
+                dtype=np.float32)
+            ts = np.asarray([base, base + 100], dtype=np.int64)
+            yield vals, ts, base
+
+    def finish(entry, runners):
+        entry.on_end()
+        for r in runners:
+            if r is not entry:
+                getattr(r, "on_end", lambda: None)()
+
+    # uninterrupted
+    e1, r1, s1 = build()
+    for vals, ts, base in batches():
+        e1.on_batch(vals, ts)
+        e1.on_watermark(base)
+    finish(e1, r1)
+
+    # snapshot after 4 batches, restore into a fresh build, continue
+    e2, r2, s2 = build()
+    it = list(batches())
+    for vals, ts, base in it[:4]:
+        e2.on_batch(vals, ts)
+        e2.on_watermark(base)
+    snap = e2.snapshot()
+    e3, r3, s3 = build()
+    e3.restore(snap)
+    for vals, ts, base in it[4:]:
+        e3.on_batch(vals, ts)
+        e3.on_watermark(base)
+    finish(e3, r3)
+
+    assert len(s1.results) > 0
+    assert _norm(s1.results) == _norm(list(s2.results) + list(s3.results))
+
+
+# ---------------------------------------------------------------------------
+# REST /jobs/:id visibility (MiniCluster path)
+# ---------------------------------------------------------------------------
+
+def _get(url, token=None):
+    req = urllib.request.Request(url)
+    if token:
+        req.add_header("Authorization", f"Bearer {token}")
+    with urllib.request.urlopen(req, timeout=10) as r:
+        return r.read()
+
+
+def test_rest_job_detail_carries_sql_path_selection():
+    from flink_tpu.runtime.minicluster import JobStatus, MiniCluster
+    from flink_tpu.runtime.rest import RestServer
+
+    env, tenv = _columnar_env(n=1024)
+    tenv.sql_query(_SQL_YSB).collect()
+    cluster = MiniCluster()
+    client = cluster.submit(plan(env._sinks), env.config, "sql-job")
+    assert client.wait(60) == JobStatus.FINISHED
+    server = RestServer(cluster).start()
+    try:
+        detail = json.loads(_get(f"{server.url}/jobs/{client.job_id}"))
+        assert detail["sqlFusedSelected"] == 1
+    finally:
+        server.stop()
+
+
+# ---------------------------------------------------------------------------
+# SQL gateway: bearer auth + path-selection reporting
+# ---------------------------------------------------------------------------
+
+_GW_ROWS = [
+    {"user": i % 5, "amount": float(i % 3), "rowtime": i * 100}
+    for i in range(400)
+]
+
+
+def test_gateway_requires_bearer_and_serves_with_it():
+    from flink_tpu.table.gateway import SqlGateway, SqlGatewayClient
+
+    gw = SqlGateway(auth_token="sekrit")
+    try:
+        # 401 without the token on every verb
+        bare = SqlGatewayClient(gw.address)
+        with pytest.raises(RuntimeError, match="bearer"):
+            bare.open_session()
+        req = urllib.request.Request(
+            gw.address + "/v1/sessions/x/operations/y/status")
+        with pytest.raises(urllib.error.HTTPError) as exc:
+            urllib.request.urlopen(req, timeout=10)
+        assert exc.value.code == 401
+
+        # 200 with it, end to end
+        client = SqlGatewayClient(gw.address, auth_token="sekrit")
+        sh = client.open_session()
+        client.register_table(sh, "pay", ["user", "amount", "rowtime"],
+                              _GW_ROWS, time_col="rowtime",
+                              types=["int", "float", "int"])
+        rows = client.execute(
+            sh, "SELECT user, COUNT(*) AS n FROM pay "
+                "GROUP BY user, TUMBLE(rowtime, INTERVAL '10' SECOND)")
+        assert sum(r["n"] for r in rows) == len(_GW_ROWS)
+
+        # authed 404s on unknown session / unknown operation
+        with pytest.raises(RuntimeError, match="unknown session"):
+            client.execute("nosuchsession", "SELECT user FROM pay")
+        with pytest.raises(RuntimeError, match="unknown operation"):
+            client.statement_status(sh, "nosuchop")
+    finally:
+        gw.stop()
+
+
+def test_gateway_reports_the_selected_execution_path():
+    from flink_tpu.table.gateway import SqlGateway, SqlGatewayClient
+
+    gw = SqlGateway()
+    try:
+        client = SqlGatewayClient(gw.address)
+        sh = client.open_session()
+        client.register_table(sh, "pay", ["user", "amount", "rowtime"],
+                              _GW_ROWS, time_col="rowtime",
+                              types=["int", "float", "int"])
+
+        # supported statement -> fused, no fallback reason
+        res = client._request(
+            "POST", f"/v1/sessions/{sh}/statements",
+            {"statement": "SELECT user, COUNT(*) AS n FROM pay "
+                          "GROUP BY user, TUMBLE(rowtime, INTERVAL '10' SECOND)"})
+        assert res["executionPath"] == "fused"
+        assert res["fallbackReason"] is None
+        status = client.statement_status(sh, res["operationHandle"])
+        assert status["executionPath"] == "fused"
+
+        # unsupported statement -> interpreted, reason attributed, rows OK
+        res = client._request(
+            "POST", f"/v1/sessions/{sh}/statements",
+            {"statement": "SELECT user, COUNT(*) AS n FROM pay "
+                          "GROUP BY user, SESSION(rowtime, INTERVAL '1' SECOND)"})
+        assert res["executionPath"] == "interpreted"
+        assert res["fallbackReason"] == "session-window"
+        status = client.statement_status(sh, res["operationHandle"])
+        assert status["status"] == "FINISHED"
+        assert status["fallbackReason"] == "session-window"
+    finally:
+        gw.stop()
