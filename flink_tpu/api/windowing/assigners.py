@@ -83,6 +83,36 @@ class WindowAssigner:
     def offset_ms(self) -> int:
         return 0
 
+    def slices_on(self, granule_ms: int):
+        """EXACT decomposition of this assigner's windows onto an arbitrary
+        slice granule: (slices_per_window, slide_slices) such that window j
+        covers exactly the half-open slice run [j*slide_slices,
+        j*slide_slices + slices_per_window) on the `granule_ms` grid.
+
+        This is the shared-partials contract (graph/window_sharing.py): a
+        group of correlated windows computes ONE ring at the gcd granule
+        and every member derives its windows from those partials, so the
+        decomposition must be exact — including the degenerate shapes a
+        naive `size // slide` computation gets wrong (a slide that does
+        not divide the size, and the size == slide tumbling collapse,
+        where the only valid granule is gcd(size, slide), not slide).
+
+        Raises ValueError when the granule does not divide both size and
+        slide (the decomposition would not be exact: a window edge would
+        fall inside a slice) or when the assigner is not sliceable."""
+        if self.slice_ms is None:
+            raise ValueError(f"{self!r} is not sliceable")
+        size = self.slices_per_window * self.slice_ms
+        slide = self.slide_slices * self.slice_ms
+        if granule_ms <= 0 or size % granule_ms or slide % granule_ms:
+            raise ValueError(
+                f"granule {granule_ms}ms does not divide size={size}ms / "
+                f"slide={slide}ms exactly — a window edge would fall inside "
+                f"a slice; use a divisor of gcd(size, slide) = "
+                f"{math.gcd(size, slide)}ms"
+            )
+        return size // granule_ms, slide // granule_ms
+
 
 class TumblingEventTimeWindows(WindowAssigner):
     def __init__(self, size_ms: int, offset_ms: int = 0):

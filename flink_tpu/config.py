@@ -249,6 +249,19 @@ class ExecutionOptions:
         "chain, execution keeps the per-step ChainRunner + window operator "
         "path with identical results."
     )
+    SHARED_PARTIALS = (
+        ConfigOptions.key("execution.window.shared-partials").bool_type().default_value(True)
+    ).with_description(
+        "Compile correlated window aggregates — sibling window() steps over "
+        "the same keyed stream with the same aggregate (e.g. 1m/5m/1h "
+        "dashboards) — into ONE shared-partial device program: slices are "
+        "computed once at the gcd granule and every member window derives "
+        "its result from the shared partials at fire time (Factor Windows, "
+        "docs/windows.md). Requires execution.chain.device-fusion "
+        "eligibility for every sibling; a perf switch, never a semantics "
+        "switch — off, or for any ineligible group, each window keeps its "
+        "own fused program with identical results."
+    )
     SUPERBATCH_STEPS = (
         ConfigOptions.key("execution.window.superbatch-steps").int_type().default_value(32)
     ).with_description(

@@ -1,0 +1,221 @@
+"""Sharing optimizer for correlated window aggregates (Factor Windows).
+
+"Factor Windows: Cost-based Query Rewriting for Optimizing Correlated
+Window Aggregates" (PAPERS.md, arXiv 2008.12379) observes that a job
+computing several windows over the same stream — the 1m/5m/1h dashboard
+shape — re-scans the stream once per window, although the windows are
+*correlated*: every member can be derived from the partials of a common
+finer "factor" window. The slice decomposition the device path already
+uses (api/windowing/assigners.py, the pane/slice trick) IS that factor
+window: slices at the gcd granule of the group. What was missing is the
+optimizer that recognizes the shape — this module.
+
+`plan_shared_windows` walks the fusion planner's per-step
+`DeviceChainPlan`s (graph/fusion.py) and groups device-fusable
+`window_aggregate` siblings that consume the SAME keyed stream (same
+producer edge, same traceable key selector and value extractor, same
+resolved aggregate, same slot-sharing group, one common window offset)
+into `SharedWindowPlan`s. The executor then builds ONE shared-partial
+runner per group: ingest lands gcd-granule partials once, each member
+window fires its own slice-run from the shared ring
+(runtime/fused_window_pipeline.SharedWindowPipeline,
+`fire_spws` in ops/superscan.make_superscan_step), and emissions route to
+each member's own downstream. Against N independent fused runs this
+saves (N-1) full ingest scans — the `estimated_sharing_factor` below.
+
+When the common producer is a pure traceable chain consumed ONLY by the
+group, the chain is absorbed into the shared program too (the sibling
+count blocked per-member absorption in graph/fusion.py; the group as a
+whole un-blocks it).
+
+Exactness: member decompositions onto the shared granule go through
+`WindowAssigner.slices_on`, the validated exact-decomposition contract —
+a slide that does not divide the size, and the size == slide tumbling
+collapse, decompose exactly or the group is refused (each member then
+keeps its own fused program; sharing is a perf switch, never a semantics
+switch).
+
+Layering: graph module — imports graph/ops only, never the runtime
+(ARCH001; the plan is pure data the executor consumes).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Dict, List, Optional, Set, Tuple
+
+from flink_tpu.graph.fusion import DeviceChainPlan, chain_is_traceable
+from flink_tpu.graph.transformation import Step, StepGraph, Transformation
+
+#: refuse groups whose shared ring would explode: a member needing more
+#: slices per window than this on the shared granule (e.g. a 1-second
+#: window grouped with a 1ms one) costs more in fire-time gathers than
+#: sharing saves in ingest
+MAX_SHARED_SPW = 4096
+
+
+@dataclasses.dataclass
+class SharedWindowPlan:
+    """One shared-partial group: member window steps (spec order), their
+    terminals/assigners, the shared traced chain (possibly empty), the
+    input edges the shared runner consumes, and the cost-model estimate."""
+
+    members: List[Step]                   # window steps; members[0] = leader
+    terminals: List[Transformation]
+    assigners: List
+    transforms: List[Transformation]      # shared absorbed chain, app order
+    inputs: List                          # executor wiring edges
+    granule_ms: int
+    member_spws: List[int]                # slices per window on the granule
+    estimated_sharing_factor: float
+    absorbed: Optional[Step] = None       # chain step folded into the program
+
+    @property
+    def name(self) -> str:
+        parts = [t.name for t in self.transforms]
+        parts.append(" | ".join(t.name for t in self.terminals))
+        return " => ".join(parts)
+
+
+def _group_signature(step: Step, plan: DeviceChainPlan):
+    """Correlation key: the stream + extraction identity a group shares.
+
+    Two window steps are correlated iff they consume the same producer
+    edge, key with the same traceable selector, extract the same value
+    column, fold with the same resolved aggregate, and share a slot
+    group — then their scans are redundant and Factor-Windows sharing
+    applies."""
+    from flink_tpu.ops.aggregators import resolve
+
+    cfg = plan.terminal.config
+    edge = step.inputs[0]
+    producer, ordinal = edge[0], edge[1]
+    return (
+        id(producer), ordinal,
+        id(cfg["key_selector"]),
+        id(cfg.get("value_fn")) if cfg.get("value_fn") is not None else None,
+        id(resolve(cfg.get("aggregate"))),
+        step.slot_group,
+    )
+
+
+def _shared_granule(assigners) -> Optional[Tuple[int, List[int], List[int]]]:
+    """(granule_ms, member_spws, member_sls) — or None when the group has
+    no exact, bounded shared decomposition (mixed offsets, a member whose
+    decomposition is inexact, or a pathological granule ratio)."""
+    if len({a.offset_ms for a in assigners}) != 1:
+        return None
+    g = 0
+    for a in assigners:
+        if a.slice_ms is None or not a.is_event_time:
+            return None
+        g = math.gcd(g, a.slice_ms)
+    spws, sls = [], []
+    for a in assigners:
+        try:
+            spw, sl = a.slices_on(g)
+        except ValueError:
+            return None
+        if spw > MAX_SHARED_SPW:
+            return None
+        spws.append(spw)
+        sls.append(sl)
+    return g, spws, sls
+
+
+def _sharing_factor(n: int, spws: List[int], sls: List[int]) -> float:
+    """Factor-Windows cost estimate: independent plans pay one full
+    ingest scan per member (the dominant, per-record cost); the shared
+    plan pays ONE scan plus the fire-time slice gathers every member
+    would have paid anyway. The estimate is the scan-count ratio damped
+    by the relative fire overhead of the finer shared granule (a member
+    whose own granule was coarser now gathers more slices per fire)."""
+    # fire work per emitted window ~ spw slices; per slice of stream time a
+    # member fires every sl slices, so fire cost density ~ spw / sl
+    fire_density = sum(spw / max(sl, 1) for spw, sl in zip(spws, sls))
+    return n / (1.0 + 0.01 * fire_density)
+
+
+def plan_shared_windows(
+    graph: StepGraph,
+    chain_plans: Dict[int, DeviceChainPlan],
+) -> List[SharedWindowPlan]:
+    """Group correlated device-fusable window siblings into shared plans.
+
+    `chain_plans` is plan_device_chains' output: only steps it classified
+    device-fusable participate (the sharing bar equals the fusion bar —
+    every member must already be able to run the traced device path).
+    Members that absorbed a private chain are not grouped (their streams
+    differ by construction); a COMMON pure traceable chain feeding only
+    the group is lifted into the shared program instead."""
+    groups: Dict[tuple, List[Step]] = {}
+    for step in graph.steps:
+        plan = chain_plans.get(id(step))
+        if plan is None or plan.absorbed is not None:
+            continue
+        if len(step.inputs) != 1:
+            continue
+        tag = step.inputs[0][2] if len(step.inputs[0]) > 2 else None
+        if tag is not None:
+            continue
+        groups.setdefault(_group_signature(step, plan), []).append(step)
+
+    consumers: Dict[int, int] = {}
+    for s in graph.steps:
+        for edge in s.inputs:
+            ent = edge[0]
+            if isinstance(ent, Step):
+                consumers[id(ent)] = consumers.get(id(ent), 0) + 1
+
+    out: List[SharedWindowPlan] = []
+    for sig, members in groups.items():
+        if len(members) < 2:
+            continue
+        terminals = [s.terminal for s in members]
+        assigners = [t.config["assigner"] for t in terminals]
+        dec = _shared_granule(assigners)
+        if dec is None:
+            continue
+        g, spws, sls = dec
+        producer = members[0].inputs[0][0]
+        transforms: List[Transformation] = []
+        inputs = [members[0].inputs[0]]
+        absorbed = None
+        if (
+            isinstance(producer, Step)
+            and producer.terminal is None
+            and chain_is_traceable(producer.chain)
+            and consumers.get(id(producer), 0) == len(members)
+            and producer.slot_group == members[0].slot_group
+            and len(producer.inputs) == 1
+        ):
+            # the whole group is the chain's only consumer set: lift the
+            # chain into the shared program (per-member absorption was
+            # blocked exactly because the siblings shared it)
+            transforms = list(producer.chain)
+            inputs = list(producer.inputs)
+            absorbed = producer
+        out.append(SharedWindowPlan(
+            members=list(members),
+            terminals=terminals,
+            assigners=assigners,
+            transforms=transforms,
+            inputs=inputs,
+            granule_ms=g,
+            member_spws=spws,
+            estimated_sharing_factor=_sharing_factor(
+                len(members), spws, sls),
+            absorbed=absorbed,
+        ))
+    return out
+
+
+def describe(plans: List[SharedWindowPlan]) -> str:
+    """Human-readable summary (mirrors fusion.describe)."""
+    return "\n".join(
+        f"shared-windows[{i}] g={p.granule_ms}ms "
+        f"x{len(p.members)} (est {p.estimated_sharing_factor:.2f}x): "
+        f"{p.name}"
+        for i, p in enumerate(plans)
+    )

@@ -42,6 +42,10 @@ LAYER_FORBIDDEN: Dict[str, List[str]] = {
     # other way around
     "parallel": ["{pkg}.runtime", "{pkg}.api", "{pkg}.table", "{pkg}.cep",
                  "{pkg}.scheduler"],
+    # job translation: step planning, the fusion planner (fusion.py) and
+    # the Factor-Windows sharing optimizer (window_sharing.py) — all emit
+    # pure plan data the executor consumes; a runtime import would invert
+    # the translation DAG
     "graph": ["{pkg}.table", "{pkg}.cep", "{pkg}.runtime"],
     # the SQL planner translates table plans into graph transformations:
     # it may import table (parsed Query shapes), graph, core, and config —

@@ -81,6 +81,44 @@ _SCATTER_NP = {
 }
 
 
+def combine_binary(op: str):
+    """Elementwise jnp combine for a scatter kind — the single dispatch
+    table the in-scan session merge carry and the global-fold kernels
+    share (numpy ufuncs do NOT dispatch on jit tracers, so the host
+    oracle's `_SCATTER_NP` table above cannot serve the kernels; the jax
+    import is deferred to kernel-build time)."""
+    import jax.numpy as jnp
+
+    table = {"add": jnp.add, "min": jnp.minimum, "max": jnp.maximum}
+    if op not in table:
+        raise ValueError(op)
+    return table[op]
+
+
+def combine_reduce(op: str):
+    """Axis reduction for a scatter kind (works on numpy and jnp arrays):
+    the fire-time segment fold over a window's slice columns."""
+    if op == "add":
+        return lambda a, axis: a.sum(axis=axis)
+    if op == "min":
+        return lambda a, axis: a.min(axis=axis)
+    if op == "max":
+        return lambda a, axis: a.max(axis=axis)
+    raise ValueError(op)
+
+
+def scan_identity(dtype, scatter: str):
+    """The neutral element of a scatter kind at a dtype — what purged ring
+    cells and empty fold lanes must hold so combining them is a no-op."""
+    if scatter == "add":
+        return 0
+    if scatter == "min":
+        return _max_of(dtype)
+    if scatter == "max":
+        return _min_of(dtype)
+    raise ValueError(scatter)
+
+
 class _ColumnarAsPython(AggregateFunction):
     """Scalar-dict interpretation of a DeviceAggregator (oracle parity)."""
 

@@ -113,8 +113,14 @@ def build_superscan(
     CH: int,
     exact: bool,
     interpret: bool,
+    fire_spws: Tuple[int, ...] = None,
 ):
     """Compile the fused T-step dispatch.
+
+    `fire_spws` (shared partials): per-fire-slot window lengths in slices,
+    length F — one gcd-granule ring serves several correlated window
+    shapes, each slot combining its own slice-run length (Factor Windows);
+    None keeps the uniform-SPW program unchanged.
 
     Returns run(smin, fire_pos, fire_valid, fire_row, purge_mask,
                 count_in [S*KB,128] i32, field_states... , idx [T*B] i32,
@@ -123,6 +129,8 @@ def build_superscan(
             field_outs...)
     """
     assert B % CH == 0 and CH % MIN_CHUNK == 0
+    spws = tuple(fire_spws) if fire_spws is not None else (SPW,) * F
+    assert len(spws) == F, f"fire_spws has {len(spws)} slots, expected {F}"
     KB = K // LANE
     HI = NSB * KB
     C = B // CH
@@ -274,7 +282,7 @@ def build_superscan(
                     fp = fpos_ref[t, f]
                     row = frow_ref[t, f]
                     acc = jnp.zeros((KB, LANE), jnp.int32)
-                    for w in range(SPW):
+                    for w in range(spws[f]):
                         col = (fp + w) % S
                         acc += count_ref[
                             pl.ds(pl.multiple_of(col * KB, KB), KB), :]
@@ -283,14 +291,14 @@ def build_superscan(
                             states, outs, vfields):
                         if kind == "max8":
                             sacc = jnp.full((KB, LANE), -1, dt)
-                            for w in range(SPW):
+                            for w in range(spws[f]):
                                 col = (fp + w) % S
                                 sacc = jnp.maximum(sacc, sref[
                                     pl.ds(pl.multiple_of(col * KB, KB), KB),
                                     :])
                         else:
                             sacc = jnp.zeros((KB, LANE), dt)
-                            for w in range(SPW):
+                            for w in range(spws[f]):
                                 col = (fp + w) % S
                                 sacc += sref[
                                     pl.ds(pl.multiple_of(col * KB, KB), KB),
@@ -370,3 +378,189 @@ def from_kernel_layout(arr, K: int, S: int):
 def rows_to_keys(out, R: int, K: int):
     """Compact fire buffer [R*K/128, 128] -> [R, K]."""
     return out.reshape(R, K)
+
+
+# ------------------------------------------------------------------
+# global-window superscan: keyed-partial -> cross-segment fold as ONE
+# T-step kernel (the Nexmark-Q7 shape: per-window GLOBAL max/min/sum)
+# ------------------------------------------------------------------
+
+def supports_global(agg, S: int, R: int, NSB: int, chunk: int) -> bool:
+    """Whether an aggregate/geometry can run on the fused global scan
+    kernel: the [S] slice ring and the [R] out rows each live in one
+    128-lane vector row, the purge mask unrolls over S scalar reads, and
+    every field folds elementwise (any add/min/max, bounded or not — the
+    fold needs no scatter unit and no one-hot matrices)."""
+    from flink_tpu.ops.aggregators import VALUE
+
+    if S > 32 or R > LANE or NSB > 8 or chunk % MIN_CHUNK != 0:
+        return False
+    return all(f.scatter in ("add", "min", "max")
+               for f in agg.fields if f.source == VALUE)
+
+
+@functools.lru_cache(maxsize=None)
+def build_global_superscan(
+    agg,
+    S: int,
+    NSB: int,
+    F: int,
+    SPW: int,
+    R: int,
+    T: int,
+    B: int,
+    CH: int,
+    interpret: bool,
+    fire_spws: Tuple[int, ...] = None,
+):
+    """Compile the fused T-step GLOBAL-window dispatch as one kernel.
+
+    The XLA global scan (ops/superscan.make_global_scan_step) already
+    removes the [K, S] ring; this kernel additionally removes the
+    per-step lax.scan overhead: ingest partials, slice-ring folds, fires
+    and purges for all T steps run as one pallas_call with the [S] ring
+    resident in a single VMEM vector row. Each chunk costs NSB masked
+    whole-chunk reductions — no scatter unit, no one-hot factors, no HBM
+    round trips. Out rows are scalars packed into one [1, 128] row.
+
+    Returns run(smin, fpos, fvalid, frow, purge,
+                count_in [1,128] i32, states ([1,128] dt, ...),
+                idx [T*B] i32, vals [T*B] f32 | None)
+        -> (count_state, field_states, count_out [1,128], field_outs)"""
+    assert B % CH == 0 and CH % MIN_CHUNK == 0
+    assert S <= 32 and R <= LANE
+    spws = tuple(fire_spws) if fire_spws is not None else (SPW,) * F
+    assert len(spws) == F
+    C = B // CH
+    vfields = [
+        (f.name, jnp.dtype(f.dtype), f.scatter, f.identity)
+        for f in agg.fields if f.source == VALUE
+    ]
+    nf = len(vfields)
+
+    def _ident(dt, scatter):
+        from flink_tpu.ops.aggregators import scan_identity
+
+        return scan_identity(dt, scatter)
+
+    def kernel(smin_ref, fpos_ref, fvalid_ref, frow_ref, purge_ref,
+               count_in_ref, *rest):
+        state_in = rest[:nf]
+        idx_ref = rest[nf]
+        off = nf + 1
+        vals_ref = rest[off] if nf else None
+        off += 1 if nf else 0
+        count_ref = rest[off]
+        states = rest[off + 1:off + 1 + nf]
+        out_ref = rest[off + 1 + nf]
+        outs = rest[off + 2 + nf:]
+
+        t = pl.program_id(0)
+        c = pl.program_id(1)
+        lane = jax.lax.broadcasted_iota(jnp.int32, (1, LANE), 1)
+
+        @pl.when(jnp.logical_and(t == 0, c == 0))
+        def _():
+            count_ref[:] = count_in_ref[:]
+            out_ref[:] = jnp.zeros_like(out_ref)
+            for sref, sin in zip(states, state_in):
+                sref[:] = sin[:]
+            for oref, (_n, dt, scatter, _i) in zip(outs, vfields):
+                oref[:] = jnp.full_like(oref, _ident(dt, scatter))
+
+        # ---- ingest one chunk: NSB masked whole-chunk folds ----
+        ii = idx_ref[:]
+        srel = jnp.where(ii >= 0, ii % NSB, -1)
+        smin = smin_ref[t]
+        for sr in range(NSB):
+            col = (smin + sr) % S
+            sel = lane == col
+            cpart = jnp.sum((srel == sr).astype(jnp.int32))
+            count_ref[:] = jnp.where(sel, count_ref[:] + cpart, count_ref[:])
+            if nf:
+                v = vals_ref[:]
+                for sref, (_n, dt, scatter, _i) in zip(states, vfields):
+                    ident = jnp.asarray(_ident(dt, scatter), dt)
+                    lanev = jnp.where(srel == sr, v.astype(dt), ident)
+                    if scatter == "add":
+                        part = lanev.sum()
+                        sref[:] = jnp.where(sel, sref[:] + part, sref[:])
+                    elif scatter == "min":
+                        part = lanev.min()
+                        sref[:] = jnp.where(
+                            sel, jnp.minimum(sref[:], part), sref[:])
+                    else:
+                        part = lanev.max()
+                        sref[:] = jnp.where(
+                            sel, jnp.maximum(sref[:], part), sref[:])
+
+        # ---- fire + purge once the step's last chunk is ingested ----
+        @pl.when(c == C - 1)
+        def _():
+            for f in range(F):
+                @pl.when(fvalid_ref[t, f] > 0)
+                def _(f=f):
+                    fp = fpos_ref[t, f]
+                    row = frow_ref[t, f]
+                    inwin = (jnp.remainder(lane - fp, S) < spws[f]) & \
+                        (lane < S)
+                    rowsel = lane == row
+                    cnt = jnp.sum(jnp.where(inwin, count_ref[:], 0))
+                    out_ref[:] = jnp.where(rowsel, cnt, out_ref[:])
+                    for sref, oref, (_n, dt, scatter, _i) in zip(
+                            states, outs, vfields):
+                        ident = jnp.asarray(_ident(dt, scatter), dt)
+                        masked = jnp.where(inwin, sref[:], ident)
+                        if scatter == "add":
+                            folded = masked.sum()
+                        elif scatter == "min":
+                            folded = masked.min()
+                        else:
+                            folded = masked.max()
+                        oref[:] = jnp.where(rowsel, folded, oref[:])
+            # purge: S scalar reads build the expired-lane mask
+            keep = jnp.ones((1, LANE), jnp.bool_)
+            for s in range(S):
+                keep = keep & ~((lane == s) & (purge_ref[t, s] == 0))
+            count_ref[:] = jnp.where(keep, count_ref[:], 0)
+            for sref, (_n, dt, scatter, _i) in zip(states, vfields):
+                sref[:] = jnp.where(
+                    keep, sref[:], jnp.asarray(_ident(dt, scatter), dt))
+
+    row_spec = pl.BlockSpec((1, LANE), lambda t, c, *_: (0, 0))
+    chunk_spec = pl.BlockSpec((CH,), lambda t, c, *_: (t * C + c,))
+
+    in_specs = [row_spec] + [row_spec] * nf + [chunk_spec]
+    if nf:
+        in_specs += [chunk_spec]
+    out_specs = [row_spec] * (1 + nf) + [row_spec] * (1 + nf)
+    out_shape = [jax.ShapeDtypeStruct((1, LANE), jnp.int32)]
+    out_shape += [jax.ShapeDtypeStruct((1, LANE), dt)
+                  for _n, dt, _s, _i in vfields]
+    out_shape += [jax.ShapeDtypeStruct((1, LANE), jnp.int32)]
+    out_shape += [jax.ShapeDtypeStruct((1, LANE), dt)
+                  for _n, dt, _s, _i in vfields]
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=5,
+        grid=(T, C),
+        in_specs=in_specs,
+        out_specs=out_specs,
+    )
+    fn = pl.pallas_call(
+        kernel, grid_spec=grid_spec, out_shape=out_shape, interpret=interpret,
+    )
+
+    @jax.jit
+    def run(smin, fpos, fvalid, frow, purge, count_in, states, idx, vals):
+        args = [count_in, *states, idx]
+        if nf:
+            args.append(vals)
+        res = fn(smin, fpos, fvalid, frow, purge, *args)
+        count_state = res[0]
+        field_states = tuple(res[1:1 + nf])
+        count_out = res[1 + nf]
+        field_outs = tuple(res[2 + nf:])
+        return count_state, field_states, count_out, field_outs
+
+    return run

@@ -159,6 +159,40 @@ def make_purge_fn(agg: DeviceAggregator, num_positions: int):
 
 
 # ---------------------------------------------------------------------------
+# bounded segment fold (global-window superscan ingest)
+# ---------------------------------------------------------------------------
+
+def bounded_segment_fold(vals, seg, nseg: int, op: str, identity):
+    """Fold a value column into `nseg` per-segment partials WITHOUT any
+    scatter or one-hot matrix: one masked whole-column reduction per
+    segment, unrolled (nseg is tiny and static — the rel-slice count of a
+    batch, never the key count). seg < 0 lanes are dropped.
+
+    This is the keyed-partial half of the global-max superscan: each batch
+    folds to [nseg] partials, the ring state folds partials across batches,
+    and a window fire folds its slice range — a psum-style cross-segment
+    fold instead of the dense per-key reduction (the single-chip analogue
+    of the mesh's cross-shard pmax). Works under jit and inside pallas
+    kernel bodies (pure jnp ops)."""
+    import jax.numpy as jnp
+
+    vals = jnp.asarray(vals)
+    ident = jnp.asarray(identity, vals.dtype)
+    parts = []
+    for s in range(nseg):
+        lane = jnp.where(seg == s, vals, ident)
+        if op == "add":
+            parts.append(lane.sum())
+        elif op == "min":
+            parts.append(lane.min())
+        elif op == "max":
+            parts.append(lane.max())
+        else:
+            raise ValueError(op)
+    return jnp.stack(parts)
+
+
+# ---------------------------------------------------------------------------
 # top-k over fired results (Nexmark Q5-style hot items)
 # ---------------------------------------------------------------------------
 

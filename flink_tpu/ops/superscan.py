@@ -11,6 +11,8 @@ compose with it without importing the runtime (ARCH001).
 
 from __future__ import annotations
 
+import functools as _functools
+
 
 def default_ingest() -> str:
     """THE backend-dependent ingest choice, single-sourced: programs built
@@ -28,7 +30,8 @@ def default_ingest() -> str:
 
 
 def make_superscan_step(agg, K, S, NSB, F, R, SPW, chunk, exact,
-                        ingest: str = "matmul", phase_counters: bool = False):
+                        ingest: str = "matmul", phase_counters: bool = False,
+                        fire_spws=None):
     """The per-step ingest/fire/purge body, shared by the single-chip
     superscan and the shard_map sharded superscan (each shard runs this on
     its local key range).
@@ -46,13 +49,22 @@ def make_superscan_step(agg, K, S, NSB, F, R, SPW, chunk, exact,
     steps that purged] — so a dispatch's device time can be attributed to
     the ingest/fire/purge phases without any extra host sync (the counts
     ride the same async readback as the fire rows). The carry becomes a
-    5-tuple; callers opt in, so the default executable shape is unchanged."""
+    5-tuple; callers opt in, so the default executable shape is unchanged.
+
+    `fire_spws` (shared-partials, graph/window_sharing.py): per-fire-slot
+    window lengths in slices, length F, replacing the uniform SPW — one
+    ring of gcd-granule partials serves several correlated window shapes
+    (Factor Windows), each firing its own slice-run length from the shared
+    state. None keeps the classic single-shape program byte-identical."""
     import jax
     import jax.numpy as jnp
 
     from flink_tpu.ops import matmul_hist
-    from flink_tpu.ops.aggregators import VALUE
+    from flink_tpu.ops.aggregators import VALUE, combine_reduce
 
+    spws = tuple(fire_spws) if fire_spws is not None else (SPW,) * F
+    if len(spws) != F:
+        raise ValueError(f"fire_spws has {len(spws)} slots, expected F={F}")
     vfields = [
         (f.name, jnp.dtype(f.dtype), f.scatter, f.identity)
         for f in agg.fields
@@ -126,12 +138,8 @@ def make_superscan_step(agg, K, S, NSB, F, R, SPW, chunk, exact,
         # (at K=8192, SPW=10, F=2 that is 20x the ingest work of an 8k
         # batch) — identical results, the eager crow was discarded unless
         # fire_valid was set anyway
-        _COMBINE = {"add": lambda a: a.sum(axis=1),
-                    "min": lambda a: a.min(axis=1),
-                    "max": lambda a: a.max(axis=1)}
-
         def write_fire(f, bufs):
-            pos = (fire_pos[f] + jnp.arange(SPW, dtype=jnp.int32)) % S
+            pos = (fire_pos[f] + jnp.arange(spws[f], dtype=jnp.int32)) % S
             row = jnp.clip(fire_row[f], 0, R - 1)
 
             def do_fire(b):
@@ -141,7 +149,7 @@ def make_superscan_step(agg, K, S, NSB, F, R, SPW, chunk, exact,
                     count_out, crow, row, 0)
                 new_outs = {}
                 for name, _dt, scatter, _ident in vfields:
-                    vrow = _COMBINE[scatter](state[name][:, pos])
+                    vrow = combine_reduce(scatter)(state[name][:, pos], 1)
                     new_outs[name] = jax.lax.dynamic_update_index_in_dim(
                         outs[name], vrow, row, 0)
                 return (new_outs if vfields else outs), count_out
@@ -183,3 +191,385 @@ def make_superscan_step(agg, K, S, NSB, F, R, SPW, chunk, exact,
         return (state, count, outs, count_out), None
 
     return step
+
+
+def make_global_scan_step(agg, S, NSB, F, R, SPW, fire_spws=None,
+                          phase_counters: bool = False):
+    """The per-step body of the GLOBAL-window superscan: keyed-partial →
+    cross-segment fold, no [K, S] ring at all.
+
+    Nexmark-Q7-shaped aggregates (a per-window GLOBAL max/min/sum with
+    keyed pre-aggregation only as an implementation detail) do not need
+    per-key state: each batch folds to [NSB] per-rel-slice partials with
+    one masked whole-column reduction per slice (ops/segment_ops.
+    bounded_segment_fold — no scatter unit, no one-hot matrices), the
+    partials fold into a tiny [S] slice ring, and a window fire folds its
+    SPW slice cells into ONE scalar. This replaces the dense per-batch
+    keyed reduction (the [K, S] nibble-histogram path plus a [R, K]
+    readback and a host-side max over keys) with the single-chip analogue
+    of the mesh's psum/pmax cross-shard merge — and the readback shrinks
+    from R*K rows to R scalars.
+
+    Unbounded min/max get a device form here for free: the fold is
+    elementwise, so no bounded-domain (max8) declaration is needed.
+
+    idx lanes may carry either bare rel-slices or the keyed encoding
+    `kid * NSB + srel` (the staged streams the keyed superscan consumes);
+    both reduce to the same rel-slice via `idx % NSB`, negatives drop.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from flink_tpu.ops.aggregators import VALUE, combine_reduce, scan_identity
+    from flink_tpu.ops.segment_ops import bounded_segment_fold
+
+    spws = tuple(fire_spws) if fire_spws is not None else (SPW,) * F
+    if len(spws) != F:
+        raise ValueError(f"fire_spws has {len(spws)} slots, expected F={F}")
+    vfields = [
+        (f.name, jnp.dtype(f.dtype), f.scatter, f.identity)
+        for f in agg.fields
+        if f.source == VALUE
+    ]
+
+    def step(carry, args):
+        if phase_counters:
+            state, count, outs, count_out, phase_c = carry
+        else:
+            state, count, outs, count_out = carry
+        idx, vals, smin_pos, fire_pos, fire_valid, fire_row, purge_mask = args
+
+        # ingest: [NSB] partials per batch, folded into the [S] ring
+        srel = jnp.where(idx >= 0, idx % NSB, -1)
+        cols = (smin_pos + jnp.arange(NSB, dtype=jnp.int32)) % S
+        cpart = bounded_segment_fold(
+            jnp.ones(idx.shape, jnp.int32), srel, NSB, "add", 0)
+        count = count.at[cols].add(cpart)
+        new_state = {}
+        for name, dt, scatter, _ident in vfields:
+            part = bounded_segment_fold(
+                vals.astype(dt), srel, NSB, scatter,
+                scan_identity(dt, scatter))
+            upd = getattr(state[name].at[cols], scatter)
+            new_state[name] = upd(part)
+        state = new_state if vfields else state
+
+        # fire: fold the window's slice cells into one scalar per slot
+        def write_fire(f, bufs):
+            pos = (fire_pos[f] + jnp.arange(spws[f], dtype=jnp.int32)) % S
+            row = jnp.clip(fire_row[f], 0, R - 1)
+
+            def do_fire(b):
+                outs, count_out = b
+                count_out = count_out.at[row].set(count[pos].sum())
+                new_outs = {}
+                for name, _dt, scatter, _ident in vfields:
+                    folded = combine_reduce(scatter)(state[name][pos], 0)
+                    new_outs[name] = outs[name].at[row].set(folded)
+                return (new_outs if vfields else outs), count_out
+
+            return jax.lax.cond(fire_valid[f] > 0, do_fire, lambda b: b, bufs)
+
+        bufs = (outs, count_out)
+        for f in range(F):
+            bufs = write_fire(f, bufs)
+        outs, count_out = bufs
+
+        # purge expired cells back to identity
+        def do_purge(sc):
+            state, count = sc
+            count = count * purge_mask
+            if vfields:
+                state = {
+                    name: jnp.where(
+                        purge_mask > 0, state[name],
+                        jnp.asarray(scan_identity(dt, scatter), dt))
+                    for name, dt, scatter, _ident in vfields
+                }
+            return state, count
+
+        purged = jnp.any(purge_mask == 0)
+        state, count = jax.lax.cond(
+            purged, do_purge, lambda sc: sc, (state, count))
+        if phase_counters:
+            phase_c = phase_c + jnp.stack([
+                jnp.sum((idx >= 0).astype(jnp.int32)),
+                jnp.sum(fire_valid).astype(jnp.int32),
+                purged.astype(jnp.int32),
+            ])
+            return (state, count, outs, count_out, phase_c), None
+        return (state, count, outs, count_out), None
+
+    return step
+
+
+@_functools.lru_cache(maxsize=None)
+def build_global_superscan(agg, S, NSB, F, R, SPW, T, B,
+                           fire_spws=None, phases: bool = False):
+    """Compiled T-step global-window superscan (lax.scan over
+    make_global_scan_step; module-level cache like _build_superscan).
+
+    run(state {field: [S]}, count [S] i32, outs {field: [R]},
+        count_out [R] i32, idx [T, B] i32, vals [T, B] f32,
+        smin_pos, fire_pos, fire_valid, fire_row, purge_mask)
+      -> (state, count, outs, count_out[, phase_counters])"""
+    import jax
+    import jax.numpy as jnp
+
+    step = make_global_scan_step(agg, S, NSB, F, R, SPW,
+                                 fire_spws=fire_spws, phase_counters=phases)
+
+    @jax.jit
+    def run(state, count, outs, count_out, idx, vals, smin_pos, fire_pos,
+            fire_valid, fire_row, purge_mask):
+        carry0 = (state, count, outs, count_out)
+        if phases:
+            carry0 = carry0 + (jnp.zeros((3,), jnp.int32),)
+        carry, _ = jax.lax.scan(
+            step, carry0,
+            (idx, vals, smin_pos, fire_pos, fire_valid, fire_row,
+             purge_mask),
+        )
+        return carry
+
+    return run
+
+
+# ---------------------------------------------------------------------------
+# fused session superscan: T ingest steps + in-scan segmented gap-merges
+# in ONE device program (runtime/tpu_session_operator.py drives this)
+# ---------------------------------------------------------------------------
+
+def session_gap_merge_scan(c, fmn, fmx, fl, vfields, idents, g, wm_rel, est):
+    """The [K, n]-wide touching-fragment gap-merge scan — ONE copy of the
+    join/break/close semantics shared by the per-watermark merge program
+    (runtime/tpu_session_operator._build_merge_scan) and the fused
+    superspan's in-carry merges (make_session_superscan below). The
+    overflow-recovery contract ("placement never changes a result")
+    requires the two paths to be bit-identical; single-sourcing the scan
+    body makes a one-sided edit to the join condition (min - cmax <= g)
+    or the close condition (cmax + g - 1 <= wm_rel) impossible.
+
+    c/fmn/fmx [K, n] and fl ([K, n] per value field) are the gathered
+    span: per-cell fragment counts and min/max rel-ms (columns with c == 0
+    are gaps — callers zero invalid columns); `est` is the emission-slot
+    carry (slots, e_start, e_end, e_cnt, e_s0, e_s1, e_flds, overflow)
+    with [K, M] slot arrays — fresh for a standalone merge, carried across
+    merges for a superspan. Returns the updated est: sessions closed by
+    this scan (a following fragment breaks the gap, or end <= wm_rel)
+    appended at each key's next slot, e_s0/e_s1 holding the session's
+    column range in THIS scan's coordinates for the caller's purge."""
+    import jax.numpy as jnp
+
+    from flink_tpu.ops.aggregators import combine_binary
+
+    combine = {sc: combine_binary(sc) for _n, _dt, sc in vfields}
+    i32 = jnp.int32
+    K, n = c.shape
+    M = est[1].shape[1]
+    mslots = jnp.arange(M, dtype=i32)[None, :]
+
+    open_ = jnp.zeros((K,), bool)
+    cmin = jnp.zeros((K,), i32)
+    cmax = jnp.full((K,), -(1 << 30), i32)
+    ccnt = jnp.zeros((K,), i32)
+    cstart = jnp.zeros((K,), i32)
+    clast = jnp.zeros((K,), i32)
+    cflds = [jnp.full((K,), ident, f.dtype) for f, ident in zip(fl, idents)]
+
+    def do_emit(mask, est):
+        (slots, e_start, e_end, e_cnt, e_s0, e_s1, e_flds, overflow) = est
+        can = mask & (slots < M)
+        oh = (mslots == slots[:, None]) & can[:, None]        # [K, M]
+        e_start = jnp.where(oh, cmin[:, None], e_start)
+        e_end = jnp.where(oh, cmax[:, None], e_end)
+        e_cnt = jnp.where(oh, ccnt[:, None], e_cnt)
+        e_s0 = jnp.where(oh, cstart[:, None], e_s0)
+        e_s1 = jnp.where(oh, clast[:, None], e_s1)
+        e_flds = [jnp.where(oh, cf[:, None], ef)
+                  for cf, ef in zip(cflds, e_flds)]
+        overflow = overflow | jnp.any(mask & (slots >= M))
+        slots = slots + can.astype(i32)
+        return (slots, e_start, e_end, e_cnt, e_s0, e_s1, e_flds, overflow)
+
+    for i in range(n):
+        ci = c[:, i]
+        frag = ci > 0
+        mni = fmn[:, i]
+        mxi = fmx[:, i]
+        joins = open_ & frag & (mni - cmax <= g)
+        breaks = open_ & frag & ~joins
+        est = do_emit(breaks, est)
+        starts = frag & ~joins
+        cmin = jnp.where(starts, mni, cmin)
+        ccnt = jnp.where(starts, 0, ccnt)
+        cstart = jnp.where(starts, i, cstart)
+        cflds = [jnp.where(starts, jnp.asarray(ident, cf.dtype), cf)
+                 for cf, ident in zip(cflds, idents)]
+        open_ = open_ | frag
+        cmax = jnp.where(frag, mxi, cmax)
+        ccnt = jnp.where(frag, ccnt + ci, ccnt)
+        clast = jnp.where(frag, i, clast)
+        cflds = [
+            jnp.where(frag, combine[sc](cf, fi[:, i]), cf)
+            for cf, fi, (_n, _dt, sc) in zip(cflds, fl, vfields)
+        ]
+    return do_emit(open_ & (cmax + g - 1 <= wm_rel), est)
+
+
+def session_ingest_scatter(K, S, vfields):
+    """The per-batch session ingest scatter — ONE copy of the [K, S] ring
+    update (count/min-ts/max-ts/value fields, kid < 0 dropped via the
+    sentinel row) shared by the per-step program
+    (runtime/tpu_session_operator._build_ingest) and the fused superspan's
+    in-scan ingest (make_session_superscan below). The overflow-recovery
+    contract ("placement never changes a result") requires the two paths
+    to be bit-identical; single-sourcing the body makes a one-sided edit
+    to the scatter semantics impossible, like session_gap_merge_scan for
+    the merge side."""
+    import jax.numpy as jnp
+
+    def ingest(cnt, mn, mx, fields, kid, spos, rel, vals):
+        flat = jnp.where(kid >= 0, kid * S + spos, K * S)
+        cnt = cnt.reshape(-1).at[flat].add(1, mode="drop").reshape(K, S)
+        mn = mn.reshape(-1).at[flat].min(rel, mode="drop").reshape(K, S)
+        mx = mx.reshape(-1).at[flat].max(rel, mode="drop").reshape(K, S)
+        new_fields = []
+        for (name, dt, scatter), f in zip(vfields, fields):
+            upd = getattr(f.reshape(-1).at[flat], scatter)
+            new_fields.append(
+                upd(vals.astype(jnp.dtype(dt)), mode="drop").reshape(K, S))
+        return cnt, mn, mx, tuple(new_fields)
+
+    return ingest
+
+
+@_functools.lru_cache(maxsize=None)
+def make_session_superscan(K, S, M, g, vfields, idents, T, B):
+    """Compile the fused session dispatch: T staged ingest steps with the
+    gap-merge scan RUNNING INSIDE THE PROGRAM at watermark steps — sessions
+    coalesce in the scan carry (the touching-session merge semantics of
+    api/windowing/assigners.py EventTimeSessionWindows.merge_windows:
+    fragments at consecutive slices join iff min_ts(frag) - max_ts(cur)
+    <= gap) and never round-trip to host per merge. Closed sessions
+    accumulate into M fixed emission slots per key across the whole
+    dispatch; ONE packed int32 array comes back per dispatch, in the exact
+    layout of the per-watermark `_build_merge_scan` (so the operator's
+    `_resolve_entry` parses both).
+
+    vfields: ((name, dtype_str, scatter), ...); idents aligned identities.
+
+    run(cnt [K,S] i32, mn [K,S] i32, mx [K,S] i32, fields ([K,S] dt, ...),
+        kid [T,B] i32, spos [T,B] i32, rel [T,B] i32, vals [T,B] f32,
+        merge_flag [T] i32, lo_pos [T] i32, lo_rel [T] i32, wm_rel [T] i32)
+      -> (cnt, mn, mx, fields, packed [K+1, (3+nf)*M + 1] i32)
+
+    Coordinates: everything slice-relative to ONE dispatch base `lo0`
+    (lo_rel[t] = merge-span base slice − lo0; rel-ms fit int32 — the
+    caller guards (span + 2) * g < 2^31). The caller guarantees the whole
+    dispatch's resident span stays inside the ring (< S slices), so every
+    merge scans the full ring from lo_pos — empty columns are no-ops.
+    Emission overflow (a key closing more than M sessions in one
+    dispatch) sets the packed overflow flag; the caller discards the
+    fused result and replays the dispatch on the exact per-watermark
+    path from its retained pre-dispatch state."""
+    import jax
+    import jax.numpy as jnp
+
+    nf = len(vfields)
+    i32 = jnp.int32
+
+    ingest = session_ingest_scatter(K, S, vfields)
+
+    def merge(state, lo_pos, lo_rel, wm_rel):
+        (cnt, mn, mx, fields, est) = state
+        idx_p = jnp.arange(S, dtype=i32)
+        pos = (lo_pos + idx_p) % S              # full-ring span, bijective
+        abs_rel = lo_rel + idx_p                # absolute slice − lo0
+        c = cnt[:, pos]                                        # [K, S]
+        fmn = mn[:, pos] + abs_rel[None, :] * g
+        fmx = mx[:, pos] + abs_rel[None, :] * g
+        fl = [f[:, pos] for f in fields]
+        mslots = jnp.arange(M, dtype=i32)[None, :]
+        slots_in = est[0]
+
+        est = session_gap_merge_scan(c, fmn, fmx, fl, vfields, idents, g,
+                                     wm_rel, est)
+        (slots, e_start, e_end, e_cnt, e_s0, e_s1, e_flds, overflow) = est
+
+        # purge exactly the cells of sessions emitted by THIS merge (the
+        # slot-range mask excludes entries from earlier merge steps of the
+        # same dispatch, whose span coordinates were a different base)
+        this = (mslots >= slots_in[:, None]) & (mslots < slots[:, None])
+        cover = (idx_p[None, None, :] >= e_s0[:, :, None]) & \
+                (idx_p[None, None, :] <= e_s1[:, :, None]) & \
+                this[:, :, None]
+        purge = jnp.any(cover, axis=1)                         # [K, S]
+        c_new = jnp.where(purge, 0, c)
+        # full-ring span: pos is a permutation, so column set-back is exact
+        cnt = cnt.at[:, pos].set(c_new)
+        mn = mn.at[:, pos].set(jnp.where(purge, g, mn[:, pos]))
+        mx = mx.at[:, pos].set(jnp.where(purge, -1, mx[:, pos]))
+        fields = tuple(
+            f.at[:, pos].set(
+                jnp.where(purge, jnp.asarray(ident, f.dtype), f[:, pos]))
+            for f, ident in zip(fields, idents)
+        )
+        return (cnt, mn, mx, fields,
+                (slots, e_start, e_end, e_cnt, e_s0, e_s1, e_flds, overflow))
+
+    def step(carry, args):
+        kid, spos, rel, vals, merge_flag, lo_pos, lo_rel, wm_rel = args
+        (cnt, mn, mx, fields, est) = carry
+        cnt, mn, mx, fields = ingest(cnt, mn, mx, fields, kid, spos, rel,
+                                     vals)
+        cnt, mn, mx, fields, est = jax.lax.cond(
+            merge_flag > 0,
+            lambda s: merge(s, lo_pos, lo_rel, wm_rel),
+            lambda s: s,
+            (cnt, mn, mx, fields, est))
+        return (cnt, mn, mx, fields, est), None
+
+    def run(cnt, mn, mx, fields, kid, spos, rel, vals,
+            merge_flag, lo_pos, lo_rel, wm_rel):
+        slots = jnp.zeros((K,), i32)
+        e_start = jnp.zeros((K, M), i32)
+        e_end = jnp.zeros((K, M), i32)
+        e_cnt = jnp.zeros((K, M), i32)
+        e_s0 = jnp.zeros((K, M), i32)
+        e_s1 = jnp.full((K, M), -1, i32)
+        e_flds = [jnp.full((K, M), ident, jnp.dtype(dt))
+                  for (_n, dt, _s), ident in zip(vfields, idents)]
+        overflow = jnp.zeros((), bool)
+        est0 = (slots, e_start, e_end, e_cnt, e_s0, e_s1, e_flds, overflow)
+        carry0 = (cnt, mn, mx, tuple(fields), est0)
+        (cnt, mn, mx, fields, est), _ = jax.lax.scan(
+            step, carry0,
+            (kid, spos, rel, vals, merge_flag, lo_pos, lo_rel, wm_rel))
+        (slots, e_start, e_end, e_cnt, _s0, _s1, e_flds, overflow) = est
+
+        # live span of the surviving fragments, in dispatch-base slice
+        # coordinates: the ring is bijective from position p -> slice
+        # base_lo_rel + ((p - base_lo_pos) % S); the host passes the
+        # dispatch-final base via the LAST step's lo_pos/lo_rel
+        idx_p = jnp.arange(S, dtype=i32)
+        pos = (lo_pos[-1] + idx_p) % S
+        abs_rel = lo_rel[-1] + idx_p
+        live = jnp.any(cnt[:, pos] > 0, axis=0)
+        lo_live = jnp.min(jnp.where(live, abs_rel, 1 << 30))
+        hi_live = jnp.max(jnp.where(live, abs_rel, -1))
+
+        blocks = [e_start, e_end, e_cnt]
+        for ef in e_flds:
+            blocks.append(jax.lax.bitcast_convert_type(
+                ef, i32) if ef.dtype != i32 else ef)
+        packed = jnp.concatenate(blocks + [slots[:, None]], axis=1)
+        scal = jnp.zeros((1, packed.shape[1]), i32)
+        scal = scal.at[0, 0].set(
+            jnp.where(hi_live >= 0, lo_live, 0).astype(i32))
+        scal = scal.at[0, 1].set(hi_live.astype(i32))
+        scal = scal.at[0, 2].set(overflow.astype(i32))
+        packed = jnp.concatenate([packed, scal], axis=0)
+        return cnt, mn, mx, fields, packed
+
+    return jax.jit(run)

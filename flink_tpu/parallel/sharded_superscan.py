@@ -84,10 +84,14 @@ class ShardedFusedPipeline:
         exact_sums: bool = True,
         axis: str = "shards",
         prologue=None,
+        assigners=None,
     ):
         # runtime import is function-scoped: parallel/ sits below runtime in
         # the layer DAG (ARCH001), and the planner is pure host state
-        from flink_tpu.runtime.fused_window_pipeline import FusedWindowPipeline
+        from flink_tpu.runtime.fused_window_pipeline import (
+            FusedWindowPipeline,
+            SharedWindowPipeline,
+        )
 
         self.mesh = mesh
         self.axis = axis
@@ -98,21 +102,33 @@ class ShardedFusedPipeline:
             )
         # the planner (and the canonical geometry/cursor state) is a
         # plan-only single-chip pipeline over the GLOBAL key space; its
-        # device arrays are never dispatched
-        self._planner = FusedWindowPipeline(
-            assigner, aggregate,
-            key_capacity=key_capacity, num_slices=num_slices, nsb=nsb,
-            fires_per_step=fires_per_step, out_rows=out_rows, chunk=chunk,
-            exact_sums=exact_sums, backend="xla", plan_only=True,
-            prologue=prologue,
-        )
+        # device arrays are never dispatched. With `assigners` (shared
+        # partials) it is the multi-spec planner: the per-shard program
+        # below picks up its per-slot fire_spws, so correlated windows
+        # share one scan ON THE MESH exactly like single-chip.
+        if assigners is not None:
+            self._planner = SharedWindowPipeline(
+                assigners, aggregate,
+                key_capacity=key_capacity, num_slices=num_slices, nsb=nsb,
+                fires_per_step=fires_per_step, out_rows=out_rows, chunk=chunk,
+                exact_sums=exact_sums, backend="xla", plan_only=True,
+                prologue=prologue,
+            )
+        else:
+            self._planner = FusedWindowPipeline(
+                assigner, aggregate,
+                key_capacity=key_capacity, num_slices=num_slices, nsb=nsb,
+                fires_per_step=fires_per_step, out_rows=out_rows, chunk=chunk,
+                exact_sums=exact_sums, backend="xla", plan_only=True,
+                prologue=prologue,
+            )
         self.agg = self._planner.agg
         self.prologue = prologue
         self.K = key_capacity
         self.K_local = key_capacity // self.n
         self.S = self._planner.S
         self.NSB = nsb
-        self.F = fires_per_step
+        self.F = self._planner.F   # total fire slots (N*F when shared)
         self.R = out_rows
         self.chunk = chunk
         self.exact = exact_sums
@@ -256,6 +272,7 @@ class ShardedFusedPipeline:
         step = make_superscan_step(
             self.agg, Kl, S, NSB, self.F, R, self._planner.spw, chunk,
             self.exact, ingest=default_ingest(), phase_counters=phases,
+            fire_spws=self._planner._fire_spws,
         )
         nf = len(self._value_fields)
 
@@ -443,6 +460,7 @@ class ShardedFusedPipeline:
         step = make_superscan_step(
             self.agg, Kl, S, NSB, self.F, R, self._planner.spw, chunk,
             self.exact, ingest=default_ingest(), phase_counters=phases,
+            fire_spws=self._planner._fire_spws,
         )
         nf = len(self._value_fields)
         pro = self.prologue
@@ -781,6 +799,13 @@ class ShardedFusedPipeline:
             "max_seen_slice": self._planner.max_seen_slice,
             "num_late_dropped": self._planner.num_late_records_dropped,
         }
+        # shared-partials planner: per-spec fire cursors are part of the
+        # canonical form (SharedWindowPipeline.snapshot writes them too —
+        # a mesh checkpoint must restore into a single-chip shared
+        # operator and vice versa)
+        cursors = getattr(self._planner, "fire_cursors", None)
+        if cursors is not None:
+            snap["fire_cursors"] = list(cursors)
         return snap
 
     def restore(self, snap: dict) -> None:
@@ -828,3 +853,5 @@ class ShardedFusedPipeline:
         self._planner.min_used_slice = snap["min_used_slice"]
         self._planner.max_seen_slice = snap["max_seen_slice"]
         self._planner.num_late_records_dropped = snap["num_late_dropped"]
+        if getattr(self._planner, "fire_cursors", None) is not None:
+            self._planner.fire_cursors = list(snap["fire_cursors"])

@@ -792,11 +792,14 @@ class WindowStepRunner(StepRunner):
         if safe is not None:
             watermark = min(watermark, safe)
         if watermark > MIN_WATERMARK:
-            if self.downstream:
-                self.downstream.on_watermark(watermark)
-            if self.sides:
-                for f in self.sides.values():
-                    f.on_watermark(watermark)
+            self._forward_watermark(watermark)
+
+    def _forward_watermark(self, watermark: int) -> None:
+        if self.downstream:
+            self.downstream.on_watermark(watermark)
+        if self.sides:
+            for f in self.sides.values():
+                f.on_watermark(watermark)
 
     def on_end(self) -> None:
         self._drain()
@@ -911,14 +914,20 @@ class DeviceChainRunner(WindowStepRunner):
     only construction and ingest differ."""
 
     def __init__(self, step: Step, plan, config: Configuration):
+        self._init_fused(plan.terminal, plan.transforms, config)
+
+    def _init_fused(self, t, transforms, config: Configuration,
+                    assigners=None) -> None:
+        """Shared construction of the fused device surface (also used by
+        SharedWindowRunner, which passes the group's `assigners` — any new
+        option threaded to FusedWindowOperator lands on both paths)."""
         from flink_tpu.runtime.fused_window_operator import FusedWindowOperator
         from flink_tpu.runtime.fused_window_pipeline import TracedPrologue
 
-        t = plan.terminal
         cfg = t.config
         prologue = TracedPrologue(
             transforms=tuple(
-                (tr.kind, tr.config["fn"]) for tr in plan.transforms),
+                (tr.kind, tr.config["fn"]) for tr in transforms),
             key_fn=cfg["key_selector"],
             value_fn=cfg.get("value_fn"),
         )
@@ -928,7 +937,7 @@ class DeviceChainRunner(WindowStepRunner):
         # resolve (never silently aliases another key's row)
         capacity = config.get(ExecutionOptions.KEY_CAPACITY)
         self.op = FusedWindowOperator(
-            cfg["assigner"],
+            None if assigners is not None else cfg["assigner"],
             cfg["aggregate"],
             key_capacity=capacity,
             superbatch_steps=config.get(ExecutionOptions.SUPERBATCH_STEPS),
@@ -940,6 +949,7 @@ class DeviceChainRunner(WindowStepRunner):
             # runs on each device's slice and one in-scan all-to-all per
             # step is the keyBy exchange
             mesh=_mesh_for_config(config, capacity),
+            **({} if assigners is None else {"assigners": list(assigners)}),
         )
         self.device = True
         self.window_fn = None
@@ -985,6 +995,97 @@ class DeviceChainRunner(WindowStepRunner):
         else:
             self.op.process_raw_batch(vals, timestamps)
         self._device_stats_tick()
+
+
+class SharedWindowSiblingRunner(StepRunner):
+    """Placeholder runner for a non-leader member of a shared-partial
+    window group (graph/window_sharing.py): it owns the member's
+    downstream edges, and the group leader pushes this member's resolved
+    emissions, watermarks, and end-of-input into them. Its own input
+    edges are never wired (the leader consumes the stream once — wiring
+    them would double-ingest), so every on_* here is unreachable."""
+
+    def __init__(self, step: Step, spec: int):
+        self.uid = step.terminal.uid
+        self.spec = spec
+        self.sql_origin = bool(step.terminal.config.get("sql_origin"))
+
+    def on_batch(self, values: np.ndarray, timestamps: np.ndarray) -> None:
+        raise AssertionError(
+            "shared-window sibling received a direct batch; its input "
+            "edges must not be wired")
+
+
+class SharedWindowRunner(DeviceChainRunner):
+    """Shared-partials runner (graph/window_sharing.py): ONE traced device
+    program serves N correlated window() siblings — gcd-granule partials
+    ingest once, every member window fires its own slice run from the
+    shared ring (Factor Windows), and each member's emissions route to
+    its own downstream edges through its sibling runner. Construction
+    mirrors DeviceChainRunner (the sharing bar equals the fusion bar);
+    only emission routing and watermark/end fan-out differ."""
+
+    def __init__(self, step: Step, shared_plan, config: Configuration):
+        self.shared_plan = shared_plan
+        self._init_fused(shared_plan.terminals[0], shared_plan.transforms,
+                         config, assigners=shared_plan.assigners)
+        # spec index -> the runner owning that member's downstream edges
+        # (spec 0 = this leader); siblings register in build_runners
+        self.member_runners: List[StepRunner] = [self]
+
+    def _spec_fanouts(self):
+        for spec, r in enumerate(self.member_runners):
+            yield spec, r.downstream, (r.sides or None)
+
+    def _drain(self) -> None:
+        if self.device_timer is not None and self._drain_resolves_device:
+            with self.device_timer.section():
+                drained = [self.op.drain_spec_output(s)
+                           for s in range(len(self.member_runners))]
+        else:
+            drained = [self.op.drain_spec_output(s)
+                       for s in range(len(self.member_runners))]
+        for spec, fan, _sides in self._spec_fanouts():
+            out = drained[spec]
+            if out and fan:
+                # same record shape as the base _drain: columnar-output
+                # entries (k is None) forward the bare device triple —
+                # sharing must never change what downstream receives
+                vals = obj_array([r if k is None else (k, r)
+                                  for (k, _w, r, _t) in out])
+                ts = np.asarray([t for (_k, _w, _r, t) in out],
+                                dtype=np.int64)
+                fan.on_batch(vals, ts)
+
+    def _forward_watermark(self, watermark: int) -> None:
+        for _spec, fan, sides in self._spec_fanouts():
+            if fan:
+                fan.on_watermark(watermark)
+            if sides:
+                for f in sides.values():
+                    f.on_watermark(watermark)
+
+    def on_marker(self, wall_ms: float) -> None:
+        # markers fan out to EVERY member's downstream, like watermarks —
+        # sharing must not blind the sibling sinks' latency histograms
+        h = getattr(self, "_marker_hist", None)
+        if h is not None:
+            h.update(time.time() * 1000.0 - wall_ms)
+        for _spec, fan, sides in self._spec_fanouts():
+            if fan:
+                fan.on_marker(wall_ms)
+            if sides:
+                for f in sides.values():
+                    f.on_marker(wall_ms)
+
+    def on_end(self) -> None:
+        self._drain()
+        for _spec, fan, sides in self._spec_fanouts():
+            if fan:
+                fan.on_end()
+            if sides:
+                for f in sides.values():
+                    f.on_end()
 
 
 class KeyedReduceRunner(StepRunner):
@@ -1576,12 +1677,36 @@ def build_runners(graph: StepGraph, config: Configuration):
             config.get(ExecutionOptions.FUSED_WINDOWS):
         plans, absorbed = plan_device_chains(graph)
 
+    # sharing optimizer (graph/window_sharing.py): correlated window
+    # siblings collapse into ONE shared-partial runner; non-leader members
+    # get placeholder runners whose downstream edges the leader feeds, and
+    # their input edges are NOT wired (the leader consumes the stream once)
+    shared_of: Dict[int, tuple] = {}    # id(step) -> (plan, spec)
+    edge_silent: set = set()            # member steps with unwired inputs
+    if plans and config.get(ExecutionOptions.SHARED_PARTIALS):
+        from flink_tpu.graph.window_sharing import plan_shared_windows
+
+        for sw in plan_shared_windows(graph, plans):
+            for spec, member in enumerate(sw.members):
+                shared_of[id(member)] = (sw, spec)
+                plans.pop(id(member), None)
+                if spec > 0:
+                    edge_silent.add(id(member))
+            if sw.absorbed is not None:
+                absorbed.add(id(sw.absorbed))
+
     runner_of: Dict[int, StepRunner] = {}
     runners: List[StepRunner] = []
     for step in graph.steps:
         if id(step) in absorbed:
             continue
-        if id(step) in plans:
+        if id(step) in shared_of:
+            sw, spec = shared_of[id(step)]
+            if spec == 0:
+                r = SharedWindowRunner(step, sw, config)
+            else:
+                r = SharedWindowSiblingRunner(step, spec)
+        elif id(step) in plans:
             r = DeviceChainRunner(step, plans[id(step)], config)
         else:
             r = _make_runner(step, config)
@@ -1589,14 +1714,25 @@ def build_runners(graph: StepGraph, config: Configuration):
             r.num_inputs = len(step.inputs)
         runner_of[id(step)] = r
         runners.append(r)
+    # leaders learn their members' runners (spec order) for emission fanout
+    for step in graph.steps:
+        ent = shared_of.get(id(step))
+        if ent is not None and ent[1] == 0:
+            sw, _spec = ent
+            leader = runner_of[id(step)]
+            leader.member_runners = [runner_of[id(m)] for m in sw.members]
 
     feeds: Dict[int, List] = {}
     for step in graph.steps:
-        if id(step) in absorbed:
+        if id(step) in absorbed or id(step) in edge_silent:
             continue
         r = runner_of[id(step)]
-        step_inputs = (plans[id(step)].inputs if id(step) in plans
-                       else step.inputs)
+        if id(step) in shared_of:
+            step_inputs = shared_of[id(step)][0].inputs
+        elif id(step) in plans:
+            step_inputs = plans[id(step)].inputs
+        else:
+            step_inputs = step.inputs
         for edge in step_inputs:
             entity, ordinal = edge[0], edge[1]
             tag = edge[2] if len(edge) > 2 else None

@@ -124,27 +124,16 @@ class StepNormalizer:
         out: List[_Step] = []
         if wm <= self.wm:
             return out
-        p = self.p
         while True:
             target = wm
             held_floor = self._held_min_slice()
             if held_floor is not None:
                 # largest watermark at which slice `held_floor` is still
-                # live: _min_live_slice(w) <= held_floor  <=>
-                # w <= fire_wm(held_floor // sl) - 1
-                cap_wm = self._fire_wm(held_floor // p.sl) - 1
+                # live (single-sourced with the pipeline; the shared-
+                # partial pipeline widens it to its longest member window)
+                cap_wm = self.p._wm_keeping_slice_live(held_floor)
                 target = min(wm, max(cap_wm, self.wm))
-            n_fires = 0
-            j_hi = self._j_fired_upto(target)
-            step_wm = target
-            if self.fire_cursor is not None and self.max_seen is not None:
-                cap = min(j_hi, self.p._j_newest(self.max_seen))
-                n_fires = max(0, cap - self.fire_cursor + 1)
-                if n_fires > p.F:
-                    # stage the advance: fire exactly F windows this step
-                    cap = self.fire_cursor + p.F - 1
-                    step_wm = min(target, self._fire_wm(cap))
-                    n_fires = p.F
+            step_wm, n_fires = self._stage_fire_step(target)
             out.append(_Step(
                 np.empty(0, np.int32), None, np.empty(0, np.int64), step_wm, n_fires
             ))
@@ -169,24 +158,32 @@ class StepNormalizer:
     def _advance_uncapped(self, wm: int) -> List[_Step]:
         """Fallback staged advance without the held-record cap."""
         out: List[_Step] = []
-        p = self.p
         while self.wm < wm:
-            n_fires = 0
-            j_hi = self._j_fired_upto(wm)
-            step_wm = wm
-            if self.fire_cursor is not None and self.max_seen is not None:
-                cap = min(j_hi, p._j_newest(self.max_seen))
-                n_fires = max(0, cap - self.fire_cursor + 1)
-                if n_fires > p.F:
-                    cap = self.fire_cursor + p.F - 1
-                    step_wm = min(wm, self._fire_wm(cap))
-                    n_fires = p.F
+            step_wm, n_fires = self._stage_fire_step(wm)
             out.append(_Step(
                 np.empty(0, np.int32), None, np.empty(0, np.int64), step_wm, n_fires
             ))
             self._commit_wm(step_wm, n_fires)
             self._drain_future(out)
         return out
+
+    def _stage_fire_step(self, target: int):
+        """(step_wm, n_fires) of the next staged advance toward `target`:
+        the largest watermark whose fire load fits one step's fire slots.
+        The shared-partial normalizer overrides this with the per-spec
+        form (each member window's slot budget binds independently)."""
+        p = self.p
+        n_fires = 0
+        step_wm = target
+        if self.fire_cursor is not None and self.max_seen is not None:
+            cap = min(self._j_fired_upto(target), p._j_newest(self.max_seen))
+            n_fires = max(0, cap - self.fire_cursor + 1)
+            if n_fires > p.F:
+                # stage the advance: fire exactly F windows this step
+                cap = self.fire_cursor + p.F - 1
+                step_wm = min(target, self._fire_wm(cap))
+                n_fires = p.F
+        return step_wm, n_fires
 
     def _held_min_slice(self) -> Optional[int]:
         if not self._future:
@@ -293,12 +290,7 @@ class StepNormalizer:
                     np.asarray(ts)[sel].astype(np.int64),
                     self.wm, 0,
                 ))
-        self.max_seen = smax if self.max_seen is None else max(self.max_seen, smax)
-        self.min_used = smin if self.min_used is None else min(self.min_used, smin)
-        cand = self.p._j_oldest(smin)
-        if self.wm > MIN_WATERMARK:
-            cand = max(cand, self._j_fired_upto(self.wm) + 1)
-        self.fire_cursor = cand if self.fire_cursor is None else min(self.fire_cursor, cand)
+        self._note_data(smin, smax)
 
     def _drain_future(self, out: List[_Step]) -> None:
         if not self._future:
@@ -313,6 +305,11 @@ class StepNormalizer:
         rows written into the ring outside a pushed step must count as
         resident data for the normalizer's fire capping and ring-floor
         math too, or the two frontier mirrors diverge."""
+        self._note_data(smin, smax)
+
+    def _note_data(self, smin: int, smax: int) -> None:
+        """Frontier + fire-cursor updates for newly-resident slices (the
+        shared-partial normalizer substitutes per-spec cursors)."""
         self.max_seen = smax if self.max_seen is None else max(self.max_seen, smax)
         self.min_used = smin if self.min_used is None else min(self.min_used, smin)
         cand = self.p._j_oldest(smin)
@@ -356,14 +353,93 @@ class StepNormalizer:
         self.num_future_held = sum(len(t) for _, _, t in self._future)
 
 
+class SharedStepNormalizer(StepNormalizer):
+    """StepNormalizer over a SharedWindowPipeline (shared partials): one
+    shared ingest/ring frontier, per-window-spec fire cursors, each member
+    window's fire-slot budget binding the staged advance independently."""
+
+    def __init__(self, pipe, raw_payload: bool = False):
+        super().__init__(pipe, raw_payload)
+        self.fire_cursors: List[Optional[int]] = [None] * len(pipe.specs)
+
+    def _note_data(self, smin: int, smax: int) -> None:
+        p = self.p
+        self.max_seen = smax if self.max_seen is None else max(self.max_seen, smax)
+        self.min_used = smin if self.min_used is None else min(self.min_used, smin)
+        for i in range(len(p.specs)):
+            cand = p._spec_j_oldest(i, smin)
+            if self.wm > MIN_WATERMARK:
+                cand = max(cand, p._spec_j_fired_upto(i, self.wm) + 1)
+            cur = self.fire_cursors[i]
+            self.fire_cursors[i] = cand if cur is None else min(cur, cand)
+
+    def _stage_fire_step(self, target: int):
+        p = self.p
+        if self.max_seen is None:
+            return target, 0
+        step_wm = target
+        Fp = p.F_per_spec
+        for i, spec in enumerate(p.specs):
+            cur = self.fire_cursors[i]
+            if cur is None:
+                continue
+            cap = min(p._spec_j_fired_upto(i, target),
+                      self.max_seen // spec.sl)
+            if cap - cur + 1 > Fp:
+                step_wm = min(step_wm, p._spec_fire_wm(i, cur + Fp - 1))
+        # fire counts settle AFTER the binding spec lowered step_wm
+        # (n_i(wm) is monotone in wm, so every spec fits its budget there)
+        total = 0
+        for i, spec in enumerate(p.specs):
+            cur = self.fire_cursors[i]
+            if cur is None:
+                continue
+            cap = min(p._spec_j_fired_upto(i, step_wm),
+                      self.max_seen // spec.sl)
+            total += max(0, cap - cur + 1)
+        return step_wm, total
+
+    def _commit_wm(self, wm: int, n_fires: int) -> None:
+        if wm <= self.wm:
+            return
+        p = self.p
+        for i in range(len(p.specs)):
+            j_hi = p._spec_j_fired_upto(i, wm)
+            cur = self.fire_cursors[i]
+            if cur is not None and j_hi >= cur:
+                self.fire_cursors[i] = j_hi + 1
+        new_min_live = p._min_live_slice(wm)   # min over specs: the
+        # longest member window holds every slice it still needs
+        self.purged_to = (
+            new_min_live if self.purged_to is None
+            else max(self.purged_to, new_min_live)
+        )
+        self.wm = wm
+
+    def snapshot(self) -> dict:
+        snap = super().snapshot()
+        snap["fire_cursors"] = list(self.fire_cursors)
+        return snap
+
+    def restore(self, snap: dict) -> None:
+        super().restore(snap)
+        self.fire_cursors = list(snap["fire_cursors"])
+
+
 class FusedWindowOperator:
     """Operator-boundary adapter: same surface as TpuWindowOperator, fused
     superbatch execution underneath. One outstanding dispatch is kept in
-    flight (resolve of dispatch i overlaps device execution of i+1)."""
+    flight (resolve of dispatch i overlaps device execution of i+1).
+
+    With `assigners` (shared partials, graph/window_sharing.py) the
+    operator runs N correlated window shapes over ONE shared-granule ring
+    and routes each member's emissions into its own output lane
+    (`drain_spec_output`); requires the traced-chain prologue (dense
+    device keying), and the state tier does not apply."""
 
     def __init__(
         self,
-        assigner: WindowAssigner,
+        assigner: Optional[WindowAssigner],
         aggregate,
         *,
         key_capacity: int = 1 << 12,
@@ -378,6 +454,7 @@ class FusedWindowOperator:
         prologue=None,
         mesh=None,
         tier=None,
+        assigners=None,
     ):
         self.agg = resolve(aggregate)
         if self.agg is None:
@@ -404,6 +481,16 @@ class FusedWindowOperator:
         self.prologue = prologue
         self.mesh = mesh
         self._construction_key_capacity = key_capacity
+        self.spec_outputs = None
+        if assigners is not None:
+            if prologue is None:
+                raise ValueError(
+                    "shared-partial windows run the traced-chain path "
+                    "(dense device keying); a prologue is required")
+            if tier is not None:
+                raise ValueError(
+                    "state.tier does not apply to the shared-partial path")
+            self.spec_outputs = [[] for _ in assigners]
         if mesh is not None:
             # multichip SPMD (parallel.mesh.*): same operator surface, the
             # dispatch runs sharded over the mesh with the keyBy shuffle as
@@ -417,7 +504,18 @@ class FusedWindowOperator:
                 mesh, assigner, self.agg,
                 key_capacity=key_capacity, num_slices=num_slices, nsb=nsb,
                 fires_per_step=fires_per_step, out_rows=out_rows,
-                chunk=chunk, prologue=prologue,
+                chunk=chunk, prologue=prologue, assigners=assigners,
+            )
+        elif assigners is not None:
+            from flink_tpu.runtime.fused_window_pipeline import (
+                SharedWindowPipeline,
+            )
+
+            self.pipe = SharedWindowPipeline(
+                assigners, self.agg,
+                key_capacity=key_capacity, num_slices=num_slices, nsb=nsb,
+                fires_per_step=fires_per_step, out_rows=out_rows, chunk=chunk,
+                prologue=prologue,
             )
         else:
             self.pipe = FusedWindowPipeline(
@@ -436,7 +534,11 @@ class FusedWindowOperator:
             self.tier.attach_device(self.pipe.gather_key_rows,
                                     self.pipe.clear_key_rows,
                                     self.pipe.write_cells)
-        self.norm = StepNormalizer(self.pipe, raw_payload=prologue is not None)
+        self.norm = (
+            SharedStepNormalizer(self.pipe, raw_payload=True)
+            if assigners is not None
+            else StepNormalizer(self.pipe, raw_payload=prologue is not None)
+        )
         self._steps: List[_Step] = []
         self._inflight: Optional[tuple] = None  # (DeferredEmissions, wm)
         self.output: List[Tuple[Any, Any, Any, int]] = []
@@ -636,34 +738,48 @@ class FusedWindowOperator:
             self.tier.purge_below(purged_to)
 
     def _emit(self, window, counts, fields) -> None:
+        if self.spec_outputs is not None:
+            # shared partials: the pipeline tags each fire with its member
+            # window spec; route the emission to that member's output lane
+            spec, win = window
+            self._emit_dense_rows(win, counts, fields,
+                                  self.spec_outputs[spec])
+            return
         if self.tier is not None:
             self._emit_tiered(window, counts, fields)
             return
         if self.prologue is not None:
-            # dense device keying: the emitted key IS the id the traced
-            # selector produced — every capacity row may be live
-            counts = np.asarray(counts)
-            live = np.flatnonzero(counts > 0)
-            if live.size == 0:
-                return
-            fdict: Dict[str, Any] = {
-                f.name: (counts if f.source == ONE
-                         else np.asarray(fields[f.name]))
-                for f in self.agg.fields
-            }
-            result = np.asarray(self.agg.extract(fdict))
-            ts = window.max_timestamp()
-            if self.columnar_output:
-                self.output.append(
-                    (None, window, (window, live, result[live]), ts))
-                return
-            for i in live:
-                self.output.append((int(i), window, result[i].item(), ts))
+            self._emit_dense_rows(window, counts, fields, self.output)
             return
         counts = np.asarray(counts)[: len(self.keydict)]
         live = np.flatnonzero(counts > 0)
         if live.size == 0:
             return
+        self._emit_keydict_rows(window, counts, fields, live)
+
+    def _emit_dense_rows(self, window, counts, fields, sink: list) -> None:
+        """Dense-device-keying emission (traced prologue): the emitted key
+        IS the id the traced selector produced — every capacity row may be
+        live. `sink` selects the output lane (shared partials route per
+        member window spec)."""
+        counts = np.asarray(counts)
+        live = np.flatnonzero(counts > 0)
+        if live.size == 0:
+            return
+        fdict: Dict[str, Any] = {
+            f.name: (counts if f.source == ONE
+                     else np.asarray(fields[f.name]))
+            for f in self.agg.fields
+        }
+        result = np.asarray(self.agg.extract(fdict))
+        ts = window.max_timestamp()
+        if self.columnar_output:
+            sink.append((None, window, (window, live, result[live]), ts))
+            return
+        for i in live:
+            sink.append((int(i), window, result[i].item(), ts))
+
+    def _emit_keydict_rows(self, window, counts, fields, live) -> None:
         fdict: Dict[str, Any] = {}
         for f in self.agg.fields:
             if f.source == ONE:
@@ -739,6 +855,13 @@ class FusedWindowOperator:
     def drain_output(self) -> List[Tuple[Any, Any, Any, int]]:
         out = self.output
         self.output = []
+        return out
+
+    def drain_spec_output(self, spec: int) -> List[Tuple[Any, Any, Any, int]]:
+        """Shared partials: drain one member window's output lane (the
+        shared runner routes lane i to member i's downstream edges)."""
+        out = self.spec_outputs[spec]
+        self.spec_outputs[spec] = []
         return out
 
     def query_state_for(self, key) -> Dict[int, Dict[str, Any]]:
@@ -958,7 +1081,13 @@ class FusedWindowOperator:
             return {"pipe": self.pipe.snapshot(),
                     "tier": self.tier.full_snapshot(),
                     "meta": meta, **self._envelope()}
+        snap_extra = {}
+        if self.spec_outputs is not None:
+            # shared partials: undrained per-member lanes ride the
+            # checkpoint like the plain output list
+            snap_extra["spec_outputs"] = [list(x) for x in self.spec_outputs]
         return {
+            **snap_extra,
             "pipe": self.pipe.snapshot(),
             "keydict": self.keydict.snapshot(),
             "normalizer": self.norm.snapshot(),
@@ -1015,3 +1144,5 @@ class FusedWindowOperator:
         self.current_watermark = snap["current_watermark"]
         self._inflight = None
         self.output = list(snap["output"])
+        if self.spec_outputs is not None:
+            self.spec_outputs = [list(x) for x in snap["spec_outputs"]]

@@ -51,6 +51,7 @@ class _PlannedFire:
     row: int          # output-buffer row
     j: int            # window index
     step: int         # step within the dispatch
+    spec: int = 0     # window spec (shared-partial pipelines; 0 otherwise)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -127,7 +128,7 @@ class DeferredEmissions:
         outs_np = {k: np.asarray(v) for k, v in self._outs.items()}
         return [
             (
-                self._pipe._window_of(pf.j),
+                self._pipe._window_of_fire(pf),
                 count_np[pf.row],
                 {k: v[pf.row] for k, v in outs_np.items()},
             )
@@ -179,6 +180,10 @@ class _PlanCursor:
             )
         self.min_used = smin if self.min_used is None else min(self.min_used, smin)
         self.max_seen = smax if self.max_seen is None else max(self.max_seen, smax)
+        self._note_fire_candidate(smin)
+
+    def _note_fire_candidate(self, smin: int) -> None:
+        p = self.p
         cand = p._j_oldest(smin)
         if self.wm > MIN_WATERMARK:
             cand = max(cand, p._j_fired_upto(self.wm) + 1)
@@ -190,6 +195,23 @@ class _PlanCursor:
         p = self.p
         if new_wm <= self.wm:
             return
+        self._plan_fires(t, new_wm, fire_pos, fire_valid, fire_row, fires)
+        # purge columns whose slices expired
+        new_min_live = p._min_live_slice(new_wm)
+        if self.min_used is not None:
+            lo = self.min_used if self.purged_to is None else max(self.purged_to, self.min_used)
+            hi_p = min(new_min_live, self.max_seen + 1)
+            if hi_p - lo >= p.S:
+                purge_mask[t, :] = 0
+            elif hi_p > lo:
+                dead = (np.arange(lo, hi_p) % p.S).astype(np.int64)
+                purge_mask[t, dead] = 0
+        self.purged_to = new_min_live if self.purged_to is None else max(self.purged_to, new_min_live)
+        self.wm = new_wm
+
+    def _plan_fires(self, t: int, new_wm: int, fire_pos, fire_valid,
+                    fire_row, fires: list) -> None:
+        p = self.p
         if self.fire_cursor is not None and self.max_seen is not None:
             hi = min(p._j_fired_upto(new_wm), p._j_newest(self.max_seen))
             slot = 0
@@ -209,18 +231,6 @@ class _PlanCursor:
                 slot += 1
             if p._j_fired_upto(new_wm) >= self.fire_cursor:
                 self.fire_cursor = p._j_fired_upto(new_wm) + 1
-        # purge columns whose slices expired
-        new_min_live = p._min_live_slice(new_wm)
-        if self.min_used is not None:
-            lo = self.min_used if self.purged_to is None else max(self.purged_to, self.min_used)
-            hi_p = min(new_min_live, self.max_seen + 1)
-            if hi_p - lo >= p.S:
-                purge_mask[t, :] = 0
-            elif hi_p > lo:
-                dead = (np.arange(lo, hi_p) % p.S).astype(np.int64)
-                purge_mask[t, dead] = 0
-        self.purged_to = new_min_live if self.purged_to is None else max(self.purged_to, new_min_live)
-        self.wm = new_wm
 
     def commit(self) -> None:
         p = self.p
@@ -301,6 +311,9 @@ class FusedWindowPipeline:
         self.offset = assigner.offset_ms
         self.size_ms = self.spw * self.g
         self.slide_ms = self.sl * self.g
+        # shared-partials (SharedWindowPipeline): per-fire-slot slice-run
+        # lengths; None = the classic uniform-SPW program
+        self._fire_spws: Optional[Tuple[int, ...]] = None
         if num_slices is None:
             num_slices = 1 << (self.spw + nsb + 8 - 1).bit_length()
         self.S = num_slices
@@ -532,6 +545,23 @@ class FusedWindowPipeline:
         start = self.offset + j * self.slide_ms
         return TimeWindow(start, start + self.size_ms)
 
+    def _window_of_fire(self, pf: "_PlannedFire") -> TimeWindow:
+        """Window of a planned fire (shared-partial pipelines dispatch on
+        pf.spec; the single-window pipeline ignores it)."""
+        return self._window_of(pf.j)
+
+    def _wm_keeping_slice_live(self, s: int) -> int:
+        """Largest watermark at which slice `s` has not been purged
+        (_min_live_slice(wm) <= s) — the held-record watermark cap the
+        StepNormalizer stages against. Single source for the formula so
+        the shared-partial pipeline can widen it to its longest window."""
+        return self.offset + (s // self.sl) * self.slide_ms + self.size_ms - 1 - 1
+
+    def _cursor(self) -> "_PlanCursor":
+        """Fire/purge planning state machine factory (the shared-partial
+        pipeline substitutes its multi-spec cursor)."""
+        return _PlanCursor(self)
+
     # ------------------------------------------------------------------
     # compiled superscan
     # ------------------------------------------------------------------
@@ -539,7 +569,7 @@ class FusedWindowPipeline:
         return _build_superscan(
             self.agg, self.K, self.S, self.NSB, self.F, self.R,
             self.spw, self.chunk, self.exact_sums, T, B,
-            phases=self.phase_counters,
+            phases=self.phase_counters, fire_spws=self._fire_spws,
         )
 
     # ------------------------------------------------------------------
@@ -637,7 +667,7 @@ class FusedWindowPipeline:
             run = ps.build_superscan(
                 self.agg, self.K, self.S, self.NSB, self.F, self.spw,
                 self.R, T, B, self.chunk, self.exact_sums,
-                self.pallas_interpret,
+                self.pallas_interpret, fire_spws=self._fire_spws,
             )
             names = [f.name for f in self._value_fields]
             idx_flat = idx_d if idx_d.ndim == 1 else idx_d.reshape(-1)
@@ -719,7 +749,7 @@ class FusedWindowPipeline:
         purge_mask = np.ones((T, self.S), dtype=np.int32)
         fires: List[_PlannedFire] = []
 
-        cur = _PlanCursor(self)
+        cur = self._cursor()
         for t, (kid, vals, ts) in enumerate(batches):
             n = len(ts)
             s_abs = self._slice_of(np.asarray(ts, dtype=np.int64))
@@ -794,7 +824,7 @@ class FusedWindowPipeline:
         purge_mask = np.ones((T, self.S), dtype=np.int32)
         fires: List[_PlannedFire] = []
 
-        cur = _PlanCursor(self)
+        cur = self._cursor()
         for t, (smin, smax) in enumerate(slice_bounds):
             if cur.wm > MIN_WATERMARK and smin < self._min_live_slice(cur.wm):
                 raise ValueError(
@@ -911,7 +941,7 @@ class FusedWindowPipeline:
         purge_mask = np.ones((T, self.S), dtype=np.int32)
         fires: List[_PlannedFire] = []
 
-        cur = _PlanCursor(self)
+        cur = self._cursor()
         for t, step in enumerate(steps):
             raw, ts = step[0], step[1]
             pre_s_abs = step[2] if len(step) > 2 else None
@@ -1022,7 +1052,7 @@ class FusedWindowPipeline:
         # are memoized singletons, custom ones identity-hash conservatively
         key = (self.prologue, self.agg, self.K, self.S, self.NSB, self.F,
                self.R, self.spw, self.chunk, self.exact_sums, T, B,
-               self.phase_counters)
+               self.phase_counters, self._fire_spws)
         fn = _CHAINED_CACHE.get(key)
         if fn is None:
             while len(_CHAINED_CACHE) >= _CHAINED_CACHE_MAX:
@@ -1046,7 +1076,7 @@ class FusedWindowPipeline:
         step = make_superscan_step(
             self.agg, self.K, self.S, self.NSB, self.F, self.R,
             self.spw, self.chunk, self.exact_sums, ingest=ingest,
-            phase_counters=phases,
+            phase_counters=phases, fire_spws=self._fire_spws,
         )
         K, NSB = self.K, self.NSB
         needs_vals = self._needs_vals
@@ -1168,18 +1198,19 @@ from flink_tpu.ops.superscan import make_superscan_step  # noqa: E402,F401
 
 @functools.lru_cache(maxsize=None)
 def _build_superscan(agg, K, S, NSB, F, R, SPW, chunk, exact, T, B,
-                     phases: bool = False):
+                     phases: bool = False, fire_spws=None):
     """Compiled T-step superscan; module-level cache so every pipeline with
     identical geometry (incl. warmup instances) shares one executable.
     With `phases` the program additionally returns the int32[3] per-phase
     step counters threaded through the scan carry (device-plane
     observability); the flag is part of the cache key, so gated jobs and
-    ungated jobs never share an executable shape."""
+    ungated jobs never share an executable shape. `fire_spws` (shared
+    partials) is likewise part of the key: per-slot slice-run lengths."""
     import jax
     import jax.numpy as jnp
 
     step = make_superscan_step(agg, K, S, NSB, F, R, SPW, chunk, exact,
-                               phase_counters=phases)
+                               phase_counters=phases, fire_spws=fire_spws)
 
     if phases:
         @jax.jit
@@ -1206,3 +1237,449 @@ def _build_superscan(agg, K, S, NSB, F, R, SPW, chunk, exact, T, B,
         return state, count, outs, count_out
 
     return run
+
+
+# ---------------------------------------------------------------------------
+# shared-partial multi-window pipeline (Factor Windows, PAPERS.md
+# arXiv 2008.12379): correlated window shapes over ONE gcd-granule ring
+# ---------------------------------------------------------------------------
+
+@dataclasses.dataclass(frozen=True)
+class _WindowSpec:
+    """One member window of a shared-partial group, in shared-granule
+    units: window j of this spec covers slices [j*sl, j*sl + spw)."""
+
+    spw: int
+    sl: int
+    size_ms: int
+    slide_ms: int
+
+
+@dataclasses.dataclass(frozen=True)
+class _SharedGridView:
+    """Synthetic sliceable-assigner view the base pipeline initializes
+    from: granule = the group gcd, spw = the LONGEST member (ring sizing,
+    ring-floor math), sl = the SHORTEST slide (conservative frontier)."""
+
+    slice_ms: int
+    slices_per_window: int
+    slide_slices: int
+    offset_ms: int
+    is_event_time: bool = True
+
+
+class _SharedPlanCursor(_PlanCursor):
+    """The multi-spec fire planner: one shared ingest/purge frontier,
+    per-window-spec fire cursors, fire slots partitioned per spec."""
+
+    def __init__(self, pipe: "SharedWindowPipeline"):
+        super().__init__(pipe)
+        self.fire_cursors = list(pipe.fire_cursors)
+
+    def _note_fire_candidate(self, smin: int) -> None:
+        p = self.p
+        for i in range(len(p.specs)):
+            cand = p._spec_j_oldest(i, smin)
+            if self.wm > MIN_WATERMARK:
+                cand = max(cand, p._spec_j_fired_upto(i, self.wm) + 1)
+            cur = self.fire_cursors[i]
+            self.fire_cursors[i] = cand if cur is None else min(cur, cand)
+
+    def _plan_fires(self, t: int, new_wm: int, fire_pos, fire_valid,
+                    fire_row, fires: list) -> None:
+        p = self.p
+        if self.max_seen is None:
+            return
+        Fp = p.F_per_spec
+        for i, spec in enumerate(p.specs):
+            cur = self.fire_cursors[i]
+            if cur is None:
+                continue
+            hi = min(p._spec_j_fired_upto(i, new_wm),
+                     self.max_seen // spec.sl)
+            slot = i * Fp
+            n = 0
+            for j in range(cur, hi + 1):
+                if n >= Fp:
+                    raise ValueError(
+                        f"window spec {i}: {hi + 1 - cur} windows fire in "
+                        f"one step > fires_per_step={Fp}")
+                if len(fires) >= p.R:
+                    raise ValueError(
+                        f"more than out_rows={p.R} fires per dispatch")
+                row = len(fires)
+                fires.append(_PlannedFire(row, j, t, spec=i))
+                fire_pos[t, slot + n] = (j * spec.sl) % p.S
+                fire_valid[t, slot + n] = 1
+                fire_row[t, slot + n] = row
+                n += 1
+            if p._spec_j_fired_upto(i, new_wm) >= cur:
+                self.fire_cursors[i] = p._spec_j_fired_upto(i, new_wm) + 1
+
+    def commit(self) -> None:
+        super().commit()
+        self.p.fire_cursors = list(self.fire_cursors)
+
+
+class SharedWindowPipeline(FusedWindowPipeline):
+    """N correlated window shapes over ONE shared slice ring.
+
+    The Factor-Windows execution form: a job computing several windows
+    over the same keyed stream (1m/5m/1h dashboards) pays for ONE scan —
+    ingest lands gcd-granule partials once, and every member window
+    derives its result from those shared partials at fire time (its own
+    slice-run length per fire slot, `fire_spws` in the superscan step).
+    Against N independent fused runs this saves (N-1) full ingest scans —
+    the dominant cost — which is the sharing factor the planner
+    (graph/window_sharing.py) estimates.
+
+    Differences from the base pipeline, all planner-side:
+    - per-spec fire cursors (`fire_cursors`); the fire slot space is
+      partitioned F_per_spec slots per member;
+    - the purge frontier is the MIN over members' live frontiers (a slice
+      purges only when the LONGEST window is done with it);
+    - `_window_of_fire` returns `(spec_index, TimeWindow)` — ONLY the
+      shared-partial operator consumes these deferred handles, and it
+      routes each emission to its member window's output.
+
+    All member assigners must be sliceable, event-time, and share one
+    offset; the shared granule is the gcd of their slice granules, and
+    each member's decomposition onto it must be exact
+    (WindowAssigner.slices_on — the degenerate-shape contract)."""
+
+    def __init__(self, assigners, aggregate, *, key_capacity: int,
+                 num_slices: Optional[int] = None, nsb: int = 4,
+                 fires_per_step: int = 4, out_rows: int = 256,
+                 chunk: int = 4096, exact_sums: bool = True,
+                 backend: str = "auto", pallas_interpret: bool = False,
+                 plan_only: bool = False, prologue=None):
+        import math
+
+        if len(assigners) < 2:
+            raise ValueError("shared partials need >= 2 window shapes")
+        offs = {a.offset_ms for a in assigners}
+        if len(offs) != 1:
+            raise ValueError(
+                f"shared partials need one common window offset, got {offs}")
+        for a in assigners:
+            if a.slice_ms is None or not a.is_event_time:
+                raise ValueError(f"{a!r} is not a sliceable event-time "
+                                 "assigner")
+        g = 0
+        for a in assigners:
+            g = math.gcd(g, a.slice_ms)
+        specs = []
+        for a in assigners:
+            spw, sl = a.slices_on(g)   # exact or ValueError
+            specs.append(_WindowSpec(spw, sl, spw * g, sl * g))
+        n = len(specs)
+        view = _SharedGridView(
+            slice_ms=g,
+            slices_per_window=max(s.spw for s in specs),
+            slide_slices=min(s.sl for s in specs),
+            offset_ms=assigners[0].offset_ms,
+        )
+        super().__init__(
+            view, aggregate, key_capacity=key_capacity,
+            num_slices=num_slices, nsb=nsb,
+            fires_per_step=n * fires_per_step, out_rows=out_rows,
+            chunk=chunk, exact_sums=exact_sums, backend=backend,
+            pallas_interpret=pallas_interpret, plan_only=plan_only,
+            prologue=prologue,
+        )
+        self.specs = tuple(specs)
+        self.F_per_spec = fires_per_step
+        self._fire_spws = tuple(
+            s.spw for s in specs for _ in range(fires_per_step))
+        self.fire_cursors = [None] * n
+
+    # -- per-spec geometry ---------------------------------------------
+    def _spec_j_fired_upto(self, i: int, wm: int) -> int:
+        s = self.specs[i]
+        return (wm + 1 - self.offset - s.size_ms) // s.slide_ms
+
+    def _spec_j_oldest(self, i: int, smin: int) -> int:
+        s = self.specs[i]
+        return _ceil_div(smin - s.spw + 1, s.sl)
+
+    def _spec_fire_wm(self, i: int, j: int) -> int:
+        s = self.specs[i]
+        return self.offset + j * s.slide_ms + s.size_ms - 1
+
+    def _spec_window_of(self, i: int, j: int) -> TimeWindow:
+        s = self.specs[i]
+        start = self.offset + j * s.slide_ms
+        return TimeWindow(start, start + s.size_ms)
+
+    # -- shared frontier overrides -------------------------------------
+    def _min_live_slice(self, wm: int) -> int:
+        return min(
+            (self._spec_j_fired_upto(i, wm) + 1) * s.sl
+            for i, s in enumerate(self.specs)
+        )
+
+    def _wm_keeping_slice_live(self, s: int) -> int:
+        # largest wm with min-over-specs of min_live <= s: the LONGEST
+        # holder wins (any one spec keeping the slice live keeps it live)
+        return max(self._spec_fire_wm(i, s // sp.sl) - 1
+                   for i, sp in enumerate(self.specs))
+
+    def _window_of_fire(self, pf: "_PlannedFire"):
+        return (pf.spec, self._spec_window_of(pf.spec, pf.j))
+
+    def _cursor(self) -> _SharedPlanCursor:
+        return _SharedPlanCursor(self)
+
+    # -- snapshot surface ----------------------------------------------
+    def snapshot(self) -> dict:
+        snap = super().snapshot()
+        snap["fire_cursors"] = list(self.fire_cursors)
+        return snap
+
+    def restore(self, snap: dict) -> None:
+        super().restore(snap)
+        self.fire_cursors = list(snap["fire_cursors"])
+
+
+# ---------------------------------------------------------------------------
+# global-window pipeline: keyed-partial -> cross-segment fold, [S] state
+# ---------------------------------------------------------------------------
+
+class FusedGlobalWindowPipeline:
+    """Per-window GLOBAL aggregation (the Nexmark Q7 shape) on the
+    superscan schedule: the host planner (a plan-only FusedWindowPipeline
+    — one source of truth for fire/purge math) plans dispatches exactly
+    like the keyed path, but device state collapses from [K, S] to a [S]
+    slice ring of partials and every fire folds its slice run into ONE
+    scalar. The dense per-batch keyed reduction (and its [R, K] readback
+    + host-side fold over keys) is replaced by a keyed-partial →
+    cross-segment fold — the single-chip analogue of the mesh's
+    psum/pmax merge; readbacks shrink to R scalars. Unbounded min/max
+    have a device form here (the fold is elementwise — no scatter unit,
+    no bounded-domain declaration).
+
+    On TPU the whole T-step dispatch runs as one pallas kernel
+    (ops/pallas_superscan.build_global_superscan) with the ring resident
+    in a single VMEM row; elsewhere (and for geometries the kernel
+    refuses) the XLA scan form (ops/superscan.make_global_scan_step)
+    keeps identical semantics. Staged inputs are interchangeable with the
+    keyed pipeline's (`idx = kid * NSB + srel` streams fold by
+    `idx % NSB`), so callers that stage on device — the bench's threefry
+    generator — switch paths without re-staging."""
+
+    def __init__(self, assigner, aggregate, *, num_slices: Optional[int] = None,
+                 nsb: int = 4, fires_per_step: int = 2, out_rows: int = 64,
+                 chunk: int = 8192, backend: str = "auto",
+                 pallas_interpret: bool = False):
+        self._planner = FusedWindowPipeline(
+            assigner, aggregate, key_capacity=128, num_slices=num_slices,
+            nsb=nsb, fires_per_step=fires_per_step, out_rows=out_rows,
+            chunk=chunk, backend="xla", plan_only=True,
+        )
+        self.agg = self._planner.agg
+        self.S = self._planner.S
+        self.NSB = nsb
+        self.F = fires_per_step
+        self.R = out_rows
+        self.chunk = chunk
+        self.backend = backend
+        self.pallas_interpret = pallas_interpret
+        self._value_fields = [f for f in self.agg.fields if f.source == VALUE]
+        self._needs_vals = bool(self._value_fields)
+        self._pallas: Optional[bool] = None
+        self.compile_tracker = None
+        self.phase_counters = False
+        import jax.numpy as jnp
+
+        from flink_tpu.ops.aggregators import scan_identity
+
+        self._count = jnp.zeros((self.S,), jnp.int32)
+        self._state = {
+            f.name: jnp.full((self.S,),
+                             scan_identity(jnp.dtype(f.dtype), f.scatter),
+                             jnp.dtype(f.dtype))
+            for f in self._value_fields
+        }
+
+    # planner-geometry delegation (the sharded pipeline's pattern)
+    @property
+    def planner(self):
+        return self._planner
+
+    def __getattr__(self, name):
+        if name == "_planner":
+            raise AttributeError(name)
+        return getattr(self._planner, name)
+
+    def attach_device_stats(self, tracker, phase_counters: bool = True) -> None:
+        """Wire a CompileTracker around the global-superscan dispatch and
+        (non-pallas, like the keyed pipeline) thread the ingest/fire/purge
+        phase counters through the scan carry. Must run before the first
+        dispatch — the phase flag is part of the executable cache key."""
+        self.compile_tracker = tracker
+        self.phase_counters = bool(phase_counters)
+
+    def _use_pallas(self) -> bool:
+        if self._pallas is None:
+            from flink_tpu.ops import pallas_superscan as ps
+
+            ok = ps.supports_global(self.agg, self.S, self.R, self.NSB,
+                                    self.chunk)
+            if self.backend == "xla":
+                self._pallas = False
+            elif self.backend == "pallas":
+                if not ok:
+                    raise ValueError(
+                        "pallas global superscan does not support this "
+                        "aggregate/geometry (need add/min/max fields, "
+                        "S<=32, R<=128, chunk-aligned batches)")
+                self._pallas = True
+            else:
+                import jax
+
+                self._pallas = ok and (jax.default_backend() == "tpu"
+                                       or self.pallas_interpret)
+        return self._pallas
+
+    def plan_superbatch(self, slice_bounds, watermarks):
+        return self._planner.plan_superbatch(slice_bounds, watermarks)
+
+    def stage_superbatch(self, batches, watermarks):
+        return self._planner.stage_superbatch(batches, watermarks)
+
+    def process_superbatch(self, batches, watermarks, *, staged=None,
+                           defer: bool = False):
+        import jax
+        import jax.numpy as jnp
+
+        from flink_tpu.ops.aggregators import scan_identity
+
+        if staged is None:
+            staged = self._planner.stage_superbatch(batches, watermarks)
+        idx_d, vals_d, plan = staged
+        (smin_pos, fire_pos, fire_valid, fire_row, purge_mask, fires) = plan
+        T = int(smin_pos.shape[0])
+        B = idx_d.shape[1] if idx_d.ndim == 2 else idx_d.shape[0] // T
+        names = [f.name for f in self._value_fields]
+
+        use_pallas = self._use_pallas()
+        CH = self.chunk
+        if use_pallas:
+            # staged inputs are chunk-padded (stage_superbatch), so CH stays
+            # self.chunk; externally staged widths halve down to the largest
+            # divisor. A width the kernel cannot chunk (below MIN_CHUNK)
+            # falls back to the XLA scan for THIS dispatch — identical
+            # semantics — unless the caller forced backend="pallas".
+            from flink_tpu.ops import pallas_superscan as ps
+
+            while CH > 1 and B % CH != 0:
+                CH //= 2
+            if B % CH != 0 or CH % ps.MIN_CHUNK != 0:
+                if self.backend == "pallas":
+                    raise ValueError(
+                        f"pallas global superscan cannot chunk batch width "
+                        f"{B} (chunks must divide B and be multiples of "
+                        f"{ps.MIN_CHUNK}); stage through the pipeline or "
+                        "use backend='auto' to allow the XLA scan fallback")
+                use_pallas = False
+
+        if use_pallas:
+            from flink_tpu.ops import pallas_superscan as ps
+
+            LANE = ps.LANE
+            idx_flat = idx_d if idx_d.ndim == 1 else idx_d.reshape(-1)
+            vals_flat = None
+            if self._needs_vals:
+                vals_flat = vals_d if vals_d.ndim == 1 else vals_d.reshape(-1)
+            run = ps.build_global_superscan(
+                self.agg, self.S, self.NSB, self.F, self._planner.spw,
+                self.R, T, B, CH, self.pallas_interpret,
+            )
+            count_row = jnp.zeros((1, LANE), jnp.int32).at[0, :self.S].set(
+                self._count)
+            state_rows = tuple(
+                jnp.full((1, LANE),
+                         scan_identity(self._state[n].dtype,
+                                       self.agg.field(n).scatter),
+                         self._state[n].dtype).at[0, :self.S].set(
+                    self._state[n])
+                for n in names
+            )
+            out = run(smin_pos, fire_pos, fire_valid, fire_row, purge_mask,
+                      count_row, state_rows, idx_flat, vals_flat) \
+                if self.compile_tracker is None else \
+                self.compile_tracker.call(
+                    "pallas_global_superscan", run,
+                    (smin_pos, fire_pos, fire_valid, fire_row, purge_mask,
+                     count_row, state_rows, idx_flat, vals_flat),
+                    {"T": T, "B": B, "S": self.S, "scope": "global"})
+            count_state, field_states, count_out_row, field_out_rows = out
+            self._count = count_state[0, :self.S]
+            self._state = {
+                n: s[0, :self.S] for n, s in zip(names, field_states)
+            }
+            count_out = count_out_row[0, :self.R]
+            outs = {n: o[0, :self.R]
+                    for n, o in zip(names, field_out_rows)}
+        else:
+            from flink_tpu.ops.superscan import build_global_superscan
+
+            if idx_d.ndim == 1:
+                idx_d = idx_d.reshape(T, B)
+            if self._needs_vals and vals_d.ndim == 1:
+                vals_d = vals_d.reshape(T, B)
+            run = build_global_superscan(
+                self.agg, self.S, self.NSB, self.F, self.R,
+                self._planner.spw, T, B, phases=self.phase_counters,
+            )
+            outs0 = {
+                f.name: jnp.full(
+                    (self.R,),
+                    scan_identity(jnp.dtype(f.dtype), f.scatter),
+                    jnp.dtype(f.dtype))
+                for f in self._value_fields
+            }
+            count_out0 = jnp.zeros((self.R,), jnp.int32)
+            args = (self._state, self._count, outs0, count_out0, idx_d,
+                    vals_d, smin_pos, fire_pos, fire_valid, fire_row,
+                    purge_mask)
+            if self.compile_tracker is None:
+                out = run(*args)
+            else:
+                out = self.compile_tracker.call(
+                    "global_superscan", run, args,
+                    {"T": T, "B": B, "S": self.S, "scope": "global"})
+            if self.phase_counters:
+                self._state, self._count, outs, count_out, pc = out
+            else:
+                self._state, self._count, outs, count_out = out
+
+        deferred = DeferredEmissions(
+            self._planner, fires, count_out, outs,
+            phase_counts=(pc if self.phase_counters and not use_pallas
+                          else None))
+        return deferred if defer else deferred.resolve()
+
+    def snapshot(self) -> dict:
+        return {
+            "count": np.asarray(self._count),
+            "state": {k: np.asarray(v) for k, v in self._state.items()},
+            "watermark": self._planner.watermark,
+            "fire_cursor": self._planner.fire_cursor,
+            "purged_to": self._planner.purged_to,
+            "min_used_slice": self._planner.min_used_slice,
+            "max_seen_slice": self._planner.max_seen_slice,
+            "num_late_dropped": self._planner.num_late_records_dropped,
+        }
+
+    def restore(self, snap: dict) -> None:
+        import jax.numpy as jnp
+
+        self._count = jnp.asarray(snap["count"])
+        self._state = {k: jnp.asarray(v) for k, v in snap["state"].items()}
+        self._planner.watermark = snap["watermark"]
+        self._planner.fire_cursor = snap["fire_cursor"]
+        self._planner.purged_to = snap["purged_to"]
+        self._planner.min_used_slice = snap["min_used_slice"]
+        self._planner.max_seen_slice = snap["max_seen_slice"]
+        self._planner.num_late_records_dropped = snap["num_late_dropped"]

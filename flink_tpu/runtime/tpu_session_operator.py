@@ -48,23 +48,14 @@ _NP_COMBINE = {"add": np.add, "min": np.minimum, "max": np.maximum}
 
 @functools.lru_cache(maxsize=None)
 def _build_ingest(K: int, S: int, B: int, vfields: tuple):
+    # the scatter body lives in ops/superscan.session_ingest_scatter — ONE
+    # copy shared with the fused superspan, so the overflow-replay path is
+    # bit-identical to the dispatch it replaces by construction
     import jax
-    import jax.numpy as jnp
 
-    def run(cnt, mn, mx, fields, kid, spos, rel, vals):
-        flat = jnp.where(kid >= 0, kid * S + spos, K * S)
-        cnt = cnt.reshape(-1).at[flat].add(1, mode="drop").reshape(K, S)
-        mn = mn.reshape(-1).at[flat].min(rel, mode="drop").reshape(K, S)
-        mx = mx.reshape(-1).at[flat].max(rel, mode="drop").reshape(K, S)
-        new_fields = []
-        for (name, dt, scatter), f in zip(vfields, fields):
-            upd = getattr(f.reshape(-1).at[flat], scatter)
-            new_fields.append(
-                upd(vals.astype(dt), mode="drop").reshape(K, S)
-            )
-        return cnt, mn, mx, tuple(new_fields)
+    from flink_tpu.ops.superscan import session_ingest_scatter
 
-    return jax.jit(run)
+    return jax.jit(session_ingest_scatter(K, S, vfields))
 
 
 @functools.lru_cache(maxsize=None)
@@ -118,7 +109,7 @@ def _build_merge_scan(K: int, S: int, P: int, M: int, g: int, vfields: tuple,
     import jax
     import jax.numpy as jnp
 
-    combine = {"add": jnp.add, "min": jnp.minimum, "max": jnp.maximum}
+    from flink_tpu.ops.superscan import session_gap_merge_scan
 
     def run(cnt, mn, mx, fields, pos, valid, wm_rel):
         i32 = jnp.int32
@@ -129,14 +120,6 @@ def _build_merge_scan(K: int, S: int, P: int, M: int, g: int, vfields: tuple,
         fmx = mx[:, pos] + idx_p[None, :] * g
         fl = [f[:, pos] for f in fields]
 
-        open_ = jnp.zeros((K,), bool)
-        cmin = jnp.zeros((K,), i32)
-        cmax = jnp.full((K,), -(1 << 30), i32)
-        ccnt = jnp.zeros((K,), i32)
-        cstart = jnp.zeros((K,), i32)
-        clast = jnp.zeros((K,), i32)
-        cflds = [jnp.full((K,), ident, f.dtype)
-                 for f, ident in zip(fl, idents)]
         slots = jnp.zeros((K,), i32)                      # next emit slot
         e_start = jnp.zeros((K, M), i32)
         e_end = jnp.zeros((K, M), i32)
@@ -148,45 +131,13 @@ def _build_merge_scan(K: int, S: int, P: int, M: int, g: int, vfields: tuple,
         overflow = jnp.zeros((), bool)
         mslots = jnp.arange(M, dtype=i32)[None, :]
 
-        def do_emit(mask, state):
-            (slots, e_start, e_end, e_cnt, e_s0, e_s1, e_flds, overflow) = state
-            can = mask & (slots < M)
-            oh = (mslots == slots[:, None]) & can[:, None]    # [K, M]
-            e_start = jnp.where(oh, cmin[:, None], e_start)
-            e_end = jnp.where(oh, cmax[:, None], e_end)
-            e_cnt = jnp.where(oh, ccnt[:, None], e_cnt)
-            e_s0 = jnp.where(oh, cstart[:, None], e_s0)
-            e_s1 = jnp.where(oh, clast[:, None], e_s1)
-            e_flds = [jnp.where(oh, cf[:, None], ef)
-                      for cf, ef in zip(cflds, e_flds)]
-            overflow = overflow | jnp.any(mask & (slots >= M))
-            slots = slots + can.astype(i32)
-            return (slots, e_start, e_end, e_cnt, e_s0, e_s1, e_flds, overflow)
-
-        est = (slots, e_start, e_end, e_cnt, e_s0, e_s1, e_flds, overflow)
-        for i in range(P):
-            ci = c[:, i]
-            frag = ci > 0
-            mni = fmn[:, i]
-            mxi = fmx[:, i]
-            joins = open_ & frag & (mni - cmax <= g)
-            breaks = open_ & frag & ~joins
-            est = do_emit(breaks, est)
-            starts = frag & ~joins
-            cmin = jnp.where(starts, mni, cmin)
-            ccnt = jnp.where(starts, 0, ccnt)
-            cstart = jnp.where(starts, i, cstart)
-            cflds = [jnp.where(starts, jnp.asarray(ident, cf.dtype), cf)
-                     for cf, ident in zip(cflds, idents)]
-            open_ = open_ | frag
-            cmax = jnp.where(frag, mxi, cmax)
-            ccnt = jnp.where(frag, ccnt + ci, ccnt)
-            clast = jnp.where(frag, i, clast)
-            cflds = [
-                jnp.where(frag, combine[sc](cf, fi[:, i]), cf)
-                for cf, fi, (_n, _dt, sc) in zip(cflds, fl, vfields)
-            ]
-        est = do_emit(open_ & (cmax + g - 1 <= wm_rel), est)
+        # the scan body lives in ops/superscan.session_gap_merge_scan —
+        # the ONE copy both this per-watermark program and the fused
+        # superspan's in-carry merges compile, so the overflow-replay
+        # parity contract cannot drift between them
+        est = session_gap_merge_scan(
+            c, fmn, fmx, fl, vfields, idents, g, wm_rel,
+            (slots, e_start, e_end, e_cnt, e_s0, e_s1, e_flds, overflow))
         (slots, e_start, e_end, e_cnt, e_s0, e_s1, e_flds, overflow) = est
 
         # purge the emitted sessions' cells, write the span back
@@ -455,6 +406,7 @@ class TpuSessionWindowOperator:
         that [smin, smax] keeps the resident span inside the ring — this is
         the zero-host-copy path for device-side sources (the session
         analogue of FusedWindowPipeline.plan_superbatch staging)."""
+        self._sync_superspan()
         lo = smin if self.ring_lo is None else min(self.ring_lo, smin)
         if self._pending and (
             (self.max_used is not None and self.max_used - lo >= self.S)
@@ -488,9 +440,132 @@ class TpuSessionWindowOperator:
         return kid if getattr(self, "_dense", False) else self.keydict.key_at(kid)
 
     # ------------------------------------------------------------------
+    # fused superspan: T staged ingest steps + in-scan gap-merges, ONE
+    # device dispatch and ONE packed readback (ops/superscan.
+    # make_session_superscan) — sessions merge in the scan carry and
+    # never round-trip to host per watermark
+    # ------------------------------------------------------------------
+    MAX_SUPERSPAN_SLOTS = 40
+
+    def process_superspan_staged(self, kid, spos, rel, vals,
+                                 step_bounds, merge_wms) -> None:
+        """Device-staged fused superspan: `kid`/`spos`/`rel`/`vals` are
+        [T, B] device arrays in ring coordinates (the per-step contract of
+        process_batch_staged, stacked), `step_bounds[t] = (smin, smax)`
+        the step's absolute gap-slice bounds, and `merge_wms[t]` the
+        watermark to gap-merge at after step t (None = ingest-only step).
+
+        The whole superspan — every ingest and every merge — is ONE
+        compiled dispatch; closed sessions accumulate into M emission
+        slots per key and come back as one packed array, resolved
+        deferred exactly like the per-watermark merge scans. Geometries
+        the fused program cannot bound (emission slots past
+        MAX_SUPERSPAN_SLOTS, rel-ms beyond int32) replay through the
+        exact per-step path instead, and an in-dispatch slot overflow
+        (pathological re-filled-slice churn) discards the fused result
+        and replays from the retained pre-dispatch state — placement
+        never changes a result."""
+        import jax.numpy as jnp
+
+        from flink_tpu.ops.superscan import make_session_superscan
+
+        T = int(kid.shape[0])
+        if not any(w is not None for w in merge_wms):
+            raise ValueError("a superspan needs at least one merge step")
+        if len(self.keydict) > 0:
+            raise ValueError(
+                "process_superspan_staged (dense ids) cannot be mixed with "
+                "the keydict-backed process_batch path on one operator"
+            )
+        self._dense = True
+        self._resolve_pending()   # learn true bounds; one dispatch in flight
+
+        smin_all = min(b[0] for b in step_bounds)
+        smax_all = max(b[1] for b in step_bounds)
+        lo0 = smin_all if self.ring_lo is None else min(self.ring_lo, smin_all)
+        hi = smax_all if self.max_used is None else max(self.max_used, smax_all)
+        if hi - lo0 >= self.S:
+            raise ValueError(
+                f"session slice ring too small: superspan [{lo0}, {hi}] "
+                f"exceeds num_slices={self.S}"
+            )
+        g = self.g
+        span = hi - lo0 + 1
+        n_merges = sum(1 for w in merge_wms if w is not None)
+        # emission-slot bound: sessions closed per key per dispatch <=
+        # fragments consumed <= span slices + per-merge re-fills; rounded
+        # up to a multiple of 8 so streams whose per-dispatch span drifts
+        # land on a few compiled shapes instead of one per distinct M
+        M = -(-(span + n_merges + 2) // 8) * 8
+        wm_last = max(w for w in merge_wms if w is not None)
+        int32_ok = (span + 2) * g < (1 << 31) and \
+            0 <= wm_last - lo0 * g < (1 << 31)
+        dtypes_ok = all(
+            np.dtype(dt) in (np.dtype(np.int32), np.dtype(np.float32))
+            for _n, dt, _s in self._vfields)
+        if M > min(self.S, self.MAX_SUPERSPAN_SLOTS) or not int32_ok \
+                or not dtypes_ok:
+            self._replay_superspan(kid, spos, rel, vals, step_bounds,
+                                   merge_wms)
+            return
+
+        merge_flag = np.asarray(
+            [1 if w is not None else 0 for w in merge_wms], np.int32)
+        lo_pos = np.full(T, lo0 % self.S, np.int32)
+        lo_rel = np.zeros(T, np.int32)
+        wm_rel = np.asarray(
+            [(w - lo0 * g) if w is not None else 0 for w in merge_wms],
+            np.int32)
+
+        old_state = (self._cnt, self._mn, self._mx, self._fields,
+                     self.ring_lo, self.max_used, self.current_watermark)
+        run = make_session_superscan(
+            self.K, self.S, M, g, self._vfields, self._idents,
+            T, int(kid.shape[1]))
+        cnt2, mn2, mx2, flds2, packed = run(
+            self._cnt, self._mn, self._mx, self._fields,
+            kid, spos, rel, vals,
+            jnp.asarray(merge_flag), jnp.asarray(lo_pos),
+            jnp.asarray(lo_rel), jnp.asarray(wm_rel))
+        self._cnt, self._mn, self._mx, self._fields = cnt2, mn2, mx2, flds2
+        self.ring_lo = lo0          # stale-low; refreshed at resolve
+        self.max_used = hi
+        self.current_watermark = max(self.current_watermark, wm_last)
+        self._since_dispatch = None   # packed live bounds are dispatch-final
+        entry = {
+            "packed": packed, "lo": lo0, "M": M, "watermark": wm_last,
+            "old_state": old_state,
+            "superspan": (kid, spos, rel, vals, step_bounds, merge_wms),
+        }
+        if self.defer_emissions:
+            self._pending.append(entry)
+            if self._future:
+                self._resolve_pending()
+        else:
+            self._resolve_entry(entry, last=True)
+        self._drain_future()
+
+    def _replay_superspan(self, kid, spos, rel, vals, step_bounds,
+                          merge_wms) -> None:
+        """Exact per-step replay of a superspan (fused-path fallback and
+        the overflow recovery path): per-step staged ingest + sync
+        per-watermark merge scans — bit-identical semantics, more
+        dispatches."""
+        was_deferred, self.defer_emissions = self.defer_emissions, False
+        try:
+            for t in range(int(kid.shape[0])):
+                self.process_batch_staged(
+                    kid[t], spos[t], rel[t], vals[t], *step_bounds[t])
+                if merge_wms[t] is not None:
+                    self.process_watermark(merge_wms[t])
+        finally:
+            self.defer_emissions = was_deferred
+
+    # ------------------------------------------------------------------
     def process_watermark(self, watermark: int) -> None:
         if watermark <= self.current_watermark:
             return
+        self._sync_superspan()
         self.current_watermark = watermark
         if self.ring_lo is None:
             self._drain_future()
@@ -583,6 +658,19 @@ class TpuSessionWindowOperator:
         pos_pad[span:] = pos_pad[span - 1]
         return P, pos_pad, np.arange(P) < span
 
+    def _sync_superspan(self) -> None:
+        """Resolve a pending fused-superspan entry before dispatching ANY
+        new device work on top of it. Its resolve may overflow-replay:
+        discard the fused lineage wholesale and rebuild state through the
+        per-step path — so a merge scan dispatched meanwhile would resolve
+        against the discarded lineage (duplicate emissions, corrupted ring
+        bounds) and an ingest into it would be lost with it. The guard
+        also keeps the superspan entry the ONLY pending entry when its
+        overflow flag is read, which is what lets the replay restore
+        `old_state` without reconciling later dispatches."""
+        if any("superspan" in e for e in self._pending):
+            self._resolve_pending()
+
     def _resolve_pending(self) -> None:
         pending, self._pending = self._pending, []
         for i, entry in enumerate(pending):
@@ -601,6 +689,16 @@ class TpuSessionWindowOperator:
         arr = np.asarray(entry["packed"])
         lo_rel, hi_rel, ovf = int(arr[-1, 0]), int(arr[-1, 1]), int(arr[-1, 2])
         if ovf:
+            if "superspan" in entry:
+                # a key closed > M sessions across the fused superspan
+                # (pathological re-filled-slice churn): discard the fused
+                # result wholesale and replay the exact per-step path from
+                # the retained pre-dispatch state
+                (self._cnt, self._mn, self._mx, self._fields,
+                 self.ring_lo, self.max_used,
+                 self.current_watermark) = entry["old_state"]
+                self._replay_superspan(*entry["superspan"])
+                return
             # a key closed > M sessions in one scan (wide-span sync path
             # only): discard the fused results and redo exactly on host
             (self._cnt, self._mn, self._mx, self._fields) = entry["old_state"]

@@ -1,0 +1,131 @@
+"""Exact slice decomposition of the sliceable assigners (ISSUE-14
+satellite): the sharing optimizer derives every member window from shared
+gcd-granule partials, so the decomposition must be EXACT in the shapes a
+naive `size // slide` computation gets wrong — a slide that does not
+divide the size, the size == slide tumbling collapse, and slide > size
+(sampling windows with dead slices). These tests pin the `slices_on`
+contract directly against the reference assignment semantics
+(assign_sliding/assign_tumbling), for the assigner's own gcd granule AND
+for coarser-group granules.
+"""
+
+import math
+
+import numpy as np
+import pytest
+
+from flink_tpu.api.windowing.assigners import (
+    EventTimeSessionWindows,
+    GlobalWindows,
+    SlidingEventTimeWindows,
+    TumblingEventTimeWindows,
+)
+from flink_tpu.core.time import assign_sliding, assign_tumbling
+
+
+def _windows_via_slices(assigner, ts: int, granule: int):
+    """Windows containing `ts` per the slice model on `granule`: slice
+    s = (ts - offset) // granule; window j covers [j*sl, j*sl + spw)."""
+    spw, sl = assigner.slices_on(granule)
+    s = (ts - assigner.offset_ms) // granule
+    out = []
+    j_hi = s // sl
+    j_lo = -((spw - s - 1) // sl) if s < spw else (s - spw) // sl + 1
+    for j in range(j_lo - 2, j_hi + 1):          # margin; filtered below
+        if j * sl <= s < j * sl + spw:
+            start = assigner.offset_ms + j * sl * granule
+            out.append((start, start + spw * granule))
+    return sorted(out)
+
+
+@pytest.mark.parametrize("size,slide", [
+    (10, 4),      # slide does not divide size: gcd granule 2
+    (9, 6),       # gcd 3
+    (7, 3),       # gcd 1
+    (10, 10),     # size == slide tumbling collapse: granule = size, NOT slide
+    (2, 5),       # slide > size: dead slices between windows
+    (3, 7),
+])
+def test_sliding_decomposition_matches_reference_assignment(size, slide):
+    a = SlidingEventTimeWindows.of(size, slide)
+    g = a.slice_ms
+    assert g == math.gcd(size, slide)
+    assert a.slices_per_window * g == size
+    assert a.slide_slices * g == slide
+    for ts in range(0, 4 * size + 4 * slide):
+        expect = sorted((w.start, w.end) for w in
+                        assign_sliding(ts, size, slide, 0))
+        got = _windows_via_slices(a, ts, g)
+        assert got == expect, (size, slide, ts)
+
+
+@pytest.mark.parametrize("size,slide,offset", [
+    (10, 4, 2), (10, 4, -2), (7, 3, 1), (9, 6, -5),
+])
+def test_sliding_decomposition_with_offset(size, slide, offset):
+    a = SlidingEventTimeWindows.of(size, slide, offset)
+    for ts in range(0, 3 * size + 3 * slide):
+        expect = sorted((w.start, w.end) for w in
+                        assign_sliding(ts, size, slide, offset))
+        assert _windows_via_slices(a, ts, a.slice_ms) == expect
+
+
+def test_tumbling_decomposition():
+    a = TumblingEventTimeWindows.of(6)
+    assert (a.slices_per_window, a.slide_slices) == (1, 1)
+    for ts in range(0, 40):
+        expect = sorted((w.start, w.end) for w in assign_tumbling(ts, 6, 0))
+        assert _windows_via_slices(a, ts, a.slice_ms) == expect
+
+
+# ---------------------------------------------------------------------------
+# slices_on: decomposition onto an ARBITRARY (group) granule
+# ---------------------------------------------------------------------------
+
+def test_slices_on_exact_for_group_gcd():
+    """The 1m/5m/1h group decomposes exactly on the 1m gcd granule."""
+    members = [TumblingEventTimeWindows.of(60_000),
+               TumblingEventTimeWindows.of(300_000),
+               TumblingEventTimeWindows.of(3_600_000)]
+    g = 0
+    for a in members:
+        g = math.gcd(g, a.slice_ms)
+    assert g == 60_000
+    assert [a.slices_on(g) for a in members] == [(1, 1), (5, 5), (60, 60)]
+
+
+def test_slices_on_degenerate_member_exact():
+    """A sliding member whose slide does not divide its size still
+    decomposes exactly on a shared granule dividing gcd(size, slide)."""
+    a = SlidingEventTimeWindows.of(90_000, 36_000)   # gcd 18s
+    assert a.slices_on(18_000) == (5, 2)
+    assert a.slices_on(6_000) == (15, 6)             # finer group granule
+    assert a.slices_on(2_000) == (45, 18)
+    # reference cross-check on the finer granule
+    for ts in range(0, 300_000, 1711):
+        expect = sorted((w.start, w.end) for w in
+                        assign_sliding(ts, 90_000, 36_000, 0))
+        assert _windows_via_slices(a, ts, 6_000) == expect
+
+
+def test_slices_on_refuses_inexact_granules():
+    a = SlidingEventTimeWindows.of(10_000, 4_000)    # gcd 2s
+    with pytest.raises(ValueError, match="does not divide"):
+        a.slices_on(3_000)       # divides neither
+    with pytest.raises(ValueError, match="does not divide"):
+        a.slices_on(4_000)       # divides slide but not size
+    with pytest.raises(ValueError, match="does not divide"):
+        a.slices_on(0)
+    # size == slide collapse: slide itself IS valid (== size == gcd), but
+    # anything that does not divide it is not
+    t = SlidingEventTimeWindows.of(5_000, 5_000)
+    assert t.slices_on(5_000) == (1, 1)
+    with pytest.raises(ValueError):
+        t.slices_on(2_000)
+
+
+def test_slices_on_not_sliceable():
+    with pytest.raises(ValueError, match="not sliceable"):
+        EventTimeSessionWindows.with_gap(1000).slices_on(1000)
+    with pytest.raises(ValueError, match="not sliceable"):
+        GlobalWindows().slices_on(1000)

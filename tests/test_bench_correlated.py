@@ -1,0 +1,108 @@
+"""Bench smoke gate for the shared-partials (correlated windows) scenario
+(ISSUE-14, Factor Windows).
+
+Runs the real `bench.correlated_windows_microbench` at smoke scale (the
+virtual 8-device CPU mesh from tests/conftest.py gives the mesh leg
+devices) and asserts the result JSON carries the `correlated_windows.*`
+keys every BENCH_*.json must now track — so the sharing speedup can't
+silently regress: a change that reroutes the 1m/5m/1h job back to three
+independent programs (`shared_selected` false), breaks shared-vs-
+independent parity on either leg, stops planning the group, or craters
+the speedup fails tier-1, not just a human eyeballing the next bench run.
+
+Absolute throughput is deliberately not asserted, and the CPU speedup
+floor is a catastrophic-regression guard only: at smoke scale on a
+shared 2-vCPU host the per-dispatch fixed costs dominate and the
+~sharing-factor acceptance bar (shared beats N independent fused runs by
+roughly N) is judged at full scale, where the saved (N-1) ingest scans
+are the dominant cost.
+"""
+
+import importlib.util
+import pathlib
+
+import pytest
+
+_BENCH = pathlib.Path(__file__).resolve().parents[1] / "bench.py"
+
+#: catastrophic-regression floor: the shared program doing the work of
+#: three must never cost more than ~3x the three separate programs — that
+#: would mean the shared scan stopped sharing anything (or recompiles per
+#: dispatch) and the optimizer actively hurts
+CPU_SHARING_SPEEDUP_FLOOR = 0.3
+
+
+@pytest.fixture(scope="module")
+def bench():
+    spec = importlib.util.spec_from_file_location("bench_correlated_smoke",
+                                                  _BENCH)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+@pytest.fixture(scope="module")
+def result(bench):
+    # smoke scale, one sweep: the gate must stay well under two minutes
+    # on the CPU backend; distinctive batch so compiled shapes are ours
+    return bench.correlated_windows_microbench(events=1 << 19, batch=24576,
+                                               sweeps=1)
+
+
+def test_result_carries_the_tracked_correlated_keys(result):
+    assert "error" not in result, result.get("error")
+    for key in (
+        "shared_tuples_per_sec",
+        "independent_tuples_per_sec",
+        "speedup_vs_independent",
+        "parity",
+        "shared_selected",
+        "groups_planned",
+        "sharing_factor_estimate",
+        "granule_ms",
+        "mesh",
+    ):
+        assert key in result, f"bench correlated block lost {key!r}"
+
+
+def test_sharing_optimizer_actually_selected(result):
+    """The reroute gate: translation must build ONE SharedWindowRunner for
+    the 1m/5m/1h group — parity alone would still pass if the optimizer
+    silently stopped firing and both legs ran three independent programs."""
+    assert result["groups_planned"] >= 1, "the sharing optimizer planned 0 groups"
+    assert result["shared_selected"], (
+        "build_runners no longer selects SharedWindowRunner for the "
+        "correlated group — the scenario would compare identical paths"
+    )
+    assert result["granule_ms"] == 60_000, (
+        "the 1m/5m/1h group's shared granule must be the gcd (1m); a "
+        "different granule means the slice decomposition changed"
+    )
+
+
+def test_shared_vs_independent_parity(result):
+    assert result["parity"], (
+        "shared-partial emissions diverged from the independent fused "
+        "programs — sharing must be a perf switch, never a semantics switch"
+    )
+    assert all(n > 0 for n in result["windows_emitted"]), (
+        f"some member window emitted nothing: {result['windows_emitted']}"
+    )
+
+
+def test_mesh_leg_runs_and_holds_parity(result):
+    mesh = result["mesh"]
+    assert "skipped" not in mesh, f"mesh leg skipped: {mesh}"
+    assert mesh["devices"] >= 2
+    assert mesh["parity"], (
+        "shared-partials diverged from independent programs ON THE MESH — "
+        "the sharded shared ring lost exactness"
+    )
+
+
+def test_sharing_speedup_above_catastrophic_floor(result):
+    assert result["speedup_vs_independent"] >= CPU_SHARING_SPEEDUP_FLOOR, (
+        f"shared-partial program runs {result['speedup_vs_independent']}x "
+        "the independent plans at smoke scale — the shared scan stopped "
+        "sharing (the ~sharing-factor bar itself is judged at full scale)"
+    )

@@ -1,0 +1,172 @@
+"""Global-window superscan (ISSUE-14): keyed-partial → cross-segment fold.
+
+The Q7-shaped laggard path: per-window GLOBAL aggregates hold a [S] slice
+ring of partials instead of [K, S] keyed state; each batch folds to NSB
+per-rel-slice partials (no scatter, no one-hot matrices) and a window
+fire folds its slice run into ONE scalar. These tests pin:
+
+- fold exactness vs numpy for every scatter kind, including UNBOUNDED
+  max/min (which have no keyed matmul form and previously had no fused
+  device path at all);
+- parity of the XLA scan form and the pallas kernel (interpret mode)
+  against the keyed pipeline folded over keys — the global result must
+  equal max/min/sum-over-keys of the keyed result by construction;
+- staged-input interchangeability: the global pipeline consumes the SAME
+  `idx = kid * NSB + srel` streams the keyed superscan stages;
+- snapshot/restore mid-stream.
+"""
+
+import numpy as np
+import pytest
+
+jnp = pytest.importorskip("jax.numpy")
+
+from flink_tpu.api.windowing.assigners import SlidingEventTimeWindows
+from flink_tpu.ops.segment_ops import bounded_segment_fold
+from flink_tpu.runtime.fused_window_pipeline import (
+    FusedGlobalWindowPipeline,
+    FusedWindowPipeline,
+)
+
+
+def test_bounded_segment_fold_vs_numpy():
+    rng = np.random.RandomState(0)
+    vals = rng.randn(4096).astype(np.float32) * 100
+    seg = rng.randint(-1, 4, size=4096).astype(np.int32)   # -1 drops
+    for op, ident, np_red in (("add", 0.0, np.sum),
+                              ("min", np.finfo(np.float32).max, np.min),
+                              ("max", np.finfo(np.float32).min, np.max)):
+        got = np.asarray(bounded_segment_fold(vals, jnp.asarray(seg), 4,
+                                              op, ident))
+        for s in range(4):
+            sel = vals[seg == s]
+            expect = np_red(sel) if len(sel) else ident
+            assert abs(float(got[s]) - float(expect)) < 1e-3, (op, s)
+
+
+def _batches(rng, T, B, t0, nkeys=64):
+    out, wms = [], []
+    for t in range(T):
+        keys = rng.randint(0, nkeys, size=B).astype(np.int32)
+        ts = (t0 + t) * 1000 + rng.randint(0, 1000, size=B).astype(np.int64)
+        vals = rng.randint(0, 250, size=B).astype(np.float32)
+        out.append((keys, vals, ts))
+        wms.append((t0 + t + 1) * 1000 - 500)
+    return out, wms
+
+
+def _keyed_fold(pipe, c, f, scatter):
+    c = np.asarray(c)
+    live = c > 0
+    folded = {}
+    for name, col in f.items():
+        col = np.asarray(col)
+        if scatter == "add":
+            folded[name] = float(col[live].sum())
+        elif scatter == "min":
+            folded[name] = float(col[live].min())
+        else:
+            folded[name] = float(col[live].max())
+    return int(c.sum()), folded
+
+
+@pytest.mark.parametrize("agg,scatter", [
+    ("max", "max"), ("min", "min"), ("sum", "add"), ("count", "add"),
+])
+@pytest.mark.parametrize("backend", ["xla", "pallas"])
+def test_global_matches_keyed_fold(agg, scatter, backend):
+    """Global pipeline == keyed pipeline folded over keys, both backends.
+    Unbounded max/min run on the global path without any domain_bits."""
+    assigner = SlidingEventTimeWindows.of(10_000, 2_000)
+    gp = FusedGlobalWindowPipeline(
+        assigner, agg, num_slices=16, nsb=4, chunk=1024,
+        backend=backend, pallas_interpret=(backend == "pallas"))
+    kp = FusedWindowPipeline(assigner, agg, key_capacity=64, num_slices=16,
+                             nsb=4, backend="xla", chunk=1024)
+    rng = np.random.RandomState(7)
+    state = rng.get_state()
+    got, ref = {}, {}
+    for blk in range(4):
+        b, wms = _batches(rng, 6, 1024, blk * 6)
+        for w, c, f in gp.process_superbatch(b, wms):
+            got[w.start] = (int(c),
+                            {k: float(v) for k, v in f.items()})
+    rng.set_state(state)
+    for blk in range(4):
+        b, wms = _batches(rng, 6, 1024, blk * 6)
+        for w, c, f in kp.process_superbatch(b, wms):
+            cnt, folded = _keyed_fold(kp, c, f, scatter)
+            ref[w.start] = (cnt, folded)
+    assert len(got) > 3
+    assert got.keys() == ref.keys()
+    for k in got:
+        assert got[k][0] == ref[k][0], f"count mismatch at {k}"
+        for name in got[k][1]:
+            assert abs(got[k][1][name] - ref[k][1][name]) < 1e-3, (k, name)
+
+
+def test_global_snapshot_restore_mid_stream():
+    assigner = SlidingEventTimeWindows.of(10_000, 2_000)
+
+    def run(restore_at=None):
+        gp = FusedGlobalWindowPipeline(assigner, "max", num_slices=16,
+                                       nsb=4, chunk=1024, backend="xla")
+        rng = np.random.RandomState(3)
+        got = {}
+        for blk in range(4):
+            b, wms = _batches(rng, 6, 1024, blk * 6)
+            for w, c, f in gp.process_superbatch(b, wms):
+                got[w.start] = (int(c), float(f["max"]))
+            if restore_at == blk:
+                snap = gp.snapshot()
+                gp2 = FusedGlobalWindowPipeline(
+                    assigner, "max", num_slices=16, nsb=4, chunk=1024,
+                    backend="xla")
+                gp2.restore(snap)
+                gp = gp2
+        return got
+
+    assert run(restore_at=1) == run()
+
+
+def test_global_phase_counters_count_and_preserve_results():
+    """attach_device_stats(phase_counters=True) threads the ingest/fire/
+    purge counters through the global scan carry (the keyed pipeline's
+    contract): totals come back nonzero on the planner's phase_totals,
+    and turning the counters on never changes a result."""
+    assigner = SlidingEventTimeWindows.of(10_000, 2_000)
+
+    def run(phases):
+        gp = FusedGlobalWindowPipeline(assigner, "max", num_slices=16,
+                                       nsb=4, chunk=1024, backend="xla")
+        if phases:
+            gp.attach_device_stats(None, phase_counters=True)
+        rng = np.random.RandomState(11)
+        got = {}
+        for blk in range(3):
+            b, wms = _batches(rng, 6, 1024, blk * 6)
+            for w, c, f in gp.process_superbatch(b, wms):
+                got[w.start] = (int(c), float(f["max"]))
+        return got, np.asarray(gp.phase_totals)
+
+    got_on, totals_on = run(True)
+    got_off, totals_off = run(False)
+    assert got_on == got_off
+    assert totals_on[0] == 3 * 6 * 1024          # every record ingested
+    assert totals_on[1] > 0                      # fires executed
+    assert np.all(totals_off == 0)               # off: counters never run
+
+
+def test_global_scalar_readback_shape():
+    """Fire rows are scalars, not [K] rows — the readback the Q7 rewrite
+    exists to shrink."""
+    assigner = SlidingEventTimeWindows.of(10_000, 10_000)
+    gp = FusedGlobalWindowPipeline(assigner, "max", num_slices=8, nsb=4,
+                                   chunk=1024, backend="xla")
+    rng = np.random.RandomState(0)
+    b, wms = _batches(rng, 12, 1024, 0)
+    fires = gp.process_superbatch(b, wms)
+    assert len(fires) > 0
+    for _w, c, f in fires:
+        assert np.ndim(c) == 0
+        assert all(np.ndim(v) == 0 for v in f.values())

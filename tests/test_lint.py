@@ -98,6 +98,14 @@ def test_path_scoped_rules_are_not_vacuous():
     assert index.get("graph/fusion.py") is not None, (
         "graph/fusion.py missing — the whole-graph fusion planner moved "
         "and ARCH001's graph-layer ban no longer covers it")
+    # the sharing optimizer must stay in graph/ under the same ban: a
+    # SharedWindowPlan is pure data about correlated window siblings the
+    # executor consumes — a runtime import here would invert the
+    # translation DAG exactly like a fusion-planner one
+    assert index.get("graph/window_sharing.py") is not None, (
+        "graph/window_sharing.py missing — the Factor-Windows sharing "
+        "optimizer moved and ARCH001's graph-layer ban no longer covers "
+        "it")
     # the SQL planner must stay REGISTERED with its runtime AND api bans:
     # it emits transformations the executor consumes — an executor (or
     # fluent-api) import here inverts the translation DAG, and a deleted

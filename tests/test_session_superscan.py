@@ -1,0 +1,265 @@
+"""Fused session superspan (ISSUE-14): T staged ingest steps + in-scan
+gap-merges as ONE device dispatch (ops/superscan.make_session_superscan).
+
+Sessions coalesce inside the scan carry — the touching-session merge
+semantics of EventTimeSessionWindows.merge_windows — and never round-trip
+to host per watermark. These tests pin:
+
+- exact parity of the fused superspan against BOTH the per-step device
+  path (process_batch_staged + process_watermark) and a host numpy
+  sessionizer, across merge cadences;
+- parity under zipf(1.0) KEY SKEW vs the host reference — skewed keys
+  maximize concurrent open sessions per merge scan, the hard case for
+  in-scan merging (hot keys hold fragments in nearly every slice of the
+  span, so every merge's [K]-wide scan carries the most live state);
+- the geometry fallback (emission slots past the bound) replays through
+  the exact per-step path with identical results;
+- mixing guards and deferred-resolution bookkeeping across superspans.
+"""
+
+import numpy as np
+import pytest
+
+jnp = pytest.importorskip("jax.numpy")
+
+from flink_tpu.api.windowing.assigners import EventTimeSessionWindows
+from flink_tpu.runtime.tpu_session_operator import TpuSessionWindowOperator
+
+GAP = 2000
+S = 64
+
+
+def _numpy_sessionize(keys, ts, vals, gap=GAP):
+    order = np.lexsort((ts, keys))
+    k, t, v = keys[order], ts[order], vals[order]
+    brk = np.empty(len(k), dtype=bool)
+    brk[0] = True
+    brk[1:] = (k[1:] != k[:-1]) | (t[1:] - t[:-1] > gap)
+    starts = np.flatnonzero(brk)
+    sums = np.add.reduceat(v, starts)
+    ends = np.r_[starts[1:], len(k)] - 1
+    return {(int(k[s]), int(t[s]), int(t[e]) + gap): float(sv)
+            for s, e, sv in zip(starts, ends, sums)}
+
+
+def _stage(ks, ts, vs):
+    kid = np.stack(ks).astype(np.int32)
+    s_abs = np.stack(ts) // GAP
+    spos = (s_abs % S).astype(np.int32)
+    rel = (np.stack(ts) - s_abs * GAP).astype(np.int32)
+    vals = np.stack(vs).astype(np.float32)
+    bounds = [(int(s_abs[t].min()), int(s_abs[t].max()))
+              for t in range(len(ks))]
+    return (jnp.asarray(kid), jnp.asarray(spos), jnp.asarray(rel),
+            jnp.asarray(vals), bounds)
+
+
+def _rotating_stream(rng, T, B, t0, key_fn):
+    ks, ts, vs = [], [], []
+    for t in range(T):
+        tt = t0 + t
+        keys = key_fn(rng, tt, B)
+        base = tt * 655 + ((np.arange(1, B + 1) * 655) // B)
+        jit = rng.integers(0, 501, size=B)
+        tss = np.maximum(base - jit, 0)
+        vals = rng.integers(0, 256, size=B).astype(np.float32)
+        ks.append(keys)
+        ts.append(tss)
+        vs.append(vals)
+    return ks, ts, vs
+
+
+def _uniform_keys(rng, tt, B):
+    active = (tt >> 2) & 3
+    return (rng.integers(0, 256, size=B) | (active << 8)).astype(np.int64)
+
+
+def _zipf_keys(rng, tt, B):
+    """zipf(1.0)-shaped keys over 256 ranks via inverse-cdf on uniform
+    draws (bounded, deterministic), hot ranks permuted per rotation so the
+    skew lands on different dense ids over time."""
+    n = 256
+    w = 1.0 / np.arange(1, n + 1)
+    cdf = np.cumsum(w) / w.sum()
+    u = rng.random(B)
+    ranks = np.searchsorted(cdf, u)
+    active = (tt >> 2) & 3
+    perm = np.argsort((np.arange(n) * 2654435761 + active) % n)
+    return (perm[ranks] | (active << 8)).astype(np.int64)
+
+
+def _mk(defer=True, slots=None):
+    op = TpuSessionWindowOperator(
+        EventTimeSessionWindows.with_gap(GAP), "sum",
+        key_capacity=1 << 10, num_slices=S, defer_emissions=defer)
+    if slots is not None:
+        op.MAX_SUPERSPAN_SLOTS = slots
+    return op
+
+
+def _drive_superspan(spans, merge_every, op=None):
+    op = op or _mk()
+    for sp, (ks, ts, vs) in enumerate(spans):
+        T = len(ks)
+        kid, spos, rel, vals, bounds = _stage(ks, ts, vs)
+        merge_wms = [
+            ((sp * T + t + 1) * 655 - 1000)
+            if (t + 1) % merge_every == 0 else None
+            for t in range(T)
+        ]
+        op.process_superspan_staged(kid, spos, rel, vals, bounds, merge_wms)
+    op.process_watermark(1 << 59)
+    return {(int(k), w.start, w.end): float(r)
+            for (k, w, r, _t) in op.drain_output()}
+
+
+def _drive_per_step(spans, merge_every):
+    op = _mk(defer=False)
+    for sp, (ks, ts, vs) in enumerate(spans):
+        T = len(ks)
+        kid, spos, rel, vals, bounds = _stage(ks, ts, vs)
+        for t in range(T):
+            op.process_batch_staged(kid[t], spos[t], rel[t], vals[t],
+                                    *bounds[t])
+            if (t + 1) % merge_every == 0:
+                op.process_watermark((sp * T + t + 1) * 655 - 1000)
+    op.process_watermark(1 << 59)
+    return {(int(k), w.start, w.end): float(r)
+            for (k, w, r, _t) in op.drain_output()}
+
+
+def _expect(spans):
+    allk = np.concatenate([k for ks, _, _ in spans for k in ks])
+    allt = np.concatenate([t for _, ts, _ in spans for t in ts])
+    allv = np.concatenate([v for _, _, vs in spans for v in vs])
+    return _numpy_sessionize(allk, allt, allv)
+
+
+def _assert_close(got, expect):
+    assert len(got) > 0
+    assert got.keys() == expect.keys()
+    for k in got:
+        assert abs(got[k] - expect[k]) <= 1e-3 * max(1.0, abs(expect[k])), k
+
+
+@pytest.mark.parametrize("merge_every", [4, 16])
+def test_superspan_parity_vs_per_step_and_numpy(merge_every):
+    rng = np.random.default_rng(11)
+    spans = [_rotating_stream(rng, 16, 384, sp * 16, _uniform_keys)
+             for sp in range(2)]
+    got = _drive_superspan(spans, merge_every)
+    ref = _drive_per_step(spans, merge_every)
+    assert got == ref
+    _assert_close(got, _expect(spans))
+
+
+def test_superspan_parity_under_zipf_skew():
+    """ISSUE-14 satellite: zipf(1.0) key skew vs the host reference. Hot
+    keys stay open across nearly every merge of the dispatch — the
+    maximum concurrent-open-sessions case for the in-scan merge carry."""
+    rng = np.random.default_rng(29)
+    spans = [_rotating_stream(rng, 16, 640, sp * 16, _zipf_keys)
+             for sp in range(2)]
+    got = _drive_superspan(spans, 8)
+    _assert_close(got, _expect(spans))
+    # the skew is real: the hottest key must own well above its uniform
+    # share of sessions' records (the test would silently weaken if the
+    # generator degraded to uniform)
+    allk = np.concatenate([k for ks, _, _ in spans for k in ks])
+    top = np.bincount(allk % 256).max() / len(allk)
+    assert top > 0.05, f"hottest rank owns {top:.3f} — not zipf-shaped"
+
+
+def test_superspan_slot_fallback_replays_exactly():
+    """A superspan whose emission-slot bound exceeds the fused cap replays
+    through the exact per-step path — same results, by the same operator
+    call."""
+    rng = np.random.default_rng(5)
+    spans = [_rotating_stream(rng, 16, 256, sp * 16, _uniform_keys)
+             for sp in range(2)]
+    got_fused = _drive_superspan(spans, 8, op=_mk())
+    got_fallback = _drive_superspan(spans, 8, op=_mk(slots=1))  # force replay
+    ref = _drive_per_step(spans, 8)
+    assert got_fused == ref
+    assert got_fallback == ref
+
+
+def test_superspan_interleaves_with_plain_staged_ingest():
+    """A superspan followed by per-step staged ingest + watermark on the
+    same operator keeps bounds/bookkeeping consistent."""
+    rng = np.random.default_rng(17)
+    spans = [_rotating_stream(rng, 16, 256, 0, _uniform_keys)]
+    op = _mk()
+    ks, ts, vs = spans[0]
+    kid, spos, rel, vals, bounds = _stage(ks, ts, vs)
+    merge_wms = [(t + 1) * 655 - 1000 if (t + 1) % 8 == 0 else None
+                 for t in range(16)]
+    op.process_superspan_staged(kid, spos, rel, vals, bounds, merge_wms)
+    tail = _rotating_stream(rng, 8, 256, 16, _uniform_keys)
+    k2, s2, r2, v2, b2 = _stage(*tail)
+    for t in range(8):
+        op.process_batch_staged(k2[t], s2[t], r2[t], v2[t], *b2[t])
+        if (t + 1) % 4 == 0:
+            op.process_watermark((16 + t + 1) * 655 - 1000)
+    op.process_watermark(1 << 59)
+    got = {(int(k), w.start, w.end): float(r)
+           for (k, w, r, _t) in op.drain_output()}
+    _assert_close(got, _expect([spans[0], tail]))
+
+
+def test_watermark_over_pending_superspan_syncs_before_dispatch():
+    """A merge scan (or per-step ingest) must never be dispatched on top
+    of an unresolved fused superspan — the entry's resolve may take the
+    defensive overflow-replay path, which discards the fused device
+    lineage wholesale; anything dispatched on it would resolve against
+    the discarded lineage (duplicate emissions, stale bounds) or be lost
+    with it. process_watermark and process_batch_staged therefore sync a
+    pending superspan entry first; with the overflow flag forced, the
+    whole flow must still match the host reference exactly."""
+    rng = np.random.default_rng(23)
+    span0 = _rotating_stream(rng, 16, 256, 0, _uniform_keys)
+    kid, spos, rel, vals, bounds = _stage(*span0)
+    merge_wms = [(t + 1) * 655 - 1000 if (t + 1) % 8 == 0 else None
+                 for t in range(16)]
+    op = _mk()
+    op.process_superspan_staged(kid, spos, rel, vals, bounds, merge_wms)
+    entry = next(e for e in op._pending if "superspan" in e)
+    arr = np.asarray(entry["packed"]).copy()
+    arr[-1, 2] = 1            # force the defensive in-dispatch overflow
+    entry["packed"] = arr
+    op.process_watermark(14_000)
+    # the superspan entry resolved (overflow -> replay) BEFORE the merge
+    # scan dispatched; only plain merge entries may remain in flight
+    assert not any("superspan" in e for e in op._pending)
+    tail = _rotating_stream(rng, 8, 256, 32, _uniform_keys)
+    k2, s2, r2, v2, b2 = _stage(*tail)
+    for t in range(8):
+        op.process_batch_staged(k2[t], s2[t], r2[t], v2[t], *b2[t])
+    op.process_watermark(1 << 59)
+    out = op.drain_output()
+    keys = [(int(k), w.start, w.end) for (k, w, _r, _t) in out]
+    assert len(keys) == len(set(keys)), "duplicate session emissions"
+    got = {kk: float(r) for kk, (_k, _w, r, _t) in zip(keys, out)}
+    _assert_close(got, _expect([span0, tail]))
+
+
+def test_superspan_refuses_keydict_mixing():
+    op = _mk()
+    op.process_batch(np.asarray([5]), np.asarray([1.0], np.float32),
+                     np.asarray([1000], np.int64))
+    rng = np.random.default_rng(1)
+    ks, ts, vs = _rotating_stream(rng, 8, 64, 0, _uniform_keys)
+    kid, spos, rel, vals, bounds = _stage(ks, ts, vs)
+    with pytest.raises(ValueError, match="cannot be mixed"):
+        op.process_superspan_staged(kid, spos, rel, vals, bounds,
+                                    [None] * 7 + [7 * 655])
+
+
+def test_superspan_requires_a_merge():
+    op = _mk()
+    rng = np.random.default_rng(1)
+    ks, ts, vs = _rotating_stream(rng, 4, 64, 0, _uniform_keys)
+    kid, spos, rel, vals, bounds = _stage(ks, ts, vs)
+    with pytest.raises(ValueError, match="at least one merge"):
+        op.process_superspan_staged(kid, spos, rel, vals, bounds,
+                                    [None] * 4)

@@ -1,0 +1,265 @@
+"""Direct unit tests for the Factor-Windows sharing optimizer
+(graph/window_sharing.py, ISSUE-14): grouping by correlation signature,
+the exact-decomposition / bounded-granule refusals, common-chain lifting,
+and shared-vs-independent execution parity at the build_runners level.
+
+The bench gate (tests/test_bench_correlated.py) pins the 1m/5m/1h
+scenario end to end; these tests pin the planner's decision table
+directly so a refusal-condition regression is attributed to the exact
+rule, not a scenario-level parity diff.
+"""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from flink_tpu.api.datastream import StreamExecutionEnvironment
+from flink_tpu.api.windowing.assigners import (
+    SlidingEventTimeWindows,
+    TumblingEventTimeWindows,
+)
+from flink_tpu.config import Configuration, ExecutionOptions
+from flink_tpu.connectors.source import Batch, DataGeneratorSource
+from flink_tpu.core.watermarks import WatermarkStrategy
+from flink_tpu.graph.fusion import plan_device_chains
+from flink_tpu.graph.transformation import plan
+from flink_tpu.graph.window_sharing import (
+    MAX_SHARED_SPW,
+    describe,
+    plan_shared_windows,
+)
+from flink_tpu.runtime.executor import build_runners
+
+
+def _source(n=3000, keys=7, span_ms=40_000):
+    def gen(idx):
+        k = (idx * 2654435761) % keys
+        col = np.stack([k, idx % 5], axis=1).astype(np.float32)
+        ts = 1_000 + idx * span_ms // n
+        return Batch(col, ts.astype(np.int64))
+
+    return DataGeneratorSource(gen, n)
+
+
+def _env(assigners, *, shared=True, aggregates=None, second_consumer=False,
+         offsets=None, n=3000, batch=512):
+    cfg = Configuration()
+    cfg.set(ExecutionOptions.BATCH_SIZE, batch)
+    cfg.set(ExecutionOptions.KEY_CAPACITY, 16)
+    cfg.set(ExecutionOptions.SHARED_PARTIALS, shared)
+    cfg.set(ExecutionOptions.COLUMNAR_OUTPUT, False)
+    env = StreamExecutionEnvironment.get_execution_environment(cfg)
+    ds = env.from_source(
+        _source(n=n),
+        watermark_strategy=WatermarkStrategy.for_bounded_out_of_orderness(0),
+    )
+    ds = ds.filter(lambda col: col[:, 1] < 4.5, traceable=True)
+    if second_consumer:
+        ds.map(lambda col: col[:, 1], traceable=True).collect()
+    keyed = ds.key_by(lambda col: col[:, 0].astype(jnp.int32),
+                      traceable=True)
+    aggregates = aggregates or ["count"] * len(assigners)
+    sinks = [
+        keyed.window(a).aggregate(agg).collect()
+        for a, agg in zip(assigners, aggregates)
+    ]
+    return env, cfg, sinks
+
+
+def _plans(env):
+    graph = plan(env._sinks)
+    chain_plans, _absorbed = plan_device_chains(graph)
+    return graph, chain_plans, plan_shared_windows(graph, chain_plans)
+
+
+# ---------------------------------------------------------------------------
+# grouping + decomposition
+# ---------------------------------------------------------------------------
+
+def test_correlated_tumbling_siblings_form_one_group():
+    env, _cfg, _ = _env([TumblingEventTimeWindows.of(1_000),
+                         TumblingEventTimeWindows.of(5_000),
+                         TumblingEventTimeWindows.of(10_000)])
+    _g, _cp, sw = _plans(env)
+    assert len(sw) == 1
+    p = sw[0]
+    assert len(p.members) == 3
+    assert p.granule_ms == 1_000
+    assert p.member_spws == [1, 5, 10]
+    # one scan instead of three: the estimate is ~n for tumbling members
+    # (fire density is tiny), and always strictly between 1 and n
+    assert 2.5 < p.estimated_sharing_factor <= 3.0
+    # the common filter chain feeds ONLY the group: lifted into the plan
+    assert p.absorbed is not None
+    assert [t.kind for t in p.transforms] == ["filter"]
+    assert "shared-windows[0]" in describe(sw)
+
+
+def test_sliding_member_decomposes_on_group_gcd():
+    env, _cfg, _ = _env([SlidingEventTimeWindows.of(10_000, 4_000),
+                         TumblingEventTimeWindows.of(60_000)])
+    _g, _cp, sw = _plans(env)
+    assert len(sw) == 1
+    assert sw[0].granule_ms == 2_000     # gcd(gcd(10s,4s)=2s, 60s)
+    assert sw[0].member_spws == [5, 30]
+
+
+def test_mixed_offsets_refuse_the_group():
+    env, _cfg, _ = _env([TumblingEventTimeWindows.of(1_000),
+                         TumblingEventTimeWindows.of(5_000, offset_ms=500)])
+    _g, _cp, sw = _plans(env)
+    assert sw == []
+
+
+def test_different_aggregates_split_signatures():
+    """sum siblings group together; the count member stays independent."""
+    env, _cfg, _ = _env(
+        [TumblingEventTimeWindows.of(1_000),
+         TumblingEventTimeWindows.of(5_000),
+         TumblingEventTimeWindows.of(5_000)],
+        aggregates=["sum", "count", "sum"],
+    )
+    _g, _cp, sw = _plans(env)
+    assert len(sw) == 1
+    assert len(sw[0].members) == 2
+    names = {t.config["aggregate"] for t in sw[0].terminals}
+    assert names == {"sum"}
+
+
+def test_pathological_granule_ratio_is_refused():
+    """A member needing more slices per window than MAX_SHARED_SPW on the
+    shared granule costs more in fire-time gathers than sharing saves."""
+    fine = SlidingEventTimeWindows.of(2_000, 1_001)      # gcd granule 1ms
+    coarse = TumblingEventTimeWindows.of(10_000_000)     # 10M slices at 1ms
+    assert 10_000_000 > MAX_SHARED_SPW
+    env, _cfg, _ = _env([fine, coarse])
+    _g, _cp, sw = _plans(env)
+    assert sw == []
+
+
+def test_single_member_is_not_a_group():
+    env, _cfg, _ = _env([TumblingEventTimeWindows.of(1_000)])
+    _g, _cp, sw = _plans(env)
+    assert sw == []
+
+
+def test_second_chain_consumer_blocks_the_lift_not_the_group():
+    """An extra consumer outside the group pins the chain on its own
+    runner; the siblings still share, consuming the chain's output edge."""
+    env, _cfg, _ = _env([TumblingEventTimeWindows.of(1_000),
+                         TumblingEventTimeWindows.of(5_000)],
+                        second_consumer=True)
+    _g, _cp, sw = _plans(env)
+    assert len(sw) == 1
+    assert sw[0].absorbed is None
+    assert sw[0].transforms == []
+
+
+# ---------------------------------------------------------------------------
+# build_runners selection + execution parity
+# ---------------------------------------------------------------------------
+
+def _run(assigners, shared, n=3000):
+    env, cfg, sinks = _env(assigners, shared=shared, n=n)
+    runners, _ = build_runners(plan(env._sinks), cfg)
+    kinds = sorted(type(r).__name__ for r in runners)
+    env.execute()
+    return kinds, [sorted((int(k), float(v)) for k, v in s.results)
+                   for s in sinks]
+
+
+@pytest.mark.parametrize("assigners_fn", [
+    lambda: [TumblingEventTimeWindows.of(1_000),
+             TumblingEventTimeWindows.of(5_000),
+             TumblingEventTimeWindows.of(10_000)],
+    lambda: [SlidingEventTimeWindows.of(10_000, 4_000),
+             TumblingEventTimeWindows.of(60_000)],
+], ids=["tumbling-3", "sliding+tumbling"])
+def test_shared_vs_independent_parity(assigners_fn):
+    """Sharing is a perf switch, never a semantics switch: per-member
+    results are byte-identical with the optimizer on and off, and the
+    runner kinds prove which path actually ran."""
+    kinds_on, rows_on = _run(assigners_fn(), shared=True)
+    kinds_off, rows_off = _run(assigners_fn(), shared=False)
+    n = len(assigners_fn())
+    assert kinds_on.count("SharedWindowRunner") == 1
+    assert kinds_on.count("SharedWindowSiblingRunner") == n - 1
+    assert kinds_off.count("DeviceChainRunner") == n
+    assert "SharedWindowRunner" not in kinds_off
+    for a, b in zip(rows_on, rows_off):
+        assert len(a) > 0
+        assert a == b
+
+
+def test_columnar_output_record_shape_matches_independent():
+    """Columnar-output sinks receive the SAME record shape with sharing on
+    and off (the bare device triple, not a (None, triple) wrapper) — the
+    perf-switch contract covers the wire format, not just the values."""
+
+    def run_columnar(shared):
+        cfg = Configuration()
+        cfg.set(ExecutionOptions.BATCH_SIZE, 512)
+        cfg.set(ExecutionOptions.KEY_CAPACITY, 16)
+        cfg.set(ExecutionOptions.SHARED_PARTIALS, shared)
+        cfg.set(ExecutionOptions.COLUMNAR_OUTPUT, True)
+        env = StreamExecutionEnvironment.get_execution_environment(cfg)
+        ds = env.from_source(
+            _source(n=2000),
+            watermark_strategy=WatermarkStrategy
+            .for_bounded_out_of_orderness(0),
+        )
+        keyed = ds.key_by(lambda col: col[:, 0].astype(jnp.int32),
+                          traceable=True)
+        sinks = [keyed.window(TumblingEventTimeWindows.of(sz))
+                 .aggregate("count").collect()
+                 for sz in (1_000, 5_000)]
+        env.execute()
+        return sinks
+
+    def shapes(sinks):
+        out = []
+        for s in sinks:
+            assert len(s.results) > 0
+            for rec in s.results:
+                out.append((type(rec).__name__, len(rec),
+                            type(rec[0]).__name__))
+        return sorted(set(out))
+
+    assert shapes(run_columnar(True)) == shapes(run_columnar(False))
+
+
+def test_marker_fans_out_to_every_member_downstream():
+    """Latency markers fan out to EVERY member's downstream, like
+    watermarks and emissions — sharing must not blind the sibling sinks'
+    latency histograms (the perf-switch contract covers the metrics
+    surface too)."""
+    env, cfg, _sinks = _env([TumblingEventTimeWindows.of(1_000),
+                             TumblingEventTimeWindows.of(5_000)])
+    runners, _ = build_runners(plan(env._sinks), cfg)
+    shared = next(r for r in runners
+                  if type(r).__name__ == "SharedWindowRunner")
+    assert len(shared.member_runners) == 2
+    seen = []
+
+    class Spy:
+        def __init__(self, i):
+            self.i = i
+
+        def on_marker(self, wall_ms):
+            seen.append((self.i, wall_ms))
+
+    for i, r in enumerate(shared.member_runners):
+        r.downstream = Spy(i)
+    shared.on_marker(42.0)
+    assert seen == [(0, 42.0), (1, 42.0)]
+
+
+def test_refused_group_runs_independent_and_matches():
+    """A refused group (mixed offsets) silently keeps per-member fused
+    programs — same results as sharing explicitly off."""
+    mk = lambda: [TumblingEventTimeWindows.of(1_000),               # noqa: E731
+                  TumblingEventTimeWindows.of(5_000, offset_ms=500)]
+    kinds_on, rows_on = _run(mk(), shared=True)
+    assert "SharedWindowRunner" not in kinds_on
+    _kinds_off, rows_off = _run(mk(), shared=False)
+    assert rows_on == rows_off
