@@ -126,6 +126,53 @@ def hbm_gbps(events: int, elapsed_s: float, *, batch: int,
     return bytes_moved / max(elapsed_s, 1e-9) / 1e9
 
 
+# ---------------------------------------------------------------------------
+# zipf key sampling — THE stateless skewed-key sampler, single-sourced:
+# every skewed bench leg (multichip, millikey, the skew matrix) draws keys
+# through this, so "zipf(1.0)" means the same distribution in every
+# scenario and skew numbers are comparable across the whole artifact
+# ---------------------------------------------------------------------------
+
+import functools as _functools
+
+
+@_functools.lru_cache(maxsize=2)
+def zipf_bounded_cdf(num_keys: int, s: float = 1.0):
+    """Bounded zipf cdf over ranks 1..num_keys: p_k ~ 1/k^s, normalized.
+    np.random.zipf is unbounded and undefined at s=1.0, so every skewed
+    leg inverse-cdf samples this instead. Cached small: the millikey
+    vocabulary's cdf is ~80 MB and two scenarios never need more."""
+    ranks = np.arange(1, int(num_keys) + 1, dtype=np.float64)
+    cdf = np.cumsum(1.0 / ranks ** float(s))
+    cdf /= cdf[-1]
+    cdf.setflags(write=False)
+    return cdf
+
+
+def zipf_keys(idx: np.ndarray, num_keys: int, s: float = 1.0,
+              hot_perm: Optional[np.ndarray] = None) -> np.ndarray:
+    """STATELESS bounded-zipf key draw for element indices `idx`.
+
+    - the uniform variate is a splitmix64-style hash of the element index,
+      NOT a chunk-seeded rng: host oracles re-generate the stream under
+      different chunk boundaries, and a per-chunk seed would diverge;
+    - rank -> key id is identity by default (key 0 is the hottest), or
+      `hot_perm` (any permutation of [0, num_keys)) to place the hot
+      RANKS deliberately — spread them to model independent hot tenants,
+      or cluster them into one device's key range to model the adjacent
+      hot-key-group shape the skew rebalancer exists to fix."""
+    idx = np.asarray(idx)
+    z = (idx.astype(np.uint64) + np.uint64(0x9E3779B97F4A7C15))
+    z = (z ^ (z >> np.uint64(30))) * np.uint64(0xBF58476D1CE4E5B9)
+    z = (z ^ (z >> np.uint64(27))) * np.uint64(0x94D049BB133111EB)
+    z = z ^ (z >> np.uint64(31))
+    u = z.astype(np.float64) / 2.0 ** 64
+    rank = np.searchsorted(zipf_bounded_cdf(num_keys, s), u)
+    if hot_perm is not None:
+        rank = np.asarray(hot_perm)[rank]
+    return rank.astype(np.int64)
+
+
 def step_bounds(t: int, B: int, slide_ms: int = SLIDE_MS):
     """Inclusive (smin, smax) slice bounds of step t's records."""
     smin = max((t * STEP_MS + STEP_MS // B - OOO_MS) // slide_ms, 0)
@@ -2244,20 +2291,15 @@ def multichip_microbench(events: Optional[int] = None,
         return {"error": f"no usable mesh ({avail} device(s), "
                          f"{num_keys} keys)", "devices": int(n)}
 
-    # bounded zipf over the key vocabulary: p_k ~ 1/k^s, inverse-cdf
-    # sampled — np.random.zipf is unbounded and undefined at s=1.0
-    ranks = np.arange(1, num_keys + 1, dtype=np.float64)
-    zipf_cdf = np.cumsum(1.0 / ranks ** zipf_s)
-    zipf_cdf /= zipf_cdf[-1]
-    # hot ranks spread over the key-id space so the hot key-GROUPS (and
-    # with contiguous ranges, the hot DEVICES) are deterministic
+    # zipf keys via the single-sourced stateless sampler (zipf_keys), hot
+    # ranks spread over the key-id space so the hot key-GROUPS (and with
+    # contiguous ranges, the hot DEVICES) are deterministic
     perm = np.random.default_rng(11).permutation(num_keys)
 
     def source(count, skewed: bool):
         def gen(idx):
             if skewed:
-                rng = np.random.default_rng(int(idx[0]) * 9176 + 13)
-                camp = perm[np.searchsorted(zipf_cdf, rng.random(len(idx)))]
+                camp = zipf_keys(idx, num_keys, zipf_s, hot_perm=perm)
             else:
                 camp = (idx * 2654435761) % num_keys
             etype = idx % 3
@@ -2458,27 +2500,12 @@ def millikey_microbench(events: Optional[int] = None,
     num_keys = num_keys or int(
         os.environ.get("BENCH_MILLIKEY_KEYS", str(10_000_000)))
 
-    # bounded zipf over the full vocabulary (the multichip pattern:
-    # inverse-cdf, hot ranks permuted over the id space)
-    ranks = np.arange(1, num_keys + 1, dtype=np.float64)
-    zipf_cdf = np.cumsum(1.0 / ranks ** zipf_s)
-    zipf_cdf /= zipf_cdf[-1]
-
     def keys_of(idx: np.ndarray, n_keys: int, skewed: bool) -> np.ndarray:
         if skewed:
-            # STATELESS uniform draw per element (splitmix-style hash):
-            # the host oracle re-generates the stream under different
-            # chunk boundaries, so a chunk-seeded rng would diverge
-            z = (idx.astype(np.uint64) + np.uint64(0x9E3779B97F4A7C15))
-            z = (z ^ (z >> np.uint64(30))) * np.uint64(0xBF58476D1CE4E5B9)
-            z = (z ^ (z >> np.uint64(27))) * np.uint64(0x94D049BB133111EB)
-            z = z ^ (z >> np.uint64(31))
-            u = z.astype(np.float64) / 2.0 ** 64
-            if n_keys == num_keys:
-                return np.searchsorted(zipf_cdf, u).astype(np.int64)
-            r = np.arange(1, n_keys + 1, dtype=np.float64)
-            cdf = np.cumsum(1.0 / r ** zipf_s)
-            return np.searchsorted(cdf / cdf[-1], u).astype(np.int64)
+            # the single-sourced STATELESS sampler (zipf_keys): the host
+            # oracle re-generates the stream under different chunk
+            # boundaries, so a chunk-seeded rng would diverge
+            return zipf_keys(idx, n_keys, zipf_s)
         return ((idx * 2654435761) % n_keys).astype(np.int64)
 
     def ts_of(idx: np.ndarray, count: int) -> np.ndarray:
@@ -2712,6 +2739,307 @@ def run_millikey_child(timeout_s: float = 600.0) -> dict:
     return _run_cpu_child('millikey', timeout_s, force_mesh=True)
 
 
+def skew_matrix_microbench(events: Optional[int] = None,
+                           batch: int = 2048,
+                           num_keys: Optional[int] = None,
+                           span_event_ms: int = 64_000,
+                           zipf_s: float = 1.0,
+                           sweeps: int = 1) -> dict:
+    """Skew scenario matrix (ISSUE-15, ROADMAP 4c): the PDSP-Bench
+    parallelism x workload x skew reporting grid over the fused
+    DataStream chain, plus the skew-ADAPTIVE flagship leg.
+
+      - `cells`: every (workload, parallelism, skew) combination —
+        workloads ysb_count (filter+keyBy+sliding count) and ysb_sum
+        (same chain, value aggregation), parallelism 1 and the mesh,
+        keys uniform and zipf(`zipf_s`) via the single-sourced stateless
+        sampler (`zipf_keys`) — tuples/s per cell, with EXACT mesh vs
+        single-chip row parity per (workload, skew);
+      - the zipf leg's hot ranks are deliberately CLUSTERED into device
+        0's key-groups (one hot key per group, so the placement is
+        pathological but splittable) — the adjacent-hot-keys shape the
+        static owner function cannot fix and the rebalancer exists to;
+      - `combine_parity`: parallel.mesh.local-combine on vs off, byte
+        parity (the perf-switch-not-semantics-switch proof at bench
+        scale), plus `local_combine_active` pinning the combiner
+        actually engaged;
+      - the ADAPTIVE leg (`adaptive` block): the mesh zipf job with
+        local-combine + skew-rebalance enabled on the in-process job
+        master — `rebalances` (must be > 0 under this traffic),
+        `post_rebalance_mesh_load_skew` vs `static_mesh_load_skew`, and
+        `skewed_uniform_ratio` = adaptive zipf tput / uniform tput (the
+        >= 0.8 acceptance bar is judged on real TPU hardware; the CPU
+        mesh gates only catastrophic regressions).
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from flink_tpu.api.datastream import StreamExecutionEnvironment
+    from flink_tpu.api.windowing.assigners import SlidingEventTimeWindows
+    from flink_tpu.config import (
+        Configuration,
+        ExecutionOptions,
+        ObservabilityOptions,
+        ParallelOptions,
+    )
+    from flink_tpu.connectors.source import Batch, DataGeneratorSource
+    from flink_tpu.core.watermarks import WatermarkStrategy
+    from flink_tpu.graph.transformation import plan
+    from flink_tpu.parallel.mesh import usable_mesh_size
+    from flink_tpu.parallel.routing import choose_key_groups
+    from flink_tpu.runtime.executor import build_runners
+
+    # half the multichip scale by default: the matrix runs 8 timed cells
+    # + 2 adaptive legs + 14 parity runs, and the child must leave the
+    # parent's budget room for the TPU attempt
+    events = events or int(
+        os.environ.get("BENCH_SKEW_EVENTS", str(1 << 19)))
+    num_keys = num_keys or NUM_KEYS
+    avail = len(jax.devices())
+    n = usable_mesh_size(0, avail, num_keys)
+    if n < 2:
+        return {"error": f"no usable mesh ({avail} device(s), "
+                         f"{num_keys} keys)", "devices": int(n)}
+
+    # adversarial hot placement: the top G/n zipf ranks land one per
+    # key-group of DEVICE 0's contiguous range (kids 0, Kg, 2*Kg, ...) —
+    # maximally imbalanced under static routing, fully splittable by a
+    # key-group rebalance; the tail fills the rest of the id space
+    G = choose_key_groups(num_keys, n)
+    kg = num_keys // G
+    hot_ids = np.arange(G // n, dtype=np.int64) * kg
+    rest = np.setdiff1d(np.arange(num_keys, dtype=np.int64), hot_ids)
+    perm = np.concatenate(
+        [hot_ids, np.random.default_rng(7).permutation(rest)])
+
+    def keys_of(idx, skewed: bool):
+        if skewed:
+            return zipf_keys(idx, num_keys, zipf_s, hot_perm=perm)
+        return ((idx * 2654435761) % num_keys).astype(np.int64)
+
+    def source(count, skewed: bool):
+        def gen(idx):
+            camp = keys_of(idx, skewed)
+            etype = idx % 3
+            col = np.stack([camp, etype], axis=1).astype(np.float32)
+            ts = 10_000 + idx * span_event_ms // count
+            return Batch(col, ts.astype(np.int64))
+
+        return DataGeneratorSource(gen, count)
+
+    t_filter = lambda col: col[:, 1] < 2.5                    # noqa: E731
+    t_key = lambda col: col[:, 0].astype(jnp.int32)           # noqa: E731
+    t_val = lambda col: col[:, 1]                             # noqa: E731
+    WORKLOADS = ("ysb_count", "ysb_sum")
+
+    def build(count, mesh_on, *, skewed, workload, combine=False,
+              rebalance=False, columnar=True, stats=False):
+        cfg = Configuration()
+        cfg.set(ExecutionOptions.CHAIN_FUSION, True)
+        cfg.set(ExecutionOptions.BATCH_SIZE, batch)
+        cfg.set(ExecutionOptions.KEY_CAPACITY, num_keys)
+        cfg.set(ExecutionOptions.COLUMNAR_OUTPUT, columnar)
+        # dispatch every 8 steps: the rebalancer (and the key-stats fold
+        # it reads) needs device-resident state EARLY in the run, and
+        # every leg shares the geometry so the ratio isolates traffic
+        # shape, not dispatch cadence
+        cfg.set(ExecutionOptions.SUPERBATCH_STEPS, 8)
+        cfg.set(ParallelOptions.MESH_ENABLED, mesh_on)
+        if mesh_on:
+            cfg.set(ParallelOptions.MESH_DEVICES, n)
+        cfg.set(ParallelOptions.MESH_LOCAL_COMBINE, combine)
+        cfg.set(ParallelOptions.MESH_SKEW_REBALANCE, rebalance)
+        cfg.set(ParallelOptions.MESH_REBALANCE_SKEW_THRESHOLD, 1.2)
+        cfg.set(ParallelOptions.MESH_REBALANCE_INTERVAL_MS, 0)
+        cfg.set(ObservabilityOptions.DEVICE_STATS_ENABLED, stats)
+        if stats:
+            cfg.set(ObservabilityOptions.DEVICE_KEY_STATS_INTERVAL_MS, 0)
+        env = StreamExecutionEnvironment(cfg)
+        ds = env.from_source(
+            source(count, skewed),
+            watermark_strategy=WatermarkStrategy.for_bounded_out_of_orderness(0),
+        )
+        chain = (ds.filter(t_filter, traceable=True)
+                   .key_by(t_key, traceable=True)
+                   .window(SlidingEventTimeWindows.of(WINDOW_MS, SLIDE_MS)))
+        if workload == "ysb_sum":
+            sink = chain.aggregate("sum", t_val,
+                                   value_traceable=True).collect()
+        else:
+            sink = chain.aggregate("count").collect()
+        return env, sink
+
+    # ---- reroute gate: the fused runner must target the mesh, and with
+    # the combiner flag on, the decomposable count/sum aggregates must
+    # actually engage the pre-exchange combine
+    env_probe, _ = build(batch, True, skewed=False, workload="ysb_count",
+                         combine=True)
+    runners, _ = build_runners(plan(env_probe._sinks), env_probe.config)
+    fused = [r for r in runners if type(r).__name__ == "DeviceChainRunner"]
+    fused_selected = bool(fused)
+    mesh_devices = fused[0].op.mesh_devices() if fused else 1
+    sharded_selected = mesh_devices > 1
+    local_combine_active = bool(
+        fused and getattr(fused[0].op.pipe, "local_combine", False))
+
+    def run(count, mesh_on, *, skewed, workload, combine=False,
+            columnar=True):
+        env, sink = build(count, mesh_on, skewed=skewed, workload=workload,
+                          combine=combine, columnar=columnar)
+        t0 = time.perf_counter()
+        env.execute()
+        return sink.results, count / max(time.perf_counter() - t0, 1e-9)
+
+    def rows_of(results):
+        return sorted((int(k), float(v)) for k, v in results)
+
+    # ---- parity gates, row mode: single-chip vs mesh vs mesh+combine
+    n_parity = max(events // 8, batch)
+    parity: dict = {}
+    combine_parity = True
+    for workload in WORKLOADS:
+        for skewed, label in ((False, "uniform"), (True, "zipf")):
+            ref = rows_of(run(n_parity, False, skewed=skewed,
+                              workload=workload, columnar=False)[0])
+            mesh_rows = rows_of(run(n_parity, True, skewed=skewed,
+                                    workload=workload, columnar=False)[0])
+            comb_rows = rows_of(run(n_parity, True, skewed=skewed,
+                                    workload=workload, combine=True,
+                                    columnar=False)[0])
+            parity[f"{workload}/{label}"] = (len(ref) > 0
+                                             and mesh_rows == ref)
+            combine_parity = combine_parity and comb_rows == ref
+
+    # ---- the matrix cells: interleaved max-of-N sweeps
+    tps: dict = {}
+    for _sweep in range(sweeps):
+        for workload in WORKLOADS:
+            for skewed, label in ((False, "uniform"), (True, "zipf")):
+                for par in (1, n):
+                    _r, t = run(events, par > 1, skewed=skewed,
+                                workload=workload)
+                    cell = (workload, par, label)
+                    tps[cell] = max(tps.get(cell, 0.0), t)
+    cells = [
+        {"workload": w, "parallelism": p, "skew": s,
+         "tuples_per_sec": round(t, 1)}
+        for (w, p, s), t in sorted(tps.items())
+    ]
+
+    # ---- static-routing skew telemetry under the adversarial zipf leg
+    static_skew = None
+    try:
+        from flink_tpu.runtime.executor import JobRuntime
+
+        env_t, _ = build(max(events // 4, batch * 8), True, skewed=True,
+                         workload="ysb_count", stats=True)
+        rt = JobRuntime(plan(env_t._sinks), env_t.config)
+        rt.run()
+        for entry in rt.device_snapshot()["operators"].values():
+            blk = entry.get("keys") or {}
+            if blk.get("meshLoadSkew") is not None:
+                static_skew = blk["meshLoadSkew"]
+                break
+    except Exception as e:  # noqa: BLE001 — the block must survive
+        static_skew = f"error: {e!r}"[:120]
+
+    # ---- the adaptive leg: local-combine + skew-rebalance on the
+    # in-process job master (the rebalancer lives there), uniform AND
+    # zipf, telemetry from the final attempt's device snapshot
+    adaptive: dict = {}
+    post_skew = None
+    rebalances = 0
+    try:
+        def run_adaptive(skewed: bool):
+            # stats on for BOTH legs: the ratio must isolate the traffic
+            # shape, not the observability plane's cost
+            env, _sink = build(events, True, skewed=skewed,
+                               workload="ysb_count", combine=True,
+                               rebalance=True, stats=True)
+            t0 = time.perf_counter()
+            client = env.execute_async(
+                "skew-adaptive" if skewed else "uniform-adaptive")
+            client.wait(600)
+            dt = max(time.perf_counter() - t0, 1e-9)
+            return client, events / dt
+
+        ref_rows = rows_of(run(n_parity, False, skewed=True,
+                               workload="ysb_count", columnar=False)[0])
+        client_u, tps_u = run_adaptive(False)
+        client_z, tps_z = run_adaptive(True)
+        rebalances = int(client_z.mesh_rebalances)
+        for entry in client_z._runtime.device_snapshot()[
+                "operators"].values():
+            blk = entry.get("keys") or {}
+            if blk.get("meshLoadSkew") is not None:
+                post_skew = blk["meshLoadSkew"]
+                break
+        # adaptive parity at reduced scale: the rebalanced job's rows
+        # must equal the single-chip reference's
+        env_p, sink_p = build(n_parity, True, skewed=True,
+                              workload="ysb_count", combine=True,
+                              rebalance=True, columnar=False)
+        client_p = env_p.execute_async("skew-adaptive-parity")
+        client_p.wait(600)
+        adaptive = {
+            "uniform_tuples_per_sec": round(tps_u, 1),
+            "zipf_tuples_per_sec": round(tps_z, 1),
+            "skewed_uniform_ratio": round(tps_z / max(tps_u, 1e-9), 4),
+            "rebalances": rebalances,
+            "routing_version":
+                client_z._runtime.mesh_routing_version(),
+            "parity": rows_of(sink_p.results) == ref_rows
+                and len(ref_rows) > 0,
+        }
+    except Exception as e:  # noqa: BLE001 — the block must survive
+        adaptive = {"error": repr(e)[:300]}
+
+    matrix_parity = all(parity.values())
+    return {
+        "devices": int(n),
+        "zipf_s": zipf_s,
+        "num_keys": num_keys,
+        "events": events,
+        "workloads": list(WORKLOADS),
+        "cells": cells,
+        "cell_parity": parity,
+        "parity": bool(matrix_parity),
+        "combine_parity": bool(combine_parity),
+        "fused_selected": bool(fused_selected),
+        "sharded_selected": bool(sharded_selected),
+        "local_combine_active": bool(local_combine_active),
+        "static_mesh_load_skew": static_skew,
+        "post_rebalance_mesh_load_skew": post_skew,
+        "rebalances": rebalances,
+        "adaptive": adaptive,
+        "skewed_uniform_ratio": adaptive.get("skewed_uniform_ratio"),
+        "workload": "ysb_skew_matrix_datastream_spmd",
+    }
+
+
+def child_skew_matrix() -> None:
+    """Skew-matrix child: CPU-pinned with the FORCED 8-device virtual mesh
+    (the single-client TPU relay exposes one chip; the same programs ride
+    ICI on real multi-chip hardware)."""
+    _emit({"event": "start", "device": "cpu-skew-matrix", "pid": os.getpid()})
+    try:
+        import jax
+        from jax._src import xla_bridge as _xb
+
+        jax.config.update("jax_platforms", "cpu")
+        _xb._backend_factories.pop("axon", None)
+        _xb._topology_factories.pop("axon", None)
+    except Exception:
+        pass
+    _emit({"event": "result", "result": skew_matrix_microbench()})
+
+
+def run_skew_matrix_child(timeout_s: float = 600.0) -> dict:
+    """Skew matrix in a CPU-pinned child on the forced 8-device virtual
+    mesh."""
+    return _run_cpu_child('skew-matrix', timeout_s, force_mesh=True)
+
+
 def chaos_microbench(names: Optional[list] = None) -> dict:
     """Resilience gate (ISSUE-10): run the chaos scenario matrix
     (flink_tpu/chaos/scenarios.py — injected rpc flaps, dataplane blips,
@@ -2834,6 +3162,13 @@ def parent_main() -> None:
     correlated = run_correlated_child()
     _emit({"event": "correlated_windows_microbench", "result": correlated})
 
+    # skew matrix (PDSP-Bench grid): parallelism x workload x skew cells
+    # with exact parity, plus the skew-adaptive leg (local-combine +
+    # key-group rebalance) — skewed/uniform ratio and post-rebalance
+    # meshLoadSkew tracked per PR like throughput
+    skew_matrix = run_skew_matrix_child()
+    _emit({"event": "skew_matrix_microbench", "result": skew_matrix})
+
     def consider(res, rank):
         nonlocal best, best_rank
         if res is None:
@@ -2874,6 +3209,16 @@ def parent_main() -> None:
                     millikey["tuples_per_sec"]
                 best["millikey_incremental_ratio"] = \
                     millikey.get("incremental_ratio")
+            best["skew_matrix"] = skew_matrix
+            # first-class skew keys (ISSUE-15 acceptance): the adaptive
+            # zipf/uniform throughput ratio and the post-rebalance device
+            # skew, tracked per PR next to the static value they improve
+            if skew_matrix.get("skewed_uniform_ratio") is not None:
+                best["skewed_uniform_ratio"] = \
+                    skew_matrix["skewed_uniform_ratio"]
+            if skew_matrix.get("post_rebalance_mesh_load_skew") is not None:
+                best["post_rebalance_mesh_load_skew"] = \
+                    skew_matrix["post_rebalance_mesh_load_skew"]
             # top-level continuity keys for the trajectory table
             if multichip.get("tuples_per_sec"):
                 best["multichip_tuples_per_sec"] = \
@@ -2993,6 +3338,8 @@ def main() -> None:
             child_multichip()
         elif label == "millikey":
             child_millikey()
+        elif label == "skew-matrix":
+            child_skew_matrix()
         elif label == "correlated":
             child_correlated()
         else:

@@ -8,9 +8,12 @@ checkpoint failures), and (3) that injected faults which caused failures
 are attributed ``injected: true`` in the PR-4 ExceptionHistory. The matrix
 covers BOTH execution paths: MiniCluster (torn-checkpoint,
 storage-brownout, device-dispatch-error, chip-loss-sharded — the multichip
-mesh losing a device mid-job and restarting at reduced mesh size) and the
-distributed JM+TM cluster (rpc-flap, dataplane-blip,
-tm-crash-during-rescale, heartbeat-partition).
+mesh losing a device mid-job and restarting at reduced mesh size,
+cold-tier-read-error on the tiered state path, and
+chip-loss-during-rebalance — a device dying while the job runs on a
+skew-rebalanced key-group routing table) and the distributed JM+TM
+cluster (rpc-flap, dataplane-blip, tm-crash-during-rescale,
+heartbeat-partition).
 
 `bench.py chaos_microbench` runs :func:`run_matrix` and emits
 ``chaos.{scenarios_passed, recovery_time_ms_p50, parity}`` into the bench
@@ -594,6 +597,146 @@ def scenario_cold_tier_read_error() -> Dict[str, Any]:
                    recovery_ms=recovery_ms, attributed=attributed)
 
 
+def scenario_chip_loss_during_rebalance() -> Dict[str, Any]:
+    """Chip loss against the SKEW-REBALANCED mesh (parallel.mesh.
+    skew-rebalance): a zipf-shaped keyed job piles its hot key-groups
+    onto device 0, the rebalancer remaps them across the mesh at a
+    step-aligned boundary, and an injected device error then kills a chip
+    while the job is running on the remapped routing table. The job must
+    recover through the normal attributed restart path at a REDUCED mesh
+    size, with the routing table rebuilt consistently with the rewound
+    CANONICAL checkpoint (checkpoints are routing-independent [K, S] by
+    construction — restore + a fresh table is exact for ANY placement),
+    at parity with the undisturbed single-chip oracle."""
+    problems: List[str] = []
+    import jax
+
+    from flink_tpu.config import ParallelOptions
+
+    n_devices = len(jax.devices())
+    if n_devices < 2:
+        return _result("chip-loss-during-rebalance", "mini", None, [],
+                       parity=True, restarts=0, skipped=True)
+
+    NUM_KEYS = 512
+
+    def keys_of(idx: np.ndarray) -> np.ndarray:
+        # ~70% of the mass on 64 hot keys. The host-keyed path assigns
+        # DENSE ids in arrival order, so the hot keys (seen first and
+        # constantly) take the low dense ids — all in device 0's
+        # contiguous range under the identity table, exactly the shape
+        # the rebalancer exists to fix — while 64 of them spread over
+        # enough key-groups that a balanced replan CAN fix it (a single
+        # hot group is unsplittable by design)
+        u = ((idx * 2654435761) % 1000) / 1000.0
+        hot = (idx % 64) * 8
+        cold = (idx * 40503) % NUM_KEYS
+        return np.where(u < 0.7, hot, cold).astype(np.int64)
+
+    def run(name: str, *, mesh: bool, chk: Optional[str] = None):
+        from flink_tpu.api.datastream import StreamExecutionEnvironment
+        from flink_tpu.api.windowing.assigners import TumblingEventTimeWindows
+        from flink_tpu.config import (
+            CheckpointingOptions,
+            Configuration,
+            ExecutionOptions,
+            RestartOptions,
+        )
+        from flink_tpu.connectors.sink import CollectSink
+        from flink_tpu.connectors.source import Batch, DataGeneratorSource
+        from flink_tpu.core.watermarks import WatermarkStrategy
+
+        config = Configuration()
+        config.set(ExecutionOptions.BATCH_SIZE, 512)
+        # distinctive ring capacity (the bench-gate pattern): these
+        # executables must be this scenario's own
+        config.set(ExecutionOptions.KEY_CAPACITY, NUM_KEYS)
+        # dispatch every 4 steps so device state (and with it the skew
+        # telemetry the rebalancer reads) materializes early in the run
+        config.set(ExecutionOptions.SUPERBATCH_STEPS, 4)
+        config.set(RestartOptions.INITIAL_BACKOFF_MS, 1)
+        if mesh:
+            config.set(ParallelOptions.MESH_ENABLED, True)
+            config.set(ParallelOptions.MESH_SKEW_REBALANCE, True)
+            config.set(ParallelOptions.MESH_LOCAL_COMBINE, True)
+            config.set(ParallelOptions.MESH_REBALANCE_SKEW_THRESHOLD, 1.2)
+            config.set(ParallelOptions.MESH_REBALANCE_INTERVAL_MS, 0)
+        if chk is not None:
+            config.set(CheckpointingOptions.INTERVAL_MS, 1)
+            config.set(CheckpointingOptions.DIRECTORY, chk)
+            config.set(CheckpointingOptions.MAX_RETAINED, 50)
+
+        count = 40 * 512
+
+        def gen(idx: np.ndarray) -> Batch:
+            ts = (idx * 2).astype(np.int64)
+            return Batch(keys_of(idx), ts)
+
+        env = StreamExecutionEnvironment(config)
+        stream = env.from_source(
+            DataGeneratorSource(gen, count=count, num_splits=1),
+            watermark_strategy=WatermarkStrategy.for_monotonous_timestamps(),
+        )
+        sink = CollectSink()
+        (stream.key_by(lambda col: col, vectorized=True)
+               .window(TumblingEventTimeWindows.of(1000)).count()
+               .sink_to(sink))
+        client = env.execute_async(name)
+        client.wait(120)
+        return client, sorted((int(k), int(n)) for k, n in sink.results)
+
+    _oracle_client, expected = run("rebalance-oracle", mesh=False)
+    chk = tempfile.mkdtemp(prefix="flink-tpu-rebal-")
+    try:
+        with fault_injection(rules=[
+            # the 14th device dispatch lands after the first rebalance
+            # (first dispatch at step 4, skew visible from step ~5, the
+            # remapped table live within a couple of boundaries)
+            {"scope": "device", "fault": "error", "nth": 14},
+        ]) as plan:
+            client, results = run("chip-loss-during-rebalance", mesh=True,
+                                  chk=chk)
+        parity = results == expected
+        _check(problems, client.status().value == "FINISHED",
+               f"job ended {client.status().value}")
+        _check(problems, parity, "result parity broken vs the oracle")
+        _check(problems, client.mesh_rebalances >= 1,
+               "no skew rebalance completed before the injected loss — "
+               "the scenario never reached the state under test")
+        _check(problems, client.num_restarts == 1,
+               f"expected 1 restart, saw {client.num_restarts}")
+        _check(problems, plan.total_fired == 1,
+               f"expected 1 injected chip loss, fired {plan.total_fired}")
+        exc = client.exceptions.payload()
+        entry = exc["entries"][0] if exc["entries"] else {}
+        attributed = bool(entry.get("injected"))
+        _check(problems, attributed,
+               "injected chip loss not attributed injected:true")
+        recs = [r for r in exc["recoveries"] if r.get("kind") == "restart"]
+        recovery_ms = recs[0]["downtime_ms"] if recs else None
+        _check(problems,
+               bool(recs) and recs[0]["restored_checkpoint_id"] is not None,
+               "recovery timeline missing the rewound checkpoint")
+        # the degrade policy halves the mesh on the attributed device
+        # loss; the rebuilt attempt's routing table must be live and
+        # valid for the REDUCED size — a stale 8-device assignment
+        # restored verbatim would have nowhere to place half its groups
+        from flink_tpu.parallel.mesh import usable_mesh_size
+
+        initial = usable_mesh_size(0, n_devices, NUM_KEYS)
+        final = client._runtime.mesh_devices()
+        _check(problems, final == max(1, initial // 2),
+               f"restart did not reduce the mesh: {initial} -> {final}")
+        version = client._runtime.mesh_routing_version()
+        _check(problems, version is not None,
+               "rebuilt attempt lost its routing table")
+    finally:
+        shutil.rmtree(chk, ignore_errors=True)
+    return _result("chip-loss-during-rebalance", "mini", plan, problems,
+                   parity=parity, restarts=client.num_restarts,
+                   recovery_ms=recovery_ms, attributed=attributed)
+
+
 def scenario_rpc_flap() -> Dict[str, Any]:
     """Transient rpc-plane flap on idempotent control calls: the first two
     checkpoint-ack attempts and two heartbeat shipments fail with
@@ -769,6 +912,7 @@ SCENARIOS: Dict[str, Callable[[], Dict[str, Any]]] = {
     "device-dispatch-error": scenario_device_dispatch_error,
     "chip-loss-sharded": scenario_chip_loss_sharded,
     "cold-tier-read-error": scenario_cold_tier_read_error,
+    "chip-loss-during-rebalance": scenario_chip_loss_during_rebalance,
     "rpc-flap": scenario_rpc_flap,
     "dataplane-blip": scenario_dataplane_blip,
     "tm-crash-during-rescale": scenario_tm_crash_during_rescale,

@@ -456,6 +456,67 @@ class ParallelOptions:
         "onto a different device count at a step boundary, exactly-once. "
         "Off keeps the autoscaler observe-only for mesh jobs."
     )
+    MESH_LOCAL_COMBINE = (
+        ConfigOptions.key("parallel.mesh.local-combine")
+        .bool_type().default_value(False)
+    ).with_description(
+        "Map-side combiner for the mesh keyBy exchange: each shard "
+        "segment-reduces its slice of every step by (key, rel-slice) "
+        "BEFORE the all-to-all, so what crosses the interconnect is at "
+        "most one partial per (source shard, key, slice) instead of the "
+        "key's full tuple mass — under zipf-skewed traffic a hot key "
+        "costs n_shards partials per slice, not its record count. "
+        "Applies to decomposable builtin aggregates (count/sum/min/max, "
+        "mean as its two add-scatter fields); non-decomposable aggregates "
+        "transparently keep the route-raw exchange. A performance switch, "
+        "never a semantics switch: partial pre-reduction uses the same "
+        "scatter combiners the ring ingest applies — counts and integer/"
+        "min/max fields are bit-exact; float-ADD fields are reassociated "
+        "(partials per source shard, then a cross-shard fold), which like "
+        "any parallel pre-aggregation is bit-exact for integer-valued "
+        "payloads and may differ in final ulps otherwise."
+    )
+    MESH_SKEW_REBALANCE = (
+        ConfigOptions.key("parallel.mesh.skew-rebalance")
+        .bool_type().default_value(False)
+    ).with_description(
+        "Skew-aware key-group routing on the in-process mesh path: the "
+        "static owner function (key-group -> contiguous device range) "
+        "becomes a device-resident routing table, and a rebalancer in the "
+        "scheduler watches the key-skew telemetry (keyGroupLoad / "
+        "meshLoadSkew) and remaps the hottest key-groups across devices "
+        "at a step-aligned boundary through the mesh-rescale "
+        "capture/restore machinery — exactly-once, with checkpoints "
+        "staying canonical [K, S] (routing is placement, never "
+        "semantics). Off keeps the static contiguous owner function."
+    )
+    MESH_KEY_GROUPS = (
+        ConfigOptions.key("parallel.mesh.key-groups").int_type()
+        .default_value(0)
+    ).with_description(
+        "Key-group count of the skew-rebalance routing table (0 = auto: "
+        "up to 128, rounded to a multiple of the mesh size that divides "
+        "the key capacity). More groups = finer-grained rebalancing at "
+        "a slightly larger replicated routing table."
+    )
+    MESH_REBALANCE_SKEW_THRESHOLD = (
+        ConfigOptions.key("parallel.mesh.rebalance.skew-threshold")
+        .float_type().default_value(1.25)
+    ).with_description(
+        "meshLoadSkew (max/mean per-device resident records) above which "
+        "the skew rebalancer considers remapping key-groups. A rebalance "
+        "only triggers when the replanned assignment also improves the "
+        "predicted skew by at least ~10% — a single unsplittable hot "
+        "group never causes rebuild churn."
+    )
+    MESH_REBALANCE_INTERVAL_MS = (
+        ConfigOptions.key("parallel.mesh.rebalance.interval-ms")
+        .int_type().default_value(1000)
+    ).with_description(
+        "Minimum milliseconds between skew-rebalancer decisions (and "
+        "between a completed rebalance and the next check). 0 decides on "
+        "every step boundary — test/bench cadence, not production."
+    )
 
 
 class StateTierOptions:

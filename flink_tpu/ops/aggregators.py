@@ -62,6 +62,15 @@ class DeviceAggregator:
     fields: Tuple[AccField, ...]
     extract: Callable[[Dict[str, Any]], Any]
     result_dtype: Any = np.float32
+    # pre-aggregation contract: True means per-(key, slice) partials of the
+    # fields, merged by each field's own scatter combiner, reconstruct the
+    # exact ring state — the property the mesh map-side combiner
+    # (parallel.mesh.local-combine) relies on. Every builtin holds it by
+    # construction (add/min/max are associative + commutative); closure-tier
+    # aggregates (e.g. the q5 top-K post-processing) never resolve to a
+    # DeviceAggregator at all, and a custom spec whose extract depends on
+    # more than the combined fields can opt out here.
+    combinable: bool = True
 
     def field(self, name: str) -> AccField:
         for f in self.fields:
@@ -242,6 +251,18 @@ BUILTINS = {
     "max": max_agg,
     "mean": mean_agg,
 }
+
+
+def decomposable(agg: DeviceAggregator) -> bool:
+    """True when the mesh map-side combiner may pre-reduce this aggregate:
+    every field's scatter kind is one of the associative+commutative
+    combiners and the spec has not opted out. The combine path sends one
+    partial per (key, rel-slice) per source shard — merged by the SAME
+    scatter ops the ring ingest applies, so pre-reduction is exact by
+    construction. Non-decomposable aggregates route raw records instead."""
+    return bool(getattr(agg, "combinable", True)) and all(
+        f.scatter in _SCATTER_NP for f in agg.fields
+    )
 
 
 def resolve(agg) -> Optional[DeviceAggregator]:

@@ -44,6 +44,16 @@ def make_superscan_step(agg, K, S, NSB, F, R, SPW, chunk, exact,
     core). Identical math either way: both are pure adds into the same
     cells, counts exact in int32.
 
+    'partials' consumes PRE-REDUCED per-step partials instead of record
+    lanes — the receive side of the mesh map-side combiner
+    (parallel.mesh.local-combine): the idx slot of `args` carries the
+    step's [K, NSB] count partial and the vals slot a tuple of [K, NSB]
+    per-VALUE-field partials (aligned with the aggregator's VALUE fields,
+    min/max cells holding their scan identity where untouched). Ingest
+    becomes one dense column combine per field — the same
+    add/min/max ops the lane scatter applies, so the ring state is exact;
+    fire and purge are the identical shared body.
+
     `phase_counters` (device-plane observability) threads an int32[3]
     counter through the carry — [records ingested, fire slots executed,
     steps that purged] — so a dispatch's device time can be attributed to
@@ -80,6 +90,24 @@ def make_superscan_step(agg, K, S, NSB, F, R, SPW, chunk, exact,
         else:
             state, count, outs, count_out = carry
         idx, vals, smin_pos, fire_pos, fire_valid, fire_row, purge_mask = args
+        cols = (smin_pos + jnp.arange(NSB, dtype=jnp.int32)) % S
+
+        if ingest == "partials":
+            # pre-reduced ingest (the map-side combiner's receive side):
+            # idx is the step's [K, NSB] count partial, vals the tuple of
+            # per-VALUE-field [K, NSB] partials — one dense column combine
+            # per field, same add/min/max semantics as the lane scatter
+            cpart = idx
+            count = count.at[:, cols].add(cpart)
+            new_state = {}
+            for (name, dt, scatter, _ident), part in zip(vfields, vals):
+                upd = getattr(state[name].at[:, cols], scatter)
+                new_state[name] = upd(part.astype(dt))
+            state = new_state if vfields else state
+            return _fire_purge(
+                state, count, outs, count_out, phase_c if phase_counters
+                else None, cpart.sum(),
+                (fire_pos, fire_valid, fire_row, purge_mask))
 
         # ingest: MXU histograms over (key, rel-slice) segments for
         # add-combining fields (or direct scatter-adds on CPU backends);
@@ -90,7 +118,6 @@ def make_superscan_step(agg, K, S, NSB, F, R, SPW, chunk, exact,
         srel = idx % NSB
         col = (smin_pos + srel) % S
         safe_kid = jnp.where(idx >= 0, kid, K)  # OOB rows drop
-        cols = (smin_pos + jnp.arange(NSB, dtype=jnp.int32)) % S
         # CPU add-ingest form: XLA lowers a FLAT 1-D index scatter ~2x
         # faster than the 2-D (kid, col) scatter, so adds go through a
         # [K*NSB] staging histogram folded densely into the ring columns —
@@ -130,6 +157,17 @@ def make_superscan_step(agg, K, S, NSB, F, R, SPW, chunk, exact,
                 upd = getattr(state[name].at[safe_kid, col], scatter)
                 new_state[name] = upd(vals.astype(dt), mode="drop")
         state = new_state if vfields else state
+        return _fire_purge(
+            state, count, outs, count_out,
+            phase_c if phase_counters else None,
+            jnp.sum((idx >= 0).astype(jnp.int32)),
+            (fire_pos, fire_valid, fire_row, purge_mask))
+
+    def _fire_purge(state, count, outs, count_out, phase_c, ingested, plan):
+        """Fire + purge, shared verbatim by the lane-scatter and
+        pre-reduced ('partials') ingest forms — the combine path must be a
+        different INGEST, never a different fire/purge."""
+        fire_pos, fire_valid, fire_row, purge_mask = plan
 
         # fire: combine the window's slice columns, write compact rows.
         # The WHOLE fire body sits under the cond, gathers included: most
@@ -183,7 +221,7 @@ def make_superscan_step(agg, K, S, NSB, F, R, SPW, chunk, exact,
             purged, do_purge, lambda sc: sc, (state, count))
         if phase_counters:
             phase_c = phase_c + jnp.stack([
-                jnp.sum((idx >= 0).astype(jnp.int32)),
+                ingested.astype(jnp.int32),
                 jnp.sum(fire_valid).astype(jnp.int32),
                 purged.astype(jnp.int32),
             ])
@@ -191,6 +229,58 @@ def make_superscan_step(agg, K, S, NSB, F, R, SPW, chunk, exact,
         return (state, count, outs, count_out), None
 
     return step
+
+
+def make_segment_partials(agg, nseg, chunk, exact, ingest: str = "matmul"):
+    """The map-side combiner's send side (parallel.mesh.local-combine):
+    build fn(idx, vals) segment-reducing ONE step's record lanes into
+    dense flat partials over `nseg` destination segments — count plus one
+    partial per VALUE field, each pre-reduced by the field's own scatter
+    combiner (add/min/max), untouched cells holding the scan identity so
+    merging them downstream is a no-op. Lanes with idx < 0 drop.
+
+    `ingest` mirrors the ring-ingest choice: 'matmul' builds add partials
+    as MXU one-hot histograms (the TPU form; matmul_hist's exact bf16
+    3-term split for float adds when `exact`), anything else uses direct
+    flat scatters. Min/max partials always scatter — no matmul form
+    exists for order statistics, exactly like the ring ingest.
+
+    Returns (fn, vfields) where vfields is the (name, dtype, scatter,
+    identity) tuple list the partials align with."""
+    import jax.numpy as jnp
+
+    from flink_tpu.ops import matmul_hist
+    from flink_tpu.ops.aggregators import VALUE, scan_identity
+
+    vfields = [
+        (f.name, jnp.dtype(f.dtype), f.scatter, f.identity)
+        for f in agg.fields
+        if f.source == VALUE
+    ]
+
+    def partials(idx, vals):
+        safe = jnp.where(idx >= 0, idx, nseg)   # OOB segment drops
+        if ingest == "matmul":
+            cpart = matmul_hist.count_hist(idx, nseg, chunk=chunk)
+        else:
+            cpart = jnp.zeros((nseg,), jnp.int32).at[safe].add(
+                jnp.int32(1), mode="drop")
+        parts = []
+        for name, dt, scatter, _ident in vfields:
+            if scatter == "add" and ingest == "matmul":
+                p = matmul_hist.weighted_hist(
+                    idx, vals, nseg, chunk=chunk, exact=exact).astype(dt)
+            elif scatter == "add":
+                p = jnp.zeros((nseg,), dt).at[safe].add(
+                    vals.astype(dt), mode="drop")
+            else:
+                init = jnp.full((nseg,), scan_identity(dt, scatter), dt)
+                p = getattr(init.at[safe], scatter)(
+                    vals.astype(dt), mode="drop")
+            parts.append(p)
+        return cpart, tuple(parts)
+
+    return partials, vfields
 
 
 def make_global_scan_step(agg, S, NSB, F, R, SPW, fire_spws=None,

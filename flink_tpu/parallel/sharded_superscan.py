@@ -19,6 +19,16 @@ INVALID, so the all-to-all needs no data-dependent compaction; each shard
 then ingests n*B lanes per step (mostly INVALID, dropped for free by the
 one-hot/scatter semantics).
 
+Two skew-adaptive layers compose on top (both pure perf switches —
+docs/multichip.md "Pre-exchange local combine" / "Skew-aware key-group
+routing"): `local_combine` segment-reduces each shard's lanes by
+(destination, key, rel-slice) BEFORE the all-to-all, so only dense
+partials cross ICI (exact for decomposable aggregates; others route raw
+transparently), and `skew_routing` replaces the static owner function
+with a KeyGroupRouting table (parallel/routing.py) whose remaps are a
+replicated-table swap plus one canonical host round trip — never a
+recompile, never a semantics change (snapshots stay canonical [K, S]).
+
 With a `TracedPrologue` (whole-graph fusion, PR 7) the pipeline additionally
 runs the user's traceable map/filter/map_ts chain + key/value extraction
 INSIDE the per-shard program, BEFORE the shuffle: each device transforms its
@@ -56,8 +66,13 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from flink_tpu.utils.jax_compat import shard_map
 
-from flink_tpu.ops.aggregators import VALUE
-from flink_tpu.ops.superscan import default_ingest, make_superscan_step
+from flink_tpu.ops.aggregators import VALUE, combine_reduce, decomposable
+from flink_tpu.ops.superscan import (
+    default_ingest,
+    make_segment_partials,
+    make_superscan_step,
+)
+from flink_tpu.parallel.routing import KeyGroupRouting
 
 
 class ShardedFusedPipeline:
@@ -85,6 +100,9 @@ class ShardedFusedPipeline:
         axis: str = "shards",
         prologue=None,
         assigners=None,
+        local_combine: bool = False,
+        skew_routing: bool = False,
+        num_key_groups: int = 0,
     ):
         # runtime import is function-scoped: parallel/ sits below runtime in
         # the layer DAG (ARCH001), and the planner is pure host state
@@ -134,6 +152,23 @@ class ShardedFusedPipeline:
         self.exact = exact_sums
         self._value_fields = [f for f in self.agg.fields if f.source == VALUE]
         self._needs_vals = bool(self._value_fields)
+        # pre-exchange local combine (parallel.mesh.local-combine): shards
+        # segment-reduce their lanes by (dst, key, rel-slice) BEFORE the
+        # all-to-all, so a hot key crosses ICI as at most n partials per
+        # slice. Exact only for decomposable aggregates — a
+        # non-decomposable spec transparently keeps the route-raw exchange
+        self.local_combine = bool(local_combine) and decomposable(self.agg)
+        # skew-aware key-group routing (parallel.mesh.skew-rebalance): the
+        # static `dst = kid // K_local` owner function becomes a
+        # device-resident [G] routing table; remapping groups is a table
+        # swap + host state re-layout, never a recompile. None = static.
+        self._num_key_groups = int(num_key_groups)
+        self.routing: Optional[KeyGroupRouting] = (
+            KeyGroupRouting(key_capacity, self.n, num_key_groups)
+            if skew_routing else None)
+        self._g_dst = self._g_slot = self._perm_dev = None
+        if self.routing is not None:
+            self._refresh_route_tables()
         self._init_state()
         self._fn_cache: Dict[tuple, Any] = {}
         # device-plane observability: an attached CompileTracker wraps the
@@ -176,12 +211,72 @@ class ShardedFusedPipeline:
         return self._planner.phase_totals
 
     def key_loads(self):
-        """Global per-key record counts ([K]) for the key-stats fold —
-        one reshape + segment-sum over the sharded count ring."""
+        """Global per-key record counts ([K], canonical key order) for the
+        key-stats fold — one reshape + segment-sum over the sharded count
+        ring (+ one gather when a routing table permutes the layout)."""
         count = getattr(self, "_count", None)
         if count is None:
             return None
-        return count.reshape(self.K, self.S).sum(axis=1)
+        loads = count.reshape(self.K, self.S).sum(axis=1)
+        if self.routing is not None:
+            loads = jnp.take(loads, self._perm_dev, axis=0)
+        return loads
+
+    # ------------------------------------------------------------------
+    # skew-aware key-group routing (parallel/routing.py): the table is a
+    # pair of replicated [G] device arrays the compiled program gathers
+    # from — remapping is a table swap plus ONE host round trip of the
+    # canonical state, never a recompile. All mutators run off the
+    # dispatch hot path (callers resolve in-flight dispatches first).
+    # ------------------------------------------------------------------
+    def _refresh_route_tables(self) -> None:
+        r = self.routing
+        self._g_dst = jnp.asarray(r.assign, jnp.int32)
+        self._g_slot = jnp.asarray(r.slot, jnp.int32)
+        self._perm_dev = jnp.asarray(r.perm, jnp.int32)
+
+    def routing_version(self) -> Optional[int]:
+        return None if self.routing is None else self.routing.version
+
+    def routing_payload(self) -> Optional[dict]:
+        return None if self.routing is None else self.routing.payload()
+
+    def mesh_group_loads(self):
+        """Per-key-group resident record loads [G] (canonical groups) —
+        the skew rebalancer's decision input. None without a table."""
+        if self.routing is None:
+            return None
+        loads = self.key_loads()
+        if loads is None:
+            return None
+        return self.routing.group_loads(np.asarray(loads))
+
+    def set_routing_assignment(self, assign) -> int:
+        """Swap in a new group->device map: pull the canonical [K, S]
+        state under the OLD table, bump the table, re-lay rows under the
+        new one. Exact by construction — canonical state never changes,
+        only its placement. Returns the new table version."""
+        if self.routing is None:
+            raise RuntimeError(
+                "skew routing is disabled (parallel.mesh.skew-rebalance)")
+        count, state = self._canonical_arrays()
+        self.routing = self.routing.with_assignment(assign)
+        self._refresh_route_tables()
+        self._put_canonical(count, state)
+        return self.routing.version
+
+    def _canonical_arrays(self):
+        """(count [K, S], {field: [K, S]}) in canonical key order."""
+        count = np.asarray(self._count).reshape(self.K, self.S)
+        state = {
+            name: np.asarray(a).reshape(self.K, self.S)
+            for name, a in self._state.items()
+        }
+        if self.routing is not None:
+            count = self.routing.to_canonical(count)
+            state = {k: self.routing.to_canonical(v)
+                     for k, v in state.items()}
+        return count, state
 
     def per_device_key_loads(self):
         """Per-device local per-key record counts ([n, K_local]): the
@@ -233,51 +328,125 @@ class ShardedFusedPipeline:
             new_k = -(-new_k // self.n) * self.n
         n, S = self.n, self.S
         pad = new_k - self.K
-        count = np.asarray(self._count).reshape(self.K, S)
+        count, state = self._canonical_arrays()
         count = np.concatenate(
             [count, np.zeros((pad, S), np.int32)])
-        state = {}
-        for f in self._value_fields:
-            arr = np.asarray(self._state[f.name]).reshape(self.K, S)
-            state[f.name] = np.concatenate(
-                [arr, np.full((pad, S), f.identity, np.dtype(f.dtype))])
+        idents = {f.name: (f.identity, np.dtype(f.dtype))
+                  for f in self._value_fields}
+        state = {
+            k: np.concatenate([v, np.full((pad, S), *idents[k])])
+            for k, v in state.items()
+        }
         self.K = new_k
         self.K_local = new_k // n
         self._planner.K = new_k
-        self._count = jax.device_put(
-            jnp.asarray(count.reshape(n, self.K_local, S)),
-            self._shard_spec(None, None))
-        self._state = {
-            k: jax.device_put(
-                jnp.asarray(v.reshape(n, self.K_local, S)),
-                self._shard_spec(None, None))
-            for k, v in state.items()
-        }
+        if self.routing is not None:
+            # the table is sized to K: rebuild at identity over the grown
+            # capacity (the rebalancer re-fires from fresh skew telemetry;
+            # carrying an old-K assignment forward would be shape-invalid)
+            self.routing = KeyGroupRouting(
+                new_k, n, self._num_key_groups,
+                version=self.routing.version + 1)
+            self._refresh_route_tables()
+        self._put_canonical(count, state)
         self._fn_cache.clear()   # executables captured the old K_local
 
     # ------------------------------------------------------------------
+    # ------------------------------------------------------------------
+    # exchange variants: the shared pieces the classic and raw builds
+    # compose. `_dst_and_local` is THE owner function — static contiguous
+    # ranges, or the routing table's group lookup; `_exchange_partials`
+    # is the map-side combiner's exchange (dense per-destination partials
+    # over ICI, folded per scatter kind on the receive side).
+    # ------------------------------------------------------------------
+    def _dst_and_local(self, g_tables):
+        """fn(valid, kid, srel) -> (dst, local segment idx), -1 invalid."""
+        n, Kl, NSB = self.n, self.K_local, self.NSB
+        if g_tables is None:
+            def fn(valid, kid, srel):
+                dst = jnp.where(valid, kid // Kl, -1)
+                lidx = jnp.where(valid, (kid % Kl) * NSB + srel, -1)
+                return dst, lidx
+            return fn
+        g_dst, g_slot = g_tables
+        Kg = self.routing.Kg
+
+        def fn(valid, kid, srel):
+            g = jnp.where(valid, kid // Kg, 0)
+            dst = jnp.where(valid, g_dst[g], -1)
+            lidx = jnp.where(
+                valid, (g_slot[g] * Kg + kid % Kg) * NSB + srel, -1)
+            return dst, lidx
+        return fn
+
+    def _exchange_partials(self, partials_fn, step, scatters):
+        """fn(carry, pidx, vals, plan_row): segment-reduce this shard's
+        lanes into flat [n*Kl*NSB] per-destination partials, ONE
+        all-to-all per channel (count + each value field), fold across
+        source shards by the field's own combiner, ingest pre-reduced."""
+        n, Kl, NSB, axis = self.n, self.K_local, self.NSB, self.axis
+
+        def fn(carry, pidx, vals, plan_row):
+            cpart, parts = partials_fn(pidx, vals)
+            rc = jax.lax.all_to_all(
+                cpart.reshape(n, Kl * NSB), axis, split_axis=0,
+                concat_axis=0, tiled=False)
+            cpart_l = rc.sum(axis=0).reshape(Kl, NSB)
+            parts_l = []
+            for p, sc in zip(parts, scatters):
+                rp = jax.lax.all_to_all(
+                    p.reshape(n, Kl * NSB), axis, split_axis=0,
+                    concat_axis=0, tiled=False)
+                parts_l.append(
+                    combine_reduce(sc)(rp, 0).reshape(Kl, NSB))
+            return step(carry, (cpart_l, tuple(parts_l)) + tuple(plan_row))
+        return fn
+
+    def _make_step(self, lanes: int, phases: bool):
+        chunk = self.chunk
+        while lanes % chunk != 0:
+            chunk //= 2
+        return make_superscan_step(
+            self.agg, self.K_local, self.S, self.NSB, self.F, self.R,
+            self._planner.spw, chunk,
+            self.exact,
+            ingest="partials" if self.local_combine else default_ingest(),
+            phase_counters=phases, fire_spws=self._planner._fire_spws,
+        )
+
+    def _make_partials_fn(self, B: int):
+        pchunk = self.chunk
+        while B % pchunk != 0:
+            pchunk //= 2
+        fn, _vf = make_segment_partials(
+            self.agg, self.n * self.K_local * self.NSB, pchunk, self.exact,
+            ingest=default_ingest())
+        return fn
+
     def _build(self, T: int, B: int):
         phases = self.phase_counters
-        key = ("classic", T, B, phases)
+        combine = self.local_combine
+        routed = self.routing is not None
+        key = ("classic", T, B, phases, combine,
+               None if not routed else self.routing.G)
         if key in self._fn_cache:
             return self._fn_cache[key]
 
         n, Kl, S, axis = self.n, self.K_local, self.S, self.axis
         NSB, R = self.NSB, self.R
-        lanes = n * B
         # the per-shard superscan body runs on K_local keys over n*B lanes
-        chunk = self.chunk
-        while lanes % chunk != 0:
-            chunk //= 2
-        step = make_superscan_step(
-            self.agg, Kl, S, NSB, self.F, R, self._planner.spw, chunk,
-            self.exact, ingest=default_ingest(), phase_counters=phases,
-            fire_spws=self._planner._fire_spws,
-        )
+        step = self._make_step(n * B, phases)
         nf = len(self._value_fields)
+        partials_fn = self._make_partials_fn(B) if combine else None
+        scatters = [f.scatter for f in self._value_fields]
 
-        def per_shard(count, state_t, idx, vals, smin_pos, fire_pos,
-                      fire_valid, fire_row, purge_mask):
+        def per_shard(count, state_t, idx, vals, *rest):
+            if routed:
+                *rest, g_dst, g_slot = rest
+                owner = self._dst_and_local((g_dst, g_slot))
+            else:
+                owner = self._dst_and_local(None)
+            smin_pos, fire_pos, fire_valid, fire_row, purge_mask = rest
             # leading mesh dim is 1 inside the shard
             count = count[0]
             idx = idx[0]
@@ -288,21 +457,38 @@ class ShardedFusedPipeline:
                 for i, f in enumerate(self._value_fields)
             }
             base = jax.lax.axis_index(axis).astype(jnp.int32) * Kl
+            if combine:
+                exchange = self._exchange_partials(
+                    partials_fn, step, scatters)
 
             def routed_step(carry, args):
                 idx_row, vals_row, *plan_row = args
-                # destination = owner of the record's key range
                 valid = idx_row >= 0
                 kid = idx_row // NSB
-                dst = jnp.where(valid, kid // Kl, -1)
+                if combine:
+                    dst, lidx = owner(valid, kid, idx_row % NSB)
+                    pidx = jnp.where(valid, dst * (Kl * NSB) + lidx, -1)
+                    return exchange(carry, pidx, vals_row, plan_row)
+                if routed:
+                    # route-raw under a table: the sender localizes (the
+                    # receiver cannot invert an arbitrary table from a
+                    # global idx without a second lookup)
+                    dst, lidx = owner(valid, kid, idx_row % NSB)
+                    send_payload, localize = lidx, (lambda r: r)
+                else:
+                    # destination = owner of the record's key range
+                    dst = jnp.where(valid, kid // Kl, -1)
+                    # localize: idx - base*NSB keeps srel intact
+                    send_payload = idx_row
+                    localize = lambda r: jnp.where(      # noqa: E731
+                        r >= 0, r - base * NSB, -1)
                 rows = jnp.arange(n, dtype=jnp.int32)[:, None]
                 route = rows == dst[None, :]                       # [n, B]
-                send_idx = jnp.where(route, idx_row[None, :], -1)
+                send_idx = jnp.where(route, send_payload[None, :], -1)
                 recv_idx = jax.lax.all_to_all(
                     send_idx, axis, split_axis=0, concat_axis=0, tiled=False
                 ).reshape(-1)                                      # [n*B]
-                # localize: idx - base*NSB keeps srel intact
-                local_idx = jnp.where(recv_idx >= 0, recv_idx - base * NSB, -1)
+                local_idx = localize(recv_idx)
                 if nf:
                     send_v = jnp.where(route, vals_row[None, :], 0.0)
                     recv_v = jax.lax.all_to_all(
@@ -347,17 +533,20 @@ class ShardedFusedPipeline:
         )
         if phases:
             out_specs = out_specs + (P(axis, None),)  # phase counters [n,3]
+        in_specs = (
+            P(axis, None, None),                      # count [n,Kl,S]
+            (P(axis, None, None),) * nf,              # field states
+            P(axis, None, None),                      # idx [n,T,B]
+            P(axis, None, None) if nf else P(None, None),  # vals
+            P(None), P(None, None), P(None, None), P(None, None),
+            P(None, None),                            # plan (replicated)
+        )
+        if routed:
+            in_specs = in_specs + (P(None), P(None))  # routing tables [G]
         sharded = shard_map(
             per_shard,
             mesh=self.mesh,
-            in_specs=(
-                P(axis, None, None),                      # count [n,Kl,S]
-                (P(axis, None, None),) * nf,              # field states
-                P(axis, None, None),                      # idx [n,T,B]
-                P(axis, None, None) if nf else P(None, None),  # vals
-                P(None), P(None, None), P(None, None), P(None, None),
-                P(None, None),                            # plan (replicated)
-            ),
+            in_specs=in_specs,
             out_specs=out_specs,
             check_vma=False,
         )
@@ -412,6 +601,8 @@ class ShardedFusedPipeline:
         args = (self._count, tuple(self._state[nm] for nm in names),
                 idx_d, vals_d, smin_pos, fire_pos, fire_valid, fire_row,
                 purge_mask)
+        if self.routing is not None:
+            args = args + (self._g_dst, self._g_slot)
         if self.compile_tracker is not None:
             out = self.compile_tracker.call(
                 "sharded_superscan", run, args,
@@ -428,15 +619,28 @@ class ShardedFusedPipeline:
             count, states, count_out, field_outs = out
         self._count = count
         self._state = dict(zip(names, states))
-        # [n, R, K_local] -> [R, K] (contiguous key ranges)
-        count_rows = jnp.transpose(count_out, (1, 0, 2)).reshape(self.R, self.K)
+        count_rows, out_rows = self._canonical_fire_rows(
+            count_out, field_outs, names)
+        deferred = DeferredEmissions(self._planner, fires, count_rows,
+                                     out_rows, phase_counts=pc_total)
+        return deferred if defer else deferred.resolve()
+
+    def _canonical_fire_rows(self, count_out, field_outs, names):
+        """[n, R, K_local] per-shard fire slabs -> [R, K] canonical key
+        order: contiguous ranges concatenate; a routing table additionally
+        permutes columns (one deferred device gather — the rows ride the
+        same async readback either way)."""
+        count_rows = jnp.transpose(count_out, (1, 0, 2)).reshape(
+            self.R, self.K)
         out_rows = {
             nm: jnp.transpose(o, (1, 0, 2)).reshape(self.R, self.K)
             for nm, o in zip(names, field_outs)
         }
-        deferred = DeferredEmissions(self._planner, fires, count_rows,
-                                     out_rows, phase_counts=pc_total)
-        return deferred if defer else deferred.resolve()
+        if self.routing is not None:
+            count_rows = jnp.take(count_rows, self._perm_dev, axis=1)
+            out_rows = {nm: jnp.take(o, self._perm_dev, axis=1)
+                        for nm, o in out_rows.items()}
+        return count_rows, out_rows
 
     # ------------------------------------------------------------------
     # traced-chain path (whole-graph fusion over the mesh): every shard
@@ -447,28 +651,31 @@ class ShardedFusedPipeline:
     # ------------------------------------------------------------------
     def _build_raw(self, T: int, B: int):
         phases = self.phase_counters
-        key = ("raw", T, B, phases)
+        combine = self.local_combine
+        routed = self.routing is not None
+        key = ("raw", T, B, phases, combine,
+               None if not routed else self.routing.G)
         if key in self._fn_cache:
             return self._fn_cache[key]
 
         n, Kl, K, S, axis = self.n, self.K_local, self.K, self.S, self.axis
         NSB, R = self.NSB, self.R
-        lanes = n * B   # post-shuffle ingest width per shard
-        chunk = self.chunk
-        while lanes % chunk != 0:
-            chunk //= 2
-        step = make_superscan_step(
-            self.agg, Kl, S, NSB, self.F, R, self._planner.spw, chunk,
-            self.exact, ingest=default_ingest(), phase_counters=phases,
-            fire_spws=self._planner._fire_spws,
-        )
+        # the per-shard superscan body ingests n*B post-shuffle lanes
+        step = self._make_step(n * B, phases)
         nf = len(self._value_fields)
+        partials_fn = self._make_partials_fn(B) if combine else None
+        scatters = [f.scatter for f in self._value_fields]
         pro = self.prologue
         needs_ts = pro.needs_ts
         transforms = tuple(pro.transforms)
         key_fn, value_fn = pro.key_fn, pro.value_fn
 
         def per_shard(count, state_t, raw, srel, *rest):
+            if routed:
+                *rest, g_dst, g_slot = rest
+                owner = self._dst_and_local((g_dst, g_slot))
+            else:
+                owner = self._dst_and_local(None)
             count = count[0]
             raw = raw[0]
             srel = srel[0]
@@ -482,6 +689,9 @@ class ShardedFusedPipeline:
                 for i, f in enumerate(self._value_fields)
             }
             base = jax.lax.axis_index(axis).astype(jnp.int32) * Kl
+            if combine:
+                exchange = self._exchange_partials(
+                    partials_fn, step, scatters)
 
             def routed_step(carry, args):
                 inner, key_bounds = carry
@@ -518,24 +728,44 @@ class ShardedFusedPipeline:
                     jnp.minimum(key_bounds[1],
                                 jnp.min(jnp.where(mask, keys, jnp.int32(0)))),
                 ])
-                # the keyBy exchange: bin by owning key range, one
-                # all-to-all over the mesh interconnect per step
-                dst = jnp.where(live, keys // Kl, -1)
-                rows = jnp.arange(n, dtype=jnp.int32)[:, None]
-                route = rows == dst[None, :]                     # [n, B]
-                send_idx = jnp.where(route, idx[None, :], -1)
-                recv_idx = jax.lax.all_to_all(
-                    send_idx, axis, split_axis=0, concat_axis=0, tiled=False
-                ).reshape(-1)                                    # [n*B]
-                local_idx = jnp.where(
-                    recv_idx >= 0, recv_idx - base * NSB, -1)
                 if nf:
                     vcol = value_fn(col) if value_fn is not None else col
                     # dead/pad rows hold uninitialized staging bytes; zero
                     # them BEFORE the shuffle so 0 * NaN can never poison
-                    # an owner shard's sums
+                    # an owner shard's sums (combine path: a NaN times a
+                    # zero one-hot in the partial histogram, same hazard)
                     vals = jnp.where(
                         live, jnp.asarray(vcol).astype(jnp.float32), 0.0)
+                else:
+                    vals = jnp.zeros((1,), jnp.float32)
+                if combine:
+                    # the map-side combiner: this shard's survivors
+                    # segment-reduce by (owner, key, rel-slice) and ONLY
+                    # the dense partials cross the interconnect — a hot
+                    # key costs n partials per slice, not its tuple mass
+                    dst, lidx = owner(live, keys, srel_row)
+                    pidx = jnp.where(live, dst * (Kl * NSB) + lidx, -1)
+                    inner, _ = exchange(inner, pidx, vals, plan_row)
+                    return (inner, key_bounds), None
+                # the keyBy exchange: bin by owning key range, one
+                # all-to-all over the mesh interconnect per step
+                if routed:
+                    # route-raw under a table: sender-side localization
+                    dst, send_payload = owner(live, keys, srel_row)
+                    localize = lambda r: r                 # noqa: E731
+                else:
+                    dst = jnp.where(live, keys // Kl, -1)
+                    send_payload = idx
+                    localize = lambda r: jnp.where(        # noqa: E731
+                        r >= 0, r - base * NSB, -1)
+                rows = jnp.arange(n, dtype=jnp.int32)[:, None]
+                route = rows == dst[None, :]                     # [n, B]
+                send_idx = jnp.where(route, send_payload[None, :], -1)
+                recv_idx = jax.lax.all_to_all(
+                    send_idx, axis, split_axis=0, concat_axis=0, tiled=False
+                ).reshape(-1)                                    # [n*B]
+                local_idx = localize(recv_idx)
+                if nf:
                     send_v = jnp.where(route, vals[None, :], 0.0)
                     recv_v = jax.lax.all_to_all(
                         send_v, axis, split_axis=0, concat_axis=0,
@@ -597,6 +827,8 @@ class ShardedFusedPipeline:
             P(None), P(None, None), P(None, None), P(None, None),
             P(None, None),                                # plan (replicated)
         )
+        if routed:
+            in_specs = in_specs + (P(None), P(None))      # routing tables
         sharded = shard_map(
             per_shard, mesh=self.mesh,
             in_specs=in_specs, out_specs=out_specs, check_vma=False,
@@ -687,6 +919,8 @@ class ShardedFusedPipeline:
         if ts_d is not None:
             args = args + (ts_d,)
         args = args + (smin_pos, fire_pos, fire_valid, fire_row, purge_mask)
+        if self.routing is not None:
+            args = args + (self._g_dst, self._g_slot)
         if self.compile_tracker is not None:
             out = self.compile_tracker.call(
                 "sharded_chained_superscan", run, args,
@@ -704,11 +938,8 @@ class ShardedFusedPipeline:
             count, states, count_out, field_outs, kb = out
         self._count = count
         self._state = dict(zip(names, states))
-        count_rows = jnp.transpose(count_out, (1, 0, 2)).reshape(self.R, self.K)
-        out_rows = {
-            nm: jnp.transpose(o, (1, 0, 2)).reshape(self.R, self.K)
-            for nm, o in zip(names, field_outs)
-        }
+        count_rows, out_rows = self._canonical_fire_rows(
+            count_out, field_outs, names)
         deferred = DeferredEmissions(self._planner, fires, count_rows,
                                      out_rows, key_bounds=kb,
                                      key_capacity=self.K,
@@ -727,16 +958,16 @@ class ShardedFusedPipeline:
     # ------------------------------------------------------------------
     def gather_key_rows(self, kids):
         k = np.asarray(kids, np.int64)
-        counts = np.asarray(self._count).reshape(self.K, self.S)[k]
-        fields = {
-            name: np.asarray(a).reshape(self.K, self.S)[k]
-            for name, a in self._state.items()
-        }
-        return counts, fields
+        count, state = self._canonical_arrays()
+        return count[k], {name: v[k] for name, v in state.items()}
 
     def _put_canonical(self, count: np.ndarray,
                        state: "Dict[str, np.ndarray]") -> None:
         n, Kl, S = self.n, self.K_local, self.S
+        if self.routing is not None:
+            count = self.routing.to_device_layout(np.asarray(count))
+            state = {k: self.routing.to_device_layout(np.asarray(v))
+                     for k, v in state.items()}
         self._count = jax.device_put(
             jnp.asarray(count.reshape(n, Kl, S)),
             self._shard_spec(None, None))
@@ -749,49 +980,48 @@ class ShardedFusedPipeline:
 
     def clear_key_rows(self, kids) -> None:
         k = np.asarray(kids, np.int64)
-        count = np.asarray(self._count).reshape(self.K, self.S).copy()
+        count, state = self._canonical_arrays()
+        count = count.copy()
         count[k] = 0
         idents = {f.name: f.identity for f in self._value_fields}
-        state = {}
-        for name, a in self._state.items():
-            arr = np.asarray(a).reshape(self.K, self.S).copy()
+        new_state = {}
+        for name, arr in state.items():
+            arr = arr.copy()
             arr[k] = idents[name]
-            state[name] = arr
-        self._put_canonical(count, state)
+            new_state[name] = arr
+        self._put_canonical(count, new_state)
 
     def write_cells(self, kids, spos, counts, fields) -> None:
         k = np.asarray(kids, np.int64)
         s = np.asarray(spos, np.int64)
-        count = np.asarray(self._count).reshape(self.K, self.S).copy()
+        count, state = self._canonical_arrays()
+        count = count.copy()
         count[k, s] = np.asarray(counts)
-        state = {}
-        for name, a in self._state.items():
-            arr = np.asarray(a).reshape(self.K, self.S).copy()
+        new_state = {}
+        for name, arr in state.items():
+            arr = arr.copy()
             arr[k, s] = np.asarray(fields[name], arr.dtype)
-            state[name] = arr
-        self._put_canonical(count, state)
+            new_state[name] = arr
+        self._put_canonical(count, new_state)
 
     def gather_cells(self, kids, spos):
         k = np.asarray(kids, np.int64)
         s = np.asarray(spos, np.int64)
-        counts = np.asarray(self._count).reshape(self.K, self.S)[k, s]
-        fields = {
-            name: np.asarray(a).reshape(self.K, self.S)[k, s]
-            for name, a in self._state.items()
-        }
-        return counts, fields
+        count, state = self._canonical_arrays()
+        return (count[k, s],
+                {name: v[k, s] for name, v in state.items()})
 
     # ------------------------------------------------------------------
     def snapshot(self) -> dict:
         """Canonical [K, S] global arrays — interchangeable with single-chip
         FusedWindowPipeline snapshots (restore re-shards, so n -> m shard
-        rescaling is just snapshot + restore)."""
+        rescaling is just snapshot + restore). A routing table un-permutes
+        before writing, so checkpoints are routing-independent too: any
+        mesh size with any table restores the same snapshot."""
+        count, state = self._canonical_arrays()
         snap = {
-            "state": {
-                k: np.asarray(v).reshape(self.K, self.S)
-                for k, v in self._state.items()
-            },
-            "count": np.asarray(self._count).reshape(self.K, self.S),
+            "state": state,
+            "count": count,
             "watermark": self._planner.watermark,
             "fire_cursor": self._planner.fire_cursor,
             "purged_to": self._planner.purged_to,
@@ -837,16 +1067,16 @@ class ShardedFusedPipeline:
             self.K = snap_k
             self.K_local = snap_k // self.n
             self._planner.K = snap_k
+            if self.routing is not None:
+                # table is sized to K: rebuild at identity for the adopted
+                # capacity (the snapshot is canonical — any table is a
+                # valid placement of it)
+                self.routing = KeyGroupRouting(
+                    snap_k, self.n, self._num_key_groups,
+                    version=self.routing.version + 1)
+                self._refresh_route_tables()
             self._fn_cache.clear()
-        n, Kl, S = self.n, self.K_local, self.S
-        self._count = jax.device_put(
-            jnp.asarray(count.reshape(n, Kl, S)),
-            self._shard_spec(None, None))
-        self._state = {
-            k: jax.device_put(
-                jnp.asarray(v.reshape(n, Kl, S)), self._shard_spec(None, None))
-            for k, v in state.items()
-        }
+        self._put_canonical(count, state)
         self._planner.watermark = snap["watermark"]
         self._planner.fire_cursor = snap["fire_cursor"]
         self._planner.purged_to = snap["purged_to"]

@@ -277,10 +277,15 @@ def _shard_combine(key: str) -> str:
     if leaf.startswith("current"):
         return "min"
     if leaf in ("keySkew", "recompileStorm", "hotKeyLoad", "meshLoadSkew",
-                "meshDevices") or leaf in _PER_DEVICE_MAX_GAUGES:
+                "meshDevices") or leaf in _PER_DEVICE_MAX_GAUGES \
+            or leaf in _REBALANCE_GAUGES:
         # meshDevices included: each shard reports ITS mesh size — summing
         # across shards would misreport a plain 2-shard job as a 2-device
-        # mesh (the job-level view is the largest mesh any shard runs)
+        # mesh (the job-level view is the largest mesh any shard runs).
+        # The skew-rebalance family folds MAX for the same shape reason:
+        # rebalance counts, table versions, and durations are per-mesh
+        # facts every shard of that mesh reports identically — summing
+        # would multiply them by the shard count
         return "max"
     if "Ratio" in leaf or leaf.endswith("TimeMsPerSecond") \
             or leaf.endswith("UtilizationPct") or "inPoolUsage" in key:
@@ -299,6 +304,14 @@ def _shard_combine(key: str) -> str:
 #: one program for the whole mesh, so it has no per-device form)
 _PER_DEVICE_MAX_GAUGES = ("keySkewPerDevice", "hotKeyLoadPerDevice",
                           "meshDeviceLoad")
+
+#: skew-rebalance gauge family (parallel.mesh.skew-rebalance, registered
+#: by the in-process job master): per-mesh facts every shard reports
+#: identically, so they fold MAX (the _TIER_GAUGES-omission lesson: a
+#: family missing from BOTH the fold rule and the device payload filters
+#: silently reads as 0 / absent at the job level)
+_REBALANCE_GAUGES = ("meshRebalances", "routingTableVersion",
+                     "lastRebalanceDurationMs")
 
 #: state-tier gauge family (state/tier_manager.py, registered by the
 #: window-step runner): counters and sizes SUM across shards — each shard
@@ -967,13 +980,15 @@ class JobManagerEndpoint(RpcEndpoint):
                 "flopsUtilizationPct", "meshLoadSkew", "meshDevices")
             or k.rsplit(".", 1)[-1] in _TIER_GAUGES
             or k.rsplit(".", 1)[-1] in _PER_DEVICE_MAX_GAUGES
+            or k.rsplit(".", 1)[-1] in _REBALANCE_GAUGES
         }
         payload["metrics"] = device_keys
         payload["per_shard"] = {
             s: {k: v for k, v in snap.items()
                 if ".device." in k or "keySkew" in k or "meshLoadSkew" in k
                 or k.rsplit(".", 1)[-1] in _TIER_GAUGES
-                or k.rsplit(".", 1)[-1] in _PER_DEVICE_MAX_GAUGES}
+                or k.rsplit(".", 1)[-1] in _PER_DEVICE_MAX_GAUGES
+                or k.rsplit(".", 1)[-1] in _REBALANCE_GAUGES}
             for s, snap in per_shard.items()
         }
         payload["enabled"] = bool(device_keys or events)

@@ -233,6 +233,18 @@ def _mesh_for_config(config: Configuration, key_capacity: int):
     return build_mesh(n)
 
 
+def _mesh_exchange_kwargs(config: Configuration) -> dict:
+    """The skew-adaptive exchange options threaded to FusedWindowOperator
+    (ignored off the mesh): the map-side combiner and the key-group
+    routing table (docs/multichip.md). Single-sourced so the classic and
+    traced-chain construction sites can never drift."""
+    return {
+        "mesh_local_combine": config.get(ParallelOptions.MESH_LOCAL_COMBINE),
+        "mesh_skew_routing": config.get(ParallelOptions.MESH_SKEW_REBALANCE),
+        "mesh_key_groups": config.get(ParallelOptions.MESH_KEY_GROUPS),
+    }
+
+
 def _tier_for_config(config: Configuration):
     """The fused window path's TierConfig when the million-key state
     plane applies (state.tier.enabled), else None. Tiering needs the host
@@ -262,12 +274,21 @@ class MeshRescaleRequested(BaseException):
     device count and the step-aligned state capture the rebuilt runtime
     restores from (checkpoint rewind across device counts — the snapshot
     is canonical [K, S], so any mesh size re-shards it). BaseException so
-    ordinary `except Exception` operator guards can never swallow it."""
+    ordinary `except Exception` operator guards can never swallow it.
 
-    def __init__(self, target: int, snapshot: dict):
-        super().__init__(f"mesh rescale to {target} devices")
+    With `routing` set this is a skew REBALANCE, not a resize: the mesh
+    size stays `target` (== current) and the rebuilt runtime applies the
+    new key-group -> device assignment BEFORE restoring the capture —
+    placement changes ride the same exactly-once capture/restore
+    machinery, and checkpoints stay canonical [K, S] throughout."""
+
+    def __init__(self, target: int, snapshot: dict, routing=None):
+        super().__init__(
+            f"mesh rescale to {target} devices" if routing is None
+            else f"mesh key-group rebalance over {target} devices")
         self.target = int(target)
         self.snapshot = snapshot
+        self.routing = routing
 
 
 def _columnarize_records(vals, where: str):
@@ -574,6 +595,7 @@ class WindowStepRunner(StepRunner):
                 # SPMD over the mesh; None keeps today's single-chip path
                 mesh=_mesh_for_config(config, capacity),
                 tier=tier,
+                **_mesh_exchange_kwargs(config),
             )
             self.device = True
         elif use_device:
@@ -949,6 +971,7 @@ class DeviceChainRunner(WindowStepRunner):
             # runs on each device's slice and one in-scan all-to-all per
             # step is the keyBy exchange
             mesh=_mesh_for_config(config, capacity),
+            **_mesh_exchange_kwargs(config),
             **({} if assigners is None else {"assigners": list(assigners)}),
         )
         self.device = True
@@ -2018,6 +2041,46 @@ class JobRuntime:
             default=1,
         )
 
+    # -- skew-aware key-group routing (parallel.mesh.skew-rebalance) ----
+    def _routed_ops(self):
+        for r in self.runners:
+            op = getattr(r, "op", None)
+            if op is not None and callable(
+                    getattr(op, "routing_version", None)) \
+                    and op.routing_version() is not None:
+                yield op
+
+    def mesh_routing_version(self) -> Optional[int]:
+        """Highest routing-table version across mesh operators (None when
+        no operator carries a table)."""
+        versions = [op.routing_version() for op in self._routed_ops()]
+        return max(versions) if versions else None
+
+    def mesh_group_loads(self):
+        """(group_loads [G], current assignment [G], mesh size) of the
+        first routed operator — the skew rebalancer's decision input;
+        None when no operator carries a routing table or no data has
+        landed on device yet."""
+        for op in self._routed_ops():
+            loads = op.mesh_group_loads()
+            if loads is not None and loads.sum() > 0:
+                return loads, op.pipe.routing.assign, op.mesh_devices()
+        return None
+
+    def set_mesh_routing(self, assign) -> None:
+        """Apply a key-group assignment to every routed operator (the
+        rebuilt attempt of a rebalance, AFTER restore — restore may adopt
+        a grown snapshot K and rebuild the table for the new capacity).
+        An assignment sized for a DIFFERENT group count is skipped, not
+        an error: the geometry changed between decision and application
+        (capacity growth mid-flight), and the rebalancer simply
+        re-decides from live skew under the new table."""
+        assign = np.asarray(assign)
+        for op in self._routed_ops():
+            if assign.shape[0] != op.pipe.routing.G:
+                continue
+            op.set_routing_assignment(assign)
+
     def operator_state_bytes(self) -> Dict[str, int]:
         """Per-operator state footprint from the operators' own
         state_bytes() (the same source as the stateBytes gauges) — the
@@ -2051,7 +2114,11 @@ class JobRuntime:
             timer = getattr(r, "device_timer", None)
             tier_fn = getattr(getattr(r, "op", None), "tier_payload", None)
             has_tier = callable(tier_fn) and tier_fn() is not None
-            if tracker is None and ks is None and not has_tier:
+            routing_fn = getattr(getattr(r, "op", None), "routing_payload",
+                                 None)
+            has_routing = callable(routing_fn) and routing_fn() is not None
+            if tracker is None and ks is None and not has_tier \
+                    and not has_routing:
                 continue
             entry: Dict[str, Any] = {}
             if timer is not None:
@@ -2073,6 +2140,15 @@ class JobRuntime:
                 tp = tier_payload()
                 if tp is not None:
                     entry["tier"] = tp
+            # skew-aware key-group routing (parallel.mesh.skew-rebalance):
+            # table version + assignment, next to the per-device skew it
+            # exists to fix
+            routing_payload = getattr(getattr(r, "op", None),
+                                      "routing_payload", None)
+            if callable(routing_payload):
+                rp = routing_payload()
+                if rp is not None:
+                    entry["routing"] = rp
             ops[getattr(r, "uid", f"runner-{idx}")] = entry
         payload["operators"] = ops
         payload["compile"] = merge_compile_payloads(
@@ -2094,6 +2170,7 @@ class JobRuntime:
         cancel_check: Optional[Callable[[], bool]] = None,
         savepoint_request: Optional[Callable[[], Optional[str]]] = None,
         rescale_request: Optional[Callable[[], Optional[int]]] = None,
+        rebalance_request: Optional[Callable[[], Optional[Any]]] = None,
     ) -> None:
         batch_size = self.config.get(ExecutionOptions.BATCH_SIZE)
         if coordinator is not None:
@@ -2113,7 +2190,8 @@ class JobRuntime:
                               RuntimeWarning)
         try:
             self._run_loop(batch_size, coordinator, cancel_check,
-                           savepoint_request, rescale_request)
+                           savepoint_request, rescale_request,
+                           rebalance_request)
         finally:
             if profiling:
                 try:
@@ -2135,6 +2213,7 @@ class JobRuntime:
         cancel_check: Optional[Callable[[], bool]],
         savepoint_request: Optional[Callable[[], Optional[str]]],
         rescale_request: Optional[Callable[[], Optional[int]]] = None,
+        rebalance_request: Optional[Callable[[], Optional[Any]]] = None,
     ) -> None:
         for d in self.sources:
             if d.current_split is None and not d.done:
@@ -2235,6 +2314,17 @@ class JobRuntime:
                         # across mesh sizes, exactly-once by construction
                         # (the capture IS the checkpoint path's capture)
                         raise MeshRescaleRequested(target, self.capture())
+                if rebalance_request is not None:
+                    assign = rebalance_request()
+                    if assign is not None:
+                        # skew rebalance: same capture/restore machinery as
+                        # a rescale, same mesh size, new key-group routing
+                        # — the rebuilt attempt applies the table, then
+                        # restores the canonical capture (placement never
+                        # changes a result)
+                        raise MeshRescaleRequested(
+                            self.mesh_devices(), self.capture(),
+                            routing=assign)
                 now_ms = time.time() * 1000.0
                 if now_ms - self._last_pt_tick >= 50.0:
                     # ProcessingTimeService tick: drive wall-clock timers

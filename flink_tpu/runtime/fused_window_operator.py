@@ -455,6 +455,9 @@ class FusedWindowOperator:
         mesh=None,
         tier=None,
         assigners=None,
+        mesh_local_combine: bool = False,
+        mesh_skew_routing: bool = False,
+        mesh_key_groups: int = 0,
     ):
         self.agg = resolve(aggregate)
         if self.agg is None:
@@ -505,6 +508,12 @@ class FusedWindowOperator:
                 key_capacity=key_capacity, num_slices=num_slices, nsb=nsb,
                 fires_per_step=fires_per_step, out_rows=out_rows,
                 chunk=chunk, prologue=prologue, assigners=assigners,
+                # skew-adaptive exchange (parallel.mesh.local-combine /
+                # .skew-rebalance): pure perf switches over the same exact
+                # results — see docs/multichip.md
+                local_combine=mesh_local_combine,
+                skew_routing=mesh_skew_routing,
+                num_key_groups=mesh_key_groups,
             )
         elif assigners is not None:
             from flink_tpu.runtime.fused_window_pipeline import (
@@ -951,6 +960,33 @@ class FusedWindowOperator:
     def mesh_devices(self) -> int:
         """Devices this operator's state is sharded over (1 = single chip)."""
         return int(getattr(self.pipe, "n", 1))
+
+    # -- skew-aware key-group routing (parallel.mesh.skew-rebalance) ----
+    def routing_version(self):
+        """Version of the mesh routing table (None off the mesh or with
+        static routing)."""
+        fn = getattr(self.pipe, "routing_version", None)
+        return fn() if callable(fn) else None
+
+    def routing_payload(self):
+        """/jobs/:id/device routing block (None without a table)."""
+        fn = getattr(self.pipe, "routing_payload", None)
+        return fn() if callable(fn) else None
+
+    def mesh_group_loads(self):
+        """Per-key-group resident loads [G] — the rebalancer's decision
+        input; None without a routing table."""
+        fn = getattr(self.pipe, "mesh_group_loads", None)
+        return fn() if callable(fn) else None
+
+    def set_routing_assignment(self, assign) -> int:
+        """Apply a new key-group -> device map at an operator-quiescent
+        point: any in-flight dispatch resolves FIRST (its fire rows were
+        produced under the old table and must canonicalize under it), then
+        the table swaps and the device rows re-lay. Exactly-once by
+        construction — canonical state and cursors never change."""
+        self._resolve_inflight()
+        return self.pipe.set_routing_assignment(assign)
 
     def mesh_capacity(self) -> int:
         """The key capacity the mesh clamp used at CONSTRUCTION time — a

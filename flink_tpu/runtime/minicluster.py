@@ -114,6 +114,12 @@ class JobClient:
         self.mesh_rescales = 0
         self.last_mesh_rescale_duration_ms = 0.0
         self._mesh_rescale_target: Optional[int] = None
+        # skew-aware key-group routing (parallel.mesh.skew-rebalance):
+        # completed routing-table rebalances on this job + the policy
+        # object that decided them (scheduler/rebalancer.py)
+        self.mesh_rebalances = 0
+        self.last_mesh_rebalance_duration_ms = 0.0
+        self.rebalancer = None
 
     # -- status -----------------------------------------------------------
     def status(self) -> JobStatus:
@@ -348,6 +354,31 @@ class MiniCluster:
         mesh_enabled = config.get(ParallelOptions.MESH_ENABLED)
         mesh_autoscale = (mesh_enabled
                           and config.get(ParallelOptions.MESH_AUTOSCALE))
+        # skew-aware key-group routing (parallel.mesh.skew-rebalance): the
+        # scheduler-side policy decides, the run loop executes at a
+        # step-aligned boundary through the rescale capture/restore
+        # machinery. Gauges register whenever the mesh is on, so the
+        # observability surface is uniform (0 / version until a table
+        # exists — the numRescales pattern above).
+        skew_rebalance = (mesh_enabled
+                          and config.get(ParallelOptions.MESH_SKEW_REBALANCE))
+        if mesh_enabled:
+            job_group.gauge("meshRebalances",
+                            lambda: client.mesh_rebalances)
+            job_group.gauge("lastRebalanceDurationMs",
+                            lambda: client.last_mesh_rebalance_duration_ms)
+            job_group.gauge(
+                "routingTableVersion",
+                lambda: (getattr(client, "_runtime", None) is not None
+                         and client._runtime.mesh_routing_version()) or 0)
+        if skew_rebalance:
+            from flink_tpu.scheduler.rebalancer import SkewRebalancer
+
+            client.rebalancer = SkewRebalancer(
+                skew_threshold=config.get(
+                    ParallelOptions.MESH_REBALANCE_SKEW_THRESHOLD),
+                interval_ms=config.get(
+                    ParallelOptions.MESH_REBALANCE_INTERVAL_MS))
         if config.get(AutoscalerOptions.ENABLED):
             from flink_tpu.metrics.registry import metrics_snapshot
             from flink_tpu.scheduler import AutoscalerCoordinator
@@ -408,6 +439,10 @@ class MiniCluster:
         # device-loss degrade policy; None = the configured size
         mesh_override: Optional[int] = None
         pending_rescale: Optional[dict] = None
+        # routing assignment for the NEXT attempt: set by a skew rebalance
+        # (applied to the rebuilt runtime BEFORE restore, so the canonical
+        # capture lands in the new placement)
+        pending_rebalance: Optional[dict] = None
 
         restore_snap = None
         restore_ms = 0.0
@@ -442,10 +477,10 @@ class MiniCluster:
             try:
                 if restore_snap is not None:
                     runtime.restore(restore_snap)
-                    if pending_rescale is None:
-                        # a live mesh rescale restores from its own
-                        # step-aligned capture, not a stored checkpoint —
-                        # stamping a "restored checkpoint None" record
+                    if pending_rescale is None and pending_rebalance is None:
+                        # a live mesh rescale/rebalance restores from its
+                        # own step-aligned capture, not a stored checkpoint
+                        # — stamping a "restored checkpoint None" record
                         # would pollute the checkpoint-restore telemetry
                         client.checkpoint_stats.report_restore(
                             restore_snap.get("checkpoint_id"), restore_ms)
@@ -475,6 +510,27 @@ class MiniCluster:
                             client.job_id, duration_ms,
                             target=runtime.mesh_devices())
                     pending_rescale = None
+                if pending_rebalance is not None:
+                    # apply the rebalanced routing table AFTER restore:
+                    # restore may ADOPT a grown snapshot K (classic keyed
+                    # path) and rebuild the table for the new capacity —
+                    # applying first would silently discard the
+                    # assignment (or raise on a G mismatch) and the
+                    # rebalancer would re-decide the same move forever.
+                    # The capture is canonical [K, S], so re-laying the
+                    # restored rows under the new table is pure placement
+                    runtime.set_mesh_routing(pending_rebalance["assign"])
+                    # the rebuilt attempt is serving under the new routing
+                    # table: stamp the completed rebalance and restart the
+                    # policy's interval clock so the new placement gets
+                    # fresh traffic before it is judged again
+                    duration_ms = (time.perf_counter()
+                                   - pending_rebalance["t0"]) * 1000.0
+                    client.mesh_rebalances += 1
+                    client.last_mesh_rebalance_duration_ms = duration_ms
+                    if client.rebalancer is not None:
+                        client.rebalancer.rebalance_completed()
+                    pending_rebalance = None
 
                 def cancel_check():
                     client.records_in = runtime.records_in  # progress gauge
@@ -503,12 +559,28 @@ class MiniCluster:
                         return None
                     return eff
 
+                def poll_rebalance(rt=runtime):
+                    # skew rebalance, polled at every step boundary: the
+                    # interval throttle gates FIRST (one clock read per
+                    # step) — only a due tick pays the per-group load
+                    # readback and the balanced replan
+                    reb = client.rebalancer
+                    if reb is None or not reb.due():
+                        return None
+                    info = rt.mesh_group_loads()
+                    if info is None:
+                        return None
+                    loads, assign, n = info
+                    return reb.maybe_decide(loads, assign, n)
+
                 runtime.run(
                     coordinator=coordinator,
                     cancel_check=cancel_check,
                     savepoint_request=lambda: self._savepoint_hook(client, runtime),
                     rescale_request=(poll_mesh_rescale
                                      if mesh_enabled else None),
+                    rebalance_request=(poll_rebalance
+                                       if skew_rebalance else None),
                 )
                 client.records_in = runtime.records_in
                 client._set_status(JobStatus.FINISHED)
@@ -517,31 +589,45 @@ class MiniCluster:
                 client._set_status(JobStatus.CANCELED)
                 return
             except MeshRescaleRequested as mr:
-                # deliberate live rescale, not a failure: rebuild the
-                # runtime over the new device count and restore from the
-                # step-aligned capture the run loop handed us (checkpoint
-                # rewind + key-group re-shard across mesh sizes; no restart
-                # counted, no backoff, restart_attempts untouched)
+                # deliberate live rescale OR skew rebalance, not a
+                # failure: rebuild the runtime (same device count for a
+                # rebalance) and restore from the step-aligned capture the
+                # run loop handed us (checkpoint rewind + key-group
+                # re-shard/re-route; no restart counted, no backoff,
+                # restart_attempts untouched)
                 client.records_in = runtime.records_in
                 mesh_override = mr.target
                 restore_snap = mr.snapshot
                 restore_ms = 0.0
-                pending_rescale = {"t0": time.perf_counter(),
-                                   "target": mr.target}
+                if mr.routing is not None:
+                    pending_rebalance = {"t0": time.perf_counter(),
+                                         "assign": mr.routing}
+                    cause = (f"mesh key-group rebalance over {mr.target} "
+                             "device(s)")
+                    kind = "rebalance"
+                else:
+                    pending_rescale = {"t0": time.perf_counter(),
+                                       "target": mr.target}
+                    cause = f"mesh rescale to {mr.target} device(s)"
+                    kind = "rescale"
                 client._set_status(JobStatus.RESTARTING)
                 client.exceptions.begin_recovery(
                     client.num_restarts,
-                    cause=f"mesh rescale to {mr.target} device(s)",
+                    cause=cause,
                     events_at_failure=client.records_in,
-                    kind="rescale")
+                    kind=kind)
                 continue
             except BaseException as e:  # noqa: BLE001 — failover boundary
                 attempt += 1
                 client.error = e
-                # a mid-rescale failure must not stamp a completed-rescale
-                # duration (PR-6 outcome hygiene): the job degraded into
-                # the plain restart path instead
+                # a mid-rescale/-rebalance failure must not stamp a
+                # completed-rescale/-rebalance duration (PR-6 outcome
+                # hygiene): the job degraded into the plain restart path
+                # instead — the restarted attempt resets to the identity
+                # routing table, consistent with the canonical checkpoint
+                # it restores (the rebalancer re-decides from live skew)
                 pending_rescale = None
+                pending_rebalance = None
                 if (mesh_enabled
                         and config.get(
                             ParallelOptions.MESH_DEGRADE_ON_DEVICE_LOSS)

@@ -178,8 +178,34 @@ function deviceSection(dev) {
     <th>compiles/re</th><th>hbm/flops %</th><th>ingest/fire/purge</th>
     <th>key skew</th><th>active keys</th><th>hot keys</th></tr></thead>
     <tbody>${ops.join("")}</tbody></table>` : "")
+    + skewTable(dev)
     + tierTable(dev)
     + (evs ? `<div class="spans">${evs}</div>` : "");
+}
+
+function skewTable(dev) {
+  // mesh skew panel (parallel.mesh.*): per-device resident load from the
+  // key-stats fold, plus the skew-rebalance routing table when
+  // parallel.mesh.skew-rebalance drives placement — an imbalanced mesh
+  // must be visible as its worst device AND as what the rebalancer last
+  // did about it
+  const rows = Object.entries(dev.operators ?? {})
+    .filter(([, o]) => (o.keys?.perDevice ?? []).length || o.routing)
+    .map(([uid, o]) => {
+      const per = (o.keys?.perDevice ?? [])
+        .map(d => `${d.device}:${fmt(d.records)}`).join(" ");
+      const r = o.routing ?? {};
+      return `<tr><td>${esc(uid)}</td>
+        <td>${fmt(o.keys?.meshLoadSkew, 2)}</td>
+        <td>${esc(per)}</td>
+        <td>${r.version !== undefined ? fmt(r.version) : "static"}</td>
+        <td>${fmt(r.movedGroups)} / ${fmt(r.numKeyGroups)}</td></tr>`;
+    });
+  if (!rows.length) return "";
+  return `<h3>mesh skew</h3><table><thead><tr><th>operator</th>
+    <th>mesh load skew</th><th>per-device records</th>
+    <th>routing version</th><th>moved/groups</th></tr></thead>
+    <tbody>${rows.join("")}</tbody></table>`;
 }
 
 function tierTable(dev) {

@@ -19,6 +19,10 @@ from flink_tpu.scheduler.autoscaler import (
     AutoscalerCoordinator,
     empty_autoscaler_payload,
 )
+from flink_tpu.scheduler.rebalancer import (
+    RebalanceDecision,
+    SkewRebalancer,
+)
 from flink_tpu.scheduler.policy import (
     LearningPolicy,
     RescaleOutcome,
@@ -38,6 +42,8 @@ from flink_tpu.scheduler.signals import (
 __all__ = [
     "AutoscalerCoordinator",
     "empty_autoscaler_payload",
+    "RebalanceDecision",
+    "SkewRebalancer",
     "LearningPolicy",
     "RescaleOutcome",
     "ScalingDecision",

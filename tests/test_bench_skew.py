@@ -1,0 +1,162 @@
+"""Bench smoke gate for the skew scenario matrix (ISSUE-15, ROADMAP 4c).
+
+Runs the real `bench.skew_matrix_microbench` at smoke scale on the
+virtual 8-device CPU mesh (tests/conftest.py forces it) and asserts the
+result carries the `skew_matrix.*` keys every BENCH_*.json must now
+track: a regression that silently drops a matrix cell, breaks any
+parity (mesh-vs-single-chip, combine-on-vs-off, or the rebalanced
+adaptive leg), stops rebalancing under forced zipf(1.0), or craters the
+skewed/uniform ratio fails tier-1, not just a human eyeballing the next
+bench run.
+
+Also pins the single-sourced zipf sampler's distribution shape, so
+"zipf(1.0)" stays the same distribution in every scenario.
+
+The >= 0.8 skewed/uniform acceptance bar is judged on real TPU hardware
+(ICI traffic is what local-combine saves; the 8 virtual "chips" here
+timeshare one CPU and the rebalance rebuild dominates at smoke scale) —
+the CPU floor gates catastrophic regressions only.
+"""
+
+import importlib.util
+import pathlib
+
+import numpy as np
+import pytest
+
+_BENCH = pathlib.Path(__file__).resolve().parents[1] / "bench.py"
+
+#: catastrophic-regression floor for the CPU-mesh skewed/uniform ratio:
+#: the adaptive zipf leg pays a stop-the-world rebalance rebuild that the
+#: uniform leg does not, which at smoke scale legitimately costs ~half
+#: the run; a collapse below this means the skewed path stopped working
+CPU_SKEW_RATIO_FLOOR = 0.15
+
+
+@pytest.fixture(scope="module")
+def bench():
+    spec = importlib.util.spec_from_file_location("bench_skew_smoke",
+                                                  _BENCH)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+@pytest.fixture(scope="module")
+def result(bench):
+    # smoke scale, distinctive geometry (the bench-gate pattern): one
+    # sweep keeps the gate well under the tier-1 budget
+    return bench.skew_matrix_microbench(events=49152, batch=2048,
+                                        num_keys=384, sweeps=1)
+
+
+def test_result_carries_the_tracked_skew_matrix_keys(result):
+    assert "error" not in result, result.get("error")
+    for key in (
+        "devices",
+        "workloads",
+        "cells",
+        "cell_parity",
+        "parity",
+        "combine_parity",
+        "fused_selected",
+        "sharded_selected",
+        "local_combine_active",
+        "static_mesh_load_skew",
+        "post_rebalance_mesh_load_skew",
+        "rebalances",
+        "adaptive",
+        "skewed_uniform_ratio",
+    ):
+        assert key in result, f"bench skew_matrix block lost {key!r}"
+    assert "error" not in result["adaptive"], result["adaptive"]
+
+
+def test_matrix_covers_parallelism_workload_skew(result):
+    """The PDSP-Bench grid: every (workload, parallelism) combination
+    must report BOTH a uniform and a zipf cell with throughput."""
+    cells = {(c["workload"], c["parallelism"], c["skew"]): c
+             for c in result["cells"]}
+    for workload in result["workloads"]:
+        for par in (1, result["devices"]):
+            for skew in ("uniform", "zipf"):
+                cell = cells.get((workload, par, skew))
+                assert cell is not None, (
+                    f"matrix lost the ({workload}, {par}, {skew}) cell")
+                assert cell["tuples_per_sec"] > 0
+
+
+def test_every_cell_at_exact_parity(result):
+    assert result["parity"], result["cell_parity"]
+    for name, ok in result["cell_parity"].items():
+        assert ok, f"mesh vs single-chip parity broken for {name}"
+
+
+def test_local_combine_is_a_pure_perf_switch(result):
+    assert result["fused_selected"] and result["sharded_selected"]
+    assert result["local_combine_active"], (
+        "parallel.mesh.local-combine no longer engages the map-side "
+        "combiner for a decomposable aggregate")
+    assert result["combine_parity"], (
+        "combine-on vs combine-off results diverged — the combiner "
+        "became a semantics switch"
+    )
+
+
+def test_rebalance_fires_and_reduces_mesh_skew(result):
+    assert result["rebalances"] >= 1, (
+        "zero rebalances under forced zipf(1.0) hot-clustered traffic — "
+        "the skew-rebalance loop is dead")
+    static = result["static_mesh_load_skew"]
+    post = result["post_rebalance_mesh_load_skew"]
+    assert isinstance(static, (int, float)) and static > 1.2, (
+        f"static-routing skew {static!r} no longer shows the imbalance "
+        "the scenario constructs")
+    assert isinstance(post, (int, float)) and post < static, (
+        f"post-rebalance meshLoadSkew {post!r} not below the static "
+        f"value {static!r}")
+    assert result["adaptive"]["parity"], (
+        "rebalanced adaptive leg broke exactly-once parity")
+
+
+def test_skewed_uniform_ratio_above_cpu_floor(result):
+    ratio = result["skewed_uniform_ratio"]
+    assert ratio is not None and ratio > CPU_SKEW_RATIO_FLOOR, (
+        f"skewed/uniform ratio {ratio} collapsed below the CPU floor "
+        f"{CPU_SKEW_RATIO_FLOOR} (the 0.8 bar is judged on TPU)")
+
+
+# ---------------------------------------------------------------------------
+# the single-sourced zipf sampler's distribution shape
+# ---------------------------------------------------------------------------
+
+def test_zipf_sampler_shape(bench):
+    n_keys, s, n = 1024, 1.0, 200_000
+    keys = bench.zipf_keys(np.arange(n), n_keys, s)
+    assert keys.min() >= 0 and keys.max() < n_keys
+    freq = np.bincount(keys, minlength=n_keys) / n
+    h = np.sum(1.0 / np.arange(1, n_keys + 1, dtype=np.float64) ** s)
+    # top rank ~ 1/H(n_keys); the first ranks dominate per the power law
+    assert freq[0] == pytest.approx(1.0 / h, rel=0.05)
+    assert freq[1] == pytest.approx(0.5 / h, rel=0.1)
+    top_mass = freq[np.argsort(-freq)[:16]].sum()
+    assert top_mass == pytest.approx(
+        np.sum(1.0 / np.arange(1, 17.0) ** s) / h, rel=0.05)
+    # ranks are (statistically) monotone decreasing
+    assert np.all(freq[:8] > freq[64:72])
+
+
+def test_zipf_sampler_is_stateless_and_permutation_preserves_shape(bench):
+    idx = np.arange(50_000)
+    whole = bench.zipf_keys(idx, 512, 1.0)
+    chunked = np.concatenate([
+        bench.zipf_keys(idx[lo:lo + 7_919], 512, 1.0)
+        for lo in range(0, len(idx), 7_919)])
+    np.testing.assert_array_equal(whole, chunked)
+    perm = np.random.default_rng(3).permutation(512)
+    permuted = bench.zipf_keys(idx, 512, 1.0, hot_perm=perm)
+    np.testing.assert_array_equal(permuted, perm[whole])
+    # same multiset of frequencies, relocated support
+    np.testing.assert_array_equal(
+        np.sort(np.bincount(whole, minlength=512)),
+        np.sort(np.bincount(permuted, minlength=512)))

@@ -136,6 +136,18 @@ def test_path_scoped_rules_are_not_vacuous():
         assert index.get(rel) is not None, (
             f"{rel} missing — the multichip SPMD core moved and the "
             "parallel layer's ARCH001 entry no longer covers it")
+    # the skew-adaptive exchange splits across two layers and both must
+    # stay under their bans: the routing-table LAYOUT algebra lives in
+    # parallel/ (pure numpy, composed by the runtime), while the
+    # rebalance POLICY lives in scheduler/ (decides from telemetry, the
+    # runtime executes through the capture/restore machinery — the
+    # autoscaler's injected-callable pattern)
+    assert index.get("parallel/routing.py") is not None, (
+        "parallel/routing.py missing — the key-group routing table moved "
+        "and the parallel layer's ARCH001 entry no longer covers it")
+    assert index.get("scheduler/rebalancer.py") is not None, (
+        "scheduler/rebalancer.py missing — the skew rebalancer moved and "
+        "the scheduler layer's runtime ban no longer covers it")
     # the million-key state plane must stay in state/ under the state
     # layer's runtime ban: the vocabulary decides placement and the tier
     # manager moves bytes through operator-injected callables — a module
