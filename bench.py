@@ -3040,6 +3040,257 @@ def run_skew_matrix_child(timeout_s: float = 600.0) -> dict:
     return _run_cpu_child('skew-matrix', timeout_s, force_mesh=True)
 
 
+def join_microbench(events: Optional[int] = None,
+                    batch: int = 1024,
+                    num_keys: int = 2048,
+                    span_event_ms: int = 64_000,
+                    zipf_s: float = 1.0) -> dict:
+    """NEXMark-derived streaming-join scenarios (ISSUE-16): the two-input
+    keyed join on the device bucket ring vs the host join oracle.
+
+      - `nexmark_q3` (local item): persons JOIN auctions ON seller with a
+        category filter on the auction side, SLIDING window — the
+        filter+join shape;
+      - `nexmark_q8` (monitor new users): persons JOIN auctions ON seller
+        over a TUMBLING window — the pure windowed equi-join;
+      - both scenarios run UNIFORM and ZIPF(`zipf_s`) key legs (the zipf
+        leg concentrates records per (key, bucket), forcing the adaptive
+        bucket-capacity growth path), each at EXACT row parity against
+        the same job with execution.join.device-enabled off — the host
+        `WindowJoinRunner` oracle;
+      - `join_tuples_per_sec` / `host_join_tuples_per_sec` /
+        `speedup_vs_host_join` per scenario — the >= 20x bar is judged on
+        real TPU hardware (the CPU child gates parity and selection, not
+        the ratio);
+      - `sql` block: the q8 shape as SQL through the planner's JOIN
+        lowering — `sql_fused_selected` (the fused runner actually
+        chosen), the explain describing the device path, row parity vs
+        the interpreted leg, and `fallback_attributed` pinning that a
+        FULL OUTER query refuses with the catalogued reason instead of a
+        bare error;
+      - `sharded` block: the q8 job on the forced 8-device mesh (the
+        sharded ring pipeline), parity vs single-chip.
+    """
+    import jax
+
+    from flink_tpu.api.datastream import StreamExecutionEnvironment
+    from flink_tpu.api.windowing.assigners import (
+        SlidingEventTimeWindows,
+        TumblingEventTimeWindows,
+    )
+    from flink_tpu.config import (
+        Configuration,
+        ExecutionOptions,
+        ParallelOptions,
+    )
+    from flink_tpu.connectors.source import Batch, DataGeneratorSource
+    from flink_tpu.core.watermarks import WatermarkStrategy
+    from flink_tpu.graph.transformation import plan
+    from flink_tpu.runtime.executor import build_runners
+    from flink_tpu.utils.arrays import obj_array
+
+    events = events or int(
+        os.environ.get("BENCH_JOIN_EVENTS", str(1 << 14)))
+    devices = len(jax.devices())
+
+    def keys_of(idx, skewed: bool):
+        if skewed:
+            return zipf_keys(idx, num_keys, zipf_s)
+        return ((idx * 2654435761) % num_keys).astype(np.int64)
+
+    def source(count, side: str, skewed: bool):
+        """Person/auction record stream: (key, payload, category)."""
+        def gen(idx):
+            ks = keys_of(idx, skewed)
+            cat = idx % 3
+            rows = obj_array([(int(k), f"{side}{int(i)}", int(c))
+                              for k, i, c in zip(ks, idx, cat)])
+            ts = 10_000 + idx * span_event_ms // count
+            return Batch(rows, ts.astype(np.int64))
+
+        return DataGeneratorSource(gen, count)
+
+    def build(count, scenario: str, *, device, skewed, mesh_on=False):
+        cfg = Configuration()
+        cfg.set(ExecutionOptions.BATCH_SIZE, batch)
+        cfg.set(ExecutionOptions.KEY_CAPACITY, num_keys)
+        cfg.set(ExecutionOptions.DEVICE_JOINS, device)
+        cfg.set(ParallelOptions.MESH_ENABLED, mesh_on)
+        env = StreamExecutionEnvironment(cfg)
+        wm = WatermarkStrategy.for_bounded_out_of_orderness(0)
+        persons = env.from_source(source(count, "p", skewed),
+                                  watermark_strategy=wm)
+        auctions = env.from_source(source(count, "a", skewed),
+                                   watermark_strategy=wm)
+        if scenario == "nexmark_q3":
+            auctions = auctions.filter(lambda r: r[2] == 0)
+            window = SlidingEventTimeWindows.of(2000, 1000)
+        else:
+            window = TumblingEventTimeWindows.of(1000)
+        sink = (persons.join(auctions)
+                .where(lambda r: r[0]).equal_to(lambda r: r[0])
+                .window(window)
+                .apply(lambda p, a: (p[0], p[1], a[1]))
+                .collect())
+        return env, sink
+
+    # ---- reroute gate: the factory must pick the device runner
+    env_probe, _ = build(batch, "nexmark_q8", device=True, skewed=False)
+    runners, _ = build_runners(plan(env_probe._sinks), env_probe.config)
+    fused_selected = any(
+        type(r).__name__ == "DeviceJoinRunner" for r in runners)
+
+    def run(count, scenario, *, device, skewed, mesh_on=False):
+        env, sink = build(count, scenario, device=device, skewed=skewed,
+                          mesh_on=mesh_on)
+        t0 = time.perf_counter()
+        env.execute()
+        dt = max(time.perf_counter() - t0, 1e-9)
+        return sorted(sink.results), 2 * count / dt
+
+    scenarios: dict = {}
+    all_parity = True
+    n_parity = max(events // 4, batch)
+    for scenario in ("nexmark_q3", "nexmark_q8"):
+        blk: dict = {"window": ("sliding(2000,1000)"
+                                if scenario == "nexmark_q3"
+                                else "tumble(1000)")}
+        for skewed, label in ((False, "uniform"), (True, "zipf")):
+            ref, _ = run(n_parity, scenario, device=False, skewed=skewed)
+            dev, _ = run(n_parity, scenario, device=True, skewed=skewed)
+            blk[f"parity_{label}"] = (len(ref) > 0 and dev == ref)
+            all_parity = all_parity and blk[f"parity_{label}"]
+        rows_d, tps_d = run(events, scenario, device=True, skewed=True)
+        rows_h, tps_h = run(events, scenario, device=False, skewed=True)
+        blk["matches"] = len(rows_d)
+        blk["join_tuples_per_sec"] = round(tps_d, 1)
+        blk["host_join_tuples_per_sec"] = round(tps_h, 1)
+        blk["speedup_vs_host_join"] = round(tps_d / max(tps_h, 1e-9), 4)
+        scenarios[scenario] = blk
+
+    # ---- sharded leg: q8 on the forced mesh vs the single-chip rows
+    sharded: dict = {}
+    try:
+        ref, _ = run(n_parity, "nexmark_q8", device=True, skewed=True)
+        env_m, sink_m = build(n_parity, "nexmark_q8", device=True,
+                              skewed=True, mesh_on=True)
+        runners_m, _ = build_runners(plan(env_m._sinks), env_m.config)
+        djr = [r for r in runners_m
+               if type(r).__name__ == "DeviceJoinRunner"]
+        env_m2, sink_m2 = build(n_parity, "nexmark_q8", device=True,
+                                skewed=True, mesh_on=True)
+        env_m2.execute()
+        sharded = {
+            "sharded_selected": bool(djr and djr[0].sharded),
+            "parity": sorted(sink_m2.results) == ref and len(ref) > 0,
+            "devices": devices,
+        }
+    except Exception as e:  # noqa: BLE001 — the block must survive
+        sharded = {"error": repr(e)[:300]}
+
+    # ---- SQL front door: q8 as SQL through the planner's JOIN lowering
+    sql: dict = {}
+    try:
+        from flink_tpu.table.table_env import TableEnvironment, TableSchema
+
+        def sql_env(device: bool):
+            cfg = Configuration()
+            cfg.set(ExecutionOptions.BATCH_SIZE, batch)
+            cfg.set(ExecutionOptions.DEVICE_JOINS, device)
+            env = StreamExecutionEnvironment(cfg)
+            tenv = TableEnvironment(env)
+            n = min(n_parity, 4096)
+            idx = np.arange(n)
+            pk, ak = keys_of(idx, True), keys_of(idx + n, True)
+            ts = (10_000 + idx * span_event_ms // n).astype(np.int64)
+            tenv.from_rows("person", [
+                {"id": int(k), "name": f"p{i}", "ptime": int(t)}
+                for i, (k, t) in enumerate(zip(pk, ts))],
+                TableSchema(["id", "name", "ptime"], rowtime="ptime"))
+            tenv.from_rows("auction", [
+                {"seller": int(k), "itemid": f"a{i}", "atime": int(t)}
+                for i, (k, t) in enumerate(zip(ak, ts))],
+                TableSchema(["seller", "itemid", "atime"],
+                            rowtime="atime"))
+            return env, tenv
+
+        q8_sql = ("SELECT p.id, p.name, a.itemid FROM person AS p "
+                  "JOIN auction AS a ON p.id = a.seller "
+                  "WINDOW TUMBLE(INTERVAL '1' SECOND)")
+        env_s, tenv_s = sql_env(True)
+        report = tenv_s.explain_sql(q8_sql)
+        sink_s = tenv_s.sql_query(q8_sql).collect()
+        runners_s, _ = build_runners(plan(env_s._sinks), env_s.config)
+        sql_fused = [r for r in runners_s
+                     if type(r).__name__ == "DeviceJoinRunner"]
+        t0 = time.perf_counter()
+        env_s.execute()
+        sql_dt = max(time.perf_counter() - t0, 1e-9)
+
+        env_i, tenv_i = sql_env(False)
+        sink_i = tenv_i.sql_query(q8_sql).collect()
+        env_i.execute()
+
+        def norm(rows):
+            return sorted(tuple(sorted(r.items())) for r in rows)
+
+        full_report = tenv_s.explain_sql(
+            "SELECT p.id, a.itemid FROM person AS p FULL OUTER JOIN "
+            "auction AS a ON p.id = a.seller")
+        sql = {
+            "sql_fused_selected": bool(
+                report.fused and sql_fused and sql_fused[0].sql_origin),
+            "explain": report.describe()[:400],
+            "parity": (norm(sink_s.results) == norm(sink_i.results)
+                       and len(sink_s.results) > 0),
+            "sql_join_tuples_per_sec": round(
+                2 * min(n_parity, 4096) / sql_dt, 1),
+            "fallback_attributed":
+                full_report.reason == "join-full-outer",
+        }
+    except Exception as e:  # noqa: BLE001 — the block must survive
+        sql = {"error": repr(e)[:300]}
+
+    q8 = scenarios["nexmark_q8"]
+    return {
+        "devices": devices,
+        "events": events,
+        "num_keys": num_keys,
+        "zipf_s": zipf_s,
+        "scenarios": scenarios,
+        "parity": bool(all_parity),
+        "fused_selected": bool(fused_selected),
+        "join_tuples_per_sec": q8["join_tuples_per_sec"],
+        "host_join_tuples_per_sec": q8["host_join_tuples_per_sec"],
+        "speedup_vs_host_join": q8["speedup_vs_host_join"],
+        "sharded": sharded,
+        "sql": sql,
+        "workload": "nexmark_join_device_ring",
+    }
+
+
+def child_join() -> None:
+    """Join child: CPU-pinned on the forced 8-device virtual mesh (the
+    sharded leg needs devices; real multi-chip rides ICI)."""
+    _emit({"event": "start", "device": "cpu-join", "pid": os.getpid()})
+    try:
+        import jax
+        from jax._src import xla_bridge as _xb
+
+        jax.config.update("jax_platforms", "cpu")
+        _xb._backend_factories.pop("axon", None)
+        _xb._topology_factories.pop("axon", None)
+    except Exception:
+        pass
+    _emit({"event": "result", "result": join_microbench()})
+
+
+def run_join_child(timeout_s: float = 600.0) -> dict:
+    """Join scenarios in a CPU-pinned child on the forced 8-device
+    virtual mesh."""
+    return _run_cpu_child('join', timeout_s, force_mesh=True)
+
+
 def chaos_microbench(names: Optional[list] = None) -> dict:
     """Resilience gate (ISSUE-10): run the chaos scenario matrix
     (flink_tpu/chaos/scenarios.py — injected rpc flaps, dataplane blips,
@@ -3169,6 +3420,12 @@ def parent_main() -> None:
     skew_matrix = run_skew_matrix_child()
     _emit({"event": "skew_matrix_microbench", "result": skew_matrix})
 
+    # streaming joins (NEXMark q3/q8): the device bucket-ring join vs the
+    # host join oracle — exact parity on uniform AND zipf legs, the SQL
+    # JOIN lowering's reroute gate, and the sharded-mesh leg
+    join_bench = run_join_child()
+    _emit({"event": "join_microbench", "result": join_bench})
+
     def consider(res, rank):
         nonlocal best, best_rank
         if res is None:
@@ -3210,6 +3467,15 @@ def parent_main() -> None:
                 best["millikey_incremental_ratio"] = \
                     millikey.get("incremental_ratio")
             best["skew_matrix"] = skew_matrix
+            best["join"] = join_bench
+            # first-class join keys (ISSUE-16 acceptance): the q8 device
+            # throughput and its ratio to the host join oracle — the
+            # >= 20x bar is judged where this lands on real TPU hardware
+            if join_bench.get("join_tuples_per_sec"):
+                best["join_tuples_per_sec"] = \
+                    join_bench["join_tuples_per_sec"]
+                best["join_speedup_vs_host"] = \
+                    join_bench.get("speedup_vs_host_join")
             # first-class skew keys (ISSUE-15 acceptance): the adaptive
             # zipf/uniform throughput ratio and the post-rebalance device
             # skew, tracked per PR next to the static value they improve
@@ -3340,6 +3606,8 @@ def main() -> None:
             child_millikey()
         elif label == "skew-matrix":
             child_skew_matrix()
+        elif label == "join":
+            child_join()
         elif label == "correlated":
             child_correlated()
         else:

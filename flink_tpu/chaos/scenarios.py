@@ -407,6 +407,101 @@ def scenario_device_dispatch_error() -> Dict[str, Any]:
                    recovery_ms=recovery_ms, attributed=attributed)
 
 
+def _run_mini_join_job(name: str, *, records: int = 1200, batch: int = 100,
+                       chk_dir: Optional[str] = None, interval_ms: int = 1,
+                       timeout_s: float = 120.0):
+    """One two-input keyed windowed JOIN job on the in-process path (the
+    DeviceJoinRunner seam): two generator sources, tumbling event-time
+    inner equi-join, rows collected. Returns (client, sorted rows)."""
+    from flink_tpu.api.datastream import StreamExecutionEnvironment
+    from flink_tpu.api.windowing.assigners import TumblingEventTimeWindows
+    from flink_tpu.config import (
+        CheckpointingOptions,
+        Configuration,
+        ExecutionOptions,
+        RestartOptions,
+    )
+    from flink_tpu.connectors.sink import CollectSink
+    from flink_tpu.connectors.source import Batch, DataGeneratorSource
+    from flink_tpu.core.watermarks import WatermarkStrategy
+    from flink_tpu.utils.arrays import obj_array
+
+    config = Configuration()
+    config.set(ExecutionOptions.BATCH_SIZE, batch)
+    # distinctive ring capacity (the bench-gate pattern: never share
+    # another test family's cached superscan geometry)
+    config.set(ExecutionOptions.KEY_CAPACITY, 768)
+    config.set(RestartOptions.INITIAL_BACKOFF_MS, 1)
+    if chk_dir is not None:
+        config.set(CheckpointingOptions.INTERVAL_MS, interval_ms)
+        config.set(CheckpointingOptions.DIRECTORY, chk_dir)
+        config.set(CheckpointingOptions.MAX_RETAINED, 50)
+
+    def gen(side: str):
+        def _gen(idx: np.ndarray) -> Batch:
+            values = [(int(i % 7), f"{side}{int(i)}") for i in idx]
+            return Batch(obj_array(values), (idx * 10).astype(np.int64))
+        return _gen
+
+    env = StreamExecutionEnvironment(config)
+    wm = WatermarkStrategy.for_monotonous_timestamps()
+    left = env.from_source(
+        DataGeneratorSource(gen("l"), count=records), watermark_strategy=wm)
+    right = env.from_source(
+        DataGeneratorSource(gen("r"), count=records), watermark_strategy=wm)
+    sink = CollectSink()
+    (left.join(right)
+         .where(lambda v: v[0]).equal_to(lambda v: v[0])
+         .window(TumblingEventTimeWindows.of(1000))
+         .apply(lambda a, b: (a[0], a[1], b[1]))
+         .sink_to(sink))
+    client = env.execute_async(name)
+    client.wait(timeout_s)
+    return client, sorted((k, l, r) for k, l, r in sink.results)
+
+
+def scenario_join_restore() -> Dict[str, Any]:
+    """One injected error at the device JOIN ingest boundary (the 6th ring
+    ingest), mid-stream — while both sides hold live ring state inside
+    unfired windows. The job must restart through the normal strategy,
+    restore the bucket rings from the latest checkpoint (geometry first,
+    then re-ingest), and finish with EXACT results vs an undisturbed run:
+    no pair lost from the rings, none double-emitted from already-fired
+    windows. The ExceptionHistory entry must carry `injected: true`."""
+    problems: List[str] = []
+    _oracle_client, expected = _run_mini_join_job("join-oracle")
+    chk = tempfile.mkdtemp(prefix="flink-tpu-join-")
+    try:
+        with fault_injection(rules=[
+            {"scope": "device", "fault": "error", "nth": 6},
+        ]) as plan:
+            client, results = _run_mini_join_job("join-restore",
+                                                 chk_dir=chk)
+    finally:
+        shutil.rmtree(chk, ignore_errors=True)
+    parity = results == expected and len(expected) > 0
+    _check(problems, client.status().value == "FINISHED",
+           f"job ended {client.status().value}")
+    _check(problems, parity, "join result parity broken")
+    _check(problems, client.num_restarts == 1,
+           f"expected 1 restart, saw {client.num_restarts}")
+    _check(problems, plan.total_fired == 1,
+           f"expected 1 injected ingest error, fired {plan.total_fired}")
+    exc = client.exceptions.payload()
+    entry = exc["entries"][0] if exc["entries"] else {}
+    attributed = bool(entry.get("injected"))
+    _check(problems, attributed,
+           "injected join-ingest error not attributed injected:true")
+    recs = exc["recoveries"]
+    recovery_ms = recs[0]["downtime_ms"] if recs else None
+    _check(problems,
+           bool(recs) and recs[0]["restored_checkpoint_id"] is not None,
+           "recovery timeline missing the rewound checkpoint")
+    return _result("join-restore", "mini", plan, problems,
+                   parity=parity, restarts=client.num_restarts,
+                   recovery_ms=recovery_ms, attributed=attributed)
+
+
 def scenario_chip_loss_sharded() -> Dict[str, Any]:
     """Chip/host loss mid-job on the MULTICHIP sharded path: the same
     keyed job runs SPMD over the device mesh (parallel.mesh.enabled), and
@@ -910,6 +1005,7 @@ SCENARIOS: Dict[str, Callable[[], Dict[str, Any]]] = {
     "torn-checkpoint": scenario_torn_checkpoint,
     "storage-brownout": scenario_storage_brownout,
     "device-dispatch-error": scenario_device_dispatch_error,
+    "join-restore": scenario_join_restore,
     "chip-loss-sharded": scenario_chip_loss_sharded,
     "cold-tier-read-error": scenario_cold_tier_read_error,
     "chip-loss-during-rebalance": scenario_chip_loss_during_rebalance,

@@ -1,0 +1,33 @@
+"""Device-side streaming joins: the two-input keyed join subsystem.
+
+Layering (ARCH001): `joins` sits beside `ops`/`state` — it may import
+core, ops, state, config, and the parallel mesh library, and must never
+import runtime, api, table, or scheduler. The runtime's
+`DeviceJoinRunner` drives these pipelines from behind the StepRunner
+boundary; the SQL planner lowers window equi-joins onto them.
+"""
+
+from flink_tpu.joins.pipeline import FusedJoinPipeline, expand_pairs
+from flink_tpu.joins.ring import BucketRing
+from flink_tpu.joins.sharded import ShardedJoinPipeline
+from flink_tpu.joins.spec import (
+    JOIN_FALLBACK_CATALOG,
+    JOIN_FALLBACK_CODES,
+    JoinGeometry,
+    JoinUnsupported,
+    fallback_code,
+    plan_join_geometry,
+)
+
+__all__ = [
+    "BucketRing",
+    "FusedJoinPipeline",
+    "ShardedJoinPipeline",
+    "JoinGeometry",
+    "JoinUnsupported",
+    "JOIN_FALLBACK_CATALOG",
+    "JOIN_FALLBACK_CODES",
+    "fallback_code",
+    "plan_join_geometry",
+    "expand_pairs",
+]

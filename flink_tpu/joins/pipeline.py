@@ -1,0 +1,175 @@
+"""Two rings + the segment cross-match: the fused join pipeline.
+
+`FusedJoinPipeline` owns the left and right `BucketRing`s of one keyed
+join operator and turns a fired window (or interval frontier) into pairs
+of ROW IDS: the match kernel gathers both sides' bucket runs into per-key
+slot lanes on device, and the host expands the per-key cross product into
+flat (left rowid, right rowid, key) arrays with pure vectorized index
+arithmetic — no per-pair Python until the caller applies its join
+function to the payload rows.
+
+Both sides share one `ts_base` (set by the first ingested batch, floored
+to the bucket grid) so relative timestamps are comparable across sides —
+interval-join deltas are (right rel-ts − left rel-ts) directly.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional, Tuple
+
+import numpy as np
+
+from flink_tpu.joins.ring import BucketRing
+from flink_tpu.joins.spec import JoinGeometry
+from flink_tpu.ops.join_ring import build_join_match
+
+
+def _excl_cumsum(a: np.ndarray) -> np.ndarray:
+    out = np.zeros(len(a), dtype=np.int64)
+    np.cumsum(a[:-1], out=out[1:])
+    return out
+
+
+def expand_pairs(lidx, lval, ridx, rval):
+    """Per-key cross product of valid lanes -> (l_rowids, r_rowids, kids).
+
+    All inputs are host [K, *] arrays read back from the match kernel; the
+    expansion is vectorized end to end (the classic repeat/tile-by-group
+    construction), so cost is O(pairs) numpy work, not Python."""
+    lval = np.asarray(lval, dtype=bool)
+    rval = np.asarray(rval, dtype=bool)
+    lcnt = lval.sum(axis=1).astype(np.int64)
+    rcnt = rval.sum(axis=1).astype(np.int64)
+    pairs = lcnt * rcnt
+    total = int(pairs.sum())
+    empty = np.empty(0, dtype=np.int64)
+    if total == 0:
+        return empty, empty, empty
+    lflat = np.asarray(lidx)[lval].astype(np.int64)
+    rflat = np.asarray(ridx)[rval].astype(np.int64)
+    out_l = np.repeat(lflat, np.repeat(rcnt, lcnt))
+    kids = np.repeat(np.arange(len(pairs), dtype=np.int64), pairs)
+    ordinal = np.arange(total, dtype=np.int64) \
+        - np.repeat(_excl_cumsum(pairs), pairs)
+    out_r = rflat[_excl_cumsum(rcnt)[kids] + ordinal % rcnt[kids]]
+    return out_l, out_r, kids
+
+
+class FusedJoinPipeline:
+    """Single-chip orchestration of one device join operator's state."""
+
+    def __init__(self, geom: JoinGeometry,
+                 put=None):
+        self.geom = geom
+        self._put = put
+        self.left = BucketRing(geom, put)
+        self.right = BucketRing(geom, put)
+        self.ts_base: Optional[int] = None
+
+    def regrow(self, geom: JoinGeometry) -> None:
+        """Swap to a larger geometry (more key lanes or record slots),
+        carrying every resident record over — the rings start SMALL and
+        double toward the configured caps, so an idle join never pins
+        cap-sized HBM arrays (the key-capacity growth contract)."""
+        snap = self.snapshot()
+        self.geom = geom
+        self.left = BucketRing(geom, self._put)
+        self.right = BucketRing(geom, self._put)
+        base = self.ts_base if self.ts_base is not None else 0
+        self.left.restore(snap["left"], base)
+        self.right.restore(snap["right"], base)
+
+    # -- ingest ------------------------------------------------------------
+    def ingest(self, side: int, kids: np.ndarray, ts: np.ndarray,
+               rows) -> None:
+        if len(kids) == 0:
+            return
+        if self.ts_base is None:
+            g = self.geom
+            self.ts_base = int(g.offset_ms
+                               + g.bucket_of(int(np.min(ts))) * g.bucket_ms)
+        ring = self.left if side == 0 else self.right
+        ring.ingest(kids, ts, rows, self.ts_base)
+
+    # -- fire --------------------------------------------------------------
+    def _window_buckets(self, start: int, end: int) -> np.ndarray:
+        g = self.geom
+        b0 = (start - g.offset_ms) // g.bucket_ms
+        return np.arange(b0, b0 + (end - start) // g.bucket_ms,
+                         dtype=np.int64)
+
+    def fire_window(self, start: int, end: int
+                    ) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+        """Inner window-join emission for [start, end): (left rowids,
+        right rowids, dense key ids), per-key cross-product order."""
+        buckets = self._window_buckets(start, end)
+        rbs_l, cnt_l = self.left.run_counts(buckets)
+        rbs_r, cnt_r = self.right.run_counts(buckets)
+        if not cnt_l.any() or not cnt_r.any():
+            e = np.empty(0, dtype=np.int64)
+            return e, e, e
+        g = self.geom
+        kern = build_join_match(g.ring_buckets, g.key_capacity,
+                                g.bucket_capacity, len(buckets),
+                                len(buckets), False)
+        lidx, _lts, lval, ridx, _rts, rval, _pairs = kern(
+            self.left.idx_arr, self.left.ts_arr, cnt_l, rbs_l,
+            self.right.idx_arr, self.right.ts_arr, cnt_r, rbs_r,
+            np.int32(0), np.int32(0))
+        return expand_pairs(np.asarray(lidx), np.asarray(lval),
+                            np.asarray(ridx), np.asarray(rval))
+
+    def match_interval(self, left_buckets, right_buckets, lo_ms: int,
+                       hi_ms: int
+                       ) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+        """Interval-join emission: pairs over the given bucket runs whose
+        (right ts − left ts) lies in [lo_ms, hi_ms]."""
+        lb = np.asarray(left_buckets, dtype=np.int64)
+        rb = np.asarray(right_buckets, dtype=np.int64)
+        rbs_l, cnt_l = self.left.run_counts(lb)
+        rbs_r, cnt_r = self.right.run_counts(rb)
+        e = np.empty(0, dtype=np.int64)
+        if not cnt_l.any() or not cnt_r.any():
+            return e, e, e
+        g = self.geom
+        kern = build_join_match(g.ring_buckets, g.key_capacity,
+                                g.bucket_capacity, len(lb), len(rb), True)
+        lidx, _lts, _lv, ridx, _rts, _rv, _pairs, mask = kern(
+            self.left.idx_arr, self.left.ts_arr, cnt_l, rbs_l,
+            self.right.idx_arr, self.right.ts_arr, cnt_r, rbs_r,
+            np.int32(lo_ms), np.int32(hi_ms))
+        k, li, ri = np.nonzero(np.asarray(mask))
+        if len(k) == 0:
+            return e, e, e
+        lidx, ridx = np.asarray(lidx), np.asarray(ridx)
+        return (lidx[k, li].astype(np.int64),
+                ridx[k, ri].astype(np.int64), k.astype(np.int64))
+
+    # -- bookkeeping -------------------------------------------------------
+    def purge_below_window(self, min_live_window_start: int) -> None:
+        g = self.geom
+        min_bucket = (min_live_window_start - g.offset_ms) // g.bucket_ms
+        self.left.purge_below(min_bucket)
+        self.right.purge_below(min_bucket)
+
+    def occupancy(self) -> int:
+        return self.left.occupancy() + self.right.occupancy()
+
+    def occupied_buckets(self) -> list:
+        return sorted(set(self.left.occupied_buckets())
+                      | set(self.right.occupied_buckets()))
+
+    def state_bytes(self) -> int:
+        return self.left.state_bytes() + self.right.state_bytes()
+
+    # -- checkpointing -----------------------------------------------------
+    def snapshot(self) -> dict:
+        return {"ts_base": self.ts_base,
+                "left": self.left.snapshot(),
+                "right": self.right.snapshot()}
+
+    def restore(self, snap: dict) -> None:
+        self.ts_base = snap["ts_base"]
+        base = self.ts_base if self.ts_base is not None else 0
+        self.left.restore(snap["left"], base)
+        self.right.restore(snap["right"], base)

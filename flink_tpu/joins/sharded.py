@@ -1,0 +1,43 @@
+"""Mesh variant of the fused join pipeline: rings sharded over keys.
+
+Both sides' ring arrays [NB, K, C] are laid out with the KEY axis
+partitioned across the mesh (`NamedSharding` over the "shards" axis), so
+each device owns the SAME contiguous key range for both inputs — the
+two-sides-one-owner layout (arXiv 1904.03800's shared-state analysis:
+co-partitioning both sides eliminates cross-worker match traffic). The
+key exchange itself is implicit: ingest scatters replicated host-staged
+coordinates into the key-sharded operand, and GSPMD keeps exactly the
+writes whose key lane lands in each shard's range — the degenerate
+all-to-all where every shard already holds the (replicated) updates. The
+match kernel is per-key throughout, so it partitions with zero
+collectives and the gathered lanes come back key-sharded.
+
+The mesh size is clamped by `usable_mesh_size` (key capacity must divide
+evenly), the same single-sourced clamp every other mesh consumer uses.
+"""
+
+from __future__ import annotations
+
+from flink_tpu.joins.pipeline import FusedJoinPipeline
+from flink_tpu.joins.spec import JoinGeometry
+from flink_tpu.parallel.mesh import SHARD_AXIS, sharded
+
+
+class ShardedJoinPipeline(FusedJoinPipeline):
+    """FusedJoinPipeline with key-sharded ring placement on a mesh."""
+
+    def __init__(self, geom: JoinGeometry, mesh):
+        import jax
+
+        if geom.key_capacity % mesh.shape[SHARD_AXIS] != 0:
+            raise ValueError(
+                f"key capacity {geom.key_capacity} does not divide over "
+                f"{mesh.shape[SHARD_AXIS]} shards (usable_mesh_size must "
+                f"clamp the mesh before building the join pipeline)")
+        self.mesh = mesh
+        spec = sharded(mesh, None, SHARD_AXIS, None)
+        super().__init__(geom, put=lambda a: jax.device_put(a, spec))
+
+    @property
+    def num_shards(self) -> int:
+        return self.mesh.shape[SHARD_AXIS]

@@ -42,6 +42,13 @@ LAYER_FORBIDDEN: Dict[str, List[str]] = {
     # other way around
     "parallel": ["{pkg}.runtime", "{pkg}.api", "{pkg}.table", "{pkg}.cep",
                  "{pkg}.scheduler"],
+    # the join subsystem (geometry/catalog, bucket rings, the fused match
+    # pipeline) sits beside parallel: it may import core/ops/state/config
+    # (and parallel, for the sharded pipeline's mesh handles) — never the
+    # runtime (DeviceJoinRunner composes the pipeline, not the reverse),
+    # api, table, cep, or the scheduler
+    "joins": ["{pkg}.runtime", "{pkg}.api", "{pkg}.table", "{pkg}.cep",
+              "{pkg}.scheduler"],
     # job translation: step planning, the fusion planner (fusion.py) and
     # the Factor-Windows sharing optimizer (window_sharing.py) — all emit
     # pure plan data the executor consumes; a runtime import would invert

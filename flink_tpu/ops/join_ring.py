@@ -1,0 +1,113 @@
+"""Device kernels for the two-input keyed join ring (flink_tpu/joins).
+
+The join state is a pair of per-key time-bucketed rings resident in HBM:
+for each side an int32 row-index array and an int32 relative-timestamp
+array, both shaped [NB, K, C] — NB ring bucket slots on the event-time
+bucket granule (gcd of window size and slide), K dense key ids, C record
+slots per (bucket, key). The host owns an occupancy mirror (counts per
+bucket x key), plans every record's (ring-bucket, key, slot) coordinate,
+and detects overflow BEFORE dispatch — so the ingest kernel is a pure
+vectorized scatter and the fire kernel a pure gather + segment-wise
+cross-match, with no data-dependent control flow on device (the superscan
+discipline: one compiled program per geometry, cached module-level).
+
+Two kernels:
+
+  ingest    scatter a staged batch of (ring-bucket, kid, slot) -> (row
+            index, rel-ts) writes into both ring arrays in one dispatch.
+
+  match     gather the bucket run one window (or interval frontier)
+            covers from BOTH rings and lay each side out as [K, L] slot
+            lanes (L = buckets x C). Validity comes from the host-shipped
+            occupancy counts, never from device state, so purged buckets
+            need no device-side zeroing. For window joins the per-key
+            match set is the full cross product of valid lanes — the pair
+            count is lcnt * rcnt and the host expands pairs from the
+            gathered index lanes. For interval joins the kernel
+            additionally emits the pair mask [K, L, R] restricted by the
+            relative-time bound (arXiv 2303.00793: window join, interval
+            join, and windowed enrich share this one bucketed-ring core —
+            the window join is the mask-free special case).
+
+Both kernels are jitted per geometry via module-level lru_cache, exactly
+like ops/superscan.py, so repeated operators of the same shape share one
+compiled executable. Arrays stay un-donated: the ring is operator state
+and the caller re-binds the returned buffers.
+"""
+
+from __future__ import annotations
+
+from functools import lru_cache
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["build_join_ingest", "build_join_match"]
+
+
+@lru_cache(maxsize=None)
+def build_join_ingest(NB: int, K: int, C: int):
+    """Jitted scatter of one staged batch into a side's ring arrays.
+
+    fn(idx_arr [NB,K,C] i32, ts_arr [NB,K,C] i32,
+       rb [n] i32, kid [n] i32, slot [n] i32, rowidx [n] i32, tsrel [n] i32)
+      -> (idx_arr', ts_arr')
+
+    Coordinates are host-planned and in-bounds by construction (the host
+    mirror raised on overflow before dispatch); padding lanes point at
+    slot C-1 of ring bucket 0 with rowidx/tsrel repeating the real last
+    lane, so `mode="drop"` is never load-bearing for correctness.
+    """
+
+    def ingest(idx_arr, ts_arr, rb, kid, slot, rowidx, tsrel):
+        idx_arr = idx_arr.at[rb, kid, slot].set(rowidx, mode="drop")
+        ts_arr = ts_arr.at[rb, kid, slot].set(tsrel, mode="drop")
+        return idx_arr, ts_arr
+
+    return jax.jit(ingest)
+
+
+@lru_cache(maxsize=None)
+def build_join_match(NB: int, K: int, C: int, n_lb: int, n_rb: int,
+                     interval: bool):
+    """Jitted gather + cross-match over one fired window's bucket run.
+
+    fn(idx_l, ts_l [NB,K,C], cnt_l [n_lb,K] i32, rbs_l [n_lb] i32,
+       idx_r, ts_r [NB,K,C], cnt_r [n_rb,K] i32, rbs_r [n_rb] i32,
+       lo i32, hi i32)
+      -> window join: (lidx [K,L], lts [K,L], lval [K,L] bool,
+                       ridx [K,R], rts [K,R], rval [K,R] bool,
+                       pairs [K] i32)
+      -> interval:    the same, plus mask [K,L,R] bool where the pair's
+                      rel-time delta (rts - lts) lies in [lo, hi]
+
+    L = n_lb*C, R = n_rb*C. The gathered lanes are what the host expands
+    emissions from; for the window join the mask is implied by the
+    validity lanes (full per-key cross product), so it is never
+    materialized or read back.
+    """
+
+    def match(idx_l, ts_l, cnt_l, rbs_l, idx_r, ts_r, cnt_r, rbs_r, lo, hi):
+        def lanes(idx_arr, ts_arr, cnt, rbs, nb):
+            # [nb, K, C] -> [K, nb*C]: per-key slot lanes over the run
+            gi = jnp.transpose(idx_arr[rbs], (1, 0, 2)).reshape(K, nb * C)
+            gt = jnp.transpose(ts_arr[rbs], (1, 0, 2)).reshape(K, nb * C)
+            # valid: [K, nb, C] -> [K, nb*C], matching the gather layout
+            valid = (jnp.arange(C, dtype=jnp.int32)[None, None, :]
+                     < cnt.T[:, :, None])
+            return gi, gt, valid.reshape(K, nb * C)
+
+        lidx, lts, lval = lanes(idx_l, ts_l, cnt_l, rbs_l, n_lb)
+        ridx, rts, rval = lanes(idx_r, ts_r, cnt_r, rbs_r, n_rb)
+        lcnt = jnp.sum(lval, axis=1, dtype=jnp.int32)
+        rcnt = jnp.sum(rval, axis=1, dtype=jnp.int32)
+        if not interval:
+            pairs = lcnt * rcnt
+            return lidx, lts, lval, ridx, rts, rval, pairs
+        delta = rts[:, None, :] - lts[:, :, None]          # [K, L, R]
+        mask = (lval[:, :, None] & rval[:, None, :]
+                & (delta >= lo) & (delta <= hi))
+        pairs = jnp.sum(mask, axis=(1, 2), dtype=jnp.int32)
+        return lidx, lts, lval, ridx, rts, rval, pairs, mask
+
+    return jax.jit(match)

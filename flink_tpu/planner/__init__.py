@@ -28,6 +28,7 @@ from typing import Dict, Optional
 
 from flink_tpu.planner.logical import (  # noqa: F401 — public surface
     FALLBACK_CATALOG,
+    JoinLogicalPlan,
     LogicalPlan,
     TableInfo,
     Unsupported,
@@ -82,9 +83,23 @@ def plan_query(
                              detail=u.detail)
     lowered = None
     if sources is not None:
-        src = sources.get(q.table)
-        if src is None:
-            return SqlPlanReport(path="interpreted", reason="unknown-table",
-                                 detail=f"no source for {q.table!r}")
-        lowered = lower(plan, src)
+        if isinstance(plan, JoinLogicalPlan):
+            # fused windowed join: the planner validated the shape; the
+            # two-input stream construction happens in the table layer
+            # (row streams are an api-layer concern), which stamps the
+            # window_join transformation sql_origin so the runtime's
+            # DeviceJoinRunner counts as the SQL-fused selection. The
+            # report stays `lowered=None` by design.
+            for name in (q.table, q.join.table2):
+                if sources.get(name) is None:
+                    return SqlPlanReport(
+                        path="interpreted", reason="unknown-table",
+                        detail=f"no source for {name!r}")
+        else:
+            src = sources.get(q.table)
+            if src is None:
+                return SqlPlanReport(
+                    path="interpreted", reason="unknown-table",
+                    detail=f"no source for {q.table!r}")
+            lowered = lower(plan, src)
     return SqlPlanReport(path="fused", plan=plan, lowered=lowered)

@@ -36,8 +36,21 @@ from flink_tpu.table.sql import BoolExpr, Operand, Query, SelectItem
 FALLBACK_CATALOG: Dict[str, str] = {
     "disabled": "table.device-fusion is off; every statement interprets",
     "unknown-table": "the statement references an unregistered table",
-    "join": "joins (windowed and regular) execute on the host join "
-            "operators until the mesh join path lands",
+    "join": "join shapes outside the fused core (aggregates or GROUP BY "
+            "over a join) stay on the host join translation",
+    "join-unwindowed": "regular (non-windowed) joins keep unbounded "
+                       "two-sided state with retraction output; the device "
+                       "join ring is windowed, so they run on the host "
+                       "StreamingJoinRunner",
+    "join-outer-windowed": "windowed LEFT/RIGHT OUTER joins need "
+                           "end-of-window padding the device emission does "
+                           "not produce; only windowed INNER joins fuse",
+    "join-full-outer": "FULL OUTER JOIN is not supported on any path: "
+                       "neither the host join operators nor the device "
+                       "join ring implements two-sided padding retraction",
+    "join-session-window": "SESSION windows are not sliceable, so a "
+                           "session-windowed join has no bucket-ring form "
+                           "(and the host windowed join refuses it too)",
     "union": "UNION ALL branches plan independently on the host",
     "no-window": "continuous (non-windowed) aggregates emit a retract "
                  "changelog; the device path is append-only windows",
@@ -223,6 +236,50 @@ class LogicalPlan:
         return "\n".join("  " * i + n for i, n in enumerate(nodes))
 
 
+@dataclasses.dataclass
+class JoinScan:
+    """One input side of a fused windowed join."""
+
+    table: TableInfo
+    alias: str
+    key_col: str                  # unqualified column on this side
+
+    def describe(self) -> str:
+        return (f"Scan[{self.table.name} AS {self.alias}, "
+                f"key={self.key_col}]")
+
+
+@dataclasses.dataclass
+class JoinLogicalPlan:
+    """A fused windowed equi-join: two scans under one shared window,
+    matched on the device join ring (runtime's DeviceJoinRunner). The
+    WHERE/projection stages run on the host DOWNSTREAM of the fused
+    emission — the join itself (both sides' buffering and the per-window
+    cross-match) is the device part."""
+
+    left: JoinScan
+    right: JoinScan
+    window: NormalizedWindow
+    output: Output
+    query: Query
+    filter_text: Optional[str] = None
+
+    def describe(self) -> str:
+        q = self.query
+        j = q.join
+        flt = f", where={self.filter_text}" if self.filter_text else ""
+        nodes = [
+            self.output.describe(),
+            (f"WindowJoin[{j.left_col} = {j.right_col}, "
+             f"{self.window.describe()}, device=join-ring{flt}]"),
+        ]
+        lines = ["  " * i + n for i, n in enumerate(nodes)]
+        indent = "  " * len(nodes)
+        lines.append(indent + self.left.describe())
+        lines.append(indent + self.right.describe())
+        return "\n".join(lines)
+
+
 def render_predicate(node) -> str:
     """Stable text form of a predicate AST (parenthesized OR under AND)."""
     if isinstance(node, BoolExpr):
@@ -243,15 +300,16 @@ def _render_operand(op: Operand) -> str:
     return str(op.value)
 
 
-def build_logical_plan(q: Query, catalog: Dict[str, TableInfo]) -> LogicalPlan:
+def build_logical_plan(
+    q: Query, catalog: Dict[str, TableInfo],
+) -> "LogicalPlan | JoinLogicalPlan":
     """Translate a parsed Query into the relational tree, rejecting (with
     catalogued reasons) every shape outside the fused front door. The
     rewrite rules then annotate the tree; see planner/rules.py."""
     if q.union_all is not None:
         raise Unsupported("union")
     if q.join is not None:
-        raise Unsupported("join", f"join on {q.join.left_col} = "
-                                  f"{q.join.right_col}")
+        return _build_join_plan(q, catalog)
     table = catalog.get(q.table)
     if table is None:
         raise Unsupported("unknown-table", f"table {q.table!r}")
@@ -310,6 +368,54 @@ def build_logical_plan(q: Query, catalog: Dict[str, TableInfo]) -> LogicalPlan:
             group_col=q.group_by[0], window=window, agg=agg),
         output=out,
         query=q,
+    )
+
+
+def _build_join_plan(q: Query, catalog: Dict[str, TableInfo]
+                     ) -> JoinLogicalPlan:
+    """The join front door: windowed INNER equi-joins plan fused (the
+    device join ring); every other join shape falls back with its OWN
+    catalogued reason — single-sourced with the runtime's join fallback
+    catalog (flink_tpu/joins/spec.py), so the SQL explain and the runner's
+    joinFallbackReason gauge attribute the same way."""
+    j = q.join
+    if j.join_type == "full":
+        raise Unsupported("join-full-outer",
+                          f"{q.table} FULL OUTER JOIN {j.table2}")
+    if j.window is None:
+        raise Unsupported("join-unwindowed",
+                          f"regular join on {j.left_col} = {j.right_col}")
+    if j.join_type != "inner":
+        raise Unsupported("join-outer-windowed",
+                          f"windowed {j.join_type.upper()} OUTER join")
+    if j.window.kind == "session":
+        raise Unsupported("join-session-window",
+                          f"session window on {j.left_col} = {j.right_col}")
+    left = catalog.get(q.table)
+    if left is None:
+        raise Unsupported("unknown-table", f"table {q.table!r}")
+    right = catalog.get(j.table2)
+    if right is None:
+        raise Unsupported("unknown-table", f"table {j.table2!r}")
+    if q.group_by or any(i.kind in ("agg", "ml_predict") for i in q.select):
+        raise Unsupported("join", "aggregate/GROUP BY over a join")
+    window = NormalizedWindow(
+        kind=j.window.kind,
+        time_col=j.window.time_col or "<batch timestamps>",
+        size_ms=j.window.size_ms,
+        slide_ms=(j.window.slide_ms if j.window.kind == "hop"
+                  else j.window.size_ms),
+    )
+    out = Output(columns=[i.output_name for i in q.select])
+    return JoinLogicalPlan(
+        left=JoinScan(table=left, alias=j.alias1,
+                      key_col=j.left_col.split(".", 1)[1]),
+        right=JoinScan(table=right, alias=j.alias2,
+                       key_col=j.right_col.split(".", 1)[1]),
+        window=window,
+        output=out,
+        query=q,
+        filter_text=q.where_text,
     )
 
 

@@ -26,11 +26,18 @@ the scale this dialect needs:
                             columnar sources keep their layout and the
                             traced extractors simply never touch pruned
                             columns
+
+Join plans (JoinLogicalPlan — windowed INNER equi-joins) take their own
+single rewrite, `rewrite_join_window`: the shared window normalizes onto
+the sliceable form whose gcd granule seeds the device join ring's bucket
+geometry, which is what turns `SELECT ... FROM a JOIN b ... WINDOW ...`
+into a fused-runner selection instead of the old blanket 'join' fallback.
 """
 
 from __future__ import annotations
 
 from flink_tpu.planner.logical import (
+    JoinLogicalPlan,
     LogicalPlan,
     Unsupported,
     predicate_is_columnar,
@@ -43,13 +50,31 @@ from flink_tpu.planner.logical import (
 from flink_tpu.table.sql import DEVICE_AGG_OF, predicate_columns
 
 
-def optimize(plan: LogicalPlan) -> LogicalPlan:
+def optimize(plan):
     """Run the full rule sequence in order; mutates and returns `plan`."""
+    if isinstance(plan, JoinLogicalPlan):
+        rewrite_join_window(plan)
+        return plan
     normalize_window(plan)
     map_aggregates(plan)
     push_predicate_below_window(plan)
     prune_projection(plan)
     return plan
+
+
+def rewrite_join_window(plan: JoinLogicalPlan) -> None:
+    """The join lowering rewrite: normalize the shared window onto the
+    sliceable form the device join ring consumes. The ring's bucket
+    granule is gcd(size, slide) — the same slice decomposition the
+    windowed-aggregate path uses — so a SQL TUMBLE/HOP join lands on the
+    fused `DeviceJoinRunner` with NO host re-bucketing: the logical
+    window spec IS the ring geometry's seed (joins/spec.py
+    plan_join_geometry starts from exactly these numbers)."""
+    w = plan.window
+    if w.size_ms <= 0 or w.slide_ms <= 0:
+        raise Unsupported("bad-window-geometry",
+                          f"size={w.size_ms} slide={w.slide_ms}")
+    w.slice_ms = window_slice_ms(w.size_ms, w.slide_ms)
 
 
 def normalize_window(plan: LogicalPlan) -> None:

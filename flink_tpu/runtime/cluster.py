@@ -276,6 +276,11 @@ def _shard_combine(key: str) -> str:
     leaf = key.rsplit(".", 1)[-1]
     if leaf.startswith("current"):
         return "min"
+    if leaf == "joinFallbackReason":
+        # a catalogued reason CODE, not a count: the job-level view is
+        # "did ANY shard degrade, and why" — summing codes across shards
+        # would fabricate a different (or uncatalogued) code
+        return "max"
     if leaf in ("keySkew", "recompileStorm", "hotKeyLoad", "meshLoadSkew",
                 "meshDevices") or leaf in _PER_DEVICE_MAX_GAUGES \
             or leaf in _REBALANCE_GAUGES:
@@ -322,6 +327,16 @@ _REBALANCE_GAUGES = ("meshRebalances", "routingTableVersion",
 #: carries them; the fold itself needs no extra rule (sum is the default).
 _TIER_GAUGES = ("vocabSize", "residentKeys", "evictions", "promotions",
                 "spilledBytes", "changelogBytes", "tierHotFillRatio")
+
+#: device-join gauge family (runtime/device_join_operator.py, registered
+#: per join operator): ring occupancy and matches emitted are per-shard
+#: counts over owned key ranges, so they SUM (the default rule);
+#: joinFallbackReason is a catalogued reason code and folds MAX above.
+#: Listed here so both /jobs/:id/device payload filters carry the family
+#: (the _TIER_GAUGES-omission lesson again: a family missing from the
+#: filters silently reads as absent at the job level).
+_JOIN_GAUGES = ("joinRingOccupancy", "joinMatchesEmitted",
+                "joinFallbackReason")
 
 
 def aggregate_shard_metrics(per_shard: Dict[int, dict]) -> dict:
@@ -981,6 +996,7 @@ class JobManagerEndpoint(RpcEndpoint):
             or k.rsplit(".", 1)[-1] in _TIER_GAUGES
             or k.rsplit(".", 1)[-1] in _PER_DEVICE_MAX_GAUGES
             or k.rsplit(".", 1)[-1] in _REBALANCE_GAUGES
+            or k.rsplit(".", 1)[-1] in _JOIN_GAUGES
         }
         payload["metrics"] = device_keys
         payload["per_shard"] = {
@@ -988,7 +1004,8 @@ class JobManagerEndpoint(RpcEndpoint):
                 if ".device." in k or "keySkew" in k or "meshLoadSkew" in k
                 or k.rsplit(".", 1)[-1] in _TIER_GAUGES
                 or k.rsplit(".", 1)[-1] in _PER_DEVICE_MAX_GAUGES
-                or k.rsplit(".", 1)[-1] in _REBALANCE_GAUGES}
+                or k.rsplit(".", 1)[-1] in _REBALANCE_GAUGES
+                or k.rsplit(".", 1)[-1] in _JOIN_GAUGES}
             for s, snap in per_shard.items()
         }
         payload["enabled"] = bool(device_keys or events)

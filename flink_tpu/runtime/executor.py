@@ -1664,6 +1664,17 @@ def _make_runner(step: Step, config: Configuration) -> StepRunner:
     if kind == "broadcast_process":
         return BroadcastProcessRunner(step, config)
     if kind in ("window_join", "co_group"):
+        # device reroute: eligible event-time window equi-joins run on the
+        # bucketed-ring pipeline; every refusal is a catalogued
+        # JoinUnsupported reason, and the host runner stays the oracle
+        if kind == "window_join":
+            from flink_tpu.joins.spec import JoinUnsupported
+            from flink_tpu.runtime.device_join_operator import DeviceJoinRunner
+
+            try:
+                return DeviceJoinRunner(step, config)
+            except JoinUnsupported:
+                pass
         return WindowJoinRunner(step, config)
     if kind == "group_agg":
         from flink_tpu.runtime.group_agg_operator import GroupAggRunner
@@ -1934,10 +1945,13 @@ class JobRuntime:
         sql_runners = [r for r in self.runners
                        if getattr(r, "sql_origin", False)]
         if sql_runners:
+            from flink_tpu.runtime.device_join_operator import DeviceJoinRunner
+
             job_group.gauge(
                 "sqlFusedSelected",
                 lambda rs=tuple(sql_runners): int(all(
-                    isinstance(r, DeviceChainRunner) for r in rs)))
+                    isinstance(r, (DeviceChainRunner, DeviceJoinRunner))
+                    for r in rs)))
         job_group.gauge("deviceTimeMsTotal", lambda: sum(
             r.device_timer.total_s * 1000.0
             for r in self.runners

@@ -54,6 +54,17 @@ class StreamingJoinRunner(StepRunner):
         self.merge_fn: Callable[[dict, dict], dict] = t.config["merge_fn"]
         self.join_type: str = t.config.get("join_type", "inner")
         if self.join_type not in ("inner", "left", "right"):
+            # typed + attributed, never a bare job-build crash: FULL OUTER
+            # is a catalogued refusal the SQL front door surfaces with the
+            # same reason code (joins/spec.py, docs/joins.md)
+            from flink_tpu.joins.spec import JoinUnsupported
+
+            if self.join_type == "full":
+                raise JoinUnsupported(
+                    "join-full-outer",
+                    "FULL OUTER JOIN is not supported: neither the host "
+                    "StreamingJoinRunner nor the device join ring "
+                    "implements two-sided padding retraction")
             raise ValueError(f"unsupported join type {self.join_type!r}")
         # per side: a schema-shaped all-NULL row used to pad the opposite
         # side of an unmatched outer row (fields present, values None — so

@@ -5,7 +5,7 @@ group windows. Supported grammar (case-insensitive keywords):
 
   SELECT <item> [, <item>]*
   FROM <table>
-  [[LEFT|RIGHT [OUTER]|INNER] JOIN <table> ON a.col = b.col [WINDOW <window>]]
+  [[LEFT|RIGHT|FULL [OUTER]|INNER] JOIN <table> ON a.col = b.col [WINDOW <window>]]
                                       -- with WINDOW: windowed join;
                                       -- without: regular streaming join
                                       -- emitting a retract changelog
@@ -233,6 +233,8 @@ class JoinSpec:
     right_col: str
     window: Optional[WindowSpec] = None
     join_type: str = "inner"   # 'inner' | 'left' | 'right' (regular only)
+                               # | 'full' (parses; refused with the typed
+                               # catalogued reason 'join-full-outer')
 
 
 @dataclasses.dataclass
@@ -310,7 +312,7 @@ class _Parser:
             alias1 = self.next()
         join_type = "inner"
         has_join = self.peek_upper() == "JOIN"
-        if self.peek_upper() in ("LEFT", "RIGHT", "INNER"):
+        if self.peek_upper() in ("LEFT", "RIGHT", "FULL", "INNER"):
             join_type = self.next().lower()
             if join_type != "inner" and self.peek_upper() == "OUTER":
                 self.next()
@@ -407,7 +409,10 @@ class _Parser:
             if self.peek_upper() == "WINDOW":
                 self.next()
                 jwindow = self.window_spec(time_col_optional=True)
-            if jwindow is not None and join_type != "inner":
+            if jwindow is not None and join_type in ("left", "right"):
+                # FULL parses through here on purpose: it gets the typed
+                # catalogued refusal ('join-full-outer') downstream, not a
+                # parse error
                 raise ValueError(
                     "LEFT/RIGHT OUTER are only supported on regular "
                     "(non-windowed) joins")

@@ -224,6 +224,15 @@ class TableEnvironment:
                      for n, t in self._tables.items()},
         )
         self.last_plan_report = report
+        if report.fused and q.join is not None:
+            # fused windowed join: the planner validated the shape
+            # (JoinLogicalPlan + rules.rewrite_join_window); construction
+            # still happens here because row streams are an api-layer
+            # concern, but the window_join transformation is stamped
+            # sql_origin so the runtime's DeviceJoinRunner counts toward
+            # sqlFusedSelected — the SQL front door selected the device
+            # join, it didn't fall back
+            return self._join_query(q, sql_fused=True)
         if report.lowered is None:
             return self._translate(q)
         low = report.lowered
@@ -569,12 +578,25 @@ class TableEnvironment:
                                          vectorized=True)
         return out
 
-    def _join_query(self, q: Query) -> DataStream:
-        """Windowed equi-join: translated onto DataStream.join (which the
-        runtime implements as coGroup over a shared window, the reference's
-        JoinedStreams design). Joined rows carry both alias-qualified and
-        (side-unique) plain column names; the SELECT projects them."""
+    def _join_query(self, q: Query, sql_fused: bool = False) -> DataStream:
+        """Windowed equi-join: translated onto DataStream.join (the fused
+        DeviceJoinRunner when the planner selected it — `sql_fused` —
+        else the host windowed join / coGroup path). Joined rows carry
+        both alias-qualified and (side-unique) plain column names; the
+        SELECT projects them."""
         j = q.join
+        if j.join_type == "full":
+            # typed + attributed at translate time, single-sourced with
+            # the planner catalog and the runner's own refusal — a FULL
+            # OUTER statement must never build a job that dies at runner
+            # construction with a bare error
+            from flink_tpu.joins.spec import JoinUnsupported
+
+            raise JoinUnsupported(
+                "join-full-outer",
+                "FULL OUTER JOIN is not supported: neither the host "
+                "StreamingJoinRunner nor the device join ring implements "
+                "two-sided padding retraction")
         if j.table2 not in self._tables:
             raise KeyError(
                 f"unknown table {j.table2!r}; registered: {list(self._tables)}")
@@ -644,6 +666,8 @@ class TableEnvironment:
                 .window(assigner)
                 .apply(merge, name=f"sql_join[{j.left_col}={j.right_col}]")
             )
+            if sql_fused:
+                joined.transform.config["sql_origin"] = True
         if q.where is not None:
             joined = joined.filter(q.where, name=f"where[{q.where_text}]")
         cols = [i for i in q.select if i.kind == "column"]

@@ -1,0 +1,98 @@
+"""Bench smoke gate for the streaming-join scenarios (ISSUE-16).
+
+Runs the real `bench.join_microbench` at smoke scale on the virtual
+8-device CPU mesh (tests/conftest.py forces it) and asserts the result
+carries the `join.*` keys every BENCH_*.json must now track: a
+regression that silently drops a NEXMark scenario, breaks device-vs-host
+join parity (uniform or zipf), stops selecting the fused runner, loses
+the sharded leg, or lets SQL fall back UNATTRIBUTED fails tier-1, not
+just a human eyeballing the next bench run.
+
+The >= 20x device-vs-host bar is judged on real TPU hardware (the host
+oracle's dict probes are exactly what the CPU "device" leg also pays at
+smoke scale) — this gate pins selection and parity, never the ratio.
+"""
+
+import importlib.util
+import pathlib
+
+import pytest
+
+_BENCH = pathlib.Path(__file__).resolve().parents[1] / "bench.py"
+
+
+@pytest.fixture(scope="module")
+def bench():
+    spec = importlib.util.spec_from_file_location("bench_join_smoke",
+                                                  _BENCH)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+@pytest.fixture(scope="module")
+def result(bench):
+    # smoke scale, distinctive geometry (the bench-gate pattern)
+    return bench.join_microbench(events=3072, batch=512, num_keys=384)
+
+
+def test_result_carries_the_tracked_join_keys(result):
+    assert "error" not in result, result.get("error")
+    for key in (
+        "devices",
+        "scenarios",
+        "parity",
+        "fused_selected",
+        "join_tuples_per_sec",
+        "host_join_tuples_per_sec",
+        "speedup_vs_host_join",
+        "sharded",
+        "sql",
+        "workload",
+    ):
+        assert key in result, f"bench join block lost {key!r}"
+    assert "error" not in result["sharded"], result["sharded"]
+    assert "error" not in result["sql"], result["sql"]
+
+
+def test_both_nexmark_scenarios_present_with_throughput(result):
+    for name in ("nexmark_q3", "nexmark_q8"):
+        blk = result["scenarios"].get(name)
+        assert blk is not None, f"bench lost the {name} scenario"
+        assert blk["matches"] > 0, f"{name} emitted no join rows"
+        assert blk["join_tuples_per_sec"] > 0
+        assert blk["host_join_tuples_per_sec"] > 0
+        assert blk["speedup_vs_host_join"] > 0
+
+
+def test_exact_parity_on_every_leg(result):
+    """Device ring vs host oracle, uniform AND zipf, both scenarios —
+    the zipf leg is the one that exercises adaptive bucket growth."""
+    assert result["parity"]
+    for name, blk in result["scenarios"].items():
+        assert blk["parity_uniform"], f"{name} uniform parity broken"
+        assert blk["parity_zipf"], f"{name} zipf parity broken"
+
+
+def test_fused_runner_actually_selected(result):
+    assert result["fused_selected"], (
+        "the factory no longer picks DeviceJoinRunner for a windowed "
+        "event-time inner equi-join")
+
+
+def test_sharded_leg_selected_and_at_parity(result):
+    assert result["sharded"]["sharded_selected"], (
+        "mesh available but the join rode the unsharded pipeline")
+    assert result["sharded"]["parity"], (
+        "sharded mesh join diverged from the single-chip rows")
+
+
+def test_sql_join_lowering_fused_and_attributed(result):
+    sql = result["sql"]
+    assert sql["sql_fused_selected"], (
+        "SQL windowed JOIN no longer selects the fused device runner")
+    assert "device=join-ring" in sql["explain"]
+    assert sql["parity"], "SQL fused vs interpreted rows diverged"
+    assert sql["fallback_attributed"], (
+        "FULL OUTER JOIN fell back without the catalogued "
+        "join-full-outer reason")

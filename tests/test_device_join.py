@@ -1,0 +1,519 @@
+"""Device join subsystem end to end (ISSUE-16).
+
+The two-input keyed join on the bucket ring (flink_tpu/joins +
+runtime/device_join_operator.py) promises EXACT parity with the host
+`WindowJoinRunner` oracle under every shape it claims: tumbling and
+sliding windows, out-of-order input with late drops, adaptive ring
+growth, degrade-to-host mid-stream (key capacity, slot overflow, ring
+wrap) with the reason attributed, snapshot/restore at both modes, the
+sharded mesh pipeline, and the SQL front door selecting the fused
+runner. Each test diffs the device leg against a host leg of the SAME
+job with `execution.join.device-enabled` off.
+"""
+
+import numpy as np
+import pytest
+
+from flink_tpu.api.datastream import StreamExecutionEnvironment
+from flink_tpu.api.windowing.assigners import (
+    SlidingEventTimeWindows,
+    TumblingEventTimeWindows,
+)
+from flink_tpu.config import Configuration, ExecutionOptions, ParallelOptions
+from flink_tpu.core.watermarks import WatermarkStrategy
+from flink_tpu.graph.transformation import plan
+from flink_tpu.joins.spec import JOIN_FALLBACK_CODES, fallback_code
+from flink_tpu.runtime.device_join_operator import DeviceJoinRunner
+from flink_tpu.runtime.executor import JobRuntime, WindowJoinRunner, build_runners
+
+
+def _env(batch=16, device=True, **extra):
+    conf = Configuration()
+    conf.set(ExecutionOptions.BATCH_SIZE, batch)
+    conf.set(ExecutionOptions.DEVICE_JOINS, device)
+    for opt, val in extra.items():
+        conf.set(getattr(ExecutionOptions, opt), val)
+    return StreamExecutionEnvironment.get_execution_environment(conf)
+
+
+def _stream(env, pairs, out_of_order=0):
+    values = [p[0] for p in pairs]
+    ts_map = {i: p[1] for i, p in enumerate(pairs)}
+    wrapped = list(enumerate(values))
+    strategy = (
+        WatermarkStrategy.for_bounded_out_of_orderness(out_of_order)
+        if out_of_order else
+        WatermarkStrategy.for_monotonous_timestamps())
+    s = env.from_collection(
+        wrapped, timestamp_fn=lambda iv: ts_map[iv[0]],
+        watermark_strategy=strategy)
+    return s.map(lambda iv: iv[1], name="unwrap")
+
+
+def _join_job(env, left, right, assigner, out_of_order=0):
+    a = _stream(env, left, out_of_order)
+    b = _stream(env, right, out_of_order)
+    return (a.join(b)
+            .where(lambda v: v[0]).equal_to(lambda v: v[0])
+            .window(assigner)
+            .apply(lambda l, r: (l[0], l[1], r[1]))
+            .collect())
+
+
+def _run(env, sink):
+    """Execute through JobRuntime so the actual runners stay inspectable."""
+    rt = JobRuntime(plan(env._sinks + env._roots), env.config)
+    rt.run()
+    return sorted(sink.results), rt
+
+
+def _device_runner(rt):
+    (r,) = [r for r in rt.runners if isinstance(r, DeviceJoinRunner)]
+    return r
+
+
+def _parity(left, right, assigner, out_of_order=0, **extra):
+    """Same join on both legs; returns (rows, device runner)."""
+    envd = _env(**extra)
+    got, rtd = _run(envd, _join_job(envd, left, right, assigner, out_of_order))
+    envh = _env(device=False, **extra)
+    exp, rth = _run(envh, _join_job(envh, left, right, assigner, out_of_order))
+    (host,) = [r for r in rth.runners if isinstance(r, WindowJoinRunner)]
+    dev = _device_runner(rtd)
+    assert got == exp, (len(got), len(exp))
+    return got, dev, host
+
+
+# ---------------------------------------------------------------------------
+# parity: tumbling / sliding / out-of-order with late drops
+# ---------------------------------------------------------------------------
+
+def test_tumbling_parity_multi_key_multi_window():
+    left = [((f"k{i % 5}", i), i * 137 % 4000) for i in range(200)]
+    right = [((f"k{i % 5}", -i), i * 211 % 4000) for i in range(150)]
+    rows, dev, _ = _parity(left, right, TumblingEventTimeWindows.of(1000))
+    assert rows and dev._host is None and dev.fallback_reason is None
+    assert dev.matches_emitted == len(rows)
+
+
+def test_sliding_parity_overlapping_windows():
+    left = [((f"k{i % 3}", i), i * 100) for i in range(60)]
+    right = [((f"k{i % 3}", i + 1000), i * 100 + 7) for i in range(60)]
+    rows, dev, _ = _parity(left, right, SlidingEventTimeWindows.of(2000, 500))
+    assert rows and dev._host is None
+
+
+def test_out_of_order_parity_counts_late_drops_like_the_host():
+    """Shuffled timestamps under a bounded-out-of-orderness strategy: the
+    device leg must emit the same pairs AND count the same per-(record,
+    window) late drops as the host oracle."""
+    rng = np.random.RandomState(7)
+    ts_l = rng.permutation(80) * 100
+    ts_r = rng.permutation(80) * 100 + 3
+    left = [((f"k{i % 4}", i), int(ts_l[i])) for i in range(80)]
+    right = [((f"k{i % 4}", -i), int(ts_r[i])) for i in range(80)]
+    rows, dev, host = _parity(
+        left, right, SlidingEventTimeWindows.of(1000, 500), out_of_order=300)
+    assert dev.num_late_dropped == host.num_late_dropped
+
+
+# ---------------------------------------------------------------------------
+# adaptive geometry: grow in place, never over-allocate up front
+# ---------------------------------------------------------------------------
+
+def test_bucket_capacity_grows_past_initial_without_degrade():
+    """>16 same-(key, bucket) records: the ring starts at capacity 16 and
+    must DOUBLE toward execution.join.bucket-capacity, not degrade."""
+    left = [(("hot", i), 100 + i % 7) for i in range(50)]
+    right = [(("hot", -i), 200 + i % 5) for i in range(40)]
+    rows, dev, _ = _parity(left, right, TumblingEventTimeWindows.of(1000))
+    assert len(rows) == 50 * 40
+    assert dev._host is None, dev.fallback_reason
+    assert dev.geom.bucket_capacity > 16
+
+
+def test_key_capacity_grows_past_initial_without_degrade():
+    """>1024 distinct keys under a large configured cap: the key lanes
+    double instead of degrading."""
+    n = 1500
+    left = [((i, "l"), (i % 8) * 100) for i in range(n)]
+    right = [((i, "r"), (i % 8) * 100 + 1) for i in range(n)]
+    rows, dev, _ = _parity(left, right, TumblingEventTimeWindows.of(1000),
+                           batch=256)
+    assert len(rows) == n
+    assert dev._host is None
+    assert dev.geom.key_capacity == 2048
+
+
+# ---------------------------------------------------------------------------
+# degrade-to-host: exactly-once replay + attributed reason
+# ---------------------------------------------------------------------------
+
+def test_key_capacity_degrade_keeps_parity_and_attributes():
+    got, dev, _ = _parity(
+        [((i, "l"), 100) for i in range(40)],
+        [((i, "r"), 200) for i in range(40)],
+        TumblingEventTimeWindows.of(1000),
+        KEY_CAPACITY=16)
+    assert len(got) == 40
+    assert dev._host is not None
+    assert dev.fallback_reason == "join-key-capacity"
+    assert fallback_code(dev.fallback_reason) \
+        == JOIN_FALLBACK_CODES["join-key-capacity"] > 0
+
+
+def test_slot_overflow_at_cap_degrades_with_parity():
+    """More same-(key, bucket) records than the configured cap allows:
+    all-or-nothing ingest refuses the batch, the live ring replays into
+    the host runner, and the failed batch replays whole — no pair lost,
+    none duplicated."""
+    left = [(("hot", i), 100) for i in range(30)]
+    right = [(("hot", -i), 150) for i in range(10)]
+    got, dev, _ = _parity(left, right, TumblingEventTimeWindows.of(1000),
+                          JOIN_BUCKET_CAPACITY=8)
+    assert len(got) == 300
+    assert dev._host is not None
+    assert dev.fallback_reason == "join-ring-overflow"
+
+
+def test_ring_wrap_degrades_with_parity():
+    """Event time running further ahead of the purge horizon than the ring
+    holds: the wrap is detected BEFORE any mutation and the stream
+    degrades, preserving every pair of both the old and the far bucket."""
+    left = [(("a", 1), 100), (("a", 2), 100 + 2 * 1000)]
+    right = [(("a", 10), 150), (("a", 20), 150 + 2 * 1000)]
+    # slack 1 => ring of 2 buckets for a 1000ms tumble: ts 2100 wraps
+    # onto the still-live bucket 0 (watermarks lag the whole collection)
+    got, dev, _ = _parity(left, right, TumblingEventTimeWindows.of(1000),
+                          out_of_order=4000, JOIN_RING_SLACK=1, batch=1)
+    assert len(got) == 2
+    assert dev._host is not None
+    assert dev.fallback_reason == "join-ring-overflow"
+
+
+def test_mid_stream_degrade_replays_ring_exactly_once():
+    """Records resident BEFORE the degrade replay into the host runner
+    with the device watermark set first: fired windows stay fired (drop
+    as late on replay), unfired windows emit exactly once."""
+    conf_pairs = dict(KEY_CAPACITY=8, batch=4)
+    # 8 early keyed pairs in window [0, 1000) fire first; then key
+    # cardinality blows past the cap in window [2000, 3000)
+    left = ([((f"e{i}", i), 100 + i) for i in range(6)]
+            + [((f"x{i}", i), 2100 + i) for i in range(20)])
+    right = ([((f"e{i}", -i), 500 + i) for i in range(6)]
+             + [((f"x{i}", -i), 2500 + i) for i in range(20)])
+    got, dev, _ = _parity(left, right, TumblingEventTimeWindows.of(1000),
+                          **conf_pairs)
+    assert len(got) == 26
+    assert dev.fallback_reason == "join-key-capacity"
+
+
+# ---------------------------------------------------------------------------
+# snapshot / restore
+# ---------------------------------------------------------------------------
+
+def _fresh_runner(**extra):
+    env = _env(**extra)
+    sink = _join_job(env, [(("k", 1), 100)], [(("k", 2), 200)],
+                     TumblingEventTimeWindows.of(1000))
+    graph = plan(env._sinks + env._roots)
+    runners, _ = build_runners(graph, env.config)
+    return _device_runner(type("rt", (), {"runners": runners})())
+
+
+def test_snapshot_restore_roundtrip_device_mode():
+    from flink_tpu.utils.arrays import obj_array
+
+    r = _fresh_runner()
+    r.downstream = None
+    r.on_batch_n(0, obj_array([("a", i) for i in range(20)]),
+                 np.arange(20, dtype=np.int64) * 50)
+    r.on_batch_n(1, obj_array([("a", -i) for i in range(10)]),
+                 np.arange(10, dtype=np.int64) * 50 + 5)
+    snap = r.snapshot()
+    assert snap["mode"] == "device"
+
+    r2 = _fresh_runner()
+    r2.downstream = None
+    r2.restore(snap)
+    assert r2.pipeline.occupancy() == r.pipeline.occupancy()
+    assert r2._keys == r._keys
+    # both fire the same pairs from the restored state
+    outs = []
+    for runner in (r, r2):
+        captured = []
+        runner.downstream = type(
+            "D", (), {"on_batch": lambda self, v, t: captured.extend(v),
+                      "on_watermark": lambda self, wm: None})()
+        runner.on_watermark(10_000)
+        outs.append(sorted(captured))
+    assert outs[0] == outs[1] and len(outs[0]) == 200
+
+
+def test_snapshot_restore_preserves_grown_geometry():
+    from flink_tpu.utils.arrays import obj_array
+
+    r = _fresh_runner()
+    r.downstream = None
+    # force bucket-capacity growth (30 same-key-bucket records > 16)
+    r.on_batch_n(0, obj_array([("hot", i) for i in range(30)]),
+                 np.full(30, 100, dtype=np.int64))
+    assert r.geom.bucket_capacity > 16
+    snap = r.snapshot()
+    r2 = _fresh_runner()
+    r2.restore(snap)
+    assert r2.geom.bucket_capacity == r.geom.bucket_capacity
+    assert r2.pipeline.occupancy() == 30
+
+
+def test_snapshot_restore_host_mode_carries_fallback():
+    from flink_tpu.utils.arrays import obj_array
+
+    r = _fresh_runner(KEY_CAPACITY=4)
+    r.downstream = None
+    r.on_batch_n(0, obj_array([(f"k{i}", i) for i in range(10)]),
+                 np.full(10, 100, dtype=np.int64))
+    assert r._host is not None
+    snap = r.snapshot()
+    assert snap["mode"] == "host"
+    r2 = _fresh_runner(KEY_CAPACITY=4)
+    r2.downstream = None
+    r2.restore(snap)
+    assert r2._host is not None
+    assert r2.fallback_reason == "join-key-capacity"
+    assert r2.pipeline is None
+
+
+def _flatten_snaps(obj):
+    if isinstance(obj, dict):
+        yield obj
+        for v in obj.values():
+            yield from _flatten_snaps(v)
+    elif isinstance(obj, (list, tuple)):
+        for v in obj:
+            yield from _flatten_snaps(v)
+
+
+def test_checkpointed_join_restores_through_job_runtime():
+    """Capture mid-stream while the join is still in DEVICE mode, crash,
+    restore into a fresh runtime, finish: output equals an undisturbed
+    run. This is the exactly-once contract for the device ring — the
+    restored pipeline must rebuild the (possibly grown) geometry before
+    replaying state, and fired windows must not re-fire."""
+
+    def build(env):
+        left = [((f"k{i % 3}", i), i * 100) for i in range(60)]
+        right = [((f"k{i % 3}", -i), i * 100 + 3) for i in range(60)]
+        return _join_job(env, left, right,
+                         SlidingEventTimeWindows.of(1000, 500))
+
+    env1 = _env(batch=8)
+    ref_sink = build(env1)
+    rt1 = JobRuntime(plan(env1._sinks), env1.config)
+    rt1.run()
+    assert _device_runner(rt1)._host is None
+    expected = sorted(ref_sink.results)
+    assert expected
+
+    env2 = _env(batch=8)
+    build(env2)
+    rt = JobRuntime(plan(env2._sinks), env2.config)
+    captured = {}
+
+    class _OneShotCoordinator:
+        def register_on_complete(self, fn):
+            pass
+
+        def maybe_trigger(self, capture):
+            if not captured and rt.records_in >= 40:
+                captured["snap"] = capture()
+                raise KeyboardInterrupt  # crash right after the capture
+
+    try:
+        rt.run(coordinator=_OneShotCoordinator())
+    except KeyboardInterrupt:
+        pass
+    assert "snap" in captured
+    # the capture happened while the join was still on-device
+    device_snaps = [s for s in _flatten_snaps(captured["snap"])
+                    if isinstance(s, dict) and s.get("mode") == "device"]
+    assert device_snaps, "checkpoint did not capture a device-mode join"
+
+    env3 = _env(batch=8)
+    sink3 = build(env3)
+    rt2 = JobRuntime(plan(env3._sinks), env3.config)
+    rt2.restore(captured["snap"])
+    rt2.run()
+    assert _device_runner(rt2)._host is None
+    assert sorted(sink3.results) == expected
+
+
+# ---------------------------------------------------------------------------
+# sharded pipeline on the virtual mesh
+# ---------------------------------------------------------------------------
+
+def test_sharded_join_parity_on_forced_mesh():
+    """MESH_ENABLED on the 8-device CPU mesh: the sharded pipeline (key
+    lanes sharded over devices, GSPMD exchange) must match the host leg
+    exactly."""
+    left = [((i % 64, i), (i // 64) * 500) for i in range(400)]
+    right = [((i % 64, -i), (i // 64) * 500 + 7) for i in range(300)]
+
+    envd = _env(batch=64)
+    envd.config.set(ParallelOptions.MESH_ENABLED, True)
+    got, rtd = _run(envd, _join_job(
+        envd, left, right, TumblingEventTimeWindows.of(1000)))
+    dev = _device_runner(rtd)
+    assert dev.sharded, "mesh available but the join pipeline is unsharded"
+    assert dev._host is None
+
+    # the host leg must see the SAME watermark cadence (batch size drives
+    # watermark emission, which drives late drops)
+    envh = _env(batch=64, device=False)
+    exp, _ = _run(envh, _join_job(
+        envh, left, right, TumblingEventTimeWindows.of(1000)))
+    assert got == exp and got
+
+
+# ---------------------------------------------------------------------------
+# SQL front door
+# ---------------------------------------------------------------------------
+
+def _sql_env(device=True):
+    from flink_tpu.table.table_env import TableEnvironment, TableSchema
+
+    conf = Configuration()
+    conf.set(ExecutionOptions.BATCH_SIZE, 16)
+    conf.set(ExecutionOptions.DEVICE_JOINS, device)
+    env = StreamExecutionEnvironment.get_execution_environment(conf)
+    tenv = TableEnvironment(env)
+    orders = [{"user": f"u{i % 3}", "amount": float(i), "rowtime": i * 100}
+              for i in range(10)]
+    users = [{"user": f"u{i}", "city": f"city{i}", "ts": i * 100}
+             for i in range(3)]
+    tenv.from_rows("orders", orders, TableSchema(
+        ["user", "amount", "rowtime"], rowtime="rowtime"))
+    tenv.from_rows("users", users, TableSchema(
+        ["user", "city", "ts"], rowtime="ts"))
+    return env, tenv
+
+
+_SQL_JOIN = ("SELECT a.user, b.city, a.amount FROM orders AS a "
+             "JOIN users AS b ON a.user = b.user "
+             "WHERE a.amount > 1 WINDOW TUMBLE(INTERVAL '10' SECOND)")
+
+
+def test_sql_window_join_selects_fused_runner_with_fused_explain():
+    env, tenv = _sql_env()
+    report = tenv.explain_sql(_SQL_JOIN)
+    assert report.fused
+    assert "device=join-ring" in report.describe()
+    tenv.sql_query(_SQL_JOIN).collect()
+    runners, _ = build_runners(plan(env._sinks), env.config)
+    djr = [r for r in runners if isinstance(r, DeviceJoinRunner)]
+    assert djr and djr[0].sql_origin
+
+
+def test_sql_fused_selected_gauge_counts_the_join():
+    env, tenv = _sql_env()
+    tenv.sql_query(_SQL_JOIN).collect()
+    rt = JobRuntime(plan(env._sinks), env.config)
+    gauge = rt.registry.all_metrics().get("job.sqlFusedSelected")
+    assert gauge is not None and gauge.value() == 1
+
+
+def test_sql_window_join_parity_device_vs_interpreted():
+    env, tenv = _sql_env()
+    sink = tenv.sql_query(_SQL_JOIN).collect()
+    env.execute("fused")
+    envh, tenvh = _sql_env(device=False)
+    sinkh = tenvh.sql_query(_SQL_JOIN).collect()
+    envh.execute("host")
+
+    def norm(rows):
+        return sorted(tuple(sorted(r.items())) for r in rows)
+
+    assert norm(sink.results) == norm(sinkh.results) and sink.results
+
+
+def test_sql_full_outer_join_attributed_not_crashed():
+    from flink_tpu.joins.spec import JoinUnsupported
+
+    _env_, tenv = _sql_env()
+    sql = ("SELECT a.user, b.city FROM orders AS a FULL OUTER JOIN users "
+           "AS b ON a.user = b.user")
+    report = tenv.explain_sql(sql)
+    assert report.path == "interpreted"
+    assert report.reason == "join-full-outer"
+    with pytest.raises(JoinUnsupported) as ei:
+        tenv.sql_query(sql)
+    assert ei.value.reason == "join-full-outer"
+
+
+def test_sql_regular_join_attributed_unwindowed():
+    _env_, tenv = _sql_env()
+    sql = ("SELECT a.user, b.city FROM orders AS a JOIN users AS b "
+           "ON a.user = b.user")
+    report = tenv.explain_sql(sql)
+    assert report.path == "interpreted"
+    assert report.reason == "join-unwindowed"
+    # and it still executes (fallback is attributed, never a failure)
+    rows = tenv.execute_sql_to_list(sql)
+    assert len(rows) == 10
+
+
+# ---------------------------------------------------------------------------
+# cluster fold + device payload filters (the _TIER_GAUGES lesson)
+# ---------------------------------------------------------------------------
+
+def test_join_gauges_fold_and_survive_the_device_payload_filters():
+    from flink_tpu.runtime.cluster import (
+        _JOIN_GAUGES,
+        _shard_combine,
+        aggregate_shard_metrics,
+    )
+
+    assert _shard_combine("job.join.joinFallbackReason") == "max"
+    assert _shard_combine("job.join.joinRingOccupancy") == "sum"
+    assert _shard_combine("job.join.joinMatchesEmitted") == "sum"
+    agg = aggregate_shard_metrics({
+        0: {"job.join.joinRingOccupancy": 10,
+            "job.join.joinMatchesEmitted": 100,
+            "job.join.joinFallbackReason": 0},
+        1: {"job.join.joinRingOccupancy": 6,
+            "job.join.joinMatchesEmitted": 40,
+            "job.join.joinFallbackReason": 7},
+    })
+    assert agg["job.join.joinRingOccupancy"] == 16
+    assert agg["job.join.joinMatchesEmitted"] == 140
+    assert agg["job.join.joinFallbackReason"] == 7   # worst shard, not 7+0
+
+    # regression for the _TIER_GAUGES omission: the family must pass BOTH
+    # device payload filters, or the job-level view silently drops it
+    import inspect
+
+    from flink_tpu.runtime import cluster as cluster_mod
+
+    src = inspect.getsource(cluster_mod.JobManagerEndpoint)
+    assert src.count("_JOIN_GAUGES") >= 2, (
+        "join gauges missing from a /jobs/:id/device payload filter")
+    for name in ("joinRingOccupancy", "joinMatchesEmitted",
+                 "joinFallbackReason"):
+        assert name in _JOIN_GAUGES
+
+
+def test_runner_registers_the_join_gauge_family():
+    env = _env()
+    sink = _join_job(env, [(("k", 1), 100)], [(("k", 2), 200)],
+                     TumblingEventTimeWindows.of(1000))
+    rt = JobRuntime(plan(env._sinks + env._roots), env.config)
+    rt.run()
+    metrics = rt.registry.all_metrics()
+    keys = [k for k in metrics
+            if k.rsplit(".", 1)[-1] in ("joinRingOccupancy",
+                                        "joinMatchesEmitted",
+                                        "joinFallbackReason")]
+    assert len(keys) == 3, sorted(metrics)
+    by_leaf = {k.rsplit(".", 1)[-1]: metrics[k].value() for k in keys}
+    assert by_leaf["joinMatchesEmitted"] == len(sink.results) == 1
+    assert by_leaf["joinFallbackReason"] == 0

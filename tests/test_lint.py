@@ -136,6 +136,23 @@ def test_path_scoped_rules_are_not_vacuous():
         assert index.get(rel) is not None, (
             f"{rel} missing — the multichip SPMD core moved and the "
             "parallel layer's ARCH001 entry no longer covers it")
+    # the join subsystem must stay REGISTERED with its runtime/api/table/
+    # scheduler bans: the bucket rings and the fused match pipeline are a
+    # kernel/state library the runtime's DeviceJoinRunner composes — a
+    # module-level runtime (or table) import would invert that DAG, and a
+    # deleted dict entry would let joins/ grow those imports silently
+    assert "joins" in LAYER_FORBIDDEN, (
+        "joins layer unregistered from ARCH001 — the join subsystem may "
+        "not import the runtime, api, table, or scheduler")
+    for banned in ("runtime", "api", "table", "scheduler"):
+        assert any(b.endswith("." + banned)
+                   for b in LAYER_FORBIDDEN["joins"]), (
+            f"joins layer no longer forbids {banned} imports")
+    for rel in ("joins/spec.py", "joins/ring.py", "joins/pipeline.py",
+                "joins/sharded.py"):
+        assert index.get(rel) is not None, (
+            f"{rel} missing — the join subsystem moved and the joins "
+            "layer's ARCH001 entry no longer covers it")
     # the skew-adaptive exchange splits across two layers and both must
     # stay under their bans: the routing-table LAYOUT algebra lives in
     # parallel/ (pure numpy, composed by the runtime), while the

@@ -287,8 +287,12 @@ def test_projection_pruning_reads_only_referenced_fields():
 # ---------------------------------------------------------------------------
 
 _FALLBACKS = [
-    ("SELECT a.user, b.user FROM pay AS a JOIN pay AS b ON a.user = b.user "
-     "WINDOW TUMBLE(INTERVAL '1' SECOND)", "join"),
+    ("SELECT a.user, b.user FROM pay AS a JOIN pay AS b ON a.user = b.user",
+     "join-unwindowed"),
+    ("SELECT a.user, COUNT(*) AS n FROM pay AS a JOIN pay AS b "
+     "ON a.user = b.user WINDOW TUMBLE(INTERVAL '1' SECOND)", "join"),
+    ("SELECT a.user, b.user FROM pay AS a FULL OUTER JOIN pay AS b "
+     "ON a.user = b.user", "join-full-outer"),
     ("SELECT user, COUNT(*) AS n FROM pay "
      "GROUP BY user, SESSION(rowtime, INTERVAL '1' SECOND)",
      "session-window"),

@@ -1,0 +1,199 @@
+"""Direct unit tests for the host regular-join oracle.
+
+`StreamingJoinRunner` (runtime/stream_join_operator.py) is the repo's
+join ORACLE: the device join path must match it exactly, and the bench
+harness diffs against it — so its own semantics need direct coverage,
+not just end-to-end SQL coverage. These tests drive the runner through
+its input gates (the same protocol the executor uses) and assert the
+three load-bearing behaviors: the appearance-count multiset under
+retraction, outer-padding emit/retract transitions, and the inherited
+two-gate watermark/end valve.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from flink_tpu.config import Configuration
+from flink_tpu.graph.transformation import Step, Transformation
+from flink_tpu.joins.spec import JoinUnsupported
+from flink_tpu.runtime.stream_join_operator import StreamingJoinRunner
+from flink_tpu.table.changelog import DELETE, INSERT, ROW_KIND_FIELD, with_kind
+from flink_tpu.utils.arrays import obj_array
+
+
+class _Capture:
+    """Downstream double recording batches, watermarks, and end."""
+
+    def __init__(self):
+        self.rows = []
+        self.watermarks = []
+        self.ended = False
+
+    def on_batch(self, values, ts):
+        self.rows.extend(list(values))
+
+    def on_watermark(self, wm):
+        self.watermarks.append(wm)
+
+    def on_end(self):
+        self.ended = True
+
+
+def _runner(join_type="inner"):
+    t = Transformation("regular_join", "join", [], config={
+        "key_selector1": lambda r: r.get("k"),
+        "key_selector2": lambda r: r.get("k"),
+        "merge_fn": lambda a, b: {**a, **{"r": b.get("r")}},
+        "join_type": join_type,
+        "null_rows": ({"k": None, "v": None}, {"k": None, "r": None}),
+    })
+    step = Step(chain=[], terminal=t, partitioning="forward")
+    r = StreamingJoinRunner(step, Configuration())
+    r.downstream = _Capture()
+    return r
+
+
+def _feed(runner, ordinal, rows, ts=0):
+    runner.on_batch_n(ordinal, obj_array(rows),
+                      np.full(len(rows), ts, dtype=np.int64))
+
+
+def _kinds(runner):
+    return [row[ROW_KIND_FIELD] for row in runner.downstream.rows]
+
+
+# ---------------------------------------------------------------------------
+# appearance-count multiset (JoinRecordStateViews.InputSideHasNoUniqueKey)
+# ---------------------------------------------------------------------------
+
+def test_duplicate_rows_keep_appearance_counts_not_presence():
+    """The per-key state is row -> COUNT, not a set: inserting the same
+    left row twice must double the join output, and retracting one copy
+    must retract exactly the pairs that copy produced."""
+    r = _runner()
+    row = {"k": "a", "v": 1.0}
+    _feed(r, 0, [row, dict(row)])            # two identical appearances
+    _feed(r, 1, [{"k": "a", "r": "west"}])
+    # each appearance joins: 2 inserts
+    assert _kinds(r) == [INSERT, INSERT]
+    r.downstream.rows.clear()
+    # retract ONE appearance: exactly one pair retracts, one survives
+    _feed(r, 0, [with_kind(dict(row), DELETE)])
+    assert _kinds(r) == [DELETE]
+    key_state = r._state[0]["a"]
+    (surviving,) = key_state.values()
+    assert surviving[1] == 1                 # count dropped 2 -> 1
+    r.downstream.rows.clear()
+    # retracting the LAST appearance empties the key's bucket entirely
+    _feed(r, 0, [with_kind(dict(row), DELETE)])
+    assert _kinds(r) == [DELETE]
+    assert "a" not in r._state[0]
+
+
+def test_retracting_an_unbuffered_row_is_an_error():
+    """A retraction for a row that never inserted is upstream corruption,
+    not a shape to paper over — the multiset refuses it loudly."""
+    r = _runner()
+    with pytest.raises(ValueError, match="not buffered"):
+        _feed(r, 0, [with_kind({"k": "ghost", "v": 0.0}, DELETE)])
+
+
+def test_insert_joins_against_full_opposite_multiset():
+    """An arriving row joins every appearance of every opposite-side row
+    under its key — 2 left copies x 3 right copies = 6 pairs."""
+    r = _runner()
+    _feed(r, 0, [{"k": "a", "v": 1.0}] * 2)
+    _feed(r, 1, [{"k": "a", "r": "w"}] * 3)
+    assert _kinds(r) == [INSERT] * 6
+
+
+# ---------------------------------------------------------------------------
+# outer padding: (row, NULL) emit/retract transitions
+# ---------------------------------------------------------------------------
+
+def test_left_outer_padding_retracts_on_first_match_and_returns_on_empty():
+    """LEFT OUTER lifecycle: unmatched left row emits a NULL padding; the
+    first right match retracts the padding and emits the join; retracting
+    the last right row re-pads the surviving left row."""
+    r = _runner("left")
+    _feed(r, 0, [{"k": "a", "v": 1.0}])
+    assert r.downstream.rows == [
+        {"k": "a", "v": 1.0, "r": None, ROW_KIND_FIELD: INSERT}]
+    assert "a" in r._padded
+    r.downstream.rows.clear()
+    # first match: join INSERT + padding DELETE, padded set empties
+    _feed(r, 1, [{"k": "a", "r": "west"}])
+    assert sorted(_kinds(r)) == sorted([INSERT, DELETE])
+    joined = [row for row in r.downstream.rows
+              if row[ROW_KIND_FIELD] == INSERT]
+    assert joined == [{"k": "a", "v": 1.0, "r": "west",
+                       ROW_KIND_FIELD: INSERT}]
+    assert "a" not in r._padded
+    r.downstream.rows.clear()
+    # right side empties again: pair retracts AND the padding comes back
+    _feed(r, 1, [with_kind({"k": "a", "r": "west"}, DELETE)])
+    assert sorted(_kinds(r)) == sorted([DELETE, INSERT])
+    repadded = [row for row in r.downstream.rows
+                if row[ROW_KIND_FIELD] == INSERT]
+    assert repadded == [{"k": "a", "v": 1.0, "r": None,
+                         ROW_KIND_FIELD: INSERT}]
+    assert "a" in r._padded
+
+
+def test_outer_row_retraction_retracts_its_padding():
+    """Retracting an unmatched outer row retracts its own NULL padding
+    (DELETE of the padded shape), leaving no state behind."""
+    r = _runner("left")
+    _feed(r, 0, [{"k": "a", "v": 1.0}])
+    r.downstream.rows.clear()
+    _feed(r, 0, [with_kind({"k": "a", "v": 1.0}, DELETE)])
+    assert r.downstream.rows == [
+        {"k": "a", "v": 1.0, "r": None, ROW_KIND_FIELD: DELETE}]
+    assert r._padded == {} and r._state[0] == {}
+
+
+# ---------------------------------------------------------------------------
+# the two-gate valve (inherited StepRunner gate protocol)
+# ---------------------------------------------------------------------------
+
+def test_watermarks_min_combine_across_both_gates():
+    """StatusWatermarkValve semantics: no watermark advances downstream
+    until BOTH gates reported, and the combined watermark is the min —
+    a fast dimension side must not flush past the slow fact side."""
+    r = _runner()
+    r.on_watermark_n(0, 100)
+    assert r.downstream.watermarks == []     # gate 1 never reported yet
+    r.on_watermark_n(1, 50)
+    assert r.downstream.watermarks == [50]   # min(100, 50)
+    r.on_watermark_n(1, 80)
+    assert r.downstream.watermarks == [50, 80]
+    r.on_watermark_n(1, 200)                 # gate 0 is now the laggard
+    assert r.downstream.watermarks == [50, 80, 100]
+    r.on_watermark_n(0, 90)                  # regression: must not re-fire
+    assert r.downstream.watermarks == [50, 80, 100]
+
+
+def test_end_fires_only_after_both_gates_end():
+    r = _runner()
+    r.on_end_n(0)
+    assert not r.downstream.ended
+    r.on_end_n(1)
+    assert r.downstream.ended
+
+
+# ---------------------------------------------------------------------------
+# FULL OUTER: typed catalogued refusal, not a bare crash (ISSUE 16 sat. 2)
+# ---------------------------------------------------------------------------
+
+def test_full_outer_raises_typed_catalogued_error():
+    with pytest.raises(JoinUnsupported) as ei:
+        _runner("full")
+    assert ei.value.reason == "join-full-outer"
+    assert "two-sided padding retraction" in ei.value.detail
+
+
+def test_unknown_join_type_still_a_value_error():
+    with pytest.raises(ValueError, match="unsupported join type"):
+        _runner("cross")
