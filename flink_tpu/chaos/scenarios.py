@@ -432,6 +432,14 @@ def _run_mini_join_job(name: str, *, records: int = 1200, batch: int = 100,
     # another test family's cached superscan geometry)
     config.set(ExecutionOptions.KEY_CAPACITY, 768)
     config.set(RestartOptions.INITIAL_BACKOFF_MS, 1)
+    # emission-latency plane, capture-eligible from the FIRST recorded
+    # fire: the restart rebuilds the join runner with a fresh tracker, so
+    # the first post-restore fire's stall interval starts at the tracker's
+    # mid-restart birth — the EmissionStall span it emits must overlap the
+    # recovery span for scenario_join_restore's stall-attribution check
+    from flink_tpu.config import ObservabilityOptions
+
+    config.set(ObservabilityOptions.EMISSION_LATENCY_OUTLIER_MIN_SAMPLES, 1)
     if chk_dir is not None:
         config.set(CheckpointingOptions.INTERVAL_MS, interval_ms)
         config.set(CheckpointingOptions.DIRECTORY, chk_dir)
@@ -497,9 +505,23 @@ def scenario_join_restore() -> Dict[str, Any]:
     _check(problems,
            bool(recs) and recs[0]["restored_checkpoint_id"] is not None,
            "recovery timeline missing the rewound checkpoint")
-    return _result("join-restore", "mini", plan, problems,
-                   parity=parity, restarts=client.num_restarts,
-                   recovery_ms=recovery_ms, attributed=attributed)
+    # stall attribution (emission-latency plane): the post-restore fires
+    # resolve late, and the tail outlier's stall interval — opened at the
+    # rebuilt tracker's mid-restart birth — must be attributed to the
+    # injected recovery span, not to checkpoints or compiles
+    stalls = client.latency_report()["attribution"]
+    stall_owners = stalls.get("attributed", {})
+    _check(problems, stalls.get("outliers", 0) > 0,
+           "no EmissionStall outlier captured across the restart")
+    _check(problems,
+           stall_owners.get("recovery.JobRestart", {}).get("count", 0) >= 1,
+           "post-restore latency spike not attributed to recovery.JobRestart"
+           f" (owners: {sorted(stall_owners)})")
+    out = _result("join-restore", "mini", plan, problems,
+                  parity=parity, restarts=client.num_restarts,
+                  recovery_ms=recovery_ms, attributed=attributed)
+    out["stall_owners"] = sorted(stall_owners)
+    return out
 
 
 def scenario_chip_loss_sharded() -> Dict[str, Any]:

@@ -826,6 +826,58 @@ class ObservabilityOptions:
         "flopsUtilizationPct roofline gauge. 0 picks a per-platform "
         "default."
     )
+    EMISSION_LATENCY_ENABLED = (
+        ConfigOptions.key("observability.emission-latency.enabled")
+        .bool_type().default_value(True)
+    ).with_description(
+        "Record per-operator emission latency — host_resolve_wall_ms minus "
+        "(window_end_event_ms + allowed lateness) — into a log-bucketed "
+        "emissionLatencyMs histogram at the instant deferred emissions "
+        "resolve, plus a watermarkLagMs gauge per windowed operator. "
+        "Stamping happens on already-host-side resolve paths (it never "
+        "forces a device sync); the fold across mesh shards merges "
+        "histogram buckets and takes MAX lag. Serves /jobs/:id/latency, "
+        "Prometheus summaries and the bench latency_frontier block."
+    )
+    EMISSION_LATENCY_OUTLIER_PCT = (
+        ConfigOptions.key("observability.emission-latency.outlier-percentile")
+        .float_type().default_value(99.0)
+    ).with_description(
+        "Fires whose emission latency lands at or above this percentile of "
+        "the operator's own histogram (once 16+ samples exist) are captured "
+        "as outliers: kept in a bounded ring and reported as latency-scope "
+        "EmissionStall spans for tail attribution against concurrent "
+        "control-plane spans (checkpoint, restart, rescale, rebalance, "
+        "recompile)."
+    )
+    EMISSION_LATENCY_OUTLIER_FLOOR_MS = (
+        ConfigOptions.key("observability.emission-latency.outlier-floor-ms")
+        .float_type().default_value(5.0)
+    ).with_description(
+        "Absolute floor under which a fire is never treated as an outlier "
+        "regardless of percentile rank — keeps a uniformly-fast operator "
+        "(sub-millisecond tail) from spamming EmissionStall spans over "
+        "noise."
+    )
+    EMISSION_LATENCY_OUTLIER_RING = (
+        ConfigOptions.key("observability.emission-latency.outlier-ring-size")
+        .int_type().default_value(64)
+    ).with_description(
+        "Outlier records retained per operator (resolve wall time + "
+        "latency) for the /jobs/:id/latency stall-attribution report. The "
+        "histogram and lifetime counters are unaffected by the ring size."
+    )
+    EMISSION_LATENCY_OUTLIER_MIN_SAMPLES = (
+        ConfigOptions.key(
+            "observability.emission-latency.outlier-min-samples")
+        .int_type().default_value(16)
+    ).with_description(
+        "Recorded fires an operator needs before any fire can be captured "
+        "as an outlier — the percentile threshold is meaningless over a "
+        "near-empty histogram. Chaos/validation runs set 1 so the first "
+        "post-restore fire is capture-eligible and its stall interval "
+        "pins the recovery span."
+    )
 
 
 class WatchdogOptions:

@@ -269,7 +269,8 @@ def _render_summary(name: str, stats: Dict[str, Any], lbl: str) -> List[str]:
     expositions use, so shard samples of a family can never drift to
     different quantile sets."""
     lines = [f"# TYPE {name} summary"]
-    for q, stat in (("0.5", "p50"), ("0.95", "p95"), ("0.99", "p99")):
+    for q, stat in (("0.5", "p50"), ("0.95", "p95"), ("0.99", "p99"),
+                    ("0.999", "p999")):
         v = stats.get(stat)
         if isinstance(v, (int, float)) and not (
                 isinstance(v, float) and math.isnan(v)):
@@ -296,6 +297,13 @@ def prometheus_text(metrics: Dict[str, Any],
         name = _prom_name(key)
         val = metric.value()
         if isinstance(metric, Histogram):
+            lines.extend(_render_summary(name, val, lbl))
+        elif isinstance(val, dict) and "count" in val:
+            # histogram-stats-shaped dict behind a NON-Histogram metric —
+            # e.g. the emission-latency plane's log-bucket snapshot gauge.
+            # Render it as the same summary family instead of silently
+            # dropping it: every registered histogram exports uniformly,
+            # whatever metric class carries it.
             lines.extend(_render_summary(name, val, lbl))
         elif isinstance(val, (int, float)) and not isinstance(val, bool):
             kind = "counter" if isinstance(metric, Counter) else "gauge"

@@ -91,11 +91,14 @@ class TraceReporter:
 
 
 class InMemoryTraceReporter(TraceReporter):
-    def __init__(self):
+    def __init__(self, max_spans: Optional[int] = None):
         self.spans: List[Span] = []
+        self._max = max_spans
 
     def report_span(self, span: Span) -> None:
         self.spans.append(span)
+        if self._max is not None:
+            del self.spans[:-self._max]
 
 
 class LoggingTraceReporter(TraceReporter):

@@ -283,7 +283,7 @@ def _shard_combine(key: str) -> str:
         return "max"
     if leaf in ("keySkew", "recompileStorm", "hotKeyLoad", "meshLoadSkew",
                 "meshDevices") or leaf in _PER_DEVICE_MAX_GAUGES \
-            or leaf in _REBALANCE_GAUGES:
+            or leaf in _REBALANCE_GAUGES or leaf in _LATENCY_MAX_GAUGES:
         # meshDevices included: each shard reports ITS mesh size — summing
         # across shards would misreport a plain 2-shard job as a 2-device
         # mesh (the job-level view is the largest mesh any shard runs).
@@ -338,16 +338,41 @@ _TIER_GAUGES = ("vocabSize", "residentKeys", "evictions", "promotions",
 _JOIN_GAUGES = ("joinRingOccupancy", "joinMatchesEmitted",
                 "joinFallbackReason")
 
+#: emission-latency plane (metrics/emission_latency.py, registered per
+#: windowed operator + the job-level p99 gauge): emissionLatencyMs ships
+#: as a FLAT log-bucket snapshot and folds BUCKET-WISE (merge_snapshots —
+#: the generic dict envelope would sum counts but max the percentiles,
+#: which overstates the merged tail); watermarkLagMs and the job p99 are
+#: worst-shard facts and fold MAX. One shared tuple feeds the fold rule
+#: AND both /jobs/:id/device-style payload filters (the _TIER_GAUGES-
+#: omission lesson: a family missing from either silently reads 0/absent
+#: job-level).
+_LATENCY_MAX_GAUGES = ("watermarkLagMs", "p99EmissionLatencyMs")
+_LATENCY_HISTOGRAMS = ("emissionLatencyMs",)
+_LATENCY_GAUGES = _LATENCY_MAX_GAUGES + _LATENCY_HISTOGRAMS
+
 
 def aggregate_shard_metrics(per_shard: Dict[int, dict]) -> dict:
     """Fold per-shard metric snapshots into one job-level view per
     _shard_combine (sum / mean / min); histogram stat dicts merge by
     max-of-p99 / min-of-min / summed count (cheap percentile union —
     exact merging would need the reservoirs, which stay TM-local)."""
+    from flink_tpu.metrics.emission_latency import (
+        merge_snapshots as _merge_emission,
+    )
+
     scalars: Dict[str, List[float]] = {}
+    emission: Dict[str, list] = {}
     agg: dict = {}
     for snap in per_shard.values():
         for key, val in snap.items():
+            if (isinstance(val, dict)
+                    and key.rsplit(".", 1)[-1] in _LATENCY_HISTOGRAMS):
+                # emission-latency histograms carry their log buckets, so
+                # the fold is EXACT: merge bucket counts, recompute the
+                # percentiles — never the generic max-envelope below
+                emission.setdefault(key, []).append(val)
+                continue
             if (isinstance(val, dict)
                     and key.rsplit(".", 1)[-1] in _PER_DEVICE_MAX_GAUGES):
                 # per-mesh-device map: fold across THIS shard's devices
@@ -395,6 +420,8 @@ def aggregate_shard_metrics(per_shard: Dict[int, dict]) -> dict:
             agg[key] = sum(vals) / len(vals)
         else:
             agg[key] = sum(vals)
+    for key, snaps in emission.items():
+        agg[key] = _merge_emission(snaps)
     if wm_skews:
         agg["job.watermarkSkewMs"] = max(wm_skews)
     return agg
@@ -808,6 +835,19 @@ class JobManagerEndpoint(RpcEndpoint):
         and TM-shipped ack spans, all stamped with the job's trace_id."""
         return list(self._jobs[job_id].spans)
 
+    def job_latency(self, job_id: str) -> dict:
+        """Emission-latency + stall-attribution report
+        (/jobs/:id/latency shape, identical to the MiniCluster's so one
+        dashboard panel reads both): the shard-folded emissionLatencyMs
+        histograms (bucket-wise merge) and watermarkLagMs MAX from
+        _aggregated_job_metrics, attributed against the job's span feed —
+        TM-shipped EmissionStall outliers vs JM/TM control-plane spans."""
+        from flink_tpu.metrics.emission_latency import build_latency_report
+
+        job = self._jobs[job_id]
+        agg, _per_shard, _jm = self._aggregated_job_metrics(job)
+        return build_latency_report(agg, list(job.spans))
+
     def job_backpressure(self, job_id: str) -> dict:
         """Per-shard busy/idle/backPressured ratios from the latest shipped
         snapshots (JobVertexBackPressureHandler analogue)."""
@@ -997,6 +1037,7 @@ class JobManagerEndpoint(RpcEndpoint):
             or k.rsplit(".", 1)[-1] in _PER_DEVICE_MAX_GAUGES
             or k.rsplit(".", 1)[-1] in _REBALANCE_GAUGES
             or k.rsplit(".", 1)[-1] in _JOIN_GAUGES
+            or k.rsplit(".", 1)[-1] in _LATENCY_GAUGES
         }
         payload["metrics"] = device_keys
         payload["per_shard"] = {
@@ -1005,7 +1046,8 @@ class JobManagerEndpoint(RpcEndpoint):
                 or k.rsplit(".", 1)[-1] in _TIER_GAUGES
                 or k.rsplit(".", 1)[-1] in _PER_DEVICE_MAX_GAUGES
                 or k.rsplit(".", 1)[-1] in _REBALANCE_GAUGES
-                or k.rsplit(".", 1)[-1] in _JOIN_GAUGES}
+                or k.rsplit(".", 1)[-1] in _JOIN_GAUGES
+                or k.rsplit(".", 1)[-1] in _LATENCY_GAUGES}
             for s, snap in per_shard.items()
         }
         payload["enabled"] = bool(device_keys or events)
@@ -1561,6 +1603,18 @@ class _ShardTask:
                                    attrs, trace_id=tid).to_dict())
             del self.spans[:-256]
 
+    def _wire_emission_spans(self, rt) -> None:
+        """Outlier EmissionStall spans from this task's windowed operators
+        ride the heartbeat span buffer (record_span) to the JM's span feed
+        exactly like checkpoint-ack spans — the distributed half of the
+        /jobs/:id/latency stall attribution (the MiniCluster half wires
+        the TraceRegistry in JobRuntime instead)."""
+        for r in rt.runners:
+            t = getattr(r, "emission_tracker", None)
+            if t is not None and t.span_sink is None:
+                t.span_sink = (lambda scope, name, s, e, a, _self=self:
+                               _self.record_span(scope, name, s, **a))
+
     def drain_spans(self) -> List[dict]:
         """Atomically take the buffered spans (heartbeat shipping); the
         caller re-inserts on a failed shipment (restore_spans)."""
@@ -1712,6 +1766,7 @@ class _ShardTask:
             aligner=aligner, debloaters=debloaters,
         )
         rt = JobRuntime(graph, self.spec.config, registry=self.registry)
+        self._wire_emission_spans(rt)
         rt_box[0] = rt
         self._resolve_local_restore()
         if self.restore is not None:
@@ -1772,6 +1827,7 @@ class _ShardTask:
 
         rt = JobRuntime(self.spec.graph, self.spec.config,
                         registry=self.registry)
+        self._wire_emission_spans(rt)
         self._resolve_local_restore()
         if self.restore is not None:
             rt.restore(self.restore["runtime"])

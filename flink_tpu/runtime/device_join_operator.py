@@ -39,10 +39,12 @@ from flink_tpu.joins.spec import (
     fallback_code,
     plan_join_geometry,
 )
+from flink_tpu.metrics.emission_latency import watermark_lag_ms
 from flink_tpu.runtime.executor import (
     StepRunner,
     WindowJoinRunner,
     _mesh_for_config,
+    make_emission_tracker,
 )
 from flink_tpu.utils.arrays import obj_array
 
@@ -94,6 +96,9 @@ class DeviceJoinRunner(StepRunner):
         # come back as row ids whose payloads the rings own)
         self._keys: Dict[Any, int] = {}
         self._wm = MIN_WATERMARK
+        # emission-latency plane: stamped in the on_watermark fire loop
+        # right after take_rows (the matches' host-visibility point)
+        self.emission_tracker = make_emission_tracker(t.uid, config)
         self.num_late_dropped = 0
         self.matches_emitted = 0
         self.fallback_reason: Optional[str] = None
@@ -265,12 +270,17 @@ class DeviceJoinRunner(StepRunner):
         out_vals: List[Any] = []
         out_ts: List[int] = []
         fn = self.join_fn
+        tracker = self.emission_tracker
         for start, end in self._ripe_windows(prev, self._wm):
             lids, rids, _kids = self.pipeline.fire_window(start, end)
             if len(lids) == 0:
                 continue
             lrows = self.pipeline.left.take_rows(lids)
             rrows = self.pipeline.right.take_rows(rids)
+            if tracker is not None:
+                # take_rows above is the host-visibility point of this
+                # window's matches — stamp after it, never before
+                tracker.record_fire(end)
             max_ts = end - 1
             out_vals.extend(fn(a, b) for a, b in zip(lrows, rrows))
             out_ts.extend([max_ts] * len(lrows))
@@ -299,6 +309,12 @@ class DeviceJoinRunner(StepRunner):
         group.gauge("currentWatermark",
                     lambda: self._host._wm if self._host is not None
                     else self._wm)
+        if self.emission_tracker is not None:
+            group.gauge("emissionLatencyMs", self.emission_tracker.snapshot)
+            group.gauge(
+                "watermarkLagMs",
+                lambda: watermark_lag_ms(
+                    self._host._wm if self._host is not None else self._wm))
         group.gauge("numLateRecordsDropped",
                     lambda: (self._sync_late(), self.num_late_dropped)[1])
         group.gauge("joinRingOccupancy",

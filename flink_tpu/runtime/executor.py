@@ -42,6 +42,11 @@ from flink_tpu.ops.aggregators import resolve
 from flink_tpu.runtime.oracle_window_operator import OracleWindowOperator
 from flink_tpu.runtime.tpu_window_operator import TpuWindowOperator
 from flink_tpu.runtime.timers import InternalTimerService
+from flink_tpu.metrics.emission_latency import (
+    EmissionLatencyTracker,
+    merge_snapshots as _merge_emission_snapshots,
+    watermark_lag_ms,
+)
 from flink_tpu.metrics.registry import MetricRegistry
 from flink_tpu.metrics.task_io import DeviceTimer, TaskIOMetrics
 from flink_tpu.state.heap import HeapKeyedStateBackend, value_state
@@ -189,6 +194,26 @@ class StepRunner:
 
     def restore(self, snap: dict) -> None:
         pass
+
+
+def make_emission_tracker(uid: str, config: Configuration):
+    """Per-operator emission-latency tracker, or None when the plane is
+    off (observability.emission-latency.enabled). One policy for every
+    windowed runner family — classic/fused/session/global/join — so the
+    /jobs/:id/latency fold always sees one key shape."""
+    if not config.get(ObservabilityOptions.EMISSION_LATENCY_ENABLED):
+        return None
+    return EmissionLatencyTracker(
+        uid,
+        outlier_pct=config.get(
+            ObservabilityOptions.EMISSION_LATENCY_OUTLIER_PCT),
+        outlier_floor_ms=config.get(
+            ObservabilityOptions.EMISSION_LATENCY_OUTLIER_FLOOR_MS),
+        ring_size=config.get(
+            ObservabilityOptions.EMISSION_LATENCY_OUTLIER_RING),
+        min_samples=config.get(
+            ObservabilityOptions.EMISSION_LATENCY_OUTLIER_MIN_SAMPLES),
+    )
 
 
 def _fused_chunk(batch_size: int) -> int:
@@ -651,6 +676,22 @@ class WindowStepRunner(StepRunner):
             else None
         )
         self._init_device_stats(config)
+        self._init_emission_plane(config)
+
+    def _init_emission_plane(self, config: Configuration) -> None:
+        """Emission-latency plane (observability.emission-latency.*).
+        Device operators stamp INLINE at their own deferred-resolve /
+        fire-loop sites (the host-visibility instant of a fired window);
+        the host oracle has no tracker surface, so the runner stamps its
+        drained rows instead — drain IS the oracle's visibility point."""
+        self.emission_tracker = make_emission_tracker(self.uid, config)
+        self._emission_lateness = getattr(self.op, "allowed_lateness", 0)
+        self._emission_at_drain = False
+        if self.emission_tracker is not None:
+            if hasattr(type(self.op), "emission_tracker"):
+                self.op.emission_tracker = self.emission_tracker
+            else:
+                self._emission_at_drain = True
 
     def _init_device_stats(self, config: Configuration) -> None:
         """Device-plane observability (metrics/device_stats.py + key_stats):
@@ -853,6 +894,11 @@ class WindowStepRunner(StepRunner):
                 out = self.op.drain_output()
         else:
             out = self.op.drain_output()
+        if out and self._emission_at_drain:
+            tr, lateness = self.emission_tracker, self._emission_lateness
+            for _k, w, _r, t in out:
+                tr.record_fire(getattr(w, "end", int(t) + 1),
+                               lateness_ms=lateness)
         if out and self.downstream:
             vals = obj_array(
                 [
@@ -866,14 +912,23 @@ class WindowStepRunner(StepRunner):
     def register_metrics(self, group) -> None:
         super().register_metrics(group)
         group.gauge("numLateRecordsDropped", lambda: self.op.num_late_records_dropped)
-        group.gauge(
-            "currentWatermark",
-            lambda: getattr(
+
+        def _wm():
+            return getattr(
                 self.op,
                 "current_watermark",
-                getattr(getattr(self.op, "timer_service", None), "current_watermark", 0),
-            ),
-        )
+                getattr(getattr(self.op, "timer_service", None),
+                        "current_watermark", 0),
+            )
+
+        group.gauge("currentWatermark", _wm)
+        if self.emission_tracker is not None:
+            # emission-latency plane: flat log-bucket snapshot (folds
+            # bucket-wise across shards) + wall-vs-watermark lag (folds
+            # MAX) — registered together so the cluster fold tuple and the
+            # device payload filter track ONE key family
+            group.gauge("emissionLatencyMs", self.emission_tracker.snapshot)
+            group.gauge("watermarkLagMs", lambda: watermark_lag_ms(_wm()))
         if self.device_timer is not None:
             self.device_timer._hist = group.histogram("deviceDispatchMs")
             self.device_timer.register(group)
@@ -986,6 +1041,7 @@ class DeviceChainRunner(WindowStepRunner):
             else None
         )
         self._init_device_stats(config)
+        self._init_emission_plane(config)
         self._warned_object_columns = False
 
     def on_batch(self, values: np.ndarray, timestamps: np.ndarray) -> None:
@@ -1997,6 +2053,28 @@ class JobRuntime:
                 if t.on_event is None:
                     t.on_event = (lambda ev, _tr=traces:
                                   _tr.report(compile_event_span(ev)))
+        # emission-latency plane (observability.emission-latency.*): the
+        # job-level p99 gauge is the bench/autoscaler surface (folds MAX
+        # across shards), and outlier EmissionStall spans ride the same
+        # trace plane as checkpoint/recovery spans — the MiniCluster's
+        # TraceRegistry here; the TM heartbeat span buffer wires its own
+        # sink in cluster.py before any fire can happen
+        em_trackers = tuple(
+            r.emission_tracker for r in self.runners
+            if getattr(r, "emission_tracker", None) is not None)
+        if em_trackers:
+            job_group.gauge(
+                "p99EmissionLatencyMs",
+                lambda ts=em_trackers: _merge_emission_snapshots(
+                    [t.snapshot() for t in ts]).get("p99", 0.0))
+            if traces is not None:
+                from flink_tpu.metrics.traces import Span
+
+                for t in em_trackers:
+                    if t.span_sink is None:
+                        t.span_sink = (
+                            lambda scope, name, s, e, a, _tr=traces:
+                            _tr.report(Span(scope, name, s, e, a)))
         # profiler capture surface (observability.profiler.*): the REST
         # /jobs/:id/device payload reports where captures landed — the
         # per-attempt jax.profiler trace used to be write-only

@@ -734,12 +734,21 @@ class FusedWindowOperator:
         # fired) — a lagged frontier, applied at resolve time
         self._inflight = (d, group[-1].wm, self.pipe.purged_to)
 
+    # emission-latency plane: set by the runner when the plane is on;
+    # stamped at the DEFERRED RESOLVE below — the only point where a
+    # fired window's rows become host-visible — never at dispatch
+    emission_tracker = None
+
     def _resolve_inflight(self) -> None:
         if self._inflight is None:
             return
         d, wm, purged_to = self._inflight
         self._inflight = None
+        tracker = self.emission_tracker
         for window, counts, fields in d.resolve():
+            if tracker is not None:
+                w = window[1] if type(window) is tuple else window
+                tracker.record_fire(w.end)
             self._emit(window, counts, fields)
         if wm > self.emitted_watermark:
             self.emitted_watermark = wm

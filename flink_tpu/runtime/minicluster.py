@@ -121,6 +121,21 @@ class JobClient:
         self.last_mesh_rebalance_duration_ms = 0.0
         self.rebalancer = None
 
+    def latency_report(self) -> dict:
+        """Emission-latency + stall-attribution report (/jobs/:id/latency
+        shape; the JM's job_latency builds the identical payload from
+        shard-folded snapshots): per-operator log-bucket histograms and
+        watermark lag from the live registry, outlier EmissionStall spans
+        attributed against the job's control-plane spans."""
+        from flink_tpu.metrics.emission_latency import build_latency_report
+        from flink_tpu.metrics.registry import metrics_snapshot
+
+        registry = getattr(self, "metrics", None)
+        snap = metrics_snapshot(registry.all_metrics()) if registry else {}
+        log = getattr(self, "span_log", None)
+        spans = [s.to_dict() for s in log.spans] if log is not None else []
+        return build_latency_report(snap, spans)
+
     # -- status -----------------------------------------------------------
     def status(self) -> JobStatus:
         return self._status
@@ -328,6 +343,14 @@ class MiniCluster:
         # OTel-shape export: buffered OTLP/JSON, served at /jobs/<id>/traces
         client.otel = OtlpJsonTraceReporter(service_name="flink-tpu")
         client.traces.add_reporter(client.otel)
+        # raw-span log for /jobs/:id/latency stall attribution: outlier
+        # EmissionStall spans joined against the same registry's
+        # checkpoint/recovery/compile spans by interval overlap (bounded —
+        # a long-running job must not grow it without limit)
+        from flink_tpu.metrics.traces import InMemoryTraceReporter
+
+        client.span_log = InMemoryTraceReporter(max_spans=512)
+        client.traces.add_reporter(client.span_log)
         interval = config.get(CheckpointingOptions.INTERVAL_MS)
         chk_dir = config.get(CheckpointingOptions.DIRECTORY)
         storage = FsCheckpointStorage(chk_dir) if chk_dir else MemoryCheckpointStorage()
@@ -446,6 +469,11 @@ class MiniCluster:
 
         restore_snap = None
         restore_ms = 0.0
+        # open recovery span: created at failure, closed only when the
+        # REBUILT attempt reaches RUNNING — the interval must cover the
+        # runtime rebuild + state restore so emission-stall attribution
+        # can overlap post-restore window-fire latency against it
+        restart_span = None
         if savepoint_restore_path is not None:
             sp_storage = FsCheckpointStorage(savepoint_restore_path)
             latest = sp_storage.latest()
@@ -495,6 +523,12 @@ class MiniCluster:
                         client.records_in - restore_snap.get("records_in", 0)
                         if restore_snap is not None else client.records_in),
                 )
+                if restart_span is not None:
+                    # failure -> RUNNING: same downtime interval the
+                    # recovery timeline records
+                    client.traces.report(restart_span.set_attribute(
+                        "restoredCheckpoint", bool(restore_snap)).end())
+                    restart_span = None
                 if pending_rescale is not None:
                     # the rebuilt attempt is serving at the new mesh size:
                     # stamp the completed rescale (counter + duration) and
@@ -655,6 +689,12 @@ class MiniCluster:
                 client.exceptions.begin_recovery(
                     attempt, cause=repr(e),
                     events_at_failure=client.records_in)
+                if restart_span is not None:
+                    # the previous recovery never reached RUNNING (the
+                    # rebuilt attempt failed during restore) — close its
+                    # span so the trace stays bounded
+                    client.traces.report(restart_span.set_attribute(
+                        "reachedRunning", False).end())
                 restart_span = client.traces.span("recovery", "JobRestart") \
                     .set_attribute("attempt", attempt) \
                     .set_attribute("delayMs", delay) \
@@ -663,9 +703,6 @@ class MiniCluster:
                 t_restore = time.perf_counter()
                 restore_snap = coordinator.latest_snapshot() if coordinator else None
                 restore_ms = (time.perf_counter() - t_restore) * 1000.0
-                client.traces.report(restart_span.set_attribute(
-                    "restoredCheckpoint",
-                    bool(restore_snap)).end())
 
     def _savepoint_hook(self, client: JobClient, runtime: JobRuntime) -> Optional[str]:
         path = client._poll_savepoint_request()

@@ -218,6 +218,15 @@ class _Handler(BaseHTTPRequestHandler):
                 if not hasattr(client, "otel"):
                     return self._json(200, {"resourceSpans": []})
                 return self._json(200, client.otel.payload())
+            if parts[2] == "latency" and len(parts) == 3:
+                # emission-latency plane: event-time tail + stall attribution
+                from flink_tpu.metrics.emission_latency import (
+                    build_latency_report,
+                )
+
+                if hasattr(client, "latency_report"):
+                    return self._json(200, _jsonable(client.latency_report()))
+                return self._json(200, _jsonable(build_latency_report({}, [])))
             if parts[2] == "metrics":
                 if not hasattr(client, "metrics"):
                     return self._json(200, {})
@@ -356,6 +365,9 @@ class _Handler(BaseHTTPRequestHandler):
                 enc = [span_to_otlp(Span.from_dict(d))
                        for d in self.jm.job_spans(job_id)]
                 return self._json(200, spans_to_otlp(enc, "flink-tpu"))
+            if parts[2] == "latency" and len(parts) == 3:
+                return self._json(200, _jsonable(
+                    self.jm.job_latency(job_id)))
             if parts[2] == "vertices" and len(parts) == 5 \
                     and parts[4] == "backpressure":
                 return self._json(200, _jsonable(

@@ -103,6 +103,7 @@ class TpuGlobalWindowOperator:
         self._step = _make_step(agg, purging)
         self._init_arrays()
         self.current_watermark = MIN_WATERMARK
+        self.emission_tracker = None   # emission-latency plane (runner-set)
         self._pending: List[Tuple[Any, Any, int]] = []
         self.output: List[Tuple[Any, Any, Any, int]] = []
         self.side_output: Dict[str, List] = {}
@@ -165,7 +166,14 @@ class TpuGlobalWindowOperator:
         mask_np = np.asarray(mask)
         if mask_np.any():
             result_np = np.asarray(result)
-            for i in np.flatnonzero(mask_np):
+            fired = np.flatnonzero(mask_np)
+            if self.emission_tracker is not None:
+                # count-triggered GlobalWindow fires have no event-time
+                # close: MAX_WATERMARK would poison the histogram, so the
+                # tracker's int64-safe clamp counts them as `sentinel`
+                self.emission_tracker.record_fire(
+                    MAX_WATERMARK, count=len(fired))
+            for i in fired:
                 self.output.append(
                     (self.keydict.key_at(int(i)), self._WINDOW, result_np[i].item(),
                      MAX_WATERMARK)

@@ -204,6 +204,10 @@ def _build_purge(K: int, S: int, nf: int, idents: tuple, dts: tuple, g: int):
 class TpuSessionWindowOperator:
     """One shard's keyed session-window aggregation on one device."""
 
+    # emission-latency plane: set by the runner; stamped where merged
+    # sessions become host rows (deferred-resolve and host-path emits)
+    emission_tracker = None
+
     def __init__(
         self,
         assigner: EventTimeSessionWindows,
@@ -732,8 +736,11 @@ class TpuSessionWindowOperator:
             results = np.asarray(self.agg.extract(fdict))
             # fire order: merged-window end then key id (oracle's timers)
             order = np.lexsort((kk, end_ts))
+            tracker = self.emission_tracker
             for i in order:
                 window = TimeWindow(int(start_ts[i]), int(end_ts[i]) + g)
+                if tracker is not None:
+                    tracker.record_fire(window.end)
                 self.output.append(
                     (self._key_of(int(kk[i])), window,
                      results[i].item(), window.max_timestamp())
@@ -829,8 +836,11 @@ class TpuSessionWindowOperator:
             one_names = [
                 f.name for f in self.agg.fields if f.source != VALUE
             ]
+            tracker = self.emission_tracker
             for mn_ts, mx_ts, k, c, fvals in emitted:
                 window = TimeWindow(mn_ts, mx_ts + g)
+                if tracker is not None:
+                    tracker.record_fire(window.end)
                 fdict = dict(zip(names, fvals))
                 for n in one_names:  # ONE-source fields carry the count
                     fdict[n] = c

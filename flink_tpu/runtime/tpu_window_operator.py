@@ -359,8 +359,15 @@ class TpuWindowOperator:
     # ------------------------------------------------------------------
     # emission
     # ------------------------------------------------------------------
+    # emission-latency plane: set by the runner; _emit_window is where this
+    # operator's fires become host-visible (np.asarray readback below)
+    emission_tracker = None
+
     def _emit_window(self, j: int, *, touch_mask: bool) -> None:
         window = self.window_of(j)
+        if self.emission_tracker is not None:
+            self.emission_tracker.record_fire(
+                window.end, lateness_ms=self.allowed_lateness)
         start_slice = j * self.sl
         result, cnt, mask = self.state.fire(
             range(start_slice, start_slice + self.spw), touch_mask=touch_mask

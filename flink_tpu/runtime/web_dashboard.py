@@ -228,6 +228,40 @@ function tierTable(dev) {
     </tr></thead><tbody>${rows.join("")}</tbody></table>`;
 }
 
+function latencySection(lat) {
+  // emission-latency plane (/jobs/:id/latency): event-time tail per
+  // operator (window close -> host-visible) plus the stall-attribution
+  // report mapping tail outliers onto concurrent control-plane spans
+  // (checkpoint, restore/rescale, compile); hidden with no samples
+  if (!lat || !(lat.samples > 0)) return "";
+  const ops = Object.entries(lat.operators ?? {}).map(([uid, o]) => {
+    const h = o.emissionLatencyMs ?? {};
+    return `<tr><td>${esc(uid)}</td>
+    <td>${fmt(h.p50, 1)} / ${fmt(h.p99, 1)} / ${fmt(h.p999, 1)}</td>
+    <td>${fmt(h.max, 1)}</td>
+    <td>${fmt(h.count)}</td>
+    <td>${fmt(o.watermarkLagMs, 1)}</td></tr>`;
+  });
+  const att = lat.attribution ?? {};
+  const owners = Object.entries(att.attributed ?? {}).map(([k, v]) => `<tr>
+    <td>${esc(k)}</td><td>${fmt(v.count)}</td>
+    <td>${fmt(v.maxLatencyMs, 1)}</td></tr>`);
+  return "<h3>emission latency</h3>" + kv({
+    "p50 / p99 / p999 ms": `${fmt(lat.p50_ms, 1)} / ${fmt(lat.p99_ms, 1)}` +
+      ` / ${fmt(lat.p999_ms, 1)}`,
+    "samples": fmt(lat.samples),
+    "watermark lag ms": fmt(lat.watermarkLagMs, 1),
+    "stall outliers": fmt(att.outliers),
+    "unattributed": fmt(att.unattributed),
+  }) + (ops.length ? `<table><thead><tr><th>operator</th>
+    <th>p50/p99/p999 ms</th><th>max ms</th><th>samples</th>
+    <th>wm lag ms</th></tr></thead><tbody>${ops.join("")}</tbody>
+    </table>` : "")
+    + (owners.length ? `<table><thead><tr><th>stall owner span</th>
+    <th>outliers</th><th>max ms</th></tr></thead>
+    <tbody>${owners.join("")}</tbody></table>` : "");
+}
+
 function operatorTable(metrics) {
   // per-operator observability: latency-marker percentiles, device time,
   // HBM state footprint — parsed from the job.operator.<uid>.* scope
@@ -255,13 +289,14 @@ function operatorTable(metrics) {
 }
 
 async function detailRow(id) {
-  const [info, metrics, traces, cps, exc, auto, dev] = await Promise.all([
+  const [info, metrics, traces, cps, exc, auto, dev, lat] = await Promise.all([
     j(`/jobs/${id}`), j(`/jobs/${id}/metrics`),
     j(`/jobs/${id}/traces`).catch(() => ({resourceSpans: []})),
     j(`/jobs/${id}/checkpoints`).catch(() => null),
     j(`/jobs/${id}/exceptions`).catch(() => null),
     j(`/jobs/${id}/autoscaler`).catch(() => null),
     j(`/jobs/${id}/device`).catch(() => null),
+    j(`/jobs/${id}/latency`).catch(() => null),
   ]);
   const spans = (traces.resourceSpans[0]?.scopeSpans[0]?.spans ?? []);
   const spanRows = spans.slice(-12).reverse().map(s => {
@@ -297,6 +332,7 @@ async function detailRow(id) {
         ([k]) => k.endsWith("numLateRecordsDropped"))?.[1]),
     "error": esc(info.error ?? "none"),
   }) + operatorTable(metrics)
+    + latencySection(lat)
     + deviceSection(dev)
     + autoscalerSection(auto)
     + checkpointSection(cps) + exceptionSection(exc)

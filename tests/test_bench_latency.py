@@ -1,0 +1,115 @@
+"""Bench smoke gate for the latency x throughput frontier (ISSUE-17).
+
+Runs the real `bench.latency_frontier_microbench` at smoke scale and
+asserts the result carries the `latency_frontier.*` keys every
+BENCH_*.json must now track: a regression that silently drops a load
+point, breaks pacing-vs-oracle parity, stops recording emission samples
+(the plane went dark), or lets the plane's overhead blow past the
+catastrophic floor fails tier-1, not just a human eyeballing the next
+bench run.
+
+The <2% overhead budget is judged on real TPU hardware over the full
+flagship run — at smoke scale on a shared CPU the on/off delta is mostly
+scheduler noise, so this gate pins only the CATASTROPHIC floor (a
+serializing bug like stamping at dispatch costs integer multiples, not
+percents).
+"""
+
+import importlib.util
+import os
+import pathlib
+
+import pytest
+
+_BENCH = pathlib.Path(__file__).resolve().parents[1] / "bench.py"
+
+
+@pytest.fixture(scope="module")
+def bench():
+    spec = importlib.util.spec_from_file_location("bench_latency_smoke",
+                                                  _BENCH)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+@pytest.fixture(scope="module")
+def result(bench):
+    # smoke scale: short legs, one sweep — the gate checks structure and
+    # parity, never absolute rates
+    os.environ["BENCH_LATENCY_EVENTS"] = str(1 << 15)
+    os.environ["BENCH_LATENCY_LEG_S"] = "0.6"
+    os.environ["BENCH_LATENCY_SWEEPS"] = "1"
+    try:
+        return bench.latency_frontier_microbench(batch=4096)
+    finally:
+        for k in ("BENCH_LATENCY_EVENTS", "BENCH_LATENCY_LEG_S",
+                  "BENCH_LATENCY_SWEEPS"):
+            os.environ.pop(k, None)
+
+
+def test_result_carries_the_tracked_frontier_keys(result):
+    assert "latency_frontier" in result
+    fr = result["latency_frontier"]
+    for key in (
+        "peak_tuples_per_sec",
+        "plane_on_tuples_per_sec",
+        "plane_off_tuples_per_sec",
+        "plane_overhead_pct",
+        "load_points",
+        "parity",
+        "samples",
+        "pacing",
+        "workload",
+    ):
+        assert key in fr, f"latency_frontier block lost {key!r}"
+    assert fr["pacing"] == "open-loop-arrival"
+    # the headline the flagship row carries
+    assert result.get("p99_emission_latency_ms") is not None
+
+
+def test_every_load_point_present_with_the_tracked_keys(result):
+    points = result["latency_frontier"]["load_points"]
+    for lp in ("25", "50", "100"):
+        blk = points.get(lp)
+        assert blk is not None, f"frontier lost the {lp}% load point"
+        for key in (
+            "target_rate_tuples_per_sec",
+            "achieved_rate_tuples_per_sec",
+            "p50_emission_ms",
+            "p99_emission_ms",
+            "p999_emission_ms",
+            "samples",
+            "watermark_lag_ms",
+            "parity",
+            "stall_outliers",
+            "stall_attributed",
+            "stall_unattributed",
+        ):
+            assert key in blk, f"load point {lp} lost {key!r}"
+
+
+def test_pacing_never_changes_results(result):
+    """Open-loop arrival pacing must only move WHEN windows fire, never
+    WHAT they contain: every paced leg at exact oracle parity."""
+    assert result["latency_frontier"]["parity"]
+    for lp, blk in result["latency_frontier"]["load_points"].items():
+        assert blk["parity"], f"load point {lp} diverged from the oracle"
+
+
+def test_plane_actually_recorded_samples(result):
+    """Zero emission samples means the plane went dark — the stamping at
+    the deferred-resolve points was lost or the default got flipped."""
+    assert result["latency_frontier"]["samples"] > 0
+    for lp, blk in result["latency_frontier"]["load_points"].items():
+        assert blk["samples"] > 0, f"load point {lp} recorded no fires"
+        assert blk["p99_emission_ms"] >= blk["p50_emission_ms"] >= 0
+
+
+def test_plane_overhead_below_catastrophic_floor(result):
+    """A serializing regression (stamping at dispatch, forced readback
+    per fire) costs integer multiples of throughput; scheduler noise at
+    smoke scale costs tens of percent. The tier-1 floor sits between."""
+    assert result["latency_frontier"]["plane_overhead_pct"] < 60.0, (
+        "emission-latency plane costs a multiple of throughput — is a "
+        "fire path forcing device sync or eager resolution?")
