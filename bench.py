@@ -2310,11 +2310,25 @@ def latency_frontier_microbench(events: Optional[int] = None,
     t_filter = lambda col: col[:, 1] < 0.5                    # noqa: E731
     t_key = lambda col: col[:, 0].astype(jnp.int32)           # noqa: E731
 
-    def run_leg(n, rate, *, plane_on=True, chk_dir=None, name="frontier"):
+    lat_target_ms = int(os.environ.get("BENCH_LATENCY_TARGET_MS", "10"))
+
+    def run_leg(n, rate, *, plane_on=True, chk_dir=None, latency=False,
+                name="frontier"):
         cfg = Configuration()
         cfg.set(ExecutionOptions.BATCH_SIZE, batch)
         cfg.set(ExecutionOptions.KEY_CAPACITY, FR_KEYS)
         cfg.set(ExecutionOptions.COLUMNAR_OUTPUT, False)
+        if latency:
+            # latency-mode leg: same program, execution.latency.* on —
+            # the controller shrinks the superbatch at light load and the
+            # in-flight ring overlaps host prep with device dispatch
+            from flink_tpu.config import LatencyOptions
+            cfg.set(LatencyOptions.TARGET_MS, lat_target_ms)
+            cfg.set(LatencyOptions.MAX_INFLIGHT, 2)
+            # smoke legs last ~1 s; the production default half-second
+            # dwell would pin the rung near the full span for most of a
+            # short leg, measuring the warm-up hold instead of the mode
+            cfg.set(LatencyOptions.MIN_DWELL_MS, 100)
         if not plane_on:
             cfg.set(ObservabilityOptions.EMISSION_LATENCY_ENABLED, False)
         if chk_dir is not None:
@@ -2413,6 +2427,95 @@ def latency_frontier_microbench(events: Optional[int] = None,
         }
         if frac == 1.0:
             p99_at_full = rep.get("p99_ms", 0.0)
+
+    # ---- latency mode: the SAME program with execution.latency.* on.
+    # Peak probe first (unpaced = 100% load): the controller must read
+    # the saturated arrival rate, escalate to the full span, and keep
+    # throughput within budget of throughput mode (peak_fraction) — the
+    # mode's cost when the fleet is busy. The donated executables live in
+    # separate cache entries, so warm up at the measured size first, then
+    # a short paced warm leg pre-compiles the small-rung geometries the
+    # 25% leg will pick (bounded by the pow2 ladder — never a storm).
+    run_leg(events, None, latency=True)
+    lat_peak = 0.0
+    for _sweep in range(sweeps):
+        _r, wall, _c, _s = run_leg(events, None, latency=True)
+        lat_peak = max(lat_peak, events / max(wall, 1e-9))
+    # warm leg with the SAME n, rate, and checkpointing as the measured
+    # 25% point: the controller walks the same rung descent, periodic
+    # checkpoints flush the same mid-stream tails, and the end-of-stream
+    # flush pads the same pow2 tails, so every donated geometry the
+    # measured leg dispatches is already compiled (compile stalls would
+    # otherwise land on the few windows a smoke leg fires and swamp its
+    # p99)
+    warm_rate = max(peak * 0.25, batch * 2.0)
+    warm_n = int(min(max(warm_rate * leg_s, batch * 4), events * 4))
+    warm_n = max(batch, warm_n - warm_n % batch)
+    warm_chk = tempfile.mkdtemp(prefix="flink-tpu-frontier-lat-warm-")
+    try:
+        run_leg(warm_n, warm_rate, chk_dir=warm_chk, latency=True,
+                name="frontier-lat-warm")
+    finally:
+        shutil.rmtree(warm_chk, ignore_errors=True)
+
+    lat_points = {}
+    lat_parity = True
+    lat_p99_at_25 = 0.0
+    lat_ach_at_100 = 0.0
+    for frac in (0.25, 0.5, 1.0):
+        rate = max(peak * frac, batch * 2.0)
+        n = int(min(max(rate * leg_s, batch * 4), events * 4))
+        n = max(batch, n - n % batch)               # whole batches
+        # the 100% point is judged as a fraction of the throughput-mode
+        # peak — itself the best of `sweeps` unpaced legs — so it gets
+        # the same best-of-sweeps treatment: a one-off stall (e.g. a
+        # checkpoint flush landing on a tail pad the warm leg never
+        # compiled) must not masquerade as a throughput regression.
+        # Parity still folds over EVERY repetition.
+        best_ach = -1.0
+        best_entry = None
+        best_rep = None
+        for _rep in range(sweeps if frac == 1.0 else 1):
+            chk = tempfile.mkdtemp(prefix="flink-tpu-frontier-lat-")
+            try:
+                results, wall, client, src = run_leg(
+                    n, rate, chk_dir=chk, latency=True,
+                    name=f"frontier-lat-{int(frac * 100)}")
+            finally:
+                shutil.rmtree(chk, ignore_errors=True)
+            rep = client.latency_report()
+            got = sorted((int(k), int(v)) for k, v in results)
+            exp = oracle(n, src.reader.t0_ms, rate)
+            parity = len(got) > 0 and got == exp
+            lat_parity = lat_parity and parity
+            ach = n / max(wall, 1e-9)
+            entry = {
+                "target_rate_tuples_per_sec": round(rate, 1),
+                "achieved_rate_tuples_per_sec": round(ach, 1),
+                "events": n,
+                "p50_emission_ms": rep.get("p50_ms", 0.0),
+                "p99_emission_ms": rep.get("p99_ms", 0.0),
+                "p999_emission_ms": rep.get("p999_ms", 0.0),
+                "samples": int(rep.get("samples", 0)),
+                "parity": bool(parity),
+                # the /jobs/:id/latency controller block: rung, ring
+                # depth, distinct compiled geometries (ladder-bounded)
+                "controller": rep.get("latency_mode") or {},
+            }
+            if ach > best_ach:
+                best_ach, best_entry, best_rep = ach, entry, rep
+        lat_points[str(int(frac * 100))] = best_entry
+        if frac == 0.25:
+            lat_p99_at_25 = best_rep.get("p99_ms", 0.0)
+        if frac == 1.0:
+            lat_ach_at_100 = best_ach
+    # the tracked peak fraction is the PACED comparison the acceptance bar
+    # names: latency-mode throughput at the 100% load point over the
+    # throughput-mode peak (the unpaced probe's wall clock folds in job
+    # setup and is scheduler-noise-bound on a shared host; the paced
+    # point is the apples-to-apples sustained-rate question)
+    peak_fraction = lat_ach_at_100 / max(peak, 1e-9)
+
     return {
         "latency_frontier": {
             "peak_tuples_per_sec": round(peak, 1),
@@ -2427,8 +2530,18 @@ def latency_frontier_microbench(events: Optional[int] = None,
             "num_keys": FR_KEYS,
             "pacing": "open-loop-arrival",
             "workload": "ysb_sliding_count_paced_wall_clock",
+            "latency_mode": {
+                "target_ms": lat_target_ms,
+                "max_inflight": 2,
+                "peak_tuples_per_sec": round(lat_peak, 1),
+                "peak_fraction": round(peak_fraction, 4),
+                "load_points": lat_points,
+                "parity": bool(lat_parity),
+            },
         },
         "p99_emission_latency_ms": p99_at_full,
+        "latency_mode_p99_ms": lat_p99_at_25,
+        "latency_mode_peak_fraction": round(peak_fraction, 4),
     }
 
 

@@ -407,6 +407,55 @@ def scenario_device_dispatch_error() -> Dict[str, Any]:
                    recovery_ms=recovery_ms, attributed=attributed)
 
 
+def scenario_latency_mode_restore() -> Dict[str, Any]:
+    """Device dispatch error with LATENCY MODE ON (small superbatch rungs,
+    in-flight ring depth 2): the fault lands while the ring can legally
+    hold an unresolved dispatch. Checkpoint barriers must drain the ring
+    before capture (exactly-once capture points unchanged), the restart
+    must reset ring + controller, and the recovered job must finish at
+    exact parity with a plain throughput-mode oracle — proving deep async
+    dispatch never double-emits or drops a fired window across restore."""
+    from flink_tpu.config import LatencyOptions
+
+    problems: List[str] = []
+    _oracle_client, expected = _run_mini_count_job("latency-oracle")
+    chk = tempfile.mkdtemp(prefix="flink-tpu-latmode-")
+    try:
+        with fault_injection(rules=[
+            {"scope": "device", "fault": "error", "nth": 6},
+        ]) as plan:
+            client, results = _run_mini_count_job(
+                "latency-mode-restore", chk_dir=chk,
+                extra_config={
+                    # aggressive target so the controller leaves the full
+                    # span and actually exercises small rungs + the ring
+                    LatencyOptions.TARGET_MS: 1,
+                    LatencyOptions.MAX_INFLIGHT: 2,
+                })
+    finally:
+        shutil.rmtree(chk, ignore_errors=True)
+    parity = results == expected
+    _check(problems, client.status().value == "FINISHED",
+           f"job ended {client.status().value}")
+    _check(problems, parity, "latency-mode parity vs throughput oracle broken")
+    _check(problems, client.num_restarts == 1,
+           f"expected 1 restart, saw {client.num_restarts}")
+    _check(problems, plan.total_fired == 1,
+           f"expected 1 injected dispatch error, fired {plan.total_fired}")
+    exc = client.exceptions.payload()
+    entry = exc["entries"][0] if exc["entries"] else {}
+    attributed = bool(entry.get("injected"))
+    _check(problems, attributed,
+           "injected dispatch error not attributed injected:true")
+    recs = exc["recoveries"]
+    recovery_ms = recs[0]["downtime_ms"] if recs else None
+    _check(problems, bool(recs) and recs[0]["restored_checkpoint_id"] is not None,
+           "recovery timeline missing the rewound checkpoint")
+    return _result("latency-mode-restore", "mini", plan, problems,
+                   parity=parity, restarts=client.num_restarts,
+                   recovery_ms=recovery_ms, attributed=attributed)
+
+
 def _run_mini_join_job(name: str, *, records: int = 1200, batch: int = 100,
                        chk_dir: Optional[str] = None, interval_ms: int = 1,
                        timeout_s: float = 120.0):
@@ -1027,6 +1076,7 @@ SCENARIOS: Dict[str, Callable[[], Dict[str, Any]]] = {
     "torn-checkpoint": scenario_torn_checkpoint,
     "storage-brownout": scenario_storage_brownout,
     "device-dispatch-error": scenario_device_dispatch_error,
+    "latency-mode-restore": scenario_latency_mode_restore,
     "join-restore": scenario_join_restore,
     "chip-loss-sharded": scenario_chip_loss_sharded,
     "cold-tier-read-error": scenario_cold_tier_read_error,

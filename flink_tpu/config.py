@@ -325,6 +325,72 @@ class ExecutionOptions:
     )
 
 
+class LatencyOptions:
+    """Latency-mode execution (execution.latency.*, docs/latency.md): the
+    fused window path trades superbatch amortization for emission latency
+    under an explicit target. Default off — throughput mode is untouched
+    and the flag is a perf switch, never a semantics switch."""
+
+    TARGET_MS = (
+        ConfigOptions.key("execution.latency.target-ms").int_type().default_value(0)
+    ).with_description(
+        "Emission-latency target for the fused window path; 0 (default) "
+        "keeps pure throughput mode, byte-identical dispatch behavior. "
+        "When set, a scheduler-side controller adapts the staged "
+        "superbatch depth between execution.latency.floor-steps and the "
+        "full execution.window.superbatch-steps span from windowed "
+        "arrival-rate estimates, snapping to a pow2 rung ladder so "
+        "adaptation never compiles more than the ladder's shapes."
+    )
+    MAX_INFLIGHT = (
+        ConfigOptions.key("execution.latency.max-inflight-dispatches")
+        .int_type().default_value(1)
+    ).with_description(
+        "Bound of the fused operator's in-flight dispatch ring: how many "
+        "enqueued superbatch dispatches may await deferred resolution at "
+        "once. 1 (default) is the classic one-outstanding-dispatch "
+        "behavior; deeper rings let dispatch N+1 stage and launch while "
+        "N's emissions resolve. Watermark/checkpoint barriers drain the "
+        "whole ring in dispatch order, so capture points and emission "
+        "order never change."
+    )
+    FLOOR_STEPS = (
+        ConfigOptions.key("execution.latency.floor-steps").int_type().default_value(2)
+    ).with_description(
+        "Smallest superbatch depth (steps per dispatch) the latency "
+        "controller may select — the bottom rung of the pow2 ladder. "
+        "Bounds the buffering delay to roughly floor-steps batch fill "
+        "times at the cost of per-dispatch amortization."
+    )
+    READBACK_STEPS = (
+        ConfigOptions.key("execution.latency.readback-steps").int_type().default_value(8)
+    ).with_description(
+        "Streaming fire readback: split each dispatch into step groups of "
+        "this size so fired-window rows start their async device-to-host "
+        "copy per group instead of waiting for span completion (results "
+        "still resolve through the same DeferredEmissions layout, bit "
+        "identical). 0 keeps span-granular readback. Single-chip XLA path "
+        "only; the mesh and pallas paths keep span-granular readback."
+    )
+    MIN_DWELL_MS = (
+        ConfigOptions.key("execution.latency.min-dwell-ms")
+        .duration_ms_type().default_value(500)
+    ).with_description(
+        "Minimum time the latency controller holds a chosen rung before a "
+        "non-escalation move (the autoscaler's stabilization-interval "
+        "discipline applied to batch geometry). Rate spikes that demand "
+        "the full span escalate immediately regardless."
+    )
+    HYSTERESIS_PCT = (
+        ConfigOptions.key("execution.latency.hysteresis-pct").int_type().default_value(25)
+    ).with_description(
+        "Dead band around each rung boundary, in percent of the boundary "
+        "rate: the windowed arrival rate must overshoot a boundary by "
+        "this margin before the controller changes rung, so a rate "
+        "oscillating across a boundary never flaps geometries."
+    )
+
+
 class TableOptions:
     """The Table/SQL front door (flink_tpu/table + flink_tpu/planner)."""
 

@@ -357,5 +357,33 @@ def build_latency_report(metrics: Dict[str, Any], spans: List[Any], *,
         "p999_ms": merged.get("p999", 0.0),
         "samples": merged.get("count", 0),
         "watermarkLagMs": max(lags) if lags else 0.0,
+        "latency_mode": _latency_mode_block(metrics),
         "attribution": stall_attribution(spans, slack_ms=slack_ms),
+    }
+
+
+#: the latency-mode controller gauge family the report folds — the same
+#: leaves cluster._LATENCY_CONTROLLER_GAUGES MAX-folds across shards
+_CONTROLLER_LEAVES = ("latencyModeActive", "currentBatchRung",
+                      "inflightDepth", "ladderRecompiles")
+
+
+def _latency_mode_block(metrics: Dict[str, Any]) -> Dict[str, Any]:
+    """Controller decisions in the /jobs/:id/latency report: worst-shard
+    (MAX) fold of the execution.latency.* gauges. `active` False with all
+    zeros when the mode is off — the report shape never changes with the
+    flag, only the values."""
+    folded = {leaf: 0 for leaf in _CONTROLLER_LEAVES}
+    for name, val in metrics.items():
+        leaf = name.rsplit(".", 1)[-1]
+        if leaf in folded:
+            try:
+                folded[leaf] = max(folded[leaf], int(val))
+            except (TypeError, ValueError):
+                pass
+    return {
+        "active": bool(folded["latencyModeActive"]),
+        "currentBatchRung": folded["currentBatchRung"],
+        "inflightDepth": folded["inflightDepth"],
+        "ladderRecompiles": folded["ladderRecompiles"],
     }

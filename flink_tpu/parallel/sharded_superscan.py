@@ -177,6 +177,14 @@ class ShardedFusedPipeline:
         # into the planner's phase_totals)
         self.compile_tracker = None
         self.phase_counters = False
+        # latency mode (scheduler/latency_controller.py): donate the
+        # sharded [n, Kl, S] scan carry to the executable. Streaming fire
+        # readback (readback_steps) stays single-chip only — splitting the
+        # mesh dispatch would multiply the per-step all-to-all count, so
+        # the mesh path keeps span-granular readback by design. Set here
+        # explicitly: __getattr__ would otherwise forward the read to the
+        # plan-only planner and a write would shadow it confusingly.
+        self.donate_carry = False
 
     # ------------------------------------------------------------------
     # planner-geometry delegation: StepNormalizer, DeferredEmissions, and
@@ -428,7 +436,7 @@ class ShardedFusedPipeline:
         combine = self.local_combine
         routed = self.routing is not None
         key = ("classic", T, B, phases, combine,
-               None if not routed else self.routing.G)
+               None if not routed else self.routing.G, self.donate_carry)
         if key in self._fn_cache:
             return self._fn_cache[key]
 
@@ -550,7 +558,10 @@ class ShardedFusedPipeline:
             out_specs=out_specs,
             check_vma=False,
         )
-        fn = jax.jit(sharded)
+        # latency mode donates the carry (args 0/1: count + field states);
+        # dispatch rebinds to the outputs, so the inputs die at enqueue
+        fn = (jax.jit(sharded, donate_argnums=(0, 1)) if self.donate_carry
+              else jax.jit(sharded))
         self._fn_cache[key] = fn
         return fn
 
@@ -654,7 +665,7 @@ class ShardedFusedPipeline:
         combine = self.local_combine
         routed = self.routing is not None
         key = ("raw", T, B, phases, combine,
-               None if not routed else self.routing.G)
+               None if not routed else self.routing.G, self.donate_carry)
         if key in self._fn_cache:
             return self._fn_cache[key]
 
@@ -848,7 +859,8 @@ class ShardedFusedPipeline:
                 return count, states, count_out, outs, kb_g, pc
             return count, states, count_out, outs, kb_g
 
-        fn = jax.jit(run)
+        fn = (jax.jit(run, donate_argnums=(0, 1)) if self.donate_carry
+              else jax.jit(run))
         self._fn_cache[key] = fn
         return fn
 

@@ -274,7 +274,11 @@ def _shard_combine(key: str) -> str:
     meaningless and averaging would hide a single hot shard), roofline
     utilization percentages average (each shard's own chip's fraction)."""
     leaf = key.rsplit(".", 1)[-1]
-    if leaf.startswith("current"):
+    if leaf.startswith("current") and leaf not in _LATENCY_MAX_GAUGES:
+        # the current* prefix means "watermark position" (fold MIN: the
+        # straggler defines job progress) — EXCEPT currentBatchRung,
+        # which is a controller geometry, where the job-level view is the
+        # largest rung any shard is still dispatching (worst latency)
         return "min"
     if leaf == "joinFallbackReason":
         # a catalogued reason CODE, not a count: the job-level view is
@@ -347,7 +351,18 @@ _JOIN_GAUGES = ("joinRingOccupancy", "joinMatchesEmitted",
 #: AND both /jobs/:id/device-style payload filters (the _TIER_GAUGES-
 #: omission lesson: a family missing from either silently reads 0/absent
 #: job-level).
-_LATENCY_MAX_GAUGES = ("watermarkLagMs", "p99EmissionLatencyMs")
+#: latency-mode controller gauges (scheduler/latency_controller.py via
+#: FusedWindowOperator.latency_gauges, registered only when
+#: execution.latency.target-ms is on): rung depth, in-flight ring depth,
+#: and distinct ladder geometries are per-shard controller facts whose
+#: job-level view is the worst shard (the deepest rung / fullest ring /
+#: most geometries compiled), so the whole family folds MAX; the tuple
+#: also feeds _LATENCY_GAUGES below so both /jobs/:id/device payload
+#: filters carry it (the _TIER_GAUGES-omission lesson yet again).
+_LATENCY_CONTROLLER_GAUGES = ("latencyModeActive", "currentBatchRung",
+                              "inflightDepth", "ladderRecompiles")
+_LATENCY_MAX_GAUGES = ("watermarkLagMs",
+                       "p99EmissionLatencyMs") + _LATENCY_CONTROLLER_GAUGES
 _LATENCY_HISTOGRAMS = ("emissionLatencyMs",)
 _LATENCY_GAUGES = _LATENCY_MAX_GAUGES + _LATENCY_HISTOGRAMS
 

@@ -270,6 +270,29 @@ def _mesh_exchange_kwargs(config: Configuration) -> dict:
     }
 
 
+def _latency_kwargs(config: Configuration) -> dict:
+    """The latency-mode option bundle threaded to FusedWindowOperator —
+    empty (NOT latency=None) when execution.latency.target-ms is off, so
+    the default config constructs the operator exactly as before the mode
+    existed. Single-sourced like _mesh_exchange_kwargs: both fused
+    construction sites (WindowStepRunner and _init_fused) consume it."""
+    from flink_tpu.config import LatencyOptions as _L
+
+    target = config.get(_L.TARGET_MS)
+    if target is None or int(target) <= 0:
+        return {}
+    from flink_tpu.scheduler.latency_controller import LatencySpec
+
+    return {"latency": LatencySpec(
+        target_ms=int(target),
+        max_inflight=config.get(_L.MAX_INFLIGHT),
+        floor_steps=config.get(_L.FLOOR_STEPS),
+        readback_steps=config.get(_L.READBACK_STEPS),
+        min_dwell_ms=config.get(_L.MIN_DWELL_MS),
+        hysteresis_pct=config.get(_L.HYSTERESIS_PCT),
+    )}
+
+
 def _tier_for_config(config: Configuration):
     """The fused window path's TierConfig when the million-key state
     plane applies (state.tier.enabled), else None. Tiering needs the host
@@ -621,6 +644,7 @@ class WindowStepRunner(StepRunner):
                 mesh=_mesh_for_config(config, capacity),
                 tier=tier,
                 **_mesh_exchange_kwargs(config),
+                **_latency_kwargs(config),
             )
             self.device = True
         elif use_device:
@@ -967,6 +991,16 @@ class WindowStepRunner(StepRunner):
                         "promotions", "spilledBytes", "changelogBytes",
                         "tierHotFillRatio"):
                 group.gauge(key, lambda k=key: self.op.tier_gauges().get(k))
+        # latency-mode controller gauges (execution.latency.target-ms):
+        # registered only when the mode is on, folded MAX across shards
+        # (cluster._LATENCY_CONTROLLER_GAUGES) — the controller's rung/
+        # ring/ladder decisions surface in /jobs/:id/device and /latency
+        latency_gauges = getattr(self.op, "latency_gauges", None)
+        if callable(latency_gauges) and latency_gauges() is not None:
+            for key in ("latencyModeActive", "currentBatchRung",
+                        "inflightDepth", "ladderRecompiles"):
+                group.gauge(key,
+                            lambda k=key: self.op.latency_gauges().get(k))
 
     def snapshot(self) -> dict:
         return {"operator": self.op.snapshot()}
@@ -1027,6 +1061,7 @@ class DeviceChainRunner(WindowStepRunner):
             # step is the keyBy exchange
             mesh=_mesh_for_config(config, capacity),
             **_mesh_exchange_kwargs(config),
+            **_latency_kwargs(config),
             **({} if assigners is None else {"assigners": list(assigners)}),
         )
         self.device = True

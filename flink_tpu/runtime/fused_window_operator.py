@@ -34,7 +34,8 @@ no-late-data contract downstream (a delayed watermark is always correct).
 from __future__ import annotations
 
 import dataclasses
-from typing import Any, Dict, List, Optional, Tuple
+from collections import deque
+from typing import Any, Deque, Dict, List, Optional, Tuple
 
 import numpy as np
 
@@ -42,6 +43,10 @@ from flink_tpu.api.windowing.assigners import WindowAssigner
 from flink_tpu.core.time import MAX_WATERMARK, MIN_WATERMARK
 from flink_tpu.ops.aggregators import ONE, VALUE, resolve
 from flink_tpu.runtime.fused_window_pipeline import FusedWindowPipeline
+from flink_tpu.scheduler.latency_controller import (
+    LatencySpec,
+    SuperbatchController,
+)
 from flink_tpu.state.columnar import KeyDictionary
 
 
@@ -458,6 +463,7 @@ class FusedWindowOperator:
         mesh_local_combine: bool = False,
         mesh_skew_routing: bool = False,
         mesh_key_groups: int = 0,
+        latency: Optional[LatencySpec] = None,
     ):
         self.agg = resolve(aggregate)
         if self.agg is None:
@@ -549,7 +555,35 @@ class FusedWindowOperator:
             else StepNormalizer(self.pipe, raw_payload=prologue is not None)
         )
         self._steps: List[_Step] = []
-        self._inflight: Optional[tuple] = None  # (DeferredEmissions, wm)
+        # bounded in-flight dispatch ring: (DeferredEmissions, wm,
+        # purged_to) entries, resolved FIFO. Depth 1 (the default) is
+        # byte-identical to the historical single `_inflight` slot —
+        # dispatch N+1 enqueues, THEN N resolves; latency mode deepens the
+        # ring so N+1 stages and launches while N's copies land.
+        self._inflight: Deque[tuple] = deque()
+        self._max_inflight = 1
+        # latency mode (execution.latency.target-ms): the adaptive rung
+        # controller + donated carries + streaming readback. None keeps
+        # every hot-path decision identical to throughput mode.
+        self.latency = latency
+        self._controller: Optional[SuperbatchController] = None
+        self._ladder_geoms: set = set()   # distinct dispatch depths seen
+        if latency is not None and latency.target_ms > 0:
+            self._controller = SuperbatchController(
+                full_steps=superbatch_steps,
+                target_ms=latency.target_ms,
+                floor_steps=latency.floor_steps,
+                min_dwell_ms=latency.min_dwell_ms,
+                hysteresis_pct=latency.hysteresis_pct,
+            )
+            self._max_inflight = max(int(latency.max_inflight), 1)
+            self.pipe.donate_carry = True
+            if mesh is None and latency.readback_steps > 0:
+                # streaming fire readback is single-chip XLA only:
+                # splitting the mesh dispatch would multiply the per-step
+                # all-to-all collective count, so the mesh keeps
+                # span-granular readback (docs/latency.md)
+                self.pipe.readback_steps = int(latency.readback_steps)
         self.output: List[Tuple[Any, Any, Any, int]] = []
         self.emitted_watermark = MIN_WATERMARK
         self.current_watermark = MIN_WATERMARK
@@ -580,7 +614,7 @@ class FusedWindowOperator:
         ids, required = self.keydict.lookup_or_insert(np.asarray(keys))
         self.pipe.ensure_key_capacity(required)
         vals = np.asarray(values, np.float32) if self._needs_value else None
-        self._steps.extend(
+        self._push_steps(
             self.norm.push(ids.astype(np.int32), vals,
                            np.asarray(timestamps, np.int64))
         )
@@ -643,7 +677,7 @@ class FusedWindowOperator:
         if live_hot.any():
             tier.note_hot_cells(ids[live_hot].astype(np.int64),
                                 s_abs[live_hot])
-        self._steps.extend(self.norm.push(ids.astype(np.int32), vals, ts))
+        self._push_steps(self.norm.push(ids.astype(np.int32), vals, ts))
         self._maybe_dispatch()
 
     def process_raw_batch(self, values: np.ndarray,
@@ -653,7 +687,7 @@ class FusedWindowOperator:
         runs inside the compiled dispatch."""
         if len(timestamps) == 0:
             return
-        self._steps.extend(
+        self._push_steps(
             self.norm.push(values, None, np.asarray(timestamps, np.int64))
         )
         self._maybe_dispatch()
@@ -675,7 +709,7 @@ class FusedWindowOperator:
             self._steps[-1].wm = steps[0].wm
             self._steps[-1].n_fires = steps[0].n_fires
             steps = steps[1:]
-        self._steps.extend(steps)
+        self._push_steps(steps)
         if watermark >= MAX_WATERMARK - 1:
             self.flush_all()
         else:
@@ -685,9 +719,26 @@ class FusedWindowOperator:
         pass  # event-time only
 
     # ------------------------------------------------------------------
+    def _push_steps(self, steps: List[_Step]) -> None:
+        """Append planner-safe steps + feed the latency controller's
+        windowed arrival estimate (watermark-only steps count: they occupy
+        superbatch slots, so they are part of the fill rate)."""
+        self._steps.extend(steps)
+        if self._controller is not None and steps:
+            self._controller.observe(len(steps))
+
+    def _dispatch_target(self) -> int:
+        """Steps a full dispatch cuts at: the adaptive rung under latency
+        mode, the fixed span otherwise."""
+        if self._controller is None:
+            return self.T
+        return self._controller.steps()
+
     def _maybe_dispatch(self) -> None:
-        while len(self._steps) >= self.T:
-            self._dispatch(self._take_group())
+        target = self._dispatch_target()
+        while len(self._steps) >= target:
+            self._dispatch(self._take_group(target=target))
+            target = self._dispatch_target()
 
     def flush_all(self) -> None:
         """Dispatch every buffered step and resolve all in-flight output.
@@ -700,16 +751,18 @@ class FusedWindowOperator:
             self._dispatch(self._take_group(tail=True))
         self._resolve_inflight()
 
-    def _take_group(self, tail: bool = False) -> List[_Step]:
+    def _take_group(self, tail: bool = False,
+                    target: Optional[int] = None) -> List[_Step]:
+        limit = self.T if target is None else target
         group: List[_Step] = []
         fires = 0
-        while self._steps and len(group) < self.T:
+        while self._steps and len(group) < limit:
             s = self._steps[0]
             if fires + s.n_fires > self.pipe.R and group:
                 break  # out_rows budget: cut the dispatch early
             fires += s.n_fires
             group.append(self._steps.pop(0))
-        target = (1 << max(len(group) - 1, 0).bit_length()) if tail else self.T
+        target = (1 << max(len(group) - 1, 0).bit_length()) if tail else limit
         # pads carry the LAST REAL step's watermark, not the normalizer's
         # committed one — steps still queued behind an early cut have lower
         # watermarks, and a future-stamped pad would do the whole jump in
@@ -727,12 +780,18 @@ class FusedWindowOperator:
         else:
             d = self.pipe.process_superbatch(
                 [(s.kid, s.vals, s.ts) for s in group], wms, defer=True)
-        self._resolve_inflight()
+        if self._controller is not None:
+            self._ladder_geoms.add(len(group))
         # the purge frontier as of THIS dispatch's staging: cold-tier rows
         # below it may only be deleted after this dispatch's emissions
         # have resolved (they read the cold rows of the windows that just
-        # fired) — a lagged frontier, applied at resolve time
-        self._inflight = (d, group[-1].wm, self.pipe.purged_to)
+        # fired) — a lagged frontier each ring entry carries to its own
+        # resolve, so purge_below always advances with resolution order
+        self._inflight.append((d, group[-1].wm, self.pipe.purged_to))
+        # depth 1 reproduces the historical slot byte-for-byte: the new
+        # dispatch enqueues first, THEN the previous one resolves
+        while len(self._inflight) > self._max_inflight:
+            self._resolve_oldest()
 
     # emission-latency plane: set by the runner when the plane is on;
     # stamped at the DEFERRED RESOLVE below — the only point where a
@@ -740,10 +799,15 @@ class FusedWindowOperator:
     emission_tracker = None
 
     def _resolve_inflight(self) -> None:
-        if self._inflight is None:
-            return
-        d, wm, purged_to = self._inflight
-        self._inflight = None
+        """Drain the whole in-flight ring (FIFO). Every barrier that needs
+        the operator quiescent — flush_all (and thus snapshot), routing
+        swaps, tier evictions — lands here, so exactly-once capture points
+        see an empty ring regardless of its configured depth."""
+        while self._inflight:
+            self._resolve_oldest()
+
+    def _resolve_oldest(self) -> None:
+        d, wm, purged_to = self._inflight.popleft()
         tracker = self.emission_tracker
         for window, counts, fields in d.resolve():
             if tracker is not None:
@@ -1039,6 +1103,30 @@ class FusedWindowOperator:
         """/jobs/:id/device tier block (None when tiering is off)."""
         return None if self.tier is None else self.tier.payload()
 
+    # -- latency-mode observability ------------------------------------
+    def latency_gauges(self):
+        """The latency-mode controller gauge family, or None when the mode
+        is off — registered by the runner next to the tier family, folded
+        MAX across shards (cluster._LATENCY_CONTROLLER_GAUGES), surfaced
+        in /jobs/:id/device and the /jobs/:id/latency report."""
+        if self._controller is None:
+            return None
+        return {
+            "latencyModeActive": 1,
+            "currentBatchRung": int(self._controller.current_steps()),
+            "inflightDepth": len(self._inflight),
+            "ladderRecompiles": len(self._ladder_geoms),
+        }
+
+    def _reset_dispatch_ring(self) -> None:
+        """Restore/rebuild quiescence: discard unresolved in-flight
+        handles (their fires re-run from the restored state) and re-hold
+        the controller's full-span rung — pre-failure arrival samples
+        describe a stream position that no longer exists."""
+        self._inflight.clear()
+        if self._controller is not None:
+            self._controller.reset()
+
     def _pack_output(self):
         """Undrained emissions ride every checkpoint; in the tiered
         incremental path they dominate the per-interval delta, so scalar
@@ -1103,7 +1191,7 @@ class FusedWindowOperator:
     def _apply_tier_meta(self, meta: dict, envelope: dict) -> None:
         self.norm.restore(meta["norm"])
         self._steps = []
-        self._inflight = None
+        self._reset_dispatch_ring()
         self.output = self._unpack_output(envelope["output"])
         self.emitted_watermark = envelope["emitted_watermark"]
         self.current_watermark = envelope["current_watermark"]
@@ -1187,7 +1275,7 @@ class FusedWindowOperator:
         self._steps = []
         self.emitted_watermark = snap["emitted_watermark"]
         self.current_watermark = snap["current_watermark"]
-        self._inflight = None
+        self._reset_dispatch_ring()
         self.output = list(snap["output"])
         if self.spec_outputs is not None:
             self.spec_outputs = [list(x) for x in snap["spec_outputs"]]

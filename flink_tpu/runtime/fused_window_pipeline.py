@@ -136,6 +136,25 @@ class DeferredEmissions:
         ]
 
 
+class _StreamedEmissions:
+    """Composite deferred handle for a step-group streamed dispatch
+    (latency mode): one DeferredEmissions per (readback_steps, B) group,
+    each of which started its async device->host copy the moment its
+    group's scan was enqueued — fires from early step groups become
+    host-visible while later groups are still computing. resolve()
+    concatenates the per-group resolutions in group order, reproducing
+    the whole-span handle's emission order and payloads exactly."""
+
+    def __init__(self, parts: List[DeferredEmissions]):
+        self._parts = parts
+
+    def resolve(self):
+        out = []
+        for p in self._parts:
+            out.extend(p.resolve())
+        return out
+
+
 class _PlanCursor:
     """The fire/purge planning state machine for one dispatch.
 
@@ -304,6 +323,16 @@ class FusedWindowPipeline:
         self.compile_tracker = None
         self.phase_counters = False
         self.phase_totals = np.zeros(3, np.int64)  # [ingest, fire, purge]
+        # latency-mode dispatch shape (scheduler/latency_controller.py),
+        # flipped by the operator when execution.latency.target-ms is on:
+        # donate_carry donates the [K, S] scan carry to the executable
+        # (kills the state copy on the hot path — part of every executable
+        # cache key, so flag-off jobs never share a donated program);
+        # readback_steps > 0 splits a T-step dispatch into (T/readback_steps)
+        # chained step-group programs so fired rows start their async
+        # device->host copy per group instead of at span completion.
+        self.donate_carry = False
+        self.readback_steps = 0
 
         self.g = assigner.slice_ms
         self.sl = assigner.slide_slices
@@ -570,6 +599,7 @@ class FusedWindowPipeline:
             self.agg, self.K, self.S, self.NSB, self.F, self.R,
             self.spw, self.chunk, self.exact_sums, T, B,
             phases=self.phase_counters, fire_spws=self._fire_spws,
+            donate=self.donate_carry,
         )
 
     # ------------------------------------------------------------------
@@ -697,6 +727,12 @@ class FusedWindowPipeline:
                 idx_d = idx_d.reshape(T, B)
             if self._needs_vals and vals_d.ndim == 1:
                 vals_d = vals_d.reshape(T, B)
+            Tg = self.readback_steps
+            if 0 < Tg < T and T % Tg == 0:
+                deferred = self._process_grouped(
+                    T, B, Tg, idx_d, vals_d, smin_pos, fire_pos,
+                    fire_valid, fire_row, purge_mask, fires)
+                return deferred if defer else deferred.resolve()
             run = self._superscan(T, B)
             outs0 = {
                 f.name: jnp.zeros((self.R, self.K), jnp.dtype(f.dtype))
@@ -727,6 +763,55 @@ class FusedWindowPipeline:
             phase_counts=(pc if self.phase_counters and not self._use_pallas()
                           else None))
         return deferred if defer else deferred.resolve()
+
+    def _process_grouped(self, T, B, Tg, idx_d, vals_d, smin_pos, fire_pos,
+                         fire_valid, fire_row, purge_mask, fires):
+        """Streaming fire readback (latency mode): run one T-step dispatch
+        as G = T/Tg chained (Tg, B) programs carrying state on device, so
+        each group's fired rows start their async device->host copy when
+        the group's scan is enqueued instead of at span completion. Fire
+        rows are planned with GLOBAL output-buffer indices across the span
+        — each group's fresh output buffer populates only its own fires'
+        rows — so resolving the per-group handles in order reproduces the
+        whole-span emission order and payloads byte-for-byte. Pow2 ladder
+        rungs make T % Tg == 0 whenever Tg fits; geometries that do not
+        divide fall through to the whole-span readback."""
+        import jax.numpy as jnp
+
+        run = self._superscan(Tg, B)
+        parts: List[DeferredEmissions] = []
+        done = 0
+        for g in range(T // Tg):
+            lo, hi = g * Tg, (g + 1) * Tg
+            outs0 = {
+                f.name: jnp.zeros((self.R, self.K), jnp.dtype(f.dtype))
+                for f in self._value_fields
+            }
+            count_out0 = jnp.zeros((self.R, self.K), jnp.int32)
+            out = self._tracked(
+                "fused_superscan", run,
+                (self._state, self._count, outs0, count_out0,
+                 idx_d[lo:hi], vals_d[lo:hi], smin_pos[lo:hi],
+                 fire_pos[lo:hi], fire_valid[lo:hi], fire_row[lo:hi],
+                 purge_mask[lo:hi]),
+                {"T": Tg, "B": B},
+            )
+            pc = None
+            if self.phase_counters:
+                self._state, self._count, outs, count_out, pc = out
+            else:
+                self._state, self._count, outs, count_out = out
+            g_fires = [pf for pf in fires if lo <= pf.step < hi]
+            done += len(g_fires)
+            # rows are assigned in fire order across the WHOLE span: the
+            # highest row this group can populate is the cumulative count
+            used = -(-max(done, 1) // 16) * 16
+            if used < self.R:
+                count_out = _slice_rows(count_out, used)
+                outs = {k: _slice_rows(v, used) for k, v in outs.items()}
+            parts.append(DeferredEmissions(
+                self, g_fires, count_out, outs, phase_counts=pc))
+        return _StreamedEmissions(parts)
 
     def stage_superbatch(self, batches, watermarks):
         """Host planning + device staging for one dispatch (separable so
@@ -1014,6 +1099,12 @@ class FusedWindowPipeline:
         T, B = srel_d.shape
 
         self._to_canonical()
+        Tg = self.readback_steps
+        if 0 < Tg < T and T % Tg == 0:
+            deferred = self._process_grouped_raw(
+                T, B, Tg, raw_d, srel_d, ts_d, smin_pos, fire_pos,
+                fire_valid, fire_row, purge_mask, fires)
+            return deferred if defer else deferred.resolve()
         run = self._chained_superscan(T, B)
         outs0 = {
             f.name: jnp.zeros((self.R, self.K), jnp.dtype(f.dtype))
@@ -1045,6 +1136,53 @@ class FusedWindowPipeline:
                                      phase_counts=pc)
         return deferred if defer else deferred.resolve()
 
+    def _process_grouped_raw(self, T, B, Tg, raw_d, srel_d, ts_d, smin_pos,
+                             fire_pos, fire_valid, fire_row, purge_mask,
+                             fires):
+        """Streaming fire readback for the traced-chain path — the
+        _process_grouped contract (global fire rows, per-group async copy,
+        byte-identical resolution order) over the chained executable; the
+        per-group key_bounds check still covers every surviving record
+        because the groups partition the span's steps."""
+        import jax.numpy as jnp
+
+        run = self._chained_superscan(Tg, B)
+        needs_ts = self.prologue.needs_ts
+        parts: List[DeferredEmissions] = []
+        done = 0
+        for g in range(T // Tg):
+            lo, hi = g * Tg, (g + 1) * Tg
+            outs0 = {
+                f.name: jnp.zeros((self.R, self.K), jnp.dtype(f.dtype))
+                for f in self._value_fields
+            }
+            count_out0 = jnp.zeros((self.R, self.K), jnp.int32)
+            xs = (raw_d[lo:hi], srel_d[lo:hi])
+            if needs_ts:
+                xs = xs + (ts_d[lo:hi],)
+            xs = xs + (smin_pos[lo:hi], fire_pos[lo:hi], fire_valid[lo:hi],
+                       fire_row[lo:hi], purge_mask[lo:hi])
+            out = self._tracked(
+                "fused_chained_superscan", run,
+                (self._state, self._count, outs0, count_out0) + xs,
+                {"T": Tg, "B": B, "raw_dtype": str(raw_d.dtype)},
+            )
+            pc = None
+            if self.phase_counters:
+                self._state, self._count, outs, count_out, key_bounds, pc = out
+            else:
+                self._state, self._count, outs, count_out, key_bounds = out
+            g_fires = [pf for pf in fires if lo <= pf.step < hi]
+            done += len(g_fires)
+            used = -(-max(done, 1) // 16) * 16
+            if used < self.R:
+                count_out = _slice_rows(count_out, used)
+                outs = {k: _slice_rows(v, used) for k, v in outs.items()}
+            parts.append(DeferredEmissions(
+                self, g_fires, count_out, outs, key_bounds=key_bounds,
+                key_capacity=self.K, phase_counts=pc))
+        return _StreamedEmissions(parts)
+
     def _chained_superscan(self, T: int, B: int):
         # module-level memo: the key holds STRONG references to the user
         # fns (via the frozen TracedPrologue), so identity-hashed entries
@@ -1052,7 +1190,7 @@ class FusedWindowPipeline:
         # are memoized singletons, custom ones identity-hash conservatively
         key = (self.prologue, self.agg, self.K, self.S, self.NSB, self.F,
                self.R, self.spw, self.chunk, self.exact_sums, T, B,
-               self.phase_counters, self._fire_spws)
+               self.phase_counters, self._fire_spws, self.donate_carry)
         fn = _CHAINED_CACHE.get(key)
         if fn is None:
             while len(_CHAINED_CACHE) >= _CHAINED_CACHE_MAX:
@@ -1142,6 +1280,12 @@ class FusedWindowPipeline:
             state, count, outs, count_out = inner
             return state, count, outs, count_out, key_bounds
 
+        if self.donate_carry:
+            # latency mode: the [K, S] carry buffers are dead the moment
+            # the dispatch is enqueued (the pipeline rebinds to the outputs
+            # unconditionally), so hand them to XLA for in-place reuse —
+            # the deferred handles hold OUTPUT buffers, never the carry
+            return jax.jit(run, donate_argnums=(0, 1))
         return jax.jit(run)
 
     # ------------------------------------------------------------------
@@ -1198,22 +1342,29 @@ from flink_tpu.ops.superscan import make_superscan_step  # noqa: E402,F401
 
 @functools.lru_cache(maxsize=None)
 def _build_superscan(agg, K, S, NSB, F, R, SPW, chunk, exact, T, B,
-                     phases: bool = False, fire_spws=None):
+                     phases: bool = False, fire_spws=None,
+                     donate: bool = False):
     """Compiled T-step superscan; module-level cache so every pipeline with
     identical geometry (incl. warmup instances) shares one executable.
     With `phases` the program additionally returns the int32[3] per-phase
     step counters threaded through the scan carry (device-plane
     observability); the flag is part of the cache key, so gated jobs and
     ungated jobs never share an executable shape. `fire_spws` (shared
-    partials) is likewise part of the key: per-slot slice-run lengths."""
+    partials) is likewise part of the key: per-slot slice-run lengths.
+    `donate` (latency mode) donates the [K, S] state/count carry inputs to
+    XLA for in-place reuse — callers rebind to the outputs unconditionally,
+    so the old buffers are dead at enqueue; keyed so throughput jobs never
+    share a donated executable."""
     import jax
     import jax.numpy as jnp
 
     step = make_superscan_step(agg, K, S, NSB, F, R, SPW, chunk, exact,
                                phase_counters=phases, fire_spws=fire_spws)
+    jit = (functools.partial(jax.jit, donate_argnums=(0, 1)) if donate
+           else jax.jit)
 
     if phases:
-        @jax.jit
+        @jit
         def run(state, count, outs, count_out, idx, vals, smin_pos,
                 fire_pos, fire_valid, fire_row, purge_mask):
             carry0 = (state, count, outs, count_out,
@@ -1227,7 +1378,7 @@ def _build_superscan(agg, K, S, NSB, F, R, SPW, chunk, exact, T, B,
 
         return run
 
-    @jax.jit
+    @jit
     def run(state, count, outs, count_out, idx, vals, smin_pos, fire_pos, fire_valid, fire_row, purge_mask):
         (state, count, outs, count_out), _ = jax.lax.scan(
             step,

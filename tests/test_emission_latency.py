@@ -295,6 +295,21 @@ def test_latency_gauges_registered_in_fold_and_filters():
     # lag/percentile scalars fold MAX (worst shard), never sum
     for leaf in _LATENCY_MAX_GAUGES:
         assert _shard_combine(f"op.win-1.{leaf}") == "max"
+    # ISSUE-18: the latency-mode controller gauges ride the same tuple —
+    # omitting any from the fold rule OR either payload filter silently
+    # hides a shard's rung/ring state at the job level (the exact
+    # _TIER_GAUGES failure class this test exists to pin)
+    from flink_tpu.runtime.cluster import _LATENCY_CONTROLLER_GAUGES
+
+    assert set(_LATENCY_CONTROLLER_GAUGES) == {
+        "latencyModeActive", "currentBatchRung",
+        "inflightDepth", "ladderRecompiles"}
+    for leaf in _LATENCY_CONTROLLER_GAUGES:
+        assert leaf in _LATENCY_MAX_GAUGES, \
+            f"{leaf} missing from the MAX fold family"
+        assert leaf in _LATENCY_GAUGES, \
+            f"{leaf} missing from the payload-filter family"
+        assert _shard_combine(f"op.win-1.{leaf}") == "max"
 
 
 def test_aggregate_shard_metrics_folds_emission_bucketwise():

@@ -1,0 +1,301 @@
+"""Latency-mode execution (ISSUE-18), policy in isolation and at the
+operator: the pow2 rung ladder, the windowed-rate controller's
+warm-up / hysteresis / min-dwell / spike-escalation discipline, byte
+parity of every rung geometry against the full-span run, the bounded
+in-flight dispatch ring draining at barriers, and the flag-off identity
+guarantee (no controller, depth-1 ring, no donation, no readback split).
+
+Controller tests drive an injected clock — policy decisions must be a
+pure function of (samples, now), never of real scheduler timing.
+"""
+
+import numpy as np
+import pytest
+
+from flink_tpu.api.windowing.assigners import TumblingEventTimeWindows
+from flink_tpu.core.time import MAX_WATERMARK
+from flink_tpu.runtime.fused_window_operator import FusedWindowOperator
+from flink_tpu.scheduler.latency_controller import (
+    LatencySpec,
+    SuperbatchController,
+    build_rung_ladder,
+)
+
+
+# ---------------------------------------------------------------------------
+# the rung ladder
+# ---------------------------------------------------------------------------
+
+def test_rung_ladder_pow2_monotone_full_span_top():
+    # the full span is ALWAYS the top rung, even when it is not pow2 —
+    # it is the one geometry the throughput path compiles anyway
+    assert build_rung_ladder(2, 48) == (2, 4, 8, 16, 32, 48)
+    assert build_rung_ladder(2, 32) == (2, 4, 8, 16, 32)
+    assert build_rung_ladder(3, 32) == (4, 8, 16, 32)   # floor snaps up to pow2
+    assert build_rung_ladder(2, 2) == (2,)
+    assert build_rung_ladder(5, 4) == (4,)              # floor clamped to full
+    for floor, full in ((1, 1), (2, 7), (2, 64), (4, 100)):
+        ladder = build_rung_ladder(floor, full)
+        assert ladder[-1] == full
+        assert all(a < b for a, b in zip(ladder, ladder[1:])), \
+            f"ladder not strictly increasing: {ladder}"
+        assert all(r == full or (r & (r - 1)) == 0 for r in ladder), \
+            f"non-pow2 intermediate rung: {ladder}"
+
+
+# ---------------------------------------------------------------------------
+# controller policy (injected clock — pure decisions)
+# ---------------------------------------------------------------------------
+
+def _ctrl(**kw):
+    t = [0.0]
+    defaults = dict(full_steps=32, target_ms=100, floor_steps=2,
+                    min_dwell_ms=500, hysteresis_pct=25,
+                    clock=lambda: t[0])
+    defaults.update(kw)
+    return SuperbatchController(**defaults), t
+
+
+def _feed(c, t, n, *, per_obs, dt):
+    for _ in range(n):
+        c.observe(per_obs, now=t[0])
+        t[0] += dt
+
+
+def test_warm_up_holds_the_full_span():
+    c, t = _ctrl()
+    assert c.steps() == 32                  # cold start = throughput geometry
+    _feed(c, t, 2, per_obs=1, dt=0.1)       # below min_samples
+    assert c.step_rate() is None
+    assert c.steps() == 32
+
+
+def test_adapts_down_at_light_load():
+    c, t = _ctrl()
+    # 100 steps/s x 100 ms target = 10-step budget -> rung 8
+    _feed(c, t, 6, per_obs=10, dt=0.1)
+    assert c.step_rate() == pytest.approx(100.0, rel=0.01)
+    assert c.steps() == 8
+
+
+def test_hysteresis_never_flaps_across_a_rung_boundary():
+    c, t = _ctrl()
+    _feed(c, t, 6, per_obs=10, dt=0.1)
+    assert c.steps() == 8
+    t[0] += 1.0                             # clear of any dwell
+    # budget oscillating across the rung-8 boundary (7 <-> 9 steps):
+    # neither side clears the 25% margin, so the rung must never move
+    for budget in (7, 9) * 10:
+        _feed(c, t, 8, per_obs=budget, dt=0.1)
+        assert c.steps() == 8, f"flapped at budget {budget}"
+
+
+def test_min_dwell_gates_consecutive_moves():
+    # window=3 so the windowed estimate turns over INSIDE the dwell —
+    # isolating the dwell gate from the window's own inertia
+    c, t = _ctrl(window=3)
+    _feed(c, t, 6, per_obs=10, dt=0.1)
+    assert c.steps() == 8                   # first move at t=0.6
+    # rate drops to 40 steps/s (4-step budget, clears the down margin),
+    # but only 0.2 s into the 0.5 s dwell: the rung must hold
+    _feed(c, t, 2, per_obs=4, dt=0.1)       # t -> 0.8
+    assert c.steps() == 8
+    # past the dwell at the same low rate: now it moves
+    _feed(c, t, 4, per_obs=4, dt=0.1)       # t -> 1.2
+    assert c.steps() == 4
+
+
+def test_rate_spike_escalates_to_full_span_bypassing_dwell():
+    c, t = _ctrl()
+    _feed(c, t, 6, per_obs=10, dt=0.1)
+    assert c.steps() == 8
+    # 30 ms later — deep inside the 500 ms dwell — the rate spikes: the
+    # budget clears the top rung's boundary with margin, and falling
+    # behind is strictly worse than a dwell violation, so escalation to
+    # the full span applies NOW
+    _feed(c, t, 3, per_obs=100, dt=0.01)
+    assert c.steps() == 32
+
+
+def test_reset_forgets_the_window_and_reholds_full_span():
+    c, t = _ctrl()
+    _feed(c, t, 6, per_obs=10, dt=0.1)
+    assert c.steps() == 8
+    c.reset()
+    assert c.step_rate() is None
+    assert c.current_steps() == 32
+    assert c.steps() == 32
+
+
+def test_gauge_read_never_advances_policy_state():
+    c, t = _ctrl()
+    _feed(c, t, 6, per_obs=10, dt=0.1)
+    # current_steps() is the gauge read: it must report the HELD rung
+    # without evaluating (and committing) a pending move
+    assert c.current_steps() == 32
+    assert c.steps() == 8
+    assert c.current_steps() == 8
+
+
+# ---------------------------------------------------------------------------
+# operator: rung parity, the in-flight ring, flag-off identity
+# ---------------------------------------------------------------------------
+
+class _PinnedController:
+    """Controller stub pinned to one rung: policy is exercised above in
+    isolation; these tests pin geometry to prove DISPATCH correctness."""
+
+    def __init__(self, steps):
+        self._steps = steps
+
+    def observe(self, n_steps, now=None):
+        pass
+
+    def steps(self, now=None):
+        return self._steps
+
+    def current_steps(self):
+        return self._steps
+
+    def reset(self):
+        pass
+
+
+def _run_stream(op, *, seed=11, steps=24, n_keys=96, batch=48):
+    r = np.random.default_rng(seed)
+    out = []
+    for s in range(steps):
+        keys = r.integers(0, n_keys, batch)
+        vals = (keys % 5 + 1).astype(np.float32)
+        ts = (s * 250 + r.integers(0, 250, batch)).astype(np.int64)
+        op.process_batch(keys, vals, ts)
+        op.process_watermark(s * 250 + 125)
+        out.extend(op.drain_output())
+    op.process_watermark(MAX_WATERMARK - 1)
+    out.extend(op.drain_output())
+    return sorted((int(k), int(w.start), float(v)) for k, w, v, _ in out)
+
+
+def _mk_op(latency=None, agg="sum"):
+    return FusedWindowOperator(
+        TumblingEventTimeWindows.of(1000), agg, key_capacity=256,
+        superbatch_steps=8, latency=latency)
+
+
+@pytest.mark.parametrize("readback", [0, 2])
+def test_every_rung_geometry_matches_the_full_span_run(readback):
+    """Byte parity across the whole ladder, with and without streaming
+    readback: rung choice and per-group readback move WHEN emissions
+    become host-visible, never WHAT they contain."""
+    ref = _run_stream(_mk_op())
+    for rung in build_rung_ladder(2, 8):
+        op = _mk_op(latency=LatencySpec(
+            target_ms=50, max_inflight=2, readback_steps=readback))
+        op._controller = _PinnedController(rung)
+        got = _run_stream(op)
+        assert got == ref, f"rung {rung} (readback={readback}) diverged"
+
+
+def test_inflight_ring_bounded_and_drained_at_barriers():
+    ref = _run_stream(_mk_op())
+    op = _mk_op(latency=LatencySpec(target_ms=50, max_inflight=3))
+    op._controller = _PinnedController(2)
+    r = np.random.default_rng(11)
+    out, max_depth = [], 0
+    for s in range(24):
+        keys = r.integers(0, 96, 48)
+        vals = (keys % 5 + 1).astype(np.float32)
+        ts = (s * 250 + r.integers(0, 250, 48)).astype(np.int64)
+        op.process_batch(keys, vals, ts)
+        op.process_watermark(s * 250 + 125)
+        out.extend(op.drain_output())
+        max_depth = max(max_depth, len(op._inflight))
+    # rung 2 dispatches every other step: the ring must actually fill to
+    # its configured depth (overlap is real) and never exceed it
+    assert max_depth == 3
+    snap = op.snapshot()                    # barrier: flush + capture
+    assert len(op._inflight) == 0, "snapshot captured a non-empty ring"
+    assert op.latency_gauges()["inflightDepth"] == 0
+    op.process_watermark(MAX_WATERMARK - 1)
+    out.extend(op.drain_output())
+    assert sorted((int(k), int(w.start), float(v))
+                  for k, w, v, _ in out) == ref
+    # restore resets ring AND controller: pre-failure samples describe a
+    # stream position that no longer exists
+    op2 = _mk_op(latency=LatencySpec(target_ms=50, max_inflight=3))
+    op2._controller.observe(4)
+    op2._controller.observe(4)
+    op2.restore(snap)
+    assert len(op2._inflight) == 0
+    assert op2._controller.step_rate() is None
+    assert op2._controller.current_steps() == 8     # full span re-held
+
+
+def test_adaptive_run_stays_on_ladder_shapes_and_keeps_parity():
+    """The bounded-compile guarantee: an adaptive run may only ever
+    dispatch ladder rungs (plus the pow2 flush tails the throughput path
+    already compiles) — never one geometry per decision."""
+    ref = _run_stream(_mk_op())
+    op = _mk_op(latency=LatencySpec(target_ms=1, max_inflight=2,
+                                    min_dwell_ms=0))
+    got = _run_stream(op)
+    assert got == ref
+    ladder = set(build_rung_ladder(2, 8))
+    pow2_tails = {1, 2, 4, 8}
+    assert op._ladder_geoms <= ladder | pow2_tails, \
+        f"off-ladder geometry dispatched: {op._ladder_geoms}"
+    assert op.latency_gauges()["ladderRecompiles"] <= \
+        len(ladder | pow2_tails)
+
+
+def test_flag_off_is_identical_to_throughput_mode():
+    """No LatencySpec => the operator is constructed exactly as before
+    the mode existed: no controller, depth-1 ring, no donated carries, no
+    readback split, no gauges — and every pre-flush dispatch cuts at the
+    fixed full span."""
+    op = _mk_op()
+    assert op._controller is None
+    assert op._max_inflight == 1
+    assert op.pipe.donate_carry is False
+    assert op.pipe.readback_steps == 0
+    assert op.latency_gauges() is None
+
+    calls = []
+    orig = op.pipe.process_superbatch
+
+    def spy(batches, wms, defer=False):
+        calls.append(len(wms))
+        return orig(batches, wms, defer=defer)
+
+    op.pipe.process_superbatch = spy
+    r = np.random.default_rng(11)
+    for s in range(24):
+        keys = r.integers(0, 96, 48)
+        vals = (keys % 5 + 1).astype(np.float32)
+        ts = (s * 250 + r.integers(0, 250, 48)).astype(np.int64)
+        op.process_batch(keys, vals, ts)
+        op.process_watermark(s * 250 + 125)
+        op.drain_output()
+    pre_flush = list(calls)
+    op.process_watermark(MAX_WATERMARK - 1)
+    op.drain_output()
+    assert pre_flush and all(c == 8 for c in pre_flush), \
+        f"flag-off dispatch trace changed: {pre_flush}"
+    # flush tails stay pow2-padded (the historical bounded-shapes rule)
+    assert all((c & (c - 1)) == 0 for c in calls[len(pre_flush):])
+
+
+def test_executor_threads_the_flag_and_defaults_off():
+    from flink_tpu.config import Configuration, LatencyOptions
+    from flink_tpu.runtime.executor import _latency_kwargs
+
+    # default: EMPTY kwargs — the operator call site is byte-identical
+    assert _latency_kwargs(Configuration()) == {}
+    cfg = Configuration()
+    cfg.set(LatencyOptions.TARGET_MS, 25)
+    cfg.set(LatencyOptions.MAX_INFLIGHT, 3)
+    kw = _latency_kwargs(cfg)
+    spec = kw["latency"]
+    assert isinstance(spec, LatencySpec)
+    assert spec.target_ms == 25 and spec.max_inflight == 3
+    assert spec.floor_steps == 2 and spec.readback_steps == 8
