@@ -265,7 +265,8 @@ def _result(name: str, path: str, plan: Optional[FaultPlan],
             problems: List[str], *, parity: Optional[bool] = None,
             restarts: int = 0, recovery_ms: Optional[float] = None,
             attributed: Optional[bool] = None,
-            skipped: bool = False) -> Dict[str, Any]:
+            skipped: bool = False,
+            doctor: Optional[str] = None) -> Dict[str, Any]:
     return {
         "name": name,
         "path": path,
@@ -281,7 +282,30 @@ def _result(name: str, path: str, plan: Optional[FaultPlan],
         # able to tell this from a pass, and the zero-injected-fires gate
         # must not read it as a seam losing its hook
         "skipped": bool(skipped),
+        # the job doctor's post-run verdict (ISSUE-19), for the scenarios
+        # that assert live diagnosis of the injected fault family
+        "doctor": doctor,
     }
+
+
+def _doctor_checks(problems: List[str], client, t0_ms: float,
+                   expected_family: str = "recovery-restart") -> str:
+    """Shared ISSUE-19 chaos assertions: the doctor's TOP diagnosis names
+    the injected fault family, and at least one watchdog ``health.*``
+    span landed inside the fault window [t0_ms, now]. Returns the
+    verdict for the result dict."""
+    doc = client.doctor_report()
+    fams = [d["family"] for d in doc.get("diagnoses", [])]
+    _check(problems, bool(fams) and fams[0] == expected_family,
+           f"doctor top diagnosis {fams[:3]} != {expected_family}")
+    _check(problems, doc.get("verdict") == expected_family,
+           f"doctor verdict {doc.get('verdict')!r} != {expected_family}")
+    log = getattr(client, "span_log", None)
+    health = [s for s in (log.spans if log is not None else [])
+              if s.scope == "health" and s.start_ts_ms >= t0_ms]
+    _check(problems, bool(health),
+           "no health.* watchdog span landed in the fault window")
+    return str(doc.get("verdict"))
 
 
 def _check(problems: List[str], ok: bool, what: str) -> bool:
@@ -415,11 +439,12 @@ def scenario_latency_mode_restore() -> Dict[str, Any]:
     must reset ring + controller, and the recovered job must finish at
     exact parity with a plain throughput-mode oracle — proving deep async
     dispatch never double-emits or drops a fired window across restore."""
-    from flink_tpu.config import LatencyOptions
+    from flink_tpu.config import LatencyOptions, ObservabilityOptions
 
     problems: List[str] = []
     _oracle_client, expected = _run_mini_count_job("latency-oracle")
     chk = tempfile.mkdtemp(prefix="flink-tpu-latmode-")
+    t0_ms = time.time() * 1000.0
     try:
         with fault_injection(rules=[
             {"scope": "device", "fault": "error", "nth": 6},
@@ -431,6 +456,12 @@ def scenario_latency_mode_restore() -> Dict[str, Any]:
                     # span and actually exercises small rungs + the ring
                     LatencyOptions.TARGET_MS: 1,
                     LatencyOptions.MAX_INFLIGHT: 2,
+                    # history/doctor plane (ISSUE-19): tick fast enough
+                    # that the short chaos job fills its rings, and opt
+                    # the watchdog's p99 breach in at a floor every fired
+                    # window crosses — the deterministic health.* span
+                    ObservabilityOptions.HISTORY_INTERVAL_MS: 25,
+                    ObservabilityOptions.DOCTOR_P99_BREACH_MS: 0.001,
                 })
     finally:
         shutil.rmtree(chk, ignore_errors=True)
@@ -451,9 +482,13 @@ def scenario_latency_mode_restore() -> Dict[str, Any]:
     recovery_ms = recs[0]["downtime_ms"] if recs else None
     _check(problems, bool(recs) and recs[0]["restored_checkpoint_id"] is not None,
            "recovery timeline missing the rewound checkpoint")
+    # ISSUE-19: the doctor must attribute the run to the injected fault
+    # family (the restart dominates) and the watchdog must have fired
+    verdict = _doctor_checks(problems, client, t0_ms)
     return _result("latency-mode-restore", "mini", plan, problems,
                    parity=parity, restarts=client.num_restarts,
-                   recovery_ms=recovery_ms, attributed=attributed)
+                   recovery_ms=recovery_ms, attributed=attributed,
+                   doctor=verdict)
 
 
 def _run_mini_join_job(name: str, *, records: int = 1200, batch: int = 100,
@@ -806,6 +841,7 @@ def scenario_chip_loss_during_rebalance() -> Dict[str, Any]:
             CheckpointingOptions,
             Configuration,
             ExecutionOptions,
+            ObservabilityOptions,
             RestartOptions,
         )
         from flink_tpu.connectors.sink import CollectSink
@@ -814,6 +850,10 @@ def scenario_chip_loss_during_rebalance() -> Dict[str, Any]:
 
         config = Configuration()
         config.set(ExecutionOptions.BATCH_SIZE, 512)
+        # history/doctor plane (ISSUE-19): fast rings + the watchdog's
+        # opt-in p99 floor so a health.* span deterministically lands
+        config.set(ObservabilityOptions.HISTORY_INTERVAL_MS, 25)
+        config.set(ObservabilityOptions.DOCTOR_P99_BREACH_MS, 0.001)
         # distinctive ring capacity (the bench-gate pattern): these
         # executables must be this scenario's own
         config.set(ExecutionOptions.KEY_CAPACITY, NUM_KEYS)
@@ -853,6 +893,7 @@ def scenario_chip_loss_during_rebalance() -> Dict[str, Any]:
 
     _oracle_client, expected = run("rebalance-oracle", mesh=False)
     chk = tempfile.mkdtemp(prefix="flink-tpu-rebal-")
+    t0_ms = time.time() * 1000.0
     try:
         with fault_injection(rules=[
             # the 14th device dispatch lands after the first rebalance
@@ -896,11 +937,15 @@ def scenario_chip_loss_during_rebalance() -> Dict[str, Any]:
         version = client._runtime.mesh_routing_version()
         _check(problems, version is not None,
                "rebuilt attempt lost its routing table")
+        # ISSUE-19: the doctor must name the injected fault family and
+        # the watchdog must have emitted a health.* span in the window
+        verdict = _doctor_checks(problems, client, t0_ms)
     finally:
         shutil.rmtree(chk, ignore_errors=True)
     return _result("chip-loss-during-rebalance", "mini", plan, problems,
                    parity=parity, restarts=client.num_restarts,
-                   recovery_ms=recovery_ms, attributed=attributed)
+                   recovery_ms=recovery_ms, attributed=attributed,
+                   doctor=verdict)
 
 
 def scenario_rpc_flap() -> Dict[str, Any]:

@@ -944,6 +944,61 @@ class ObservabilityOptions:
         "post-restore fire is capture-eligible and its stall interval "
         "pins the recovery span."
     )
+    HISTORY_INTERVAL_MS = (
+        ConfigOptions.key("observability.history.interval-ms")
+        .duration_ms_type().default_value(1000)
+    ).with_description(
+        "Sampling interval of the metric history plane: every registered "
+        "job/operator metric is sampled into a bounded time-series ring "
+        "on the existing processing-time tick (MiniCluster step boundary "
+        "/ JobManager schedule tick) — counters recorded as windowed "
+        "rates, gauges as values, histograms as per-sample p50/p99 "
+        "sub-series. Served at /jobs/:id/history on both execution paths."
+    )
+    HISTORY_RETENTION_POINTS = (
+        ConfigOptions.key("observability.history.retention-points")
+        .int_type().default_value(256)
+    ).with_description(
+        "Points retained per metric series (a bounded ring — the oldest "
+        "point falls off when the ring is full). Together with the "
+        "sampling interval this bounds the lookback window: 256 points "
+        "at 1000 ms is ~4.3 minutes of trajectory per metric."
+    )
+    DOCTOR_ENABLED = (
+        ConfigOptions.key("observability.doctor.enabled")
+        .bool_type().default_value(True)
+    ).with_description(
+        "Run the job doctor and its health watchdog: /jobs/:id/doctor "
+        "serves a ranked, evidence-attributed bottleneck diagnosis joined "
+        "over the history rings and the span stream, and the watchdog "
+        "turns threshold breaches (throughput collapse vs the job's own "
+        "recent baseline, watermark stall, backpressure saturation, "
+        "emission-p99 breach) into rate-limited health.* spans."
+    )
+    DOCTOR_WINDOW_MS = (
+        ConfigOptions.key("observability.doctor.window-ms")
+        .duration_ms_type().default_value(60000)
+    ).with_description(
+        "Lookback window of one doctor diagnosis: history points and "
+        "spans older than this are ignored when scoring bottleneck "
+        "families."
+    )
+    DOCTOR_WATCHDOG_MIN_GAP_MS = (
+        ConfigOptions.key("observability.doctor.watchdog-min-gap-ms")
+        .duration_ms_type().default_value(5000)
+    ).with_description(
+        "Rate limit per health.* span family: a sustained breach emits at "
+        "most one span per gap, so a wedged job cannot flood the bounded "
+        "span ring with identical watchdog spans."
+    )
+    DOCTOR_P99_BREACH_MS = (
+        ConfigOptions.key("observability.doctor.p99-breach-ms")
+        .float_type().default_value(0.0)
+    ).with_description(
+        "Emission-latency p99 threshold for the health.P99Breach watchdog "
+        "span (0 disables the check — there is no universal latency SLO; "
+        "jobs with one declare it here)."
+    )
 
 
 class WatchdogOptions:

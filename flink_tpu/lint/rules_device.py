@@ -32,6 +32,11 @@ CONTROL_PLANE = (
     "runtime/dataplane.py",
     "security/framing.py",
     "security/transport.py",
+    # the history/doctor plane consumes plain-data snapshots and span
+    # dicts handed to it — a jax import here would drag backend init
+    # into every REST reader and JM schedule tick
+    "metrics/history.py",
+    "metrics/doctor.py",
 )
 
 

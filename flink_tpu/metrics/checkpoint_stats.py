@@ -323,11 +323,21 @@ class CheckpointStatsTracker:
     def register_metrics(self, group) -> None:
         """Register the standard gauges on a metric group (names per the
         reference's CheckpointStatsTracker.registerMetrics)."""
-        for name in ("numberOfCompletedCheckpoints", "numberOfFailedCheckpoints",
-                     "consecutiveFailedCheckpoints",
-                     "numberOfInProgressCheckpoints", "lastCheckpointDuration",
-                     "lastCheckpointSize", "lastCheckpointRestoreTimestamp"):
-            group.gauge(name, lambda n=name: self.gauge_values()[n])
+        # fold/kind declarations (ISSUE-19): completed/failed totals are
+        # monotone (kind="counter" -> the history plane records checkpoint
+        # RATES); the last* family are point-in-time facts that fold MAX
+        # (the newest checkpoint wins — summing a duration across shards
+        # reporting the same checkpoint would multiply it)
+        for name, fold, kind in (
+                ("numberOfCompletedCheckpoints", "sum", "counter"),
+                ("numberOfFailedCheckpoints", "sum", "counter"),
+                ("consecutiveFailedCheckpoints", "max", None),
+                ("numberOfInProgressCheckpoints", "sum", None),
+                ("lastCheckpointDuration", "max", None),
+                ("lastCheckpointSize", "max", None),
+                ("lastCheckpointRestoreTimestamp", "max", None)):
+            group.gauge(name, lambda n=name: self.gauge_values()[n],
+                        fold=fold, kind=kind)
 
     def payload(self) -> Dict[str, Any]:
         """REST /jobs/:id/checkpoints body (CheckpointingStatistics shape:
@@ -500,9 +510,12 @@ class ExceptionHistory:
             }
 
     def register_metrics(self, group) -> None:
-        for name in ("numRestarts", "lastRestartDowntimeMs",
-                     "lastCheckpointRestoreDurationMs"):
-            group.gauge(name, lambda n=name: self.gauge_values()[n])
+        for name, fold, kind in (
+                ("numRestarts", "sum", "counter"),
+                ("lastRestartDowntimeMs", "max", None),
+                ("lastCheckpointRestoreDurationMs", "max", None)):
+            group.gauge(name, lambda n=name: self.gauge_values()[n],
+                        fold=fold, kind=kind)
 
     def payload(self) -> Dict[str, Any]:
         """REST /jobs/:id/exceptions body: root exception + bounded entry

@@ -299,11 +299,14 @@ class CompileTracker:
 
     def register(self, group) -> None:
         """Register the compile-observability gauges on a metric group."""
-        group.gauge("numCompiles", lambda: self.num_compiles)
-        group.gauge("numRecompiles", lambda: self.num_recompiles)
+        group.gauge("numCompiles", lambda: self.num_compiles,
+                    fold="sum", kind="counter")
+        group.gauge("numRecompiles", lambda: self.num_recompiles,
+                    fold="sum", kind="counter")
         group.gauge("compileTimeMsTotal",
-                    lambda: round(self.compile_ms_total, 3))
-        group.gauge("recompileStorm", self.recompile_storm)
+                    lambda: round(self.compile_ms_total, 3),
+                    fold="sum", kind="counter")
+        group.gauge("recompileStorm", self.recompile_storm, fold="max")
 
     # -- exposure ----------------------------------------------------------
     def events(self) -> List[Dict[str, Any]]:

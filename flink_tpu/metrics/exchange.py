@@ -27,8 +27,12 @@ def register_channel_metrics(
     the wire, incl. frame overhead), `outbound` an OutputChannel (bytes
     written, incl. control frames on the channel's socket)."""
     if inbound is not None:
-        group.gauge(f"numBytesIn.{name}", lambda ch=inbound: ch.bytes_in)
-        group.gauge(f"numBytesInPerSecond.{name}", inbound.in_rate)
+        group.gauge(f"numBytesIn.{name}", lambda ch=inbound: ch.bytes_in,
+                    fold="sum", kind="counter")
+        group.gauge(f"numBytesInPerSecond.{name}", inbound.in_rate,
+                    fold="sum")
     if outbound is not None:
-        group.gauge(f"numBytesOut.{name}", lambda ch=outbound: ch.bytes_out)
-        group.gauge(f"numBytesOutPerSecond.{name}", outbound.out_rate)
+        group.gauge(f"numBytesOut.{name}", lambda ch=outbound: ch.bytes_out,
+                    fold="sum", kind="counter")
+        group.gauge(f"numBytesOutPerSecond.{name}", outbound.out_rate,
+                    fold="sum")

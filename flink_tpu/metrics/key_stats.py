@@ -277,26 +277,33 @@ class KeyStatsCollector:
                     if e.get(field) is not None}
 
     def register(self, group) -> None:
-        group.gauge("keySkew", self.skew)
-        group.gauge("activeKeys", self.active_keys)
-        group.gauge("hotKeyLoad", self.hot_key_load)
+        # skew/hot-key gauges fold MAX: the job's skew is its worst shard
+        group.gauge("keySkew", self.skew, fold="max")
+        group.gauge("activeKeys", self.active_keys, fold="sum")
+        group.gauge("hotKeyLoad", self.hot_key_load, fold="max")
         # histogram-stats-shaped dict gauges: ship on metrics_snapshot and
         # render as Prometheus summaries, like shipped histograms do
-        group.gauge("keyGroupLoad", lambda: dict(self._group_load))
+        # (fold "hist": the generic approx stats envelope)
+        group.gauge("keyGroupLoad", lambda: dict(self._group_load),
+                    fold="hist")
         group.gauge("keyGroupStateBytes",
-                    lambda: dict(self._group_state_bytes))
+                    lambda: dict(self._group_state_bytes),
+                    fold="hist")
         if self._mesh_loads_fn is not None:
-            # per-mesh-device maps ({device: value}): shipped so the JM's
-            # aggregate_shard_metrics can fold MAX across the shard's own
-            # devices (an imbalanced mesh must be visible as its WORST
-            # device, never device 0's view)
-            group.gauge("meshLoadSkew", self.mesh_load_skew)
+            # per-mesh-device maps ({device: value}): declared
+            # "per-device-max" so the JM's aggregate_shard_metrics folds
+            # MAX across the shard's own devices FIRST (an imbalanced mesh
+            # must be visible as its WORST device, never device 0's view)
+            group.gauge("meshLoadSkew", self.mesh_load_skew, fold="max")
             group.gauge("meshDeviceLoad",
-                        lambda: self._per_device_map("records"))
+                        lambda: self._per_device_map("records"),
+                        fold="per-device-max")
             group.gauge("keySkewPerDevice",
-                        lambda: self._per_device_map("keySkew"))
+                        lambda: self._per_device_map("keySkew"),
+                        fold="per-device-max")
             group.gauge("hotKeyLoadPerDevice",
-                        lambda: self._per_device_map("hotKeyLoad"))
+                        lambda: self._per_device_map("hotKeyLoad"),
+                        fold="per-device-max")
 
     # -- exposure ----------------------------------------------------------
     def payload(self) -> Dict[str, Any]:

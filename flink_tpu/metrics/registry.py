@@ -18,7 +18,32 @@ from collections import deque
 from typing import Any, Callable, Dict, List, Optional
 
 
+# Shard-fold vocabulary (consumed by runtime.cluster.aggregate_shard_metrics
+# and declared at registration, never inferred from the metric name):
+#   "sum"            add across shards (totals, counts, rates-of-totals)
+#   "min"            min across shards (progress frontiers: currentWatermark)
+#   "max"            max across shards (high-water marks, versions, worst-case)
+#   "mean"           arithmetic mean (ratios, utilization percentages)
+#   "emission"       emission-latency snapshot dict -> exact bucket-wise merge
+#   "per-device-max" {device: value} dict -> max over devices, max over shards
+#   "hist"           histogram stats dict -> approximate envelope fold
+#                    (count sums, min mins, max/mean/quantiles max — marked
+#                    "approx": true in the folded payload)
+FOLD_KINDS = ("sum", "min", "max", "mean", "emission", "per-device-max",
+              "hist")
+
+# Sampling-kind vocabulary (consumed by metrics.history.MetricHistory):
+#   "counter"    monotone total — history records the windowed RATE per sec
+#   "gauge"      point-in-time value — recorded as-is
+#   "meter"      already a rate — recorded as-is
+#   "histogram"  stats dict — history derives p50/p99 sub-series
+METRIC_KINDS = ("counter", "gauge", "meter", "histogram")
+
+
 class Counter:
+    fold = "sum"
+    kind = "counter"
+
     def __init__(self):
         self._value = 0
 
@@ -34,8 +59,25 @@ class Counter:
 
 
 class Gauge:
-    def __init__(self, fn: Callable[[], Any]):
+    """`fold` declares how shards combine (see FOLD_KINDS); `kind` declares
+    how the history plane samples it — a gauge wrapping a monotone total
+    (evictions, numRecordsIn) registers kind="counter" so history records
+    its windowed rate instead of an ever-growing line. None means
+    undeclared: the shard fold falls back to the DEPRECATED name heuristic
+    in runtime.cluster (which warns), and the registry audit test fails
+    unless the family is allowlisted."""
+
+    def __init__(self, fn: Callable[[], Any], fold: Optional[str] = None,
+                 kind: Optional[str] = None):
+        if fold is not None and fold not in FOLD_KINDS:
+            raise ValueError(f"unknown fold kind {fold!r} (one of "
+                             f"{FOLD_KINDS})")
+        if kind is not None and kind not in METRIC_KINDS:
+            raise ValueError(f"unknown metric kind {kind!r} (one of "
+                             f"{METRIC_KINDS})")
         self._fn = fn
+        self.fold = fold
+        self.kind = kind or "gauge"
 
     def value(self):
         return self._fn()
@@ -49,6 +91,9 @@ class Meter:
     for the same reason) — a dataplane channel marking per frame must not
     grow a tuple per frame. Lock-protected: senders mark() from their own
     threads while the heartbeat/snapshot thread reads rate()."""
+
+    fold = "sum"
+    kind = "meter"
 
     BUCKET_S = 0.1
 
@@ -93,6 +138,9 @@ class Meter:
 class Histogram:
     """Reservoir histogram with quantiles (DescriptiveStatisticsHistogram
     analogue; bounded ring reservoir)."""
+
+    fold = "hist"
+    kind = "histogram"
 
     def __init__(self, size: int = 1024):
         self._values = deque(maxlen=size)
@@ -144,8 +192,11 @@ class MetricGroup:
     def counter(self, name: str) -> Counter:
         return self._registry._register(self.scope, name, Counter())
 
-    def gauge(self, name: str, fn: Callable[[], Any]) -> Gauge:
-        return self._registry._register(self.scope, name, Gauge(fn))
+    def gauge(self, name: str, fn: Callable[[], Any],
+              fold: Optional[str] = None,
+              kind: Optional[str] = None) -> Gauge:
+        return self._registry._register(self.scope, name,
+                                        Gauge(fn, fold=fold, kind=kind))
 
     def meter(self, name: str) -> Meter:
         return self._registry._register(self.scope, name, Meter())
@@ -321,6 +372,8 @@ def prometheus_text_from_snapshot(snapshot: Dict[str, Any],
     lbl = _render_labels(labels)
     lines = []
     for key, val in sorted(snapshot.items()):
+        if key.startswith("__"):      # reserved metadata (__folds__ etc.)
+            continue
         name = _prom_name(key)
         if isinstance(val, dict):
             lines.extend(_render_summary(name, val, lbl))
@@ -380,8 +433,18 @@ def merge_prometheus_text(texts: "List[str]") -> str:
 def metrics_snapshot(metrics: Dict[str, Any]) -> Dict[str, Any]:
     """Plain-data view of a metric table — int/float scalars and histogram
     stat dicts only — safe to JSON-encode or ship over the restricted RPC
-    wire (TM -> JM metric shipping)."""
+    wire (TM -> JM metric shipping).
+
+    Two reserved metadata keys ride along under dunder names (so every
+    existing consumer's numeric/suffix filters skip them naturally):
+    ``__folds__`` maps metric key -> declared shard-fold kind (only keys
+    that DECLARED one — aggregate_shard_metrics reads these instead of the
+    deprecated name heuristic) and ``__kinds__`` maps metric key ->
+    sampling kind (counter/gauge/meter/histogram — the history plane reads
+    these to record counters as windowed rates)."""
     out: Dict[str, Any] = {}
+    folds: Dict[str, str] = {}
+    kinds: Dict[str, str] = {}
     for key, metric in metrics.items():
         try:
             val = metric.value()
@@ -399,6 +462,18 @@ def metrics_snapshot(metrics: Dict[str, Any]) -> Dict[str, Any]:
             }
         elif isinstance(val, (int, float)):
             out[key] = val
+        else:
+            continue
+        fold = getattr(metric, "fold", None)
+        if fold is not None:
+            folds[key] = fold
+        kind = getattr(metric, "kind", None)
+        if kind is not None:
+            kinds[key] = kind
+    if folds:
+        out["__folds__"] = folds
+    if kinds:
+        out["__kinds__"] = kinds
     return out
 
 

@@ -107,13 +107,17 @@ class TaskIOMetrics:
     def register(self, group) -> None:
         """Register the TaskIOMetricGroup-analogue gauges on `group`."""
         r = self.ratios
-        group.gauge("busyTimeRatio", lambda: r()["busyRatio"])
-        group.gauge("idleTimeRatio", lambda: r()["idleRatio"])
-        group.gauge("backPressuredTimeRatio", lambda: r()["backPressuredRatio"])
-        group.gauge("busyTimeMsPerSecond", lambda: self.ms_per_second("busy"))
-        group.gauge("idleTimeMsPerSecond", lambda: self.ms_per_second("idle"))
+        # per-task fractions (each bounded per task) fold MEAN
+        group.gauge("busyTimeRatio", lambda: r()["busyRatio"], fold="mean")
+        group.gauge("idleTimeRatio", lambda: r()["idleRatio"], fold="mean")
+        group.gauge("backPressuredTimeRatio",
+                    lambda: r()["backPressuredRatio"], fold="mean")
+        group.gauge("busyTimeMsPerSecond",
+                    lambda: self.ms_per_second("busy"), fold="mean")
+        group.gauge("idleTimeMsPerSecond",
+                    lambda: self.ms_per_second("idle"), fold="mean")
         group.gauge("backPressuredTimeMsPerSecond",
-                    lambda: self.ms_per_second("backPressured"))
+                    lambda: self.ms_per_second("backPressured"), fold="mean")
 
 
 class DeviceTimer:
@@ -148,5 +152,7 @@ class DeviceTimer:
         return DeviceTimer._Section(self)
 
     def register(self, group) -> None:
-        group.gauge("deviceTimeMsTotal", lambda: self.total_s * 1000.0)
-        group.gauge("deviceDispatches", lambda: self.dispatches)
+        group.gauge("deviceTimeMsTotal", lambda: self.total_s * 1000.0,
+                    fold="sum", kind="counter")
+        group.gauge("deviceDispatches", lambda: self.dispatches,
+                    fold="sum", kind="counter")

@@ -231,6 +231,12 @@ class _JobState:
     trace_id: str = ""
     metric_snapshots: Dict[int, dict] = field(default_factory=dict)
     spans: List[dict] = field(default_factory=list)
+    # history plane (ISSUE-19): bounded metric time-series rings sampled
+    # from the shard-folded snapshots on the schedule tick, plus the
+    # threshold watchdog emitting health.* spans into `spans` (both
+    # metrics-layer objects — Any avoids a dataclass-level import)
+    history: Any = None
+    watchdog: Any = None
     # fault-tolerance observability: per-checkpoint stat records + lifetime
     # counters, and the bounded exception/restart history that replaced the
     # single overwritten failure string (sizes set by the JM at submit)
@@ -261,18 +267,28 @@ _MAX_JOB_SPANS = 1024
 
 
 def _shard_combine(key: str) -> str:
-    """How a metric key folds across shards: per-task fractions (ratios,
-    pool occupancy, busy/idle/backPressured TimeMsPerSecond — each bounded
-    per task) average; watermark positions take the MIN (the job-level
-    combined watermark is what EVERY subtask has reached — averaging would
-    report progress a straggler shard has not made); everything else
+    """DEPRECATED name-heuristic fold fallback (ISSUE-19).
+
+    Fold kinds are now DECLARED at registration (`MetricGroup.gauge(...,
+    fold=...)` in metrics/registry.py) and shipped in each snapshot's
+    reserved ``__folds__`` entry — `aggregate_shard_metrics` reads the
+    declaration and only reaches here for keys without one (old TMs,
+    unmigrated third-party gauges), emitting a once-per-key
+    DeprecationWarning. This function is the ONLY place the `current*`
+    prefix rule and the exemption tuples may be consulted for folding;
+    new metric families must declare instead of growing this heuristic
+    (the `_TIER_GAUGES`-omission bug class from PRs 10/11/14/17).
+
+    The heuristic itself: per-task fractions (ratios, pool occupancy,
+    busy/idle/backPressured TimeMsPerSecond — each bounded per task)
+    average; watermark positions take the MIN (the job-level combined
+    watermark is what EVERY subtask has reached — averaging would report
+    progress a straggler shard has not made); skew/storm/hot-key gauges
+    take the MAX (the job's skew is its worst shard); everything else
     (counters, totals, and THROUGHPUT rates like numRecordsInPerSecond,
-    which is work done) sums. Matches on the full key, not just the leaf:
-    per-channel gauges like exchange.inPoolUsage.<n> have a numeric leaf.
-    Device-plane additions: skew/storm/hot-key gauges take the MAX (the
-    job's skew is its worst shard — summing a per-shard ratio would be
-    meaningless and averaging would hide a single hot shard), roofline
-    utilization percentages average (each shard's own chip's fraction)."""
+    which is work done) sums. Matches on the full key, not just the
+    leaf: per-channel gauges like exchange.inPoolUsage.<n> have a
+    numeric leaf."""
     leaf = key.rsplit(".", 1)[-1]
     if leaf.startswith("current") and leaf not in _LATENCY_MAX_GAUGES:
         # the current* prefix means "watermark position" (fold MIN: the
@@ -366,42 +382,107 @@ _LATENCY_MAX_GAUGES = ("watermarkLagMs",
 _LATENCY_HISTOGRAMS = ("emissionLatencyMs",)
 _LATENCY_GAUGES = _LATENCY_MAX_GAUGES + _LATENCY_HISTOGRAMS
 
+#: the ONE leaf-name set both /jobs/:id/device payload filters consult
+#: (ISSUE-19 consolidation of the scattered per-filter tuple unions — the
+#: _TIER_GAUGES-omission lesson: two hand-maintained filters drift, one
+#: derived set cannot)
+_DEVICE_PAYLOAD_LEAVES = frozenset(
+    ("keySkew", "activeKeys", "hotKeyLoad", "keyGroupLoad",
+     "keyGroupStateBytes", "hbmUtilizationPct", "flopsUtilizationPct",
+     "meshLoadSkew", "meshDevices")
+    + _TIER_GAUGES + _PER_DEVICE_MAX_GAUGES + _REBALANCE_GAUGES
+    + _JOIN_GAUGES + _LATENCY_GAUGES)
+
+
+def _is_device_payload_key(key: str) -> bool:
+    """Does `key` belong in a /jobs/:id/device payload (job-level fold
+    and per-shard alike)? Reserved ``__`` metadata never does."""
+    if key.startswith("__"):
+        return False
+    return (".device." in key or "keySkew" in key or "meshLoadSkew" in key
+            or key.rsplit(".", 1)[-1] in _DEVICE_PAYLOAD_LEAVES)
+
+
+#: keys that already fell back to the name heuristic (warn once per key,
+#: not once per heartbeat fold)
+_WARNED_UNDECLARED: set = set()
+
+
+def _fold_for(key: str, declared: Dict[str, str]) -> str:
+    """Declared fold kind, else the DEPRECATED name heuristic (warns once
+    per key)."""
+    how = declared.get(key)
+    if how is not None:
+        return how
+    if key not in _WARNED_UNDECLARED:
+        _WARNED_UNDECLARED.add(key)
+        import warnings
+
+        warnings.warn(
+            f"metric {key!r} declares no fold kind; falling back to the "
+            "deprecated name heuristic — register it with "
+            "gauge(..., fold=...) (metrics/registry.py)",
+            DeprecationWarning, stacklevel=3)
+    return _shard_combine(key)
+
 
 def aggregate_shard_metrics(per_shard: Dict[int, dict]) -> dict:
-    """Fold per-shard metric snapshots into one job-level view per
-    _shard_combine (sum / mean / min); histogram stat dicts merge by
-    max-of-p99 / min-of-min / summed count (cheap percentile union —
-    exact merging would need the reservoirs, which stay TM-local)."""
+    """Fold per-shard metric snapshots into one job-level view.
+
+    The fold kind per key comes from the snapshots' reserved ``__folds__``
+    declarations (registered with the metric — metrics/registry.py);
+    undeclared keys fall back to the deprecated `_shard_combine` name
+    heuristic with a warning. Dict-valued metrics fold by declaration
+    too: ``"emission"`` merges log buckets exactly, ``"per-device-max"``
+    maxes over the shard's device map first, and everything else takes
+    the approximate envelope — max-of-p99 / min-of-min / summed count
+    (cheap percentile union; exact merging would need the reservoirs,
+    which stay TM-local) — marked ``"approx": true`` in the folded
+    payload so readers never mistake it for the exact bucket-wise merge
+    emission histograms get."""
     from flink_tpu.metrics.emission_latency import (
         merge_snapshots as _merge_emission,
     )
+
+    declared: Dict[str, str] = {}
+    for snap in per_shard.values():
+        folds = snap.get("__folds__")
+        if isinstance(folds, dict):
+            declared.update(folds)
 
     scalars: Dict[str, List[float]] = {}
     emission: Dict[str, list] = {}
     agg: dict = {}
     for snap in per_shard.values():
         for key, val in snap.items():
-            if (isinstance(val, dict)
-                    and key.rsplit(".", 1)[-1] in _LATENCY_HISTOGRAMS):
-                # emission-latency histograms carry their log buckets, so
-                # the fold is EXACT: merge bucket counts, recompute the
-                # percentiles — never the generic max-envelope below
-                emission.setdefault(key, []).append(val)
+            if key.startswith("__"):    # reserved metadata, not a metric
                 continue
-            if (isinstance(val, dict)
-                    and key.rsplit(".", 1)[-1] in _PER_DEVICE_MAX_GAUGES):
-                # per-mesh-device map: fold across THIS shard's devices
-                # first (MAX — the job's view of a skew/storm/hot-key
-                # family is its worst device, and device indexes repeat
-                # across shards so elementwise merging would be
-                # meaningless), then the scalar MAX rule across shards
-                devs = [v for v in val.values()
-                        if isinstance(v, (int, float))]
-                if devs:
-                    scalars.setdefault(key, []).append(float(max(devs)))
-                continue
+            leaf = key.rsplit(".", 1)[-1]
             if isinstance(val, dict):
+                how = declared.get(key)
+                if how == "emission" or (how is None
+                                         and leaf in _LATENCY_HISTOGRAMS):
+                    # emission-latency histograms carry their log buckets,
+                    # so the fold is EXACT: merge bucket counts, recompute
+                    # the percentiles — never the generic envelope below
+                    emission.setdefault(key, []).append(val)
+                    continue
+                if how == "per-device-max" or (
+                        how is None and leaf in _PER_DEVICE_MAX_GAUGES):
+                    # per-mesh-device map: fold across THIS shard's
+                    # devices first (MAX — the job's view of a skew/storm/
+                    # hot-key family is its worst device, and device
+                    # indexes repeat across shards so elementwise merging
+                    # would be meaningless), then MAX across shards
+                    devs = [v for v in val.values()
+                            if isinstance(v, (int, float))]
+                    if devs:
+                        scalars.setdefault(key, []).append(float(max(devs)))
+                    continue
                 cur = agg.setdefault(key, {})
+                # honest labeling: the envelope is approximate (exact
+                # quantile merging needs the TM-local reservoirs)
+                cur["approx"] = True
                 for stat, v in val.items():
                     if not isinstance(v, (int, float)):
                         continue
@@ -415,7 +496,7 @@ def aggregate_shard_metrics(per_shard: Dict[int, dict]) -> dict:
                 scalars.setdefault(key, []).append(val)
     wm_skews = []
     for key, vals in scalars.items():
-        how = _shard_combine(key)
+        how = _fold_for(key, declared)
         if how == "max":
             agg[key] = max(vals)
         elif how == "min":
@@ -462,10 +543,25 @@ class JobManagerEndpoint(RpcEndpoint):
         autoscaler_config=None,
         tolerable_failed_checkpoints: int = 0,
         stuck_task_timeout_ms: int = 0,
+        history_interval_ms: int = 1000,
+        history_retention_points: int = 256,
+        doctor_enabled: bool = True,
+        doctor_window_ms: float = 60000.0,
+        watchdog_min_gap_ms: float = 5000.0,
+        p99_breach_ms: float = 0.0,
     ):
         super().__init__(name="jobmanager")
         self.rpc = rpc
         self.auto_records_per_task = auto_records_per_task
+        # observability.history.* / observability.doctor.* (ISSUE-19): the
+        # JM samples each job's shard-folded snapshot into bounded rings on
+        # the schedule tick and runs the threshold watchdog over them
+        self.history_interval_ms = history_interval_ms
+        self.history_retention_points = history_retention_points
+        self.doctor_enabled = doctor_enabled
+        self.doctor_window_ms = doctor_window_ms
+        self.watchdog_min_gap_ms = watchdog_min_gap_ms
+        self.p99_breach_ms = p99_breach_ms
         # execution.checkpointing.tolerable-failed-checkpoints: consecutive
         # checkpoint failures absorbed (FAILED stats record + gauge) before
         # the job takes the restart path
@@ -552,6 +648,30 @@ class JobManagerEndpoint(RpcEndpoint):
     def _schedule_tick(self) -> None:
         self._try_schedule_all()
         self._watchdog_tick()
+        self._history_tick()
+
+    def _history_tick(self) -> None:
+        """Sample each RUNNING job's shard-folded snapshot into its
+        history rings (JM main thread, riding the existing schedule tick
+        — the processing-time tick of the distributed path) and let the
+        health watchdog inspect the fresh window. The cheap due() gate
+        runs first so an idle tick costs two comparisons."""
+        for job in list(self._jobs.values()):
+            if (job.status != "RUNNING" or job.history is None
+                    or not job.metric_snapshots or not job.history.due()):
+                continue
+            try:
+                agg, per_shard, _ = self._aggregated_job_metrics(job)
+                kinds: Dict[str, str] = {}
+                for snap in per_shard.values():
+                    k = snap.get("__kinds__")
+                    if isinstance(k, dict):
+                        kinds.update(k)
+                job.history.sample(agg, kinds=kinds)
+                if job.watchdog is not None:
+                    job.watchdog.observe(job.history)
+            except Exception as e:
+                _swallow("history_tick", e)
 
     def _watchdog_tick(self) -> None:
         """Stuck-task watchdog (JM main thread): a task whose heartbeat-
@@ -724,6 +844,24 @@ class JobManagerEndpoint(RpcEndpoint):
                 history_size=self.checkpoint_history_size),
             exceptions=ExceptionHistory(size=self.exception_history_size),
         )
+        # history plane + watchdog (ISSUE-19): rings live on the JM job
+        # state (the folded view is assembled here); watchdog breaches
+        # land in job.spans through the same _job_span path as every
+        # other JM control-plane span
+        from flink_tpu.metrics.doctor import HealthWatchdog
+        from flink_tpu.metrics.history import MetricHistory
+
+        job.history = MetricHistory(
+            interval_ms=self.history_interval_ms,
+            retention_points=self.history_retention_points)
+        if self.doctor_enabled:
+            def _health_sink(scope, name, start_ms, end_ms, attrs,
+                             _job=job):
+                self._job_span(_job, scope, name, start_ms, **attrs)
+
+            job.watchdog = HealthWatchdog(
+                _health_sink, min_gap_ms=self.watchdog_min_gap_ms,
+                p99_breach_ms=self.p99_breach_ms)
         if savepoint_path is not None:
             # start FROM a savepoint (execution.savepoint.path analogue):
             # seed the restore chain with the written snapshot set — the
@@ -862,6 +1000,36 @@ class JobManagerEndpoint(RpcEndpoint):
         job = self._jobs[job_id]
         agg, _per_shard, _jm = self._aggregated_job_metrics(job)
         return build_latency_report(agg, list(job.spans))
+
+    def job_history(self, job_id: str, metric: Optional[str] = None,
+                    since: Optional[float] = None) -> dict:
+        """Metric time-series rings (/jobs/:id/history?metric=&since=
+        shape, identical to the MiniCluster's): per-key bounded point
+        lists sampled from the shard-folded snapshots — counters as
+        windowed rates, gauges as values, histograms as per-sample
+        p50/p99 sub-series."""
+        job = self._jobs[job_id]
+        if job.history is None:
+            return {"enabled": False, "series": {}, "sample_count": 0}
+        payload = job.history.payload(
+            metric=metric or None,
+            since_ms=float(since) if since not in (None, "") else None)
+        payload["enabled"] = True
+        return payload
+
+    def job_doctor(self, job_id: str) -> dict:
+        """Ranked bottleneck diagnosis (/jobs/:id/doctor shape, identical
+        to the MiniCluster's): the job doctor joined over the history
+        rings and the span feed."""
+        from flink_tpu.metrics.doctor import diagnose
+
+        job = self._jobs[job_id]
+        if job.history is None:
+            return {"verdict": "unknown", "score": 0.0, "diagnoses": [],
+                    "window_ms": self.doctor_window_ms, "samples": 0,
+                    "watchdog_events": 0}
+        return diagnose(job.history, list(job.spans),
+                        window_ms=self.doctor_window_ms)
 
     def job_backpressure(self, job_id: str) -> dict:
         """Per-shard busy/idle/backPressured ratios from the latest shipped
@@ -1042,27 +1210,11 @@ class JobManagerEndpoint(RpcEndpoint):
             recompileStorm=_num("job.device.recompileStorm", int),
             events=events[-64:],
         )
-        device_keys = {
-            k: v for k, v in agg.items()
-            if ".device." in k or k.rsplit(".", 1)[-1] in (
-                "keySkew", "activeKeys", "hotKeyLoad", "keyGroupLoad",
-                "keyGroupStateBytes", "hbmUtilizationPct",
-                "flopsUtilizationPct", "meshLoadSkew", "meshDevices")
-            or k.rsplit(".", 1)[-1] in _TIER_GAUGES
-            or k.rsplit(".", 1)[-1] in _PER_DEVICE_MAX_GAUGES
-            or k.rsplit(".", 1)[-1] in _REBALANCE_GAUGES
-            or k.rsplit(".", 1)[-1] in _JOIN_GAUGES
-            or k.rsplit(".", 1)[-1] in _LATENCY_GAUGES
-        }
+        device_keys = {k: v for k, v in agg.items()
+                       if _is_device_payload_key(k)}
         payload["metrics"] = device_keys
         payload["per_shard"] = {
-            s: {k: v for k, v in snap.items()
-                if ".device." in k or "keySkew" in k or "meshLoadSkew" in k
-                or k.rsplit(".", 1)[-1] in _TIER_GAUGES
-                or k.rsplit(".", 1)[-1] in _PER_DEVICE_MAX_GAUGES
-                or k.rsplit(".", 1)[-1] in _REBALANCE_GAUGES
-                or k.rsplit(".", 1)[-1] in _JOIN_GAUGES
-                or k.rsplit(".", 1)[-1] in _LATENCY_GAUGES}
+            s: {k: v for k, v in snap.items() if _is_device_payload_key(k)}
             for s, snap in per_shard.items()
         }
         payload["enabled"] = bool(device_keys or events)
@@ -1747,7 +1899,7 @@ class _ShardTask:
         # per-channel byte counters/rates on both ends (numBytesIn/Out)
         exch_group = self.registry.group("job", "exchange")
         for eid, ch in ins.items():
-            exch_group.gauge(f"inPoolUsage.{eid}", ch.occupancy)
+            exch_group.gauge(f"inPoolUsage.{eid}", ch.occupancy, fold="mean")
             register_channel_metrics(exch_group, eid, inbound=ch)
         for eid, och in outs.items():
             register_channel_metrics(exch_group, eid, outbound=och)
@@ -2009,9 +2161,10 @@ class _ShardTask:
                                  ("stateKeyCount", "state_key_count")):
             fn = getattr(op, attr, None)
             if fn is not None:
-                op_group.gauge(gauge_name, fn)
+                op_group.gauge(gauge_name, fn, fold="sum")
         op_group.gauge("numLateRecordsDropped",
-                       lambda: getattr(op, "num_late_records_dropped", 0))
+                       lambda: getattr(op, "num_late_records_dropped", 0),
+                       fold="sum", kind="counter")
         # device-plane observability: compile tracking where the operator
         # exposes the attach surface (fused/sharded paths), key-skew
         # telemetry wherever per-key counts are device-resident. The
@@ -2074,7 +2227,7 @@ class _ShardTask:
                 # the job-level gauge the autoscaler's signal extractor
                 # reads (absent on builds without device stats — the
                 # signal is OPTIONAL there, never implicit zero)
-                job_group.gauge("keySkew", key_stats.skew)
+                job_group.gauge("keySkew", key_stats.skew, fold="max")
         results: list = []
         self._resolve_local_restore()
         if self.restore is not None:
@@ -2129,10 +2282,12 @@ class _ShardTask:
         ins = {src: self.te.exchange.channel(self._channel_id(src))
                for src in range(P) if src != self.shard}
         for src, ch in ins.items():
-            job_group.gauge(f"exchange.inPoolUsage.{src}", ch.occupancy)
+            job_group.gauge(f"exchange.inPoolUsage.{src}", ch.occupancy,
+                            fold="mean")
             register_channel_metrics(exch_metrics_group, str(src), inbound=ch)
         job_group.gauge("numDataplaneReconnects", lambda: sum(
-            ch.num_reconnects for ch in outs.values()))
+            ch.num_reconnects for ch in outs.values()),
+            fold="sum", kind="counter")
         # liveness probe for the reconnect window: its OWN tight-timeout
         # gateway — the task's main jm gateway runs at the 120s payload
         # reply budget, and a peer_alive probe blocking that long on a
@@ -2600,6 +2755,19 @@ def main(argv: Optional[List[str]] = None) -> None:
                     CheckpointingOptions.TOLERABLE_FAILED_CHECKPOINTS),
                 stuck_task_timeout_ms=conf.get(
                     WatchdogOptions.STUCK_TASK_TIMEOUT_MS),
+                # observability.history.* / observability.doctor.* group
+                history_interval_ms=conf.get(
+                    ObservabilityOptions.HISTORY_INTERVAL_MS),
+                history_retention_points=conf.get(
+                    ObservabilityOptions.HISTORY_RETENTION_POINTS),
+                doctor_enabled=conf.get(
+                    ObservabilityOptions.DOCTOR_ENABLED),
+                doctor_window_ms=float(conf.get(
+                    ObservabilityOptions.DOCTOR_WINDOW_MS)),
+                watchdog_min_gap_ms=float(conf.get(
+                    ObservabilityOptions.DOCTOR_WATCHDOG_MIN_GAP_MS)),
+                p99_breach_ms=conf.get(
+                    ObservabilityOptions.DOCTOR_P99_BREACH_MS),
             )
             _install_chaos_from_conf(conf)
         JobManagerEndpoint(

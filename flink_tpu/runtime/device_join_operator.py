@@ -308,25 +308,34 @@ class DeviceJoinRunner(StepRunner):
         super().register_metrics(group)
         group.gauge("currentWatermark",
                     lambda: self._host._wm if self._host is not None
-                    else self._wm)
+                    else self._wm,
+                    fold="min")
         if self.emission_tracker is not None:
-            group.gauge("emissionLatencyMs", self.emission_tracker.snapshot)
+            group.gauge("emissionLatencyMs", self.emission_tracker.snapshot,
+                        fold="emission", kind="histogram")
             group.gauge(
                 "watermarkLagMs",
                 lambda: watermark_lag_ms(
-                    self._host._wm if self._host is not None else self._wm))
+                    self._host._wm if self._host is not None else self._wm),
+                fold="max")
         group.gauge("numLateRecordsDropped",
-                    lambda: (self._sync_late(), self.num_late_dropped)[1])
+                    lambda: (self._sync_late(), self.num_late_dropped)[1],
+                    fold="sum", kind="counter")
         group.gauge("joinRingOccupancy",
                     lambda: 0 if self.pipeline is None
-                    else self.pipeline.occupancy())
-        group.gauge("joinMatchesEmitted", lambda: self.matches_emitted)
+                    else self.pipeline.occupancy(),
+                    fold="sum")
+        group.gauge("joinMatchesEmitted", lambda: self.matches_emitted,
+                    fold="sum", kind="counter")
+        # a catalogued reason CODE, not a count — "did ANY shard degrade"
         group.gauge("joinFallbackReason",
-                    lambda: fallback_code(self.fallback_reason))
+                    lambda: fallback_code(self.fallback_reason),
+                    fold="max")
         group.gauge("stateBytes",
                     lambda: 0 if self.pipeline is None
-                    else self.pipeline.state_bytes())
-        group.gauge("stateKeyCount", lambda: len(self._keys))
+                    else self.pipeline.state_bytes(),
+                    fold="sum")
+        group.gauge("stateKeyCount", lambda: len(self._keys), fold="sum")
 
     # -- checkpointing -----------------------------------------------------
     def snapshot(self) -> dict:

@@ -935,7 +935,9 @@ class WindowStepRunner(StepRunner):
 
     def register_metrics(self, group) -> None:
         super().register_metrics(group)
-        group.gauge("numLateRecordsDropped", lambda: self.op.num_late_records_dropped)
+        group.gauge("numLateRecordsDropped",
+                    lambda: self.op.num_late_records_dropped,
+                    fold="sum", kind="counter")
 
         def _wm():
             return getattr(
@@ -945,62 +947,80 @@ class WindowStepRunner(StepRunner):
                         "current_watermark", 0),
             )
 
-        group.gauge("currentWatermark", _wm)
+        # watermark position: the job-level combined watermark is what
+        # EVERY subtask has reached, so the fold is MIN
+        group.gauge("currentWatermark", _wm, fold="min")
         if self.emission_tracker is not None:
-            # emission-latency plane: flat log-bucket snapshot (folds
-            # bucket-wise across shards) + wall-vs-watermark lag (folds
-            # MAX) — registered together so the cluster fold tuple and the
-            # device payload filter track ONE key family
-            group.gauge("emissionLatencyMs", self.emission_tracker.snapshot)
-            group.gauge("watermarkLagMs", lambda: watermark_lag_ms(_wm()))
+            # emission-latency plane: flat log-bucket snapshot (declared
+            # "emission" — folds bucket-wise EXACTLY across shards) +
+            # wall-vs-watermark lag (worst shard -> MAX)
+            group.gauge("emissionLatencyMs", self.emission_tracker.snapshot,
+                        fold="emission", kind="histogram")
+            group.gauge("watermarkLagMs", lambda: watermark_lag_ms(_wm()),
+                        fold="max")
         if self.device_timer is not None:
             self.device_timer._hist = group.histogram("deviceDispatchMs")
             self.device_timer.register(group)
         state_bytes = getattr(self.op, "state_bytes", None)
         if state_bytes is not None:
             # HBM-resident state footprint of this operator's device arrays
-            group.gauge("stateBytes", state_bytes)
+            group.gauge("stateBytes", state_bytes, fold="sum")
         key_count = getattr(self.op, "state_key_count", None)
         if key_count is not None:
-            group.gauge("stateKeyCount", key_count)
+            group.gauge("stateKeyCount", key_count, fold="sum")
         # device plane: compile counters, roofline, phase counters, key
         # telemetry — all on the operator scope so laggard kernels are
         # attributable per step
         if self.device_stats is not None:
             self.device_stats.register(group)
+            # roofline fractions are each shard's own chip's view -> MEAN
             group.gauge("hbmUtilizationPct",
-                        lambda: self.device_roofline()["hbmUtilizationPct"])
+                        lambda: self.device_roofline()["hbmUtilizationPct"],
+                        fold="mean")
             group.gauge("flopsUtilizationPct",
-                        lambda: self.device_roofline()["flopsUtilizationPct"])
+                        lambda: self.device_roofline()["flopsUtilizationPct"],
+                        fold="mean")
             phases = getattr(self.op, "phase_totals", None)
             if callable(phases):
                 group.gauge("phaseIngestRecords",
-                            lambda: phases()["ingestRecords"])
-                group.gauge("phaseFireSteps", lambda: phases()["fireSteps"])
-                group.gauge("phasePurgeSteps", lambda: phases()["purgeSteps"])
+                            lambda: phases()["ingestRecords"],
+                            fold="sum", kind="counter")
+                group.gauge("phaseFireSteps",
+                            lambda: phases()["fireSteps"],
+                            fold="sum", kind="counter")
+                group.gauge("phasePurgeSteps",
+                            lambda: phases()["purgeSteps"],
+                            fold="sum", kind="counter")
         if self.key_stats is not None:
             self.key_stats.register(group)
-        # state-tier gauges (state/tier_manager.py): one gauge per family
-        # key; shipped on heartbeats like every registered gauge, folded
-        # job-level by aggregate_shard_metrics (counters/sizes SUM across
-        # shards — each shard owns its key range; tierHotFillRatio means
-        # via the generic Ratio rule)
+        # state-tier gauges (state/tier_manager.py): counters/sizes SUM
+        # across shards — each shard owns its key range; tierHotFillRatio
+        # (a per-shard fraction) MEANs. Eviction/promotion totals are
+        # monotone, so the history plane records them as churn RATES.
         tier_gauges = getattr(self.op, "tier_gauges", None)
         if callable(tier_gauges) and tier_gauges() is not None:
-            for key in ("vocabSize", "residentKeys", "evictions",
-                        "promotions", "spilledBytes", "changelogBytes",
-                        "tierHotFillRatio"):
-                group.gauge(key, lambda k=key: self.op.tier_gauges().get(k))
+            for key, kind in (("vocabSize", None), ("residentKeys", None),
+                              ("evictions", "counter"),
+                              ("promotions", "counter"),
+                              ("spilledBytes", "counter"),
+                              ("changelogBytes", "counter")):
+                group.gauge(key, lambda k=key: self.op.tier_gauges().get(k),
+                            fold="sum", kind=kind)
+            group.gauge("tierHotFillRatio",
+                        lambda: self.op.tier_gauges().get("tierHotFillRatio"),
+                        fold="mean")
         # latency-mode controller gauges (execution.latency.target-ms):
         # registered only when the mode is on, folded MAX across shards
-        # (cluster._LATENCY_CONTROLLER_GAUGES) — the controller's rung/
-        # ring/ladder decisions surface in /jobs/:id/device and /latency
+        # (the deepest rung / fullest ring / most geometries is the job's
+        # latency view) — the controller's rung/ring/ladder decisions
+        # surface in /jobs/:id/device and /latency
         latency_gauges = getattr(self.op, "latency_gauges", None)
         if callable(latency_gauges) and latency_gauges() is not None:
             for key in ("latencyModeActive", "currentBatchRung",
                         "inflightDepth", "ladderRecompiles"):
                 group.gauge(key,
-                            lambda k=key: self.op.latency_gauges().get(k))
+                            lambda k=key: self.op.latency_gauges().get(k),
+                            fold="max")
 
     def snapshot(self) -> dict:
         return {"operator": self.op.snapshot()}
@@ -2022,11 +2042,14 @@ class JobRuntime:
             if bp is not None:   # stage-output senders blocked on credits
                 self.io.add_backpressure_source(bp)
         self.io.register(job_group)
-        job_group.gauge("numRecordsIn", lambda: self.records_in)
+        job_group.gauge("numRecordsIn", lambda: self.records_in,
+                        fold="sum", kind="counter")
         # mesh-as-slot-resource visibility: 1 on the single-chip path, the
         # actual shard count when parallel.mesh.enabled promoted the job —
         # dashboards and the autoscaler read THIS, not the requested config
-        job_group.gauge("meshDevices", self.mesh_devices)
+        # (fold MAX: each shard reports ITS mesh size — summing would
+        # misreport a plain 2-shard job as a 2-device mesh)
+        job_group.gauge("meshDevices", self.mesh_devices, fold="max")
         # SQL front-door visibility: present only for SQL-originated jobs
         # (planner-lowered window terminals carry sql_origin). 1 when every
         # SQL window step selected the fused DeviceChainRunner — the
@@ -2038,15 +2061,18 @@ class JobRuntime:
         if sql_runners:
             from flink_tpu.runtime.device_join_operator import DeviceJoinRunner
 
+            # fold MIN: the job is "fully fused" only when EVERY shard is
             job_group.gauge(
                 "sqlFusedSelected",
                 lambda rs=tuple(sql_runners): int(all(
                     isinstance(r, (DeviceChainRunner, DeviceJoinRunner))
-                    for r in rs)))
+                    for r in rs)),
+                fold="min")
         job_group.gauge("deviceTimeMsTotal", lambda: sum(
             r.device_timer.total_s * 1000.0
             for r in self.runners
-            if getattr(r, "device_timer", None) is not None))
+            if getattr(r, "device_timer", None) is not None),
+            fold="sum", kind="counter")
         # device plane: job-level compile/roofline/skew gauges — these are
         # the keys the TM heartbeat ships and the autoscaler's signal
         # extractor reads (job.device.*, job.keySkew); compile events also
@@ -2058,29 +2084,33 @@ class JobRuntime:
         if trackers:
             dg = job_group.add_group("device")
             dg.gauge("numCompiles",
-                     lambda: sum(t.num_compiles for t in trackers))
+                     lambda: sum(t.num_compiles for t in trackers),
+                     fold="sum", kind="counter")
             dg.gauge("numRecompiles",
-                     lambda: sum(t.num_recompiles for t in trackers))
+                     lambda: sum(t.num_recompiles for t in trackers),
+                     fold="sum", kind="counter")
             dg.gauge("compileTimeMsTotal", lambda: round(
-                sum(t.compile_ms_total for t in trackers), 3))
+                sum(t.compile_ms_total for t in trackers), 3),
+                fold="sum", kind="counter")
             dg.gauge("recompileStorm",
-                     lambda: max(t.recompile_storm() for t in trackers))
+                     lambda: max(t.recompile_storm() for t in trackers),
+                     fold="max")
             dg.gauge("hbmUtilizationPct", lambda: max(
                 (r.device_roofline()["hbmUtilizationPct"]
                  for r in self.runners
                  if getattr(r, "device_stats", None) is not None),
-                default=0.0))
+                default=0.0), fold="mean")
             dg.gauge("flopsUtilizationPct", lambda: max(
                 (r.device_roofline()["flopsUtilizationPct"]
                  for r in self.runners
                  if getattr(r, "device_stats", None) is not None),
-                default=0.0))
+                default=0.0), fold="mean")
         if collectors:
             def _job_skew(cs=collectors):
                 skews = [s for s in (c.skew() for c in cs) if s is not None]
                 return max(skews) if skews else None
 
-            job_group.gauge("keySkew", _job_skew)
+            job_group.gauge("keySkew", _job_skew, fold="max")
         if traces is not None and trackers:
             from flink_tpu.metrics.device_stats import compile_event_span
 
@@ -2101,7 +2131,8 @@ class JobRuntime:
             job_group.gauge(
                 "p99EmissionLatencyMs",
                 lambda ts=em_trackers: _merge_emission_snapshots(
-                    [t.snapshot() for t in ts]).get("p99", 0.0))
+                    [t.snapshot() for t in ts]).get("p99", 0.0),
+                fold="max")
             if traces is not None:
                 from flink_tpu.metrics.traces import Span
 

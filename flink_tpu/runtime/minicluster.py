@@ -136,6 +136,38 @@ class JobClient:
         spans = [s.to_dict() for s in log.spans] if log is not None else []
         return build_latency_report(snap, spans)
 
+    def history_report(self, metric: Optional[str] = None,
+                       since: Optional[float] = None) -> dict:
+        """Metric time-series rings (/jobs/:id/history?metric=&since=
+        shape; the JM's job_history builds the identical payload from
+        shard-folded snapshots): per-key bounded point lists sampled on
+        the processing-time tick — counters as windowed rates, gauges as
+        values, histograms as per-sample p50/p99 sub-series."""
+        history = getattr(self, "history", None)
+        if history is None:
+            return {"enabled": False, "series": {}, "sample_count": 0}
+        payload = history.payload(
+            metric=metric or None,
+            since_ms=float(since) if since not in (None, "") else None)
+        payload["enabled"] = True
+        return payload
+
+    def doctor_report(self) -> dict:
+        """Ranked bottleneck diagnosis (/jobs/:id/doctor shape; identical
+        payload on the distributed path): the job doctor joined over the
+        history rings and this job's span log."""
+        from flink_tpu.metrics.doctor import diagnose
+
+        history = getattr(self, "history", None)
+        window_ms = float(getattr(self, "doctor_window_ms", 60000.0))
+        if history is None:
+            return {"verdict": "unknown", "score": 0.0, "diagnoses": [],
+                    "window_ms": window_ms, "samples": 0,
+                    "watchdog_events": 0}
+        log = getattr(self, "span_log", None)
+        spans = [s.to_dict() for s in log.spans] if log is not None else []
+        return diagnose(history, spans, window_ms=window_ms)
+
     # -- status -----------------------------------------------------------
     def status(self) -> JobStatus:
         return self._status
@@ -351,6 +383,33 @@ class MiniCluster:
 
         client.span_log = InMemoryTraceReporter(max_spans=512)
         client.traces.add_reporter(client.span_log)
+        # history plane + health watchdog (ISSUE-19): the client samples
+        # its own folded registry view on the processing-time tick (the
+        # cancel_check step boundary below); watchdog breaches land in the
+        # same trace registry as every other control-plane span
+        from flink_tpu.metrics.doctor import HealthWatchdog
+        from flink_tpu.metrics.history import MetricHistory
+        from flink_tpu.metrics.traces import Span
+
+        client.history = MetricHistory(
+            interval_ms=config.get(ObservabilityOptions.HISTORY_INTERVAL_MS),
+            retention_points=config.get(
+                ObservabilityOptions.HISTORY_RETENTION_POINTS))
+        client.doctor_window_ms = float(
+            config.get(ObservabilityOptions.DOCTOR_WINDOW_MS))
+        client.watchdog = None
+        if config.get(ObservabilityOptions.DOCTOR_ENABLED):
+            def _health_sink(scope, name, start_ms, end_ms, attrs,
+                             _c=client):
+                _c.traces.report(Span(scope, name, start_ms, end_ms,
+                                      dict(attrs, jobId=_c.job_id)))
+
+            client.watchdog = HealthWatchdog(
+                _health_sink,
+                min_gap_ms=float(config.get(
+                    ObservabilityOptions.DOCTOR_WATCHDOG_MIN_GAP_MS)),
+                p99_breach_ms=config.get(
+                    ObservabilityOptions.DOCTOR_P99_BREACH_MS))
         interval = config.get(CheckpointingOptions.INTERVAL_MS)
         chk_dir = config.get(CheckpointingOptions.DIRECTORY)
         storage = FsCheckpointStorage(chk_dir) if chk_dir else MemoryCheckpointStorage()
@@ -386,14 +445,19 @@ class MiniCluster:
         skew_rebalance = (mesh_enabled
                           and config.get(ParallelOptions.MESH_SKEW_REBALANCE))
         if mesh_enabled:
+            # per-mesh facts every shard would report identically -> MAX
+            # (the _REBALANCE_GAUGES rule, now declared at registration)
             job_group.gauge("meshRebalances",
-                            lambda: client.mesh_rebalances)
+                            lambda: client.mesh_rebalances,
+                            fold="max", kind="counter")
             job_group.gauge("lastRebalanceDurationMs",
-                            lambda: client.last_mesh_rebalance_duration_ms)
+                            lambda: client.last_mesh_rebalance_duration_ms,
+                            fold="max")
             job_group.gauge(
                 "routingTableVersion",
                 lambda: (getattr(client, "_runtime", None) is not None
-                         and client._runtime.mesh_routing_version()) or 0)
+                         and client._runtime.mesh_routing_version()) or 0,
+                fold="max")
         if skew_rebalance:
             from flink_tpu.scheduler.rebalancer import SkewRebalancer
 
@@ -433,9 +497,11 @@ class MiniCluster:
             # without a mesh executor these read a constant 0 — registered
             # anyway so the gauge surface matches the distributed JM and
             # dashboards scrape one shape
-            job_group.gauge("numRescales", lambda: client.mesh_rescales)
+            job_group.gauge("numRescales", lambda: client.mesh_rescales,
+                            fold="max", kind="counter")
             job_group.gauge("lastRescaleDurationMs",
-                            lambda: client.last_mesh_rescale_duration_ms)
+                            lambda: client.last_mesh_rescale_duration_ms,
+                            fold="max")
             client._autoscaler_metrics = (
                 lambda c=client: metrics_snapshot(c.metrics.all_metrics()))
         coordinator = (
@@ -578,6 +644,19 @@ class MiniCluster:
                             client.job_id,
                             runtime.mesh_devices() if mesh_autoscale else 1,
                             client._autoscaler_metrics)
+                    # history sampling on the same processing-time tick
+                    # (the autoscaler's throttled-snapshot pattern): the
+                    # cheap due() gate runs every step, the registry
+                    # snapshot only on a due interval tick
+                    if client.history.due():
+                        from flink_tpu.metrics.registry import (
+                            metrics_snapshot,
+                        )
+
+                        client.history.sample(
+                            metrics_snapshot(client.metrics.all_metrics()))
+                        if client.watchdog is not None:
+                            client.watchdog.observe(client.history)
                     return client._cancel.is_set()
 
                 def poll_mesh_rescale(rt=runtime):

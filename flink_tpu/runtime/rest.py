@@ -227,6 +227,24 @@ class _Handler(BaseHTTPRequestHandler):
                 if hasattr(client, "latency_report"):
                     return self._json(200, _jsonable(client.latency_report()))
                 return self._json(200, _jsonable(build_latency_report({}, [])))
+            if parts[2] == "history" and len(parts) == 3:
+                # metrics history plane: bounded per-key time-series rings
+                # sampled on the job's processing-time tick
+                metric, since = self._history_query()
+                if since is None and "since" in self.path:
+                    return self._json(400, {"error": "since must be a number"})
+                if hasattr(client, "history_report"):
+                    return self._json(200, _jsonable(
+                        client.history_report(metric=metric, since=since)))
+                return self._json(200, {"enabled": False, "series": {},
+                                        "sample_count": 0})
+            if parts[2] == "doctor" and len(parts) == 3:
+                # job doctor: ranked bottleneck attribution over the recent
+                # history window joined with the span stream
+                if hasattr(client, "doctor_report"):
+                    return self._json(200, _jsonable(client.doctor_report()))
+                return self._json(200, {"verdict": "unknown", "score": 0.0,
+                                        "diagnoses": []})
             if parts[2] == "metrics":
                 if not hasattr(client, "metrics"):
                     return self._json(200, {})
@@ -305,6 +323,23 @@ class _Handler(BaseHTTPRequestHandler):
         self._json(404, {"error": f"no route {self.path}"})
 
     # -- observability helpers --------------------------------------------
+    def _history_query(self):
+        """Parse ?metric=&since= for the history routes; `since` is epoch
+        ms (None when absent or non-numeric — the caller 400s on the
+        latter when the param was present)."""
+        from urllib.parse import parse_qs, urlparse
+
+        qs = parse_qs(urlparse(self.path).query)
+        metric = qs.get("metric", [None])[0]
+        since = None
+        raw = qs.get("since", [None])[0]
+        if raw is not None:
+            try:
+                since = float(raw)
+            except ValueError:
+                since = None
+        return metric, since
+
     def _backpressure(self, client, uid: str):
         """Backpressure view of an in-process (MiniCluster) job: the job
         runs as ONE task, so the task-level busy/idle/backPressured ratios
@@ -368,6 +403,13 @@ class _Handler(BaseHTTPRequestHandler):
             if parts[2] == "latency" and len(parts) == 3:
                 return self._json(200, _jsonable(
                     self.jm.job_latency(job_id)))
+            if parts[2] == "history" and len(parts) == 3:
+                metric, since = self._history_query()
+                return self._json(200, _jsonable(
+                    self.jm.job_history(job_id, metric=metric, since=since)))
+            if parts[2] == "doctor" and len(parts) == 3:
+                return self._json(200, _jsonable(
+                    self.jm.job_doctor(job_id)))
             if parts[2] == "vertices" and len(parts) == 5 \
                     and parts[4] == "backpressure":
                 return self._json(200, _jsonable(

@@ -456,11 +456,14 @@ class StageOutputRunner:
         # exchange-side observability: credits left (outPoolUsage inverse —
         # 0 while the downstream stage lags) and cumulative time this task
         # spent blocked on them (the task's backPressured contribution)
-        group.gauge("availableCredits", self.sender.available_credits)
+        group.gauge("availableCredits", self.sender.available_credits,
+                    fold="sum")
         group.gauge("backPressuredTimeMsTotal",
-                    lambda: self.backpressure_seconds() * 1000.0)
+                    lambda: self.backpressure_seconds() * 1000.0,
+                    fold="sum", kind="counter")
         if self.debloater is not None:
-            group.gauge("debloatedBatchSize", self.debloater.batch_size)
+            group.gauge("debloatedBatchSize", self.debloater.batch_size,
+                        fold="sum")
 
     def backpressure_seconds(self) -> float:
         """Cumulative seconds blocked waiting for downstream credits; the

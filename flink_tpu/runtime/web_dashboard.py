@@ -262,6 +262,63 @@ function latencySection(lat) {
     <tbody>${owners.join("")}</tbody></table>` : "");
 }
 
+function sparkline(points, w = 180, h = 26) {
+  // inline-SVG sparkline over [t, v] pairs from the history rings —
+  // dependency-free, one polyline per series
+  if (!points || points.length < 2) return "";
+  const ts = points.map(p => p[0]), vs = points.map(p => p[1]);
+  const t0 = Math.min(...ts), t1 = Math.max(...ts);
+  const v0 = Math.min(...vs), v1 = Math.max(...vs);
+  const sx = t => t1 > t0 ? (t - t0) / (t1 - t0) * (w - 2) + 1 : w / 2;
+  const sy = v => v1 > v0 ? h - 2 - (v - v0) / (v1 - v0) * (h - 4) : h / 2;
+  const pts = points.map(p => `${sx(p[0]).toFixed(1)},${sy(p[1]).toFixed(1)}`);
+  return `<svg width="${w}" height="${h}" style="vertical-align:middle">
+    <polyline fill="none" stroke="#81a1c1" stroke-width="1.2"
+      points="${pts.join(" ")}"/></svg>`;
+}
+
+function historySection(hist) {
+  // metrics history plane (/jobs/:id/history): per-key bounded rings —
+  // counters shown as rates, histograms as their p50/p99 sub-series;
+  // hidden until the first sampling tick lands
+  if (!hist || !hist.series || !Object.keys(hist.series).length) return "";
+  const rows = Object.entries(hist.series)
+    .filter(([, s]) => (s.points ?? []).length >= 2)
+    .slice(0, 14)
+    .map(([key, s]) => {
+      const last = s.points[s.points.length - 1][1];
+      return `<tr><td>${esc(key)}</td>
+        <td>${esc(s.kind)}</td>
+        <td>${sparkline(s.points)}</td>
+        <td>${fmt(last, 2)}</td></tr>`;
+    });
+  if (!rows.length) return "";
+  return `<h3>history (${fmt(hist.sample_count)} samples @ ` +
+    `${fmt(hist.interval_ms)}ms)</h3>` +
+    `<table><thead><tr><th>metric</th><th>kind</th><th>trend</th>
+    <th>last</th></tr></thead><tbody>${rows.join("")}</tbody></table>`;
+}
+
+function doctorSection(doc) {
+  // job doctor (/jobs/:id/doctor): ranked, evidence-attributed bottleneck
+  // diagnosis over the recent history window joined with the span stream
+  if (!doc || !(doc.diagnoses ?? []).length && doc.verdict === "unknown")
+    return "";
+  const vClass = doc.verdict === "healthy" ? "RUNNING"
+    : (doc.verdict === "unknown" ? "CREATED" : "FAILED");
+  const rows = (doc.diagnoses ?? []).slice(0, 6).map(d => `<tr>
+    <td class="${d.score >= 0.5 ? "FAILED" : "CREATED"}">${esc(d.family)}</td>
+    <td>${fmt(d.score, 2)}</td>
+    <td>${esc(String(d.summary ?? "").slice(0, 90))}</td>
+    <td>${esc(Object.entries(d.evidence ?? {}).slice(0, 4)
+        .map(([k, v]) => `${k}=${fmt(v, 2)}`).join(" "))}</td></tr>`);
+  return `<h3>doctor: <span class="${vClass}">${esc(doc.verdict)}</span>` +
+    ` (${fmt(doc.watchdog_events)} watchdog events)</h3>` +
+    (rows.length ? `<table><thead><tr><th>family</th><th>score</th>
+    <th>summary</th><th>evidence</th></tr></thead>
+    <tbody>${rows.join("")}</tbody></table>` : "");
+}
+
 function operatorTable(metrics) {
   // per-operator observability: latency-marker percentiles, device time,
   // HBM state footprint — parsed from the job.operator.<uid>.* scope
@@ -289,7 +346,8 @@ function operatorTable(metrics) {
 }
 
 async function detailRow(id) {
-  const [info, metrics, traces, cps, exc, auto, dev, lat] = await Promise.all([
+  const [info, metrics, traces, cps, exc, auto, dev, lat, hist, doc] =
+    await Promise.all([
     j(`/jobs/${id}`), j(`/jobs/${id}/metrics`),
     j(`/jobs/${id}/traces`).catch(() => ({resourceSpans: []})),
     j(`/jobs/${id}/checkpoints`).catch(() => null),
@@ -297,6 +355,8 @@ async function detailRow(id) {
     j(`/jobs/${id}/autoscaler`).catch(() => null),
     j(`/jobs/${id}/device`).catch(() => null),
     j(`/jobs/${id}/latency`).catch(() => null),
+    j(`/jobs/${id}/history`).catch(() => null),
+    j(`/jobs/${id}/doctor`).catch(() => null),
   ]);
   const spans = (traces.resourceSpans[0]?.scopeSpans[0]?.spans ?? []);
   const spanRows = spans.slice(-12).reverse().map(s => {
@@ -332,6 +392,8 @@ async function detailRow(id) {
         ([k]) => k.endsWith("numLateRecordsDropped"))?.[1]),
     "error": esc(info.error ?? "none"),
   }) + operatorTable(metrics)
+    + doctorSection(doc)
+    + historySection(hist)
     + latencySection(lat)
     + deviceSection(dev)
     + autoscalerSection(auto)

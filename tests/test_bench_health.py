@@ -1,0 +1,105 @@
+"""Bench smoke gate for the history/doctor plane (ISSUE-19).
+
+Runs the real `bench.health_microbench` at smoke scale and asserts the
+result carries the `health.*` keys every BENCH_*.json must now track: a
+regression that silently empties the history rings (the sampler stopped
+ticking), loses the counter-rate derivation, returns verdict "unknown"
+on an undisturbed flagship-shaped leg, or lets the sampler's measured
+overhead blow past the catastrophic floor fails tier-1, not just a human
+eyeballing the next bench run.
+
+The <=2% sampler-overhead budget is judged on real TPU hardware over the
+full flagship run — at smoke scale on a shared CPU the self-timed ratio
+is dominated by the short wall clock, so this gate pins only the
+CATASTROPHIC floor (a per-record sampling bug costs integer multiples,
+not fractions of a percent).
+"""
+
+import importlib.util
+import os
+import pathlib
+
+import pytest
+
+_BENCH = pathlib.Path(__file__).resolve().parents[1] / "bench.py"
+
+
+@pytest.fixture(scope="module")
+def bench():
+    spec = importlib.util.spec_from_file_location("bench_health_smoke",
+                                                  _BENCH)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+@pytest.fixture(scope="module")
+def result(bench):
+    # smoke scale: one short MiniCluster job — the gate checks structure
+    # and ring liveness, never absolute rates
+    os.environ["BENCH_HEALTH_EVENTS"] = str(1 << 18)
+    try:
+        res = bench.health_microbench()
+        # counter rates need two sampler ticks; a scheduler stall on a
+        # shared 1-CPU runner can compress the whole run into one tick
+        # even though the structural margin is several-fold. A real
+        # regression (the tick gate broken, the rate derivation lost)
+        # fails EVERY run, so one retry bounds the false-failure rate
+        # without masking real breakage.
+        if res["sample_count"] < 2 or res["rate_series"] == 0:
+            res = bench.health_microbench()
+        return res
+    finally:
+        os.environ.pop("BENCH_HEALTH_EVENTS", None)
+
+
+def test_result_carries_the_tracked_health_keys(result):
+    for key in (
+        "verdict",
+        "verdict_score",
+        "diagnoses",
+        "watchdog_events",
+        "sampler_overhead_pct",
+        "sample_count",
+        "sample_time_ms",
+        "history_series",
+        "history_points",
+        "rate_series",
+        "interval_ms",
+        "tuples_per_sec",
+        "workload",
+    ):
+        assert key in result, f"health block lost {key!r}"
+
+
+def test_doctor_reached_a_verdict(result):
+    """"unknown" means the sampler never ticked — the history plane went
+    dark and the doctor had nothing to diagnose. Any real verdict
+    (healthy, or compile-stall on a short CPU leg where XlaCompile
+    genuinely dominates) passes; the absence of observation fails."""
+    assert result["verdict"] != "unknown", (
+        "doctor verdict 'unknown' on an undisturbed flagship-shaped leg "
+        "— did the history sampler stop ticking?")
+    assert result["sample_count"] >= 1
+
+
+def test_history_rings_are_live(result):
+    """Empty rings mean the processing-time tick lost the sampling hook
+    (or the snapshot went dunder-only). Counter families must appear as
+    derived counter-rate series — that is the contract the doctor's
+    throughput-collapse detector reads."""
+    assert result["history_series"] > 0, "history rings are empty"
+    assert result["history_points"] > 0, "history rings hold no points"
+    assert result["rate_series"] > 0, (
+        "no counter-rate series — counters are no longer recorded as "
+        "windowed rates")
+
+
+def test_sampler_overhead_below_catastrophic_floor(result):
+    """A per-record (or per-batch-synchronous) sampling regression costs
+    integer multiples of wall time; the measured steady-state cost is
+    fractions of a percent even at a 20x-default tick rate. The tier-1
+    floor sits between."""
+    assert result["sampler_overhead_pct"] < 10.0, (
+        "history sampler costs a structural fraction of wall time — is "
+        "it sampling per record instead of per interval tick?")

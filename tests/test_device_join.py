@@ -489,17 +489,27 @@ def test_join_gauges_fold_and_survive_the_device_payload_filters():
     assert agg["job.join.joinFallbackReason"] == 7   # worst shard, not 7+0
 
     # regression for the _TIER_GAUGES omission: the family must pass BOTH
-    # device payload filters, or the job-level view silently drops it
+    # device payload filters, or the job-level view silently drops it.
+    # Both filters now consult ONE derived leaf set behind the shared
+    # _is_device_payload_key predicate, so the invariant is structural:
+    # the family sits in the set, and both JobManagerEndpoint payload
+    # sites go through the predicate.
     import inspect
 
     from flink_tpu.runtime import cluster as cluster_mod
+    from flink_tpu.runtime.cluster import (_DEVICE_PAYLOAD_LEAVES,
+                                           _is_device_payload_key)
 
-    src = inspect.getsource(cluster_mod.JobManagerEndpoint)
-    assert src.count("_JOIN_GAUGES") >= 2, (
-        "join gauges missing from a /jobs/:id/device payload filter")
     for name in ("joinRingOccupancy", "joinMatchesEmitted",
                  "joinFallbackReason"):
         assert name in _JOIN_GAUGES
+        assert name in _DEVICE_PAYLOAD_LEAVES, (
+            "join gauges missing from the shared device payload leaf set")
+        assert _is_device_payload_key(f"job.join.{name}")
+    src = inspect.getsource(cluster_mod.JobManagerEndpoint)
+    assert src.count("_is_device_payload_key") >= 2, (
+        "a /jobs/:id/device payload filter stopped consulting the shared "
+        "device-payload predicate")
 
 
 def test_runner_registers_the_join_gauge_family():

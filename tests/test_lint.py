@@ -73,6 +73,18 @@ def test_path_scoped_rules_are_not_vacuous():
     from flink_tpu.lint.rules_wire import SerializationFreeDataplaneRule
 
     index = ModuleIndex(PKG)
+    # the history/doctor plane must stay REGISTERED under DEV003's jax
+    # ban: both modules consume plain-data snapshots/span dicts, and a
+    # module-level jax import would drag backend init into every REST
+    # reader and JM schedule tick. A rename (or a dropped tuple entry)
+    # would disable the ban silently.
+    for rel in ("metrics/history.py", "metrics/doctor.py"):
+        assert rel in CONTROL_PLANE, (
+            f"{rel} no longer registered in DEV003's CONTROL_PLANE — "
+            "the history/doctor plane may not import jax")
+        assert index.get(rel) is not None, (
+            f"{rel} missing — the history/doctor plane moved and "
+            "DEV003's control-plane ban no longer covers it")
     for layer in LAYER_FORBIDDEN:
         assert any(index.in_subtree(layer)), (
             f"layer {layer!r} has no modules — LAYER_FORBIDDEN is stale "

@@ -1,0 +1,569 @@
+"""Metrics history plane + job doctor (ISSUE-19).
+
+Covers the three tentpole layers end to end:
+
+- `MetricHistory`: bounded rings sampled on the processing-time tick —
+  counters as windowed rates (clamped at rewind), gauges as values,
+  histogram-stats dicts as per-sample p50/p99 sub-series; the REST
+  payload shape with metric=/since= filters.
+- declared fold semantics: `metrics_snapshot` ships `__folds__` /
+  `__kinds__`, `aggregate_shard_metrics` folds by declaration (the old
+  `current*`-prefix heuristic survives only as a deprecated fallback
+  that warns), generic histogram dicts folded by the envelope carry
+  `"approx": true`; plus the registry-wide audit — every gauge
+  registration in the package declares its fold or sits on the single
+  allowlist below with a written reason.
+- the job doctor on constructed regimes (compile-stall-, backpressure-,
+  tier-churn-dominated; restart attenuation), the `HealthWatchdog`
+  thresholds + rate limiting, and `/jobs/:id/history` + `/jobs/:id/doctor`
+  over REST on BOTH execution paths (MiniCluster and the jm_gateway
+  bridge).
+"""
+
+import ast
+import json
+import pathlib
+import time
+import urllib.error
+import urllib.request
+import warnings
+
+import numpy as np
+import pytest
+
+import flink_tpu
+from flink_tpu.metrics.doctor import (
+    HEALTH_SPAN_SCOPE,
+    HealthWatchdog,
+    diagnose,
+)
+from flink_tpu.metrics.history import MetricHistory
+from flink_tpu.metrics.registry import (
+    FOLD_KINDS,
+    METRIC_KINDS,
+    Counter,
+    Histogram,
+    Meter,
+    MetricRegistry,
+    metrics_snapshot,
+)
+
+_PKG = pathlib.Path(flink_tpu.__file__).parent
+
+
+# ---------------------------------------------------------------------------
+# MetricHistory rings
+# ---------------------------------------------------------------------------
+
+def test_history_gauges_recorded_as_values_counters_as_rates():
+    h = MetricHistory(interval_ms=10, retention_points=64)
+    kinds = {"numRecordsIn": "counter"}
+    h.sample({"numRecordsIn": 0, "lag": 5.0}, kinds=kinds, now_ms=1000.0)
+    h.sample({"numRecordsIn": 500, "lag": 7.0}, kinds=kinds, now_ms=2000.0)
+    h.sample({"numRecordsIn": 1500, "lag": 9.0}, kinds=kinds, now_ms=3000.0)
+    series = h.snapshot_series()
+    # the gauge keeps raw values
+    assert [v for _, v in series["lag"]] == [5.0, 7.0, 9.0]
+    # the counter becomes a windowed rate: first sight yields no point
+    assert [v for _, v in series["numRecordsIn"]] == [500.0, 1000.0]
+    assert h.payload()["series"]["numRecordsIn"]["kind"] == "counter-rate"
+
+
+def test_history_counter_rewind_clamps_to_zero_rate():
+    """A restore rewinds the monotone totals; the ring must read that as
+    a rate-0 stall (the signal the collapse watchdog keys on), never a
+    negative rate."""
+    h = MetricHistory(interval_ms=10)
+    kinds = {"n": "counter"}
+    h.sample({"n": 1000}, kinds=kinds, now_ms=1000.0)
+    h.sample({"n": 200}, kinds=kinds, now_ms=2000.0)    # rewound
+    h.sample({"n": 700}, kinds=kinds, now_ms=3000.0)
+    assert [v for _, v in h.snapshot_series()["n"]] == [0.0, 500.0]
+
+
+def test_history_hist_dicts_become_p50_p99_subseries_with_count_rate():
+    h = MetricHistory(interval_ms=10)
+    snap = {"emissionLatencyMs": {"count": 10, "p50": 2.0, "p99": 9.0,
+                                  "mean": 3.0}}
+    h.sample(snap, now_ms=1000.0)
+    snap2 = {"emissionLatencyMs": {"count": 30, "p50": 3.0, "p99": 12.0,
+                                   "mean": 4.0}}
+    h.sample(snap2, now_ms=2000.0)
+    series = h.snapshot_series()
+    assert [v for _, v in series["emissionLatencyMs.p50"]] == [2.0, 3.0]
+    assert [v for _, v in series["emissionLatencyMs.p99"]] == [9.0, 12.0]
+    # fire RATE rides along (20 fires / 1 s)
+    assert [v for _, v in series["emissionLatencyMs.count"]] == [20.0]
+    # non-histogram dicts (maps without quantiles) are skipped, not points
+    h.sample({"recompile_causes": {"a": 1}}, now_ms=3000.0)
+    assert "recompile_causes" not in h.snapshot_series()
+
+
+def test_history_retention_bound_and_due_gate():
+    h = MetricHistory(interval_ms=100, retention_points=4)
+    assert h.due(now_ms=0.0)                      # first tick always due
+    for i in range(10):
+        h.sample({"g": float(i)}, now_ms=i * 100.0)
+    assert not h.due(now_ms=950.0)                # 50ms since last sample
+    assert h.due(now_ms=1000.0)
+    pts = h.snapshot_series()["g"]
+    assert len(pts) == 4 and [v for _, v in pts] == [6.0, 7.0, 8.0, 9.0]
+
+
+def test_history_window_matches_suffix_across_operator_scopes():
+    h = MetricHistory(interval_ms=10)
+    h.sample({"operator.w-1.watermarkLagMs": 5.0,
+              "operator.w-2.watermarkLagMs": 9.0,
+              "watermarkLagMsTotal": 1.0}, now_ms=1000.0)
+    pts = h.window("watermarkLagMs", 60000.0, now_ms=1000.0)
+    assert sorted(v for _, v in pts) == [5.0, 9.0]
+
+
+def test_history_payload_filters_and_never_raises():
+    h = MetricHistory(interval_ms=10)
+    h.sample({"a.rate": 1.0, "b.rate": 2.0, "c": 3.0}, now_ms=1000.0)
+    h.sample({"a.rate": 4.0, "b.rate": 5.0, "c": 6.0}, now_ms=2000.0)
+    p = h.payload(metric="rate")
+    assert set(p["series"]) == {"a.rate", "b.rate"}
+    p = h.payload(since_ms=1500.0)
+    assert all(len(s["points"]) == 1 for s in p["series"].values())
+    assert p["sample_count"] == 2 and p["interval_ms"] == 10
+    # garbage snapshots must never raise (observability cannot fail jobs)
+    h.sample(None, now_ms=3000.0)
+    h.sample({"bad": object()}, now_ms=4000.0)
+    assert h.sample_count == 4
+    # dunder keys are metadata, never series
+    h.sample({"__folds__": {"x": "sum"}, "x": 1.0}, now_ms=5000.0)
+    assert "__folds__" not in h.snapshot_series()
+
+
+# ---------------------------------------------------------------------------
+# declared fold semantics
+# ---------------------------------------------------------------------------
+
+def test_metrics_snapshot_ships_fold_and_kind_declarations():
+    r = MetricRegistry()
+    g = r.group("job")
+    g.counter("numRecordsIn")
+    g.gauge("currentWatermark", lambda: 42.0, fold="min")
+    g.gauge("keySkew", lambda: 1.5, fold="max")
+    g.gauge("numLate", lambda: 3, fold="sum", kind="counter")
+    snap = metrics_snapshot(r.all_metrics())
+    folds, kinds = snap["__folds__"], snap["__kinds__"]
+    assert folds["job.numRecordsIn"] == "sum"
+    assert kinds["job.numRecordsIn"] == "counter"
+    assert folds["job.currentWatermark"] == "min"
+    assert folds["job.keySkew"] == "max"
+    assert kinds["job.numLate"] == "counter"
+
+
+def test_gauge_rejects_unknown_fold_and_kind():
+    g = MetricRegistry().group("job")
+    with pytest.raises(ValueError):
+        g.gauge("x", lambda: 0, fold="median")
+    with pytest.raises(ValueError):
+        g.gauge("y", lambda: 0, fold="sum", kind="speedometer")
+    assert Counter.fold == "sum" and Counter.kind == "counter"
+    assert Meter.fold == "sum" and Meter.kind == "meter"
+    assert Histogram.fold == "hist" and Histogram.kind == "histogram"
+
+
+def test_aggregate_folds_by_declaration_without_warning():
+    from flink_tpu.runtime.cluster import aggregate_shard_metrics
+
+    shards = {}
+    for sid, (wm, skew, n) in enumerate(((100.0, 1.2, 10),
+                                         (50.0, 3.0, 20))):
+        shards[sid] = {"currentWatermark": wm, "keySkew": skew,
+                       "numRecordsIn": n,
+                       "__folds__": {"currentWatermark": "min",
+                                     "keySkew": "max",
+                                     "numRecordsIn": "sum"},
+                       "__kinds__": {"numRecordsIn": "counter"}}
+    with warnings.catch_warnings():
+        warnings.simplefilter("error", DeprecationWarning)
+        agg = aggregate_shard_metrics(shards)
+    assert agg["currentWatermark"] == 50.0
+    assert agg["keySkew"] == 3.0
+    assert agg["numRecordsIn"] == 30
+
+
+def test_undeclared_keys_fall_back_to_heuristic_with_deprecation():
+    from flink_tpu.runtime import cluster as cluster_mod
+    from flink_tpu.runtime.cluster import aggregate_shard_metrics
+
+    cluster_mod._WARNED_UNDECLARED.discard("legacyThingTotal")
+    shards = {0: {"legacyThingTotal": 5}, 1: {"legacyThingTotal": 7}}
+    with pytest.warns(DeprecationWarning, match="legacyThingTotal"):
+        agg = aggregate_shard_metrics(shards)
+    assert agg["legacyThingTotal"] == 12
+
+
+def test_generic_histogram_envelope_fold_is_marked_approx():
+    """The envelope fold (count-sum, min-min, everything else the MAX
+    upper bound) is an approximation — the artifact must say so instead
+    of passing merged quantiles off as exact."""
+    from flink_tpu.runtime.cluster import aggregate_shard_metrics
+
+    shards = {
+        0: {"latencyMs": {"count": 10, "min": 1.0, "max": 5.0, "mean": 2.0,
+                          "p50": 2.0, "p99": 4.0}},
+        1: {"latencyMs": {"count": 30, "min": 0.5, "max": 9.0, "mean": 4.0,
+                          "p50": 3.0, "p99": 8.0}},
+    }
+    agg = aggregate_shard_metrics(shards)
+    blk = agg["latencyMs"]
+    assert blk["approx"] is True
+    assert blk["count"] == 40 and blk["min"] == 0.5 and blk["max"] == 9.0
+    assert blk["p99"] == 8.0                     # upper bound, not exact
+    assert blk["mean"] == 4.0                    # upper envelope too
+
+
+# every `.gauge(` registration in the package must declare its fold; a
+# metric family that truly cannot declare one goes here, keyed by
+# "<relpath>:<name-or-line>" with a WRITTEN reason — additions without a
+# reason are a review failure, and an empty dict is the healthy state
+_UNDECLARED_GAUGE_ALLOWLIST: dict = {}
+
+
+def test_registry_wide_every_gauge_declares_its_fold():
+    """ISSUE-19 satellite: a new gauge registered without `fold=` lands
+    in the deprecated prefix heuristic and gets folded by name-pattern
+    guesswork across shards. This audit makes that a tier-1 failure at
+    the REGISTRATION site, not a wrong number in a dashboard later."""
+    undeclared = []
+    for path in sorted(_PKG.rglob("*.py")):
+        tree = ast.parse(path.read_text(), filename=str(path))
+        for node in ast.walk(tree):
+            if (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr == "gauge"
+                    and not any(kw.arg == "fold" for kw in node.keywords)):
+                rel = path.relative_to(_PKG.parent).as_posix()
+                undeclared.append(f"{rel}:{node.lineno}")
+    missing = [u for u in undeclared
+               if u not in _UNDECLARED_GAUGE_ALLOWLIST]
+    assert not missing, (
+        "gauge registrations without a declared fold (declare "
+        "fold=/kind= at the registration site, or allowlist WITH a "
+        f"reason): {missing}")
+
+
+def test_fold_vocabulary_is_closed():
+    assert set(FOLD_KINDS) == {"sum", "min", "max", "mean",
+                               "emission", "per-device-max", "hist"}
+    assert set(METRIC_KINDS) == {"counter", "gauge", "meter",
+                                 "histogram"}
+
+
+def test_prefix_heuristic_survives_only_in_the_deprecated_fallback():
+    """Zero `current*`-prefix fold logic outside `_shard_combine` (the
+    deprecated fallback) — the scattered exemption tuples must not grow
+    back at call sites."""
+    src = (_PKG / "runtime" / "cluster.py").read_text()
+    tree = ast.parse(src)
+    offenders = []
+    for node in ast.walk(tree):
+        if isinstance(node, ast.FunctionDef) \
+                and node.name != "_shard_combine":
+            seg = ast.get_source_segment(src, node) or ""
+            if 'startswith("current")' in seg:
+                offenders.append(node.name)
+    assert not offenders, (
+        f"current* prefix heuristic leaked outside the deprecated "
+        f"fallback: {offenders}")
+
+
+# ---------------------------------------------------------------------------
+# job doctor: constructed regimes
+# ---------------------------------------------------------------------------
+
+_NOW = 1_000_000.0        # ms
+
+
+def _fill(history, series, *, t0=_NOW - 55_000, dt=2_500, kinds=None):
+    """Synthetic sampling: `series` maps key -> list of values, one
+    sample per dt ms starting at t0 (inside the 60s doctor window, with
+    enough points before the recent-quarter split for a baseline)."""
+    n = max(len(v) for v in series.values())
+    for i in range(n):
+        snap = {k: v[i] for k, v in series.items() if i < len(v)}
+        history.sample(snap, kinds=kinds, now_ms=t0 + i * dt)
+
+
+def test_doctor_compile_stall_dominated_regime():
+    h = MetricHistory(interval_ms=10)
+    _fill(h, {"numRecordsIn": [i * 1000 for i in range(20)]},
+          kinds={"numRecordsIn": "counter"})
+    spans = [{"scope": "device", "name": "XlaCompile",
+              "start_ts_ms": _NOW - 50_000, "end_ts_ms": _NOW - 20_000}]
+    doc = diagnose(h, spans, now_ms=_NOW)
+    assert doc["verdict"] == "compile-stall"
+    top = doc["diagnoses"][0]
+    assert top["score"] >= 0.5
+    assert top["evidence"]["compile_ms"] == pytest.approx(30_000.0)
+    assert "explained_by" not in top["evidence"]
+
+
+def test_doctor_backpressure_dominated_regime():
+    h = MetricHistory(interval_ms=10)
+    _fill(h, {"backPressuredTimeRatio": [0.9] * 20,
+              "numRecordsIn": [i * 1000 for i in range(20)]},
+          kinds={"numRecordsIn": "counter"})
+    doc = diagnose(h, [], now_ms=_NOW)
+    assert doc["verdict"] == "backpressure"
+    ev = doc["diagnoses"][0]["evidence"]
+    assert ev["mean_backpressured_ratio"] == pytest.approx(0.9)
+
+
+def test_doctor_tier_churn_dominated_regime():
+    h = MetricHistory(interval_ms=10)
+    _fill(h, {"evictions": [i * 2000 for i in range(20)],
+              "promotions": [i * 2000 for i in range(20)],
+              "residentKeys": [100.0] * 20},
+          kinds={"evictions": "counter", "promotions": "counter"})
+    doc = diagnose(h, [], now_ms=_NOW)
+    assert doc["verdict"] == "tier-churn"
+    assert doc["diagnoses"][0]["evidence"]["churn_per_sec"] > 100.0
+
+
+def test_doctor_restart_outranks_the_symptoms_it_explains():
+    """One restart + a massive compile burst + a throughput collapse: the
+    root cause must rank first; the symptoms survive as attenuated,
+    `explained_by`-marked diagnoses below it."""
+    h = MetricHistory(interval_ms=10)
+    totals = [i * 10_000 for i in range(15)] + [150_000] * 5   # stalls
+    _fill(h, {"numRecordsIn": totals}, kinds={"numRecordsIn": "counter"})
+    spans = [
+        {"scope": "recovery", "name": "JobRestart",
+         "start_ts_ms": _NOW - 12_000, "end_ts_ms": _NOW - 10_000},
+        {"scope": "device", "name": "XlaCompile",
+         "start_ts_ms": _NOW - 50_000, "end_ts_ms": _NOW - 10_000},
+    ]
+    doc = diagnose(h, spans, now_ms=_NOW)
+    assert doc["verdict"] == "recovery-restart"
+    fams = {d["family"]: d for d in doc["diagnoses"]}
+    assert fams["recovery-restart"]["score"] >= 0.7
+    for symptom in ("compile-stall", "throughput-collapse"):
+        assert symptom in fams
+        assert fams[symptom]["evidence"]["explained_by"] == \
+            "recovery-restart"
+        assert fams[symptom]["score"] < fams["recovery-restart"]["score"]
+
+
+def test_doctor_healthy_and_unknown_verdicts():
+    h = MetricHistory(interval_ms=10)
+    assert diagnose(h, [], now_ms=_NOW)["verdict"] == "unknown"
+    _fill(h, {"numRecordsIn": [i * 1000 for i in range(20)]},
+          kinds={"numRecordsIn": "counter"})
+    doc = diagnose(h, [], now_ms=_NOW)
+    assert doc["verdict"] == "healthy" and doc["score"] == 0.0
+
+
+# ---------------------------------------------------------------------------
+# HealthWatchdog
+# ---------------------------------------------------------------------------
+
+class _Sink:
+    def __init__(self):
+        self.spans = []
+
+    def __call__(self, scope, name, start_ms, end_ms, attrs):
+        self.spans.append((scope, name, start_ms, end_ms, attrs))
+
+
+def test_watchdog_emits_collapse_span_and_rate_limits():
+    h = MetricHistory(interval_ms=10)
+    now = 100_000.0
+    totals = [i * 10_000 for i in range(12)] + [120_000] * 4   # stalls
+    kinds = {"numRecordsIn": "counter"}
+    for i, t in enumerate(totals):
+        h.sample({"numRecordsIn": t}, kinds=kinds,
+                 now_ms=now - 30_000 + i * 2_000)
+    sink = _Sink()
+    wd = HealthWatchdog(sink, min_gap_ms=5_000, window_ms=30_000)
+    wd.observe(h, now_ms=now)
+    wd.observe(h, now_ms=now + 1_000)            # inside the gap: dropped
+    collapses = [s for s in sink.spans if s[1] == "ThroughputCollapse"]
+    assert len(collapses) == 1 and wd.events == 1
+    scope, _, start, end, attrs = collapses[0]
+    assert scope == HEALTH_SPAN_SCOPE and start == end
+    assert attrs["recent_rate"] < attrs["baseline_rate"] * 0.5
+    wd.observe(h, now_ms=now + 6_000)            # past the gap: emits
+    assert wd.events == 2
+
+
+def test_watchdog_stall_backpressure_and_p99_breach():
+    h = MetricHistory(interval_ms=10)
+    now = 100_000.0
+    for i in range(8):
+        h.sample({"watermarkLagMs": i * 2_000.0,       # slope 1.0
+                  "backPressuredTimeRatio": 0.95,
+                  "emissionLatencyMs": {"count": i + 1, "p50": 1.0,
+                                        "p99": 40.0}},
+                 now_ms=now - 16_000 + i * 2_000)
+    sink = _Sink()
+    wd = HealthWatchdog(sink, min_gap_ms=1, window_ms=30_000,
+                        p99_breach_ms=25.0)
+    wd.observe(h, now_ms=now)
+    names = {s[1] for s in sink.spans}
+    assert {"WatermarkStall", "BackpressureSaturation",
+            "P99Breach"} <= names
+    # p99 breach is OPT-IN: the default 0.0 threshold never fires
+    sink2 = _Sink()
+    HealthWatchdog(sink2, min_gap_ms=1).observe(h, now_ms=now)
+    assert "P99Breach" not in {s[1] for s in sink2.spans}
+    # a broken sink must never take the tick down
+    def boom(*a):
+        raise RuntimeError("sink died")
+    HealthWatchdog(boom, min_gap_ms=1, p99_breach_ms=25.0) \
+        .observe(h, now_ms=now)
+
+
+# ---------------------------------------------------------------------------
+# REST, both execution paths
+# ---------------------------------------------------------------------------
+
+def _get_json(url):
+    with urllib.request.urlopen(url, timeout=10) as r:
+        return json.loads(r.read())
+
+
+def test_minicluster_history_and_doctor_over_rest():
+    from flink_tpu.api.datastream import StreamExecutionEnvironment
+    from flink_tpu.api.windowing.assigners import TumblingEventTimeWindows
+    from flink_tpu.config import (
+        Configuration,
+        ExecutionOptions,
+        ObservabilityOptions,
+    )
+    from flink_tpu.core.watermarks import WatermarkStrategy
+    from flink_tpu.graph.transformation import plan
+    from flink_tpu.runtime.minicluster import JobStatus, MiniCluster
+    from flink_tpu.runtime.rest import RestServer
+
+    conf = Configuration()
+    conf.set(ExecutionOptions.BATCH_SIZE, 32)
+    conf.set(ObservabilityOptions.HISTORY_INTERVAL_MS, 1)
+    env = StreamExecutionEnvironment(conf)
+    (env.from_collection(
+        [(f"k{i % 4}", i * 100) for i in range(512)],
+        timestamp_fn=lambda x: x[1],
+        watermark_strategy=WatermarkStrategy.for_monotonous_timestamps())
+        .key_by(lambda x: x[0])
+        .window(TumblingEventTimeWindows.of(1000))
+        .count()
+        .collect())
+    cluster = MiniCluster()
+    client = cluster.submit(plan(env._sinks), conf, "history-job")
+    assert client.wait(60) == JobStatus.FINISHED
+    server = RestServer(cluster).start()
+    try:
+        base = f"{server.url}/jobs/{client.job_id}"
+        hist = _get_json(f"{base}/history")
+        assert hist["enabled"] and hist["sample_count"] >= 2
+        series = hist["series"]
+        assert series, "history rings empty over REST"
+        # counters surface as counter-rate series
+        rates = [k for k, s in series.items()
+                 if s["kind"] == "counter-rate"]
+        assert any(k.endswith("numRecordsIn") for k in rates)
+        # metric= filters to the family, since= drops old points
+        only = _get_json(f"{base}/history?metric=numRecordsIn")
+        assert only["series"] and all("numRecordsIn" in k
+                                      for k in only["series"])
+        t_latest = max(p[0] for s in series.values() for p in s["points"])
+        recent = _get_json(f"{base}/history?since={t_latest}")
+        assert all(len(s["points"]) <= 1 for s in recent["series"].values())
+        # malformed since is a 400, not a 500 or a silent full dump
+        with pytest.raises(urllib.error.HTTPError) as exc:
+            _get_json(f"{base}/history?since=abc")
+        assert exc.value.code == 400
+
+        doc = _get_json(f"{base}/doctor")
+        assert doc["verdict"] != "unknown"
+        assert "diagnoses" in doc and "watchdog_events" in doc
+        # client-side reports match the REST payloads' shape
+        assert client.history_report()["sample_count"] == \
+            hist["sample_count"]
+        assert client.doctor_report()["verdict"] == doc["verdict"]
+    finally:
+        server.stop()
+
+
+class _SlowBatches(list):
+    """Per-access delay so the JM schedule tick observes RUNNING state
+    (the distributed path's processing-time tick) several times."""
+
+    def __init__(self, batches, delay):
+        super().__init__(batches)
+        self._delay = delay
+
+    def __getitem__(self, i):
+        time.sleep(self._delay)
+        return super().__getitem__(i)
+
+
+def test_distributed_jm_history_and_doctor_over_rest_bridge(tmp_path):
+    from flink_tpu.api.windowing.assigners import TumblingEventTimeWindows
+    from flink_tpu.runtime.cluster import (
+        DistributedJobSpec,
+        JobManagerEndpoint,
+        TaskExecutorEndpoint,
+    )
+    from flink_tpu.runtime.minicluster import MiniCluster
+    from flink_tpu.runtime.rest import RestServer
+    from flink_tpu.runtime.rpc import RpcService
+
+    def source_factory(shard, num_shards):
+        rng = np.random.default_rng(3 + shard)
+        batches = [((rng.integers(0, 4, 16)).astype(np.int64),
+                    np.ones(16, dtype=np.float64),
+                    (s * 500 + rng.integers(0, 500, 16)).astype(np.int64),
+                    s * 500 + 250) for s in range(14)]
+        return _SlowBatches(batches, delay=0.1)
+
+    spec = DistributedJobSpec(
+        name="history-bridge", source_factory=source_factory,
+        assigner=TumblingEventTimeWindows.of(2000), aggregate="sum",
+        max_parallelism=16,
+    )
+    svc_jm, svc_tm = RpcService(), RpcService()
+    jm = JobManagerEndpoint(
+        svc_jm, checkpoint_dir=str(tmp_path / "chk"),
+        restart_delay=0.1, heartbeat_interval=0.2,
+        history_interval_ms=50,
+    )
+    te = TaskExecutorEndpoint(svc_tm, slots=1)
+    te.connect(svc_jm.address)
+    client = svc_jm.gateway(svc_jm.address, "jobmanager")
+    job_id = client.submit_job(spec.to_bytes(), 1)
+    deadline = time.time() + 60
+    while time.time() < deadline:
+        if client.job_status(job_id)["status"] in ("FINISHED", "FAILED"):
+            break
+        time.sleep(0.1)
+    assert client.job_status(job_id)["status"] == "FINISHED"
+
+    server = RestServer(MiniCluster(),
+                        jm_gateway=svc_jm.gateway(svc_jm.address,
+                                                  "jobmanager")).start()
+    try:
+        hist = _get_json(f"{server.url}/jobs/{job_id}/history")
+        assert hist["enabled"] and hist["sample_count"] >= 1
+        assert hist["series"], \
+            "JM-path history rings empty over the REST bridge"
+        # the JM samples shard-FOLDED snapshots; counter families arrive
+        # as rates exactly like the MiniCluster path
+        if hist["sample_count"] >= 2:
+            assert any(s["kind"] == "counter-rate"
+                       for s in hist["series"].values())
+        doc = _get_json(f"{server.url}/jobs/{job_id}/doctor")
+        assert doc["verdict"] != "unknown"
+        assert doc["samples"] == hist["sample_count"]
+    finally:
+        server.stop()
+        te.stop()
+        jm.heartbeats.stop()
+        svc_jm.stop()
+        svc_tm.stop()
