@@ -2677,6 +2677,34 @@ def run_health_child(timeout_s: float = 300.0) -> dict:
     return _run_cpu_child('health', timeout_s)
 
 
+def lint_summary() -> dict:
+    """Full-registry lint over the installed package, timed — the
+    `lint: {modules, rules, violations, analysis_ms}` block stamped into
+    every BENCH_*.json next to `health`. Runs in-process (pure AST, no
+    device), against the checked-in baseline so `violations` counts
+    ACTIVE findings, not justified debt."""
+    t0 = time.perf_counter()
+    try:
+        import pathlib
+
+        import flink_tpu
+        from flink_tpu.lint import Baseline, run_lint
+
+        pkg = pathlib.Path(flink_tpu.__file__).parent
+        bl_path = pkg.parent / "lint_baseline.json"
+        baseline = Baseline.load(bl_path) if bl_path.exists() else None
+        report = run_lint(pkg, baseline=baseline)
+        return {
+            "modules": report.modules_scanned,
+            "rules": len(report.rules),
+            "violations": len(report.violations),
+            "analysis_ms": round((time.perf_counter() - t0) * 1e3, 1),
+        }
+    except Exception as e:  # noqa: BLE001 — the stamp must never sink a run
+        return {"error": f"{type(e).__name__}: {e}",
+                "analysis_ms": round((time.perf_counter() - t0) * 1e3, 1)}
+
+
 def child_sql_path() -> None:
     """SQL-path child: CPU-pinned like child_api_path — the three-way
     comparison is CPU-jit vs CPU-jit (same backend all paths), and the
@@ -3928,6 +3956,13 @@ def parent_main() -> None:
     health = run_health_child()
     _emit({"event": "health_microbench", "result": health})
 
+    # static-analysis plane (ISSUE-20 acceptance): the full 16-rule lint
+    # run rides every artifact next to health — a PR that regresses the
+    # analyzer's coverage or leaves active violations shows up in the
+    # trajectory, not just in CI
+    lint_info = lint_summary()
+    _emit({"event": "lint_summary", "result": lint_info})
+
     def consider(res, rank):
         nonlocal best, best_rank
         if res is None:
@@ -3981,6 +4016,9 @@ def parent_main() -> None:
             # health block (ISSUE-19 acceptance): the doctor's verdict and
             # the sampler's measured overhead ride every artifact
             best["health"] = health
+            # lint block (ISSUE-20 acceptance): the exactly-once contract
+            # analyzer's verdict on the tree, timed
+            best["lint"] = lint_info
             # first-class join keys (ISSUE-16 acceptance): the q8 device
             # throughput and its ratio to the host join oracle — the
             # >= 20x bar is judged where this lands on real TPU hardware

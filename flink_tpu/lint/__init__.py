@@ -10,10 +10,20 @@ built on the Python AST:
 - ``rule``      — :class:`Rule` base class, :class:`Violation`, the registry
 - ``locks``     — per-class lock model (lock attrs, guarded regions,
                   nested acquisitions) consumed by the concurrency rules
+- ``contracts`` — the exactly-once declaration vocabulary
+                  (``@inflight_ring`` / ``@drains`` / ``@absorbs_faults``):
+                  behavior-neutral runtime decorators plus the AST-side
+                  extraction the analyzer reads them back with
+- ``dataflow``  — interprocedural summary layer (self-call chains to
+                  MAX_COMPOSE_DEPTH, jit-option inputs, cache sites,
+                  fault-carrying fixpoint) shared by the EXON rules via
+                  :meth:`DataflowIndex.shared`
 - ``rules_concurrency`` / ``rules_device`` / ``rules_wire`` /
-  ``rules_architecture`` — the three rule families (CONC/DEV/WIRE+ARCH+DOC)
+  ``rules_architecture`` / ``rules_exactly_once`` — the rule families
+  (CONC/DEV/WIRE+ARCH+DOC/EXON), sixteen rules total
 - ``baseline``  — frozen-violation store; every entry carries a written
-                  justification or the engine refuses it
+                  justification or the engine refuses it, with stale-entry
+                  auto-prune for retired rules and deleted files
 - ``engine``    — runs the registry over an index, applies the baseline
 - ``cli``       — ``python -m flink_tpu.lint`` with text/JSON/SARIF output
 

@@ -23,7 +23,7 @@ from __future__ import annotations
 import dataclasses
 import json
 import pathlib
-from typing import Dict, Iterable, List, Optional
+from typing import Dict, Iterable, List, Optional, Sequence, Tuple
 
 BASELINE_VERSION = 1
 TODO_MARKER = "TODO"
@@ -97,6 +97,36 @@ class Baseline:
         if entry is not None:
             self._matched.add(entry.fingerprint)
         return entry
+
+    def prune_stale(self, project_root,
+                    known_rule_ids: Sequence[str]
+                    ) -> List[Tuple[BaselineEntry, str]]:
+        """Drop entries that can never match again — their file is gone
+        or their rule id is no longer registered — and return the pruned
+        ``(entry, reason)`` pairs so the caller can warn (and rewrite the
+        file with :meth:`save` under ``--prune-baseline``).
+
+        Distinct from :meth:`stale_entries`: that catches *fixed* debt
+        after a run (fingerprint reported by no rule), which is an engine
+        error demanding human attention; this catches entries that
+        structurally cannot match (deleted file, retired rule), which
+        previously were carried forever because the engine error pointed
+        at a file nobody could re-lint."""
+        root = pathlib.Path(project_root)
+        known = set(known_rule_ids)
+        pruned: List[Tuple[BaselineEntry, str]] = []
+        kept: List[BaselineEntry] = []
+        for e in self.entries:
+            if e.rule not in known:
+                pruned.append((e, f"unknown rule {e.rule!r}"))
+            elif not (root / e.path).exists():
+                pruned.append((e, f"file {e.path} no longer exists"))
+            else:
+                kept.append(e)
+        if pruned:
+            self.entries = kept
+            self._by_fp = {e.fingerprint: e for e in kept}
+        return pruned
 
     def stale_entries(self) -> List[BaselineEntry]:
         """Entries whose violation no rule reports anymore — fixed debt

@@ -60,6 +60,11 @@ def build_parser() -> argparse.ArgumentParser:
                    help="add entries (justification=TODO) for all current "
                         "violations, then exit 0; the engine fails until "
                         "each TODO is replaced with a real justification")
+    p.add_argument("--prune-baseline", action="store_true",
+                   help="rewrite the baseline file without entries whose "
+                        "file no longer exists or whose rule id is unknown "
+                        "(pruning always happens in memory with a warning; "
+                        "this flag persists it)")
     p.add_argument("--rule", action="append", default=None, metavar="ID",
                    help="run only this rule id/name (repeatable)")
     p.add_argument("--list-rules", action="store_true",
@@ -153,12 +158,13 @@ def main(argv: Optional[List[str]] = None) -> int:
             print(f"{r.id}  {r.name:28s} [{r.family}]")
         return EXIT_CLEAN
 
-    if args.no_baseline and args.write_baseline:
+    if args.no_baseline and (args.write_baseline or args.prune_baseline):
         # --write-baseline must MERGE into the existing file; with
         # --no-baseline it would rebuild from empty and overwrite every
-        # human-written justification
-        print("error: --no-baseline and --write-baseline are mutually "
-              "exclusive", file=sys.stderr)
+        # human-written justification. --prune-baseline has nothing to
+        # prune when the baseline is ignored.
+        print("error: --no-baseline is mutually exclusive with "
+              "--write-baseline / --prune-baseline", file=sys.stderr)
         return EXIT_BASELINE_ERROR
 
     root = pathlib.Path(args.root) if args.root else _default_root()
@@ -180,6 +186,19 @@ def main(argv: Optional[List[str]] = None) -> int:
     if not args.no_baseline:
         if baseline_path.exists():
             baseline = Baseline.load(baseline_path)
+            # entries that can never match again (deleted file, retired
+            # rule) are dropped up front — otherwise the engine's stale
+            # check reports them forever against a file nobody can re-lint
+            pruned = baseline.prune_stale(
+                baseline_path.parent, [r.id for r in all_rules()])
+            for entry, reason in pruned:
+                print(f"baseline: pruned stale entry {entry.fingerprint} "
+                      f"({reason})", file=sys.stderr)
+            if args.prune_baseline:
+                baseline.save(baseline_path)
+                print(f"rewrote {baseline_path}: {len(pruned)} stale entr"
+                      f"{'y' if len(pruned) == 1 else 'ies'} removed, "
+                      f"{len(baseline)} kept", file=sys.stderr)
         elif args.write_baseline:
             baseline = Baseline(path=baseline_path)
         elif args.baseline:

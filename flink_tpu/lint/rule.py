@@ -78,6 +78,7 @@ _RULE_MODULES = (
     "flink_tpu.lint.rules_device",
     "flink_tpu.lint.rules_wire",
     "flink_tpu.lint.rules_architecture",
+    "flink_tpu.lint.rules_exactly_once",
 )
 
 

@@ -42,6 +42,7 @@ from typing import Any, Callable, Dict, List, Optional, Tuple
 import numpy as np
 
 from flink_tpu.chaos import plan as _chaos
+from flink_tpu.lint.contracts import absorbs_faults
 
 _LOG = logging.getLogger(__name__)
 
@@ -613,6 +614,7 @@ class JobManagerEndpoint(RpcEndpoint):
                 threading.Thread(target=self._autoscaler_loop, daemon=True,
                                  name="autoscaler").start()
 
+    @absorbs_faults('background autoscaler tick: a failed tick is logged and retried next interval; job failover, not this timer thread, owns fault propagation')
     def _autoscaler_loop(self) -> None:
         while not self._stopped.wait(self._autoscaler_interval):
             try:
@@ -638,6 +640,7 @@ class JobManagerEndpoint(RpcEndpoint):
                               job.spec_max_parallelism),
             )
 
+    @absorbs_faults('JM schedule tick: a failed tick is logged and the loop retries; task failures surface through the failover path, not this timer thread')
     def _schedule_loop(self) -> None:
         while not self._stopped.wait(max(self.restart_delay, 0.2)):
             try:
@@ -650,6 +653,7 @@ class JobManagerEndpoint(RpcEndpoint):
         self._watchdog_tick()
         self._history_tick()
 
+    @absorbs_faults('metrics history sampling is best-effort observability; a failed sample must not take down the scheduler tick')
     def _history_tick(self) -> None:
         """Sample each RUNNING job's shard-folded snapshot into its
         history rings (JM main thread, riding the existing schedule tick
@@ -1552,6 +1556,7 @@ class JobManagerEndpoint(RpcEndpoint):
                        trig_t0, checkpointId=cp_id)
         return cp_id
 
+    @absorbs_faults('savepoint write failure is recorded in job.failed_savepoints and reported; re-raising on the RPC thread would kill the JM endpoint, not surface the checkpoint failure')
     def ack_checkpoint(self, job_id: str, attempt: int, shard: int,
                        checkpoint_id: int, snapshot: dict) -> None:
         job = self._jobs.get(job_id)
@@ -1701,6 +1706,7 @@ class JobManagerEndpoint(RpcEndpoint):
             # instead of re-triggering at RPC speed forever
             job.failed_savepoints.append(f"{path}: {reason}")
 
+    @absorbs_faults("checkpoint trigger timer: a failed trigger is logged and retried next interval; the coordinator's decline/timeout path owns checkpoint-failure semantics")
     def _checkpoint_loop(self) -> None:
         while True:
             time.sleep(self.checkpoint_interval)
@@ -1812,6 +1818,7 @@ class _ShardTask:
         # the JM main thread is blocked in its trigger RPC to us, so a
         # synchronous jm.decline_checkpoint here is a circular RPC wait
         # (JM-main -> TM-main -> JM-main) that deadlocks both processes.
+        @absorbs_faults("best-effort decline for an already-finished task; the JM's checkpoint timeout covers a lost decline")
         def _decline():
             try:
                 self.jm.decline_checkpoint(
@@ -1843,6 +1850,7 @@ class _ShardTask:
                 self.job_id, self.restore_local_cp, self.shard
             )
 
+    @absorbs_faults('stage failover boundary: the failure is reported to the JM as task FAILED and rides the normal restart path — which is exactly where the chaos contract routes injected faults')
     def _run_graph_stage(self) -> None:
         """One stage of a slot-sharing-group-split StepGraph (this task's
         shard index = stage index). The stage's sub-graph runs as a normal
@@ -2048,6 +2056,7 @@ class _ShardTask:
     def _channel_id(self, src: int) -> str:
         return f"{self.job_id}/a{self.attempt}/{src}->{self.shard}"
 
+    @absorbs_faults('task failover boundary: the exception is reported to the JM as task FAILED and rides the restart path; injected faults surfacing as task failure IS the chaos model')
     def _run_safe(self) -> None:
         try:
             self._run()
@@ -2125,6 +2134,7 @@ class _ShardTask:
             key_group_range=kg_range,
         )
 
+    @absorbs_faults('per-record send/close handlers inside the task body feed the same failover boundary as _run_safe: failures surface as task FAILED and ride the restart path')
     def _run(self) -> None:
         if isinstance(self.spec, GraphJobSpec):
             from flink_tpu.runtime.stages import num_stages
@@ -2528,6 +2538,7 @@ class TaskExecutorEndpoint(RpcEndpoint):
                                                name=f"hb-{self.tm_id}")
             self._hb_thread.start()
 
+    @absorbs_faults('heartbeat sender: a failed beat is retried next interval and the JM-side liveness timeout owns the death verdict; re-raising would kill the beat thread and falsify liveness')
     def _hb_loop(self) -> None:
         # beat at least every 0.5s (liveness), faster when the shipping
         # interval asks for fresher metric/step snapshots — a sub-500ms

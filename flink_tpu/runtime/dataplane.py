@@ -49,6 +49,7 @@ from collections import deque
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
 from flink_tpu.chaos import plan as _chaos
+from flink_tpu.lint.contracts import absorbs_faults
 from flink_tpu.metrics.registry import Meter
 from flink_tpu.security.framing import FrameAuthError, RestrictedUnpicklingError
 from flink_tpu.security.transport import (
@@ -209,6 +210,7 @@ class ExchangeServer:
         server_self = self
 
         class Handler(socketserver.BaseRequestHandler):
+            @absorbs_faults('exchange server connection thread: disconnects and injected crashes sever the connection — returning models peer death; the consumer surfaces the stall via its channel-failure path')
             def handle(self):
                 sock = self.request
                 try:
@@ -390,6 +392,7 @@ class OutputChannel:
             self.bytes_out += n
             self._out_meter.mark(n)
 
+    @absorbs_faults('credit listener: a broken credit socket wakes the sender with channel-closed, which surfaces as a send failure on the task thread — this loop has no caller to re-raise to')
     def _credit_loop(self, sock, codec) -> None:
         while True:
             try:

@@ -41,6 +41,7 @@ import numpy as np
 
 from flink_tpu.api.windowing.assigners import WindowAssigner
 from flink_tpu.core.time import MAX_WATERMARK, MIN_WATERMARK
+from flink_tpu.lint.contracts import inflight_ring
 from flink_tpu.ops.aggregators import ONE, VALUE, resolve
 from flink_tpu.runtime.fused_window_pipeline import FusedWindowPipeline
 from flink_tpu.scheduler.latency_controller import (
@@ -431,6 +432,7 @@ class SharedStepNormalizer(StepNormalizer):
         self.fire_cursors = list(snap["fire_cursors"])
 
 
+@inflight_ring("_inflight", drained_by="_resolve_inflight")
 class FusedWindowOperator:
     """Operator-boundary adapter: same surface as TpuWindowOperator, fused
     superbatch execution underneath. One outstanding dispatch is kept in

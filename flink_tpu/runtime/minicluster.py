@@ -28,6 +28,7 @@ from flink_tpu.checkpoint.storage import (
     MemoryCheckpointStorage,
 )
 from flink_tpu.config import CheckpointingOptions, Configuration, ParallelOptions
+from flink_tpu.lint.contracts import absorbs_faults
 from flink_tpu.graph.transformation import StepGraph
 from flink_tpu.runtime.executor import (
     JobCancelledException,
@@ -348,6 +349,7 @@ class MiniCluster:
             if installed_chaos and _chaos.active_plan() is chaos_plan:
                 _chaos.uninstall_plan()
 
+    @absorbs_faults('driver failover boundary: the caught failure increments the attempt counter and re-runs the job per the restart strategy; injected faults ride this path by design')
     def _run_job_inner(
         self,
         client: JobClient,

@@ -36,6 +36,7 @@ from concurrent.futures import Future
 from typing import Any, Callable, Dict, FrozenSet, Optional
 
 from flink_tpu.chaos import plan as _chaos
+from flink_tpu.lint.contracts import absorbs_faults
 
 
 # ---------------------------------------------------------------------------
@@ -162,6 +163,7 @@ class RpcService:
         service = self
 
         class Handler(socketserver.BaseRequestHandler):
+            @absorbs_faults("RPC server loop: handler errors ship back to the caller as failed replies; the crash model (sever the connection, no reply) is implemented at the seam's own InjectedCrash handler")
             def handle(self):
                 sock = self.request
                 codec = None

@@ -16,6 +16,7 @@ import jax
 from jax.sharding import Mesh
 
 from flink_tpu.core.keygroups import key_groups_for_hashes
+from flink_tpu.lint.contracts import inflight_ring
 from flink_tpu.core.records import hash_keys
 from flink_tpu.ops import segment_ops
 from flink_tpu.parallel.mesh import SHARD_AXIS
@@ -24,6 +25,7 @@ from flink_tpu.runtime.tpu_window_operator import TpuWindowOperator
 from flink_tpu.state.columnar import KeyDictionary, RingFrontiers
 
 
+@inflight_ring("_pending", drained_by="flush")
 class ShardedTpuWindowOperator(TpuWindowOperator):
     """Host-routed multi-shard operator; inherits all window/slice math and
     the watermark protocol from the single-shard operator, overriding the

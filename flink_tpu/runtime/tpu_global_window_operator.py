@@ -26,6 +26,7 @@ import numpy as np
 from flink_tpu.api.windowing.assigners import GlobalWindow, GlobalWindows
 from flink_tpu.api.windowing.triggers import CountTrigger, PurgingTrigger, Trigger
 from flink_tpu.core.time import MAX_WATERMARK, MIN_WATERMARK
+from flink_tpu.lint.contracts import inflight_ring
 from flink_tpu.ops import segment_ops
 from flink_tpu.ops.aggregators import DeviceAggregator, ONE, resolve
 from flink_tpu.state.columnar import KeyDictionary
@@ -76,6 +77,7 @@ def supported_trigger(trigger) -> Optional[Tuple[int, bool]]:
     return None
 
 
+@inflight_ring("_pending", drained_by="flush")
 class TpuGlobalWindowOperator:
     """Duck-types the window-operator runner interface."""
 

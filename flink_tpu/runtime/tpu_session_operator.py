@@ -40,6 +40,7 @@ import numpy as np
 
 from flink_tpu.api.windowing.assigners import EventTimeSessionWindows
 from flink_tpu.core.time import MIN_WATERMARK, TimeWindow
+from flink_tpu.lint.contracts import inflight_ring
 from flink_tpu.ops.aggregators import DeviceAggregator, VALUE, resolve
 from flink_tpu.state.columnar import KeyDictionary
 
@@ -201,6 +202,7 @@ def _build_purge(K: int, S: int, nf: int, idents: tuple, dts: tuple, g: int):
     return jax.jit(run)
 
 
+@inflight_ring("_pending", drained_by="_resolve_pending")
 class TpuSessionWindowOperator:
     """One shard's keyed session-window aggregation on one device."""
 

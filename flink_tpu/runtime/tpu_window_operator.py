@@ -37,6 +37,7 @@ import numpy as np
 from flink_tpu.api.windowing.assigners import WindowAssigner
 from flink_tpu.api.functions import LATE_DATA_TAG
 from flink_tpu.core.time import MIN_WATERMARK, TimeWindow
+from flink_tpu.lint.contracts import inflight_ring
 from flink_tpu.ops import segment_ops
 from flink_tpu.ops.aggregators import DeviceAggregator, resolve
 from flink_tpu.state.columnar import ColumnarWindowState
@@ -46,6 +47,7 @@ def _ceil_div(a: int, b: int) -> int:
     return -((-a) // b)
 
 
+@inflight_ring("_pending", drained_by="flush")
 class TpuWindowOperator:
     """One operator instance (one shard's key space) on one device."""
 

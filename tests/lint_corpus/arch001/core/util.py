@@ -1,0 +1,6 @@
+# SEEDED: core layer imports the runtime at module level
+from arch001.runtime import executor
+
+
+def run(job):
+    return executor.run_local(job)

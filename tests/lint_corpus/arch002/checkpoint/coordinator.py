@@ -1,0 +1,5 @@
+def restore_and_run(path):
+    # SEEDED: even a lazy runtime import inverts the dependency
+    from arch002.runtime.executor import run_local
+
+    return run_local(path)

@@ -1,0 +1,15 @@
+import threading
+
+
+class Counter:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._count = 0
+
+    def add(self, n):
+        with self._lock:
+            self._count += n
+
+    def reset(self):
+        # SEEDED: bare write races add()'s locked write
+        self._count = 0

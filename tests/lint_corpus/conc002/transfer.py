@@ -1,0 +1,18 @@
+import threading
+
+
+class Transfer:
+    def __init__(self):
+        self._accounts = threading.Lock()
+        self._journal = threading.Lock()
+
+    def debit(self):
+        with self._accounts:
+            with self._journal:
+                return 1
+
+    def audit(self):
+        # SEEDED: opposite acquisition order -> static lock-order cycle
+        with self._journal:
+            with self._accounts:
+                return 2

@@ -1,0 +1,14 @@
+import threading
+import time
+
+
+class Poller:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._last = 0.0
+
+    def poll(self):
+        with self._lock:
+            # SEEDED: every contender waits out the full sleep
+            time.sleep(0.1)
+            self._last = time.time()

@@ -1,0 +1,8 @@
+import threading
+
+
+def spawn(fn):
+    # SEEDED: unnamed, implicitly non-daemon thread
+    t = threading.Thread(target=fn)
+    t.start()
+    return t

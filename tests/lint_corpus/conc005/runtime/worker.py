@@ -1,0 +1,6 @@
+def best_effort_ping(sock):
+    try:
+        sock.send(b"ping")
+    except Exception:
+        # SEEDED: silent blanket swallow on the runtime plane
+        pass

@@ -1,0 +1,9 @@
+import jax
+import jax.numpy as jnp
+
+
+@jax.jit
+def bad_mean(x):
+    total = jnp.sum(x)
+    # SEEDED: .item() forces a device->host readback per call
+    return total.item()

@@ -1,0 +1,10 @@
+import jax
+
+
+def run_epoch(batches, fn):
+    outs = []
+    for batch in batches:
+        # SEEDED: a fresh compiled callable per iteration
+        step = jax.jit(fn)
+        outs.append(step(batch))
+    return outs

@@ -1,0 +1,6 @@
+# SEEDED: control-plane module imports jax at module level
+import jax
+
+
+def plan_slots(n):
+    return jax.device_count() + n

@@ -1,0 +1,8 @@
+class ConfigOptions:
+    @staticmethod
+    def key(name):
+        return name
+
+
+# SEEDED: no docs/configuration.md exists next to this corpus package
+UNDOCUMENTED = ConfigOptions.key("corpus.undocumented.option")

@@ -1,0 +1,43 @@
+"""Corpus twin of FusedWindowOperator with the drain call removed."""
+from collections import deque
+
+from flink_tpu.lint.contracts import inflight_ring
+
+
+@inflight_ring("_inflight", drained_by="_resolve_inflight")
+class BadFusedOperator:
+    def __init__(self):
+        self._inflight = deque()
+        self._state = {}
+
+    def dispatch(self, batch):
+        self._inflight.append(batch)
+
+    def _resolve_inflight(self):
+        while self._inflight:
+            self._state.update(self._inflight.popleft())
+
+    def flush_all(self):
+        # SEEDED MUTATION: the real operator calls self._resolve_inflight()
+        # here; without it the snapshot captures a cut that silently drops
+        # everything still in the dispatch ring
+        return dict(self._state)
+
+    def snapshot(self):
+        self.flush_all()
+        return dict(self._state)
+
+
+class UndeclaredOperator:
+    """Captures checkpoint state while owning an UNDECLARED in-flight
+    container — the analyzer cannot verify what was never declared."""
+
+    def __init__(self):
+        self._pending = []
+        self._state = {}
+
+    def enqueue(self, item):
+        self._pending.append(item)
+
+    def snapshot(self):
+        return dict(self._state)

@@ -1,0 +1,54 @@
+"""Control twins: the same operators with the contract intact."""
+from collections import deque
+
+from flink_tpu.lint.contracts import inflight_ring
+
+
+@inflight_ring("_inflight", drained_by="_resolve_inflight")
+class GoodFusedOperator:
+    """snapshot -> flush_all -> _resolve_inflight: the drain is reached
+    through the self-call chain, not directly — proves the
+    interprocedural composition, not just lexical matching."""
+
+    def __init__(self):
+        self._inflight = deque()
+        self._state = {}
+        self._future_batches = []      # held records: legally RIDE the cut
+
+    def dispatch(self, batch):
+        self._inflight.append(batch)
+
+    def _resolve_inflight(self):
+        while self._inflight:
+            self._state.update(self._inflight.popleft())
+
+    def flush_all(self):
+        self._resolve_inflight()
+        return dict(self._state)
+
+    def snapshot(self):
+        self.flush_all()
+        return dict(self._state)
+
+
+@inflight_ring("_pending", drained_by="_resolve_pending")
+class GoodGuardedOperator:
+    """`if self._pending: drain()` — a guard that tests only the ring
+    itself still dominates the capture."""
+
+    def __init__(self):
+        self._pending = []
+        self._state = {}
+
+    def enqueue(self, item):
+        self._pending.append(item)
+
+    def _resolve_pending(self):
+        for item in self._pending:
+            self._state.update(item)
+        self._pending.clear()
+
+    def snapshot(self):
+        if self._pending:
+            self._resolve_pending()
+        return dict(self._state)

@@ -1,0 +1,35 @@
+"""Corpus twins of the PR-17 donation bug: a jit option input missing
+from the executable cache key."""
+import functools
+
+import jax
+
+_BACKEND = "cpu"
+
+
+def _kernel(x):
+    return x
+
+
+@functools.lru_cache(maxsize=None)
+def build_step(shape, dtype):
+    # SEEDED MUTATION: _BACKEND flows into jit options but is not a
+    # parameter — the functools cache key cannot see a backend flip
+    return jax.jit(_kernel, backend=_BACKEND, static_argnums=(0,))
+
+
+class BadStepCache:
+    def __init__(self, donate):
+        self._donate = donate
+        self._cache = {}
+
+    def get(self, fn, shape, dtype):
+        # SEEDED MUTATION: key omits self._donate, which selects the
+        # donation calling convention — a flip serves an executable that
+        # frees (or fails to free) the wrong buffers
+        key = (shape, dtype)
+        if key in self._cache:
+            return self._cache[key]
+        step = jax.jit(fn, donate_argnums=(0,) if self._donate else ())
+        self._cache[key] = step
+        return step

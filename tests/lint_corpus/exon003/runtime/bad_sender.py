@@ -1,0 +1,20 @@
+"""Corpus twin of a dataplane seam whose retry loop eats the fault."""
+from exon003.chaos import plan as _chaos
+
+
+def send_batch(sock, payload):
+    hook = _chaos.HOOK
+    if hook is not None:
+        hook("dataplane", "send")      # the fault seam
+    sock.sendall(payload)
+
+
+def retry_once(sock, payload):
+    try:
+        send_batch(sock, payload)
+    except OSError:
+        # SEEDED MUTATION: OSError catches InjectedCrash (a
+        # ConnectionError subclass) — injected process death becomes a
+        # soft retry and the chaos test passes vacuously
+        return False
+    return True

@@ -1,0 +1,37 @@
+"""Control twins: the fault stays transparent through the handler."""
+from exon003.chaos import plan as _chaos
+from flink_tpu.lint.contracts import absorbs_faults
+
+
+def send_batch(sock, payload):
+    hook = _chaos.HOOK
+    if hook is not None:
+        hook("dataplane", "send")      # the fault seam
+    sock.sendall(payload)
+
+
+def retry_once(sock, payload):
+    try:
+        send_batch(sock, payload)
+    except _chaos.InjectedCrash:
+        raise                           # chaos stays loud
+    except OSError:
+        return False
+    return True
+
+
+def rethrow(sock, payload):
+    try:
+        send_batch(sock, payload)
+    except OSError as e:
+        raise RuntimeError("send failed") from e
+
+
+@absorbs_faults("corpus control: absorption IS this helper's contract — "
+                "the caller treats any failure as peer death")
+def allowlisted(sock, payload):
+    try:
+        send_batch(sock, payload)
+    except OSError:
+        return False
+    return True

@@ -1,0 +1,6 @@
+import pickle
+
+
+def decode_frame(payload):
+    # SEEDED: raw deserialization of socket-originated bytes
+    return pickle.loads(payload)

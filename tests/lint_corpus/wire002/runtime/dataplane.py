@@ -1,0 +1,6 @@
+# SEEDED: the dataplane module serializes payloads itself
+import pickle
+
+
+def ship_batch(sock, batch):
+    sock.sendall(pickle.dumps(batch))

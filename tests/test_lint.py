@@ -307,6 +307,45 @@ def test_sarif_output_matches_golden(capsys):
     assert uris == {"samplepkg/worker.py", "samplepkg/runtime/blob.py"}
 
 
+def test_sarif_output_validates_against_2_1_0_schema(capsys):
+    """The emitted SARIF must satisfy the 2.1.0 schema (vendored subset:
+    the official OASIS schema's required/enum/type constraints for every
+    object we produce — CI has no network to fetch the full file)."""
+    import jsonschema
+
+    rc = lint_main([str(SAMPLE_PKG), "--no-baseline",
+                    "--rule", "CONC004", "--rule", "WIRE001",
+                    "--format", "sarif"])
+    out = capsys.readouterr().out
+    assert rc == EXIT_VIOLATIONS
+    doc = json.loads(out)
+    schema = json.loads(
+        (FIXTURES / "sarif-schema-2.1.0.subset.json").read_text())
+    jsonschema.validate(doc, schema)          # raises on violation
+
+    # the schema subset must not be vacuous: each of these mutations is
+    # illegal under the real 2.1.0 schema and must be rejected here too
+    for mutate in (
+        lambda d: d.update(version="3.0.0"),
+        lambda d: d.pop("runs"),
+        lambda d: d["runs"][0].pop("tool"),
+        lambda d: d["runs"][0]["tool"]["driver"].pop("name"),
+        lambda d: d["runs"][0]["results"][0].pop("message"),
+        lambda d: d["runs"][0]["results"][0].update(level="fatal"),
+        lambda d: d["runs"][0]["results"][0]["locations"][0]
+        ["physicalLocation"]["region"].update(startLine=0),
+    ):
+        broken = json.loads(out)
+        mutate(broken)
+        try:
+            jsonschema.validate(broken, schema)
+        except jsonschema.ValidationError:
+            pass
+        else:
+            raise AssertionError(
+                f"schema subset accepted an illegal mutation: {mutate}")
+
+
 # ---------------------------------------------------------------------------
 # 3. baseline lifecycle: add -> suppress -> remove -> fail
 # ---------------------------------------------------------------------------
@@ -426,6 +465,80 @@ def test_fingerprints_survive_line_churn(tmp_path):
     report = run_lint(root)
     assert report.violations[0].fingerprint == fp_before
     assert report.violations[0].line == line_before + 2  # line moved; fp did not
+
+
+def _baseline_with_stale_entries(tmp_path) -> pathlib.Path:
+    """A baseline holding one live entry, one whose file is gone, and one
+    whose rule id was retired."""
+    root = _write_violating_pkg(tmp_path)
+    bl_path = tmp_path / "lint_baseline.json"
+    report = run_lint(root)
+    baseline = Baseline(path=bl_path)
+    baseline.add(report.violations[0], justification="documented debt")
+    baseline.entries.append(type(baseline.entries[0])(
+        rule="CONC004", path="vpkg/deleted_module.py", scope="gone",
+        symbol="thread@gone", justification="file was deleted in PR 12"))
+    baseline.entries.append(type(baseline.entries[0])(
+        rule="ZZZZ999", path="vpkg/w.py", scope="spawn",
+        symbol="whatever", justification="rule was retired"))
+    baseline.save()
+    return root
+
+
+def test_prune_stale_drops_missing_file_and_unknown_rule(tmp_path):
+    """Entries whose file no longer exists or whose rule id is unknown
+    were previously carried forever (the stale check reports them as
+    engine errors against a file nobody can re-lint); prune_stale drops
+    exactly those and keeps the live entry."""
+    root = _baseline_with_stale_entries(tmp_path)
+    baseline = Baseline.load(tmp_path / "lint_baseline.json")
+    assert len(baseline) == 3
+
+    pruned = baseline.prune_stale(tmp_path, [r.id for r in all_rules()])
+    reasons = sorted(reason for _, reason in pruned)
+    assert len(pruned) == 2
+    assert any("no longer exists" in r for r in reasons)
+    assert any("unknown rule" in r for r in reasons)
+    assert len(baseline) == 1
+    assert baseline.entries[0].path.endswith("w.py")
+
+    # the pruned baseline still suppresses the live violation
+    report = run_lint(root, baseline=baseline)
+    assert report.exit_code == EXIT_CLEAN
+    assert len(report.suppressed) == 1
+
+
+def test_cli_prune_baseline_rewrites_the_file(tmp_path, capsys):
+    root = _baseline_with_stale_entries(tmp_path)
+    bl_path = tmp_path / "lint_baseline.json"
+
+    # without the flag: pruned in memory (run is clean, warning printed)
+    # but the file keeps all three entries
+    assert lint_main([str(root), "--baseline", str(bl_path)]) == EXIT_CLEAN
+    err = capsys.readouterr().err
+    assert err.count("pruned stale entry") == 2
+    assert len(json.loads(bl_path.read_text())["entries"]) == 3
+
+    # with the flag: the file is rewritten without the stale entries
+    assert lint_main([str(root), "--baseline", str(bl_path),
+                      "--prune-baseline"]) == EXIT_CLEAN
+    err = capsys.readouterr().err
+    assert "2 stale entries removed, 1 kept" in err
+    doc = json.loads(bl_path.read_text())
+    assert len(doc["entries"]) == 1
+    assert doc["entries"][0]["path"].endswith("w.py")
+
+    # idempotent: a second prune finds nothing
+    assert lint_main([str(root), "--baseline", str(bl_path),
+                      "--prune-baseline"]) == EXIT_CLEAN
+    assert "0 stale entries removed" in capsys.readouterr().err
+
+
+def test_prune_baseline_rejects_no_baseline_combo(tmp_path, capsys):
+    root = _write_violating_pkg(tmp_path)
+    assert lint_main([str(root), "--no-baseline",
+                      "--prune-baseline"]) == EXIT_BASELINE_ERROR
+    assert "mutually exclusive" in capsys.readouterr().err
 
 
 def test_rule_filter_skips_stale_check_for_other_rules(tmp_path):

@@ -1,0 +1,100 @@
+"""Seeded-violation corpus: every registered rule must actually fire.
+
+``tests/lint_corpus/<rule id lowercased>/`` holds one tiny synthetic
+package per rule, each seeding the exact violation class the rule exists
+to catch (ISSUE-20 — ``test_path_scoped_rules_are_not_vacuous`` only
+proves the *paths* exist; this proves the *detection* works). For each
+package the FULL registry runs and must report:
+
+- the target rule fires (the rule is not vacuous),
+- no other rule fires (the corpus is a controlled experiment, not noise),
+- violations anchor only in ``bad_*`` modules — the ``good_*`` twins are
+  the mutation check's control group: the same code shape with the seeded
+  defect repaired (drain call restored, cache-key component added, fault
+  re-raised/allowlisted), which must pass.
+
+Zero-on-the-real-tree is asserted per rule in tests/test_lint_full.py
+(which times each rule individually anyway); here we only prove firing.
+"""
+
+import pathlib
+
+import pytest
+
+from flink_tpu.lint import all_rules, run_lint
+
+CORPUS = pathlib.Path(__file__).parent / "lint_corpus"
+RULE_IDS = [r.id for r in all_rules()]
+
+
+def test_every_rule_has_a_corpus_package():
+    """Registry <-> corpus coverage both ways: a new rule without a
+    corpus package is vacuous-by-default; a corpus dir without a rule is
+    dead weight (a retired rule whose fixtures were forgotten)."""
+    dirs = {p.name for p in CORPUS.iterdir() if p.is_dir()}
+    want = {rid.lower() for rid in RULE_IDS}
+    assert want - dirs == set(), (
+        f"rules without a corpus package: {sorted(want - dirs)}")
+    assert dirs - want == set(), (
+        f"corpus packages without a registered rule: {sorted(dirs - want)}")
+
+
+@pytest.mark.parametrize("rule_id", RULE_IDS)
+def test_rule_fires_on_its_corpus(rule_id):
+    report = run_lint(CORPUS / rule_id.lower())     # full registry
+    fired = report.by_rule(rule_id)
+    assert fired, (
+        f"{rule_id} did not fire on its seeded corpus — the rule is "
+        f"vacuous (or the corpus no longer seeds the violation)")
+    strays = [v for v in report.violations if v.rule_id != rule_id]
+    assert not strays, (
+        f"corpus for {rule_id} trips other rules (not a controlled "
+        f"experiment): {[(v.rule_id, v.path, v.line) for v in strays]}")
+    polluted = [v for v in fired
+                if pathlib.PurePosixPath(v.path).name.startswith("good_")]
+    assert not polluted, (
+        f"{rule_id} fired on its control twin — the repaired shape must "
+        f"pass: {[(v.path, v.line, v.symbol) for v in polluted]}")
+
+
+# ---------------------------------------------------------------------------
+# mutation checks: the seeded defect flips exactly the expected finding
+# ---------------------------------------------------------------------------
+
+def test_exon001_catches_removed_drain_and_undeclared_ring():
+    """The corpus twin of FusedWindowOperator with `_resolve_inflight`
+    removed from flush_all is caught as an undrained ring (through the
+    snapshot -> flush_all chain), and the undeclared `_pending` container
+    on a capturing class is caught independently."""
+    report = run_lint(CORPUS / "exon001")
+    by_symbol = {v.symbol: v for v in report.by_rule("EXON001")}
+    assert "undrained:_inflight" in by_symbol
+    assert by_symbol["undrained:_inflight"].scope == \
+        "BadFusedOperator.snapshot"
+    assert "undeclared:_pending" in by_symbol
+    assert by_symbol["undeclared:_pending"].scope == "UndeclaredOperator"
+    assert {v.path for v in report.by_rule("EXON001")} == \
+        {"exon001/runtime/bad_operator.py"}
+
+
+def test_exon002_catches_missing_key_components():
+    """Both memoization styles: the functools cache whose parameters
+    omit a jit-option input, and the dict memo whose key tuple omits the
+    donation flag (the PR-17 bug class)."""
+    report = run_lint(CORPUS / "exon002")
+    symbols = {v.symbol for v in report.by_rule("EXON002")}
+    assert "lru-key-incomplete" in symbols
+    assert any(s.startswith("key-incomplete:") for s in symbols)
+    msgs = " ".join(v.message for v in report.by_rule("EXON002"))
+    assert "self._donate" in msgs and "_BACKEND" in msgs
+
+
+def test_exon003_catches_the_swallowed_fault():
+    """`except OSError: return` around a seam-reaching call absorbs
+    InjectedCrash; the three transparent shapes in the control twin
+    (explicit clause, wrap-and-raise, @absorbs_faults) all pass."""
+    report = run_lint(CORPUS / "exon003")
+    fired = report.by_rule("EXON003")
+    assert {v.path for v in fired} == {"exon003/runtime/bad_sender.py"}
+    assert {v.symbol for v in fired} == {"except:OSError"}
+    assert {v.scope for v in fired} == {"retry_once"}

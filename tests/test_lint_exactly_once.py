@@ -1,0 +1,527 @@
+"""Unit tests for the exactly-once contract analyzer (ISSUE-20):
+the contracts vocabulary, the dataflow summary layer, and the three EXON
+rules over synthesized fixture packages (same mechanism as
+test_lint_rules.py — the rules are package-relative by design).
+"""
+
+import ast
+import textwrap
+
+import pytest
+
+from flink_tpu.lint import ModuleIndex, get_rule
+from flink_tpu.lint import contracts, dataflow
+
+
+def make_index(tmp_path, files, package="fixpkg"):
+    root = tmp_path / package
+    for rel, src in files.items():
+        p = root / rel
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(textwrap.dedent(src))
+    (root / "__init__.py").touch()
+    return ModuleIndex(root)
+
+
+def run_rule(rule_id, tmp_path, files, package="fixpkg"):
+    return list(get_rule(rule_id).check(make_index(tmp_path, files, package)))
+
+
+# ---------------------------------------------------------------------------
+# contracts: runtime decorators + AST extraction
+# ---------------------------------------------------------------------------
+
+def test_contracts_runtime_decorators_attach_metadata():
+    @contracts.inflight_ring("_a", drained_by="drain_a")
+    @contracts.inflight_ring("_b", drained_by="drain_b")
+    class C:
+        @contracts.drains("_a", "_b")
+        def flush(self):
+            pass
+
+    # innermost decorator applies first, so _b is declared first
+    assert getattr(C, contracts.RING_ATTR) == \
+        (("_b", "drain_b"), ("_a", "drain_a"))
+    assert getattr(C.flush, contracts.DRAINS_ATTR) == ("_a", "_b")
+
+    @contracts.absorbs_faults("server loop: absorption is the contract")
+    def handler():
+        pass
+
+    assert getattr(handler, contracts.ABSORBS_ATTR).startswith("server")
+
+
+def test_contracts_refuse_empty_declarations():
+    with pytest.raises(ValueError):
+        contracts.inflight_ring("", drained_by="x")
+    with pytest.raises(ValueError):
+        contracts.inflight_ring("_a", drained_by="")
+    with pytest.raises(ValueError):
+        contracts.drains()
+    with pytest.raises(ValueError):
+        contracts.absorbs_faults("")
+    with pytest.raises(ValueError):
+        contracts.absorbs_faults("   ")
+
+
+def test_contracts_ast_extraction_matches_any_spelling():
+    """The analyzer reads decorators off never-imported ASTs, matching
+    the trailing name — bare, module-qualified, and aliased spellings."""
+    tree = ast.parse(textwrap.dedent("""
+        import flink_tpu.lint.contracts as _c
+        from flink_tpu.lint import contracts
+
+        @contracts.inflight_ring("_inflight", drained_by="_resolve")
+        @_c.inflight_ring("_pending", drained_by="flush")
+        class Op:
+            @contracts.drains("_inflight")
+            def flush_all(self):
+                pass
+
+            @_c.absorbs_faults("attributed reason")
+            def eat(self):
+                pass
+
+            @contracts.absorbs_faults("")
+            def eat_empty(self):
+                pass
+
+            def plain(self):
+                pass
+    """))
+    cls = tree.body[2]
+    decls = contracts.ring_decls(cls)
+    assert [(d.attr, d.drained_by) for d in decls] == \
+        [("_inflight", "_resolve"), ("_pending", "flush")]
+    by_name = {f.name: f for f in cls.body
+               if isinstance(f, ast.FunctionDef)}
+    assert contracts.drain_decls(by_name["flush_all"]) == ("_inflight",)
+    assert contracts.absorbs_reason(by_name["eat"]) == "attributed reason"
+    # empty literal -> "" (reject-able), undecorated -> None (absent)
+    assert contracts.absorbs_reason(by_name["eat_empty"]) == ""
+    assert contracts.absorbs_reason(by_name["plain"]) is None
+    dmap = contracts.class_drain_map(cls)
+    assert dmap["_inflight"] == ["_resolve", "flush_all"]
+    assert dmap["_pending"] == ["flush"]
+
+
+# ---------------------------------------------------------------------------
+# dataflow: summary-layer behaviors the rules lean on
+# ---------------------------------------------------------------------------
+
+def test_dotted_names_keeps_args_skips_callees():
+    expr = ast.parse("f(self.x) + g.h(y.z)", mode="eval").body
+    assert dataflow.dotted_names(expr) == {"self.x", "y.z"}
+
+
+def test_shared_dataflow_index_is_cached_per_module_index(tmp_path):
+    index = make_index(tmp_path, {"a.py": "X = 1\n"})
+    assert dataflow.DataflowIndex.shared(index) is \
+        dataflow.DataflowIndex.shared(index)
+    other = make_index(tmp_path, {"b.py": "Y = 2\n"}, package="otherpkg")
+    assert dataflow.DataflowIndex.shared(other) is not \
+        dataflow.DataflowIndex.shared(index)
+
+
+def test_fault_carrying_fixpoint_propagates_through_callers(tmp_path):
+    index = make_index(tmp_path, {
+        "runtime/seam.py": """
+            from fixpkg.chaos import plan as _chaos
+
+            def send(sock):
+                hook = _chaos.HOOK
+                if hook is not None:
+                    hook("rpc", "send")
+                sock.sendall(b"x")
+
+            def relay(sock):
+                send(sock)
+
+            def unrelated(sock):
+                sock.close()
+        """})
+    dfi = dataflow.DataflowIndex.shared(index)
+    carrying = dfi.fault_carrying_names()
+    assert "send" in carrying and "relay" in carrying
+    assert "unrelated" not in carrying
+
+
+# ---------------------------------------------------------------------------
+# EXON001 quiescence-before-capture
+# ---------------------------------------------------------------------------
+
+OP_HEADER = """\
+        from collections import deque
+
+        from flink_tpu.lint.contracts import drains, inflight_ring
+
+"""
+
+
+def test_exon001_flags_snapshot_without_drain(tmp_path):
+    vs = run_rule("EXON001", tmp_path, {"runtime/op.py": OP_HEADER + """
+        @inflight_ring("_inflight", drained_by="_resolve")
+        class Op:
+            def __init__(self):
+                self._inflight = deque()
+
+            def _resolve(self):
+                self._inflight.clear()
+
+            def snapshot(self):
+                return {}
+    """})
+    assert [v.symbol for v in vs] == ["undrained:_inflight"]
+    assert vs[0].scope == "Op.snapshot"
+
+
+def test_exon001_drain_reached_through_self_call_chain(tmp_path):
+    """snapshot -> flush_all -> _resolve: interprocedural, not lexical."""
+    vs = run_rule("EXON001", tmp_path, {"runtime/op.py": OP_HEADER + """
+        @inflight_ring("_inflight", drained_by="_resolve")
+        class Op:
+            def __init__(self):
+                self._inflight = deque()
+
+            def _resolve(self):
+                self._inflight.clear()
+
+            def flush_all(self):
+                self._resolve()
+
+            def snapshot(self):
+                self.flush_all()
+                return {}
+    """})
+    assert vs == []
+
+
+def test_exon001_ring_guard_accepted_other_guards_not(tmp_path):
+    """`if self._pending: drain()` dominates; `if self._enabled:` does
+    not — the capture can run with the ring full and the flag off."""
+    files = {"runtime/op.py": OP_HEADER + """
+        @inflight_ring("_pending", drained_by="_resolve")
+        class RingGuard:
+            def __init__(self):
+                self._pending = []
+
+            def _resolve(self):
+                self._pending.clear()
+
+            def snapshot(self):
+                if self._pending:
+                    self._resolve()
+                return {}
+
+
+        @inflight_ring("_pending", drained_by="_resolve")
+        class FlagGuard:
+            def __init__(self):
+                self._pending = []
+                self._enabled = True
+
+            def _resolve(self):
+                self._pending.clear()
+
+            def snapshot(self):
+                if self._enabled:
+                    self._resolve()
+                return {}
+    """}
+    vs = run_rule("EXON001", tmp_path, files)
+    assert [(v.scope, v.symbol) for v in vs] == \
+        [("FlagGuard.snapshot", "undrained:_pending")]
+
+
+def test_exon001_missing_drain_method_and_stale_ring(tmp_path):
+    vs = run_rule("EXON001", tmp_path, {"runtime/op.py": OP_HEADER + """
+        @inflight_ring("_inflight", drained_by="_nope")
+        class MissingDrain:
+            def __init__(self):
+                self._inflight = deque()
+
+            def snapshot(self):
+                return {}
+
+
+        @inflight_ring("_ghost", drained_by="_resolve")
+        class StaleRing:
+            def _resolve(self):
+                pass
+
+            def snapshot(self):
+                return {}
+    """})
+    assert sorted(v.symbol for v in vs) == \
+        ["missing-drain:_inflight", "stale-ring:_ghost"]
+
+
+def test_exon001_undeclared_inflight_container(tmp_path):
+    vs = run_rule("EXON001", tmp_path, {"runtime/op.py": """
+        class Op:
+            def __init__(self):
+                self._pending_dispatch = []
+                self._future_rows = []       # held records: fine
+                self._state = {}
+
+            def snapshot(self):
+                return dict(self._state)
+    """})
+    assert [v.symbol for v in vs] == ["undeclared:_pending_dispatch"]
+
+
+def test_exon001_inherited_drain_passes(tmp_path):
+    """A subclass draining through an inherited method must not trip the
+    missing-drain/stale-ring checks (the analyzer sees one class body at
+    a time; bases get the benefit of the doubt)."""
+    vs = run_rule("EXON001", tmp_path, {"runtime/op.py": OP_HEADER + """
+        class Base:
+            def __init__(self):
+                self._pending = []
+
+            def flush(self):
+                self._pending.clear()
+
+
+        @inflight_ring("_pending", drained_by="flush")
+        class Child(Base):
+            def snapshot(self):
+                self.flush()
+                return {}
+    """})
+    assert vs == []
+
+
+def test_exon001_only_capture_subtrees_are_checked(tmp_path):
+    """The same undrained class outside runtime/parallel/joins is not on
+    the capture path."""
+    src = OP_HEADER + """
+        @inflight_ring("_inflight", drained_by="_resolve")
+        class Op:
+            def __init__(self):
+                self._inflight = deque()
+
+            def _resolve(self):
+                self._inflight.clear()
+
+            def snapshot(self):
+                return {}
+    """
+    assert run_rule("EXON001", tmp_path, {"metrics/op.py": src}) == []
+    assert len(run_rule("EXON001", tmp_path, {"joins/op.py": src})) == 1
+
+
+# ---------------------------------------------------------------------------
+# EXON002 executable-cache-key-completeness
+# ---------------------------------------------------------------------------
+
+def test_exon002_lru_builder_missing_option_input(tmp_path):
+    vs = run_rule("EXON002", tmp_path, {"ops/b.py": """
+        import functools
+
+        import jax
+
+        _BACKEND = "cpu"
+
+        def _k(x):
+            return x
+
+        @functools.lru_cache(maxsize=None)
+        def build(shape, dtype):
+            return jax.jit(_k, backend=_BACKEND)
+    """})
+    assert [v.symbol for v in vs] == ["lru-key-incomplete"]
+    assert "_BACKEND" in vs[0].message
+
+
+def test_exon002_lru_builder_clean_when_input_is_a_param(tmp_path):
+    vs = run_rule("EXON002", tmp_path, {"ops/b.py": """
+        import functools
+
+        import jax
+
+        def _k(x):
+            return x
+
+        @functools.lru_cache(maxsize=None)
+        def build(shape, dtype, backend):
+            return jax.jit(_k, backend=backend)
+    """})
+    assert vs == []
+
+
+def test_exon002_derived_option_local_resolves_to_param(tmp_path):
+    """Regression for the ops/segment_ops.make_ingest_fn false positive:
+    donate_args is DERIVED from the donate parameter, so the cache key
+    (the lru params) does see it — one derivation hop must resolve."""
+    vs = run_rule("EXON002", tmp_path, {"ops/b.py": """
+        import functools
+
+        import jax
+
+        @functools.lru_cache(maxsize=None)
+        def make_ingest(n, donate=True):
+            def ingest(ring, batch):
+                return ring
+            donate_args = (0, 1) if donate else ()
+            return jax.jit(ingest, donate_argnums=donate_args)
+    """})
+    assert vs == []
+
+
+def test_exon002_dict_memo_key_missing_self_attr(tmp_path):
+    vs = run_rule("EXON002", tmp_path, {"ops/b.py": """
+        import jax
+
+        class Cache:
+            def __init__(self, donate):
+                self._donate = donate
+                self._cache = {}
+
+            def get(self, fn, shape):
+                key = (shape,)
+                if key in self._cache:
+                    return self._cache[key]
+                step = jax.jit(fn, donate_argnums=(0,) if self._donate
+                               else ())
+                self._cache[key] = step
+                return step
+    """})
+    assert len(vs) == 1
+    assert vs[0].symbol.startswith("key-incomplete:")
+    assert "self._donate" in vs[0].message
+
+
+def test_exon002_dict_memo_clean_when_key_covers_inputs(tmp_path):
+    vs = run_rule("EXON002", tmp_path, {"ops/b.py": """
+        import jax
+
+        class Cache:
+            def __init__(self, donate):
+                self._donate = donate
+                self._cache = {}
+
+            def get(self, fn, shape):
+                key = (shape, self._donate)
+                if key in self._cache:
+                    return self._cache[key]
+                step = jax.jit(fn, donate_argnums=(0,) if self._donate
+                               else ())
+                self._cache[key] = step
+                return step
+    """})
+    assert vs == []
+
+
+# ---------------------------------------------------------------------------
+# EXON003 fault-transparency
+# ---------------------------------------------------------------------------
+
+SEAM = """\
+        from fixpkg.chaos import plan as _chaos
+
+        def send(sock):
+            hook = _chaos.HOOK
+            if hook is not None:
+                hook("rpc", "send")
+            sock.sendall(b"x")
+
+"""
+
+
+def test_exon003_wide_handler_on_seam_fires(tmp_path):
+    vs = run_rule("EXON003", tmp_path, {"runtime/s.py": SEAM + """
+        def retry(sock):
+            try:
+                send(sock)
+            except OSError:
+                return False
+            return True
+    """})
+    assert [v.symbol for v in vs] == ["except:OSError"]
+    assert vs[0].scope == "retry"
+
+
+def test_exon003_narrow_handler_and_no_seam_are_clean(tmp_path):
+    vs = run_rule("EXON003", tmp_path, {"runtime/s.py": SEAM + """
+        def narrow(sock):
+            try:
+                send(sock)
+            except TimeoutError:       # cannot catch InjectedCrash
+                return False
+            return True
+
+        def cleanup(sock):
+            try:
+                sock.close()            # no seam reachable
+            except OSError:
+                pass
+            return True
+    """})
+    assert vs == []
+
+
+def test_exon003_transparent_shapes_pass(tmp_path):
+    vs = run_rule("EXON003", tmp_path, {"runtime/s.py": SEAM + """
+        def explicit(sock):
+            try:
+                send(sock)
+            except _chaos.InjectedCrash:
+                raise
+            except OSError:
+                return False
+
+        def bare(sock):
+            try:
+                send(sock)
+            except OSError:
+                raise
+
+        def wrap(sock):
+            try:
+                send(sock)
+            except OSError as e:
+                raise RuntimeError("send failed") from e
+    """})
+    assert vs == []
+
+
+def test_exon003_absorbs_faults_allowlists_with_reason(tmp_path):
+    files = {"runtime/s.py": SEAM + """
+        from flink_tpu.lint.contracts import absorbs_faults
+
+        @absorbs_faults("peer death model: the caller retries the batch")
+        def allowlisted(sock):
+            try:
+                send(sock)
+            except OSError:
+                return False
+
+        @absorbs_faults("")
+        def empty_reason(sock):
+            try:
+                send(sock)
+            except OSError:
+                return False
+    """}
+    vs = run_rule("EXON003", tmp_path, files)
+    assert [v.scope for v in vs] == ["empty_reason"]
+    assert "empty reason" in vs[0].message
+
+
+def test_exon003_nested_def_honors_enclosing_decorator(tmp_path):
+    """The rpc.py Handler.handle shape: the handler lives in a def nested
+    inside a decorated ancestor."""
+    vs = run_rule("EXON003", tmp_path, {"runtime/s.py": SEAM + """
+        from flink_tpu.lint.contracts import absorbs_faults
+
+        @absorbs_faults("server loop ships errors back as failed replies")
+        def make_server(sock):
+            def handle():
+                try:
+                    send(sock)
+                except OSError:
+                    return
+            return handle
+    """})
+    assert vs == []
